@@ -1,0 +1,206 @@
+"""Llama-3-class decoder transformer, pure-JAX functional style.
+
+Flagship dense model of the recipe tree (reference analog:
+llm/llama-3_1-finetuning -- the reference shells out to torchtune; here the
+model is native). Design is TPU-first:
+
+  * params are plain pytrees of arrays with a parallel pytree of *logical
+    axis* tuples -> shardings come from `parallel.mesh.ShardingRules`;
+  * layers are stacked on a leading axis and executed with `lax.scan`
+    (one compiled layer body, fast XLA compiles, natural remat point);
+  * attention dispatches to the Pallas flash kernel on TPU;
+  * all matmuls run in bfloat16 on the MXU, softmax/norm stats in fp32.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.ops import attention as attention_ops
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    mlp_dim: int = 14336
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+    attention_impl: str = "auto"  # auto|pallas|reference|ring
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=vocab_size, dim=128, n_layers=4,
+                           n_heads=8, n_kv_heads=4, mlp_dim=256,
+                           max_seq_len=512)
+
+    def flops_per_token(self) -> float:
+        """Approximate fwd+bwd FLOPs per token (6 * params for matmuls +
+        attention term); used for MFU accounting."""
+        p_layer = (self.dim * (self.n_heads + 2 * self.n_kv_heads) *
+                   self.head_dim + self.n_heads * self.head_dim * self.dim +
+                   3 * self.dim * self.mlp_dim)
+        p = self.n_layers * p_layer + self.vocab_size * self.dim * (
+            1 if self.tie_embeddings else 2)
+        return 6.0 * p
+
+    def num_params(self) -> int:
+        p_layer = (self.dim * (self.n_heads + 2 * self.n_kv_heads) *
+                   self.head_dim + self.n_heads * self.head_dim * self.dim +
+                   3 * self.dim * self.mlp_dim + 2 * self.dim)
+        return (self.n_layers * p_layer + self.dim +
+                self.vocab_size * self.dim * (1 if self.tie_embeddings else 2))
+
+
+def param_specs(cfg: LlamaConfig) -> Params:
+    """Logical-axis names for every param, mirroring init()'s tree."""
+    specs = {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "q_heads_x_dim"),
+            "wk": ("layers", "embed", "kv_heads_x_dim"),
+            "wv": ("layers", "embed", "kv_heads_x_dim"),
+            "wo": ("layers", "q_heads_x_dim", "embed"),
+            "mlp_norm": ("layers", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+    if cfg.tie_embeddings:
+        specs.pop("lm_head")
+    return specs
+
+
+def init(cfg: LlamaConfig, key: jax.Array) -> Params:
+    """Initialize params (stacked-layer layout)."""
+    k = jax.random.split(key, 9)
+    d, hd = cfg.dim, cfg.head_dim
+    L = cfg.n_layers
+    dt = cfg.dtype
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) *
+                (fan_in ** -0.5)).astype(dt)
+
+    params: Params = {
+        "embed": dense(k[0], (cfg.vocab_size, d), d),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), dtype=dt),
+            "wq": dense(k[1], (L, d, cfg.n_heads * hd), d),
+            "wk": dense(k[2], (L, d, cfg.n_kv_heads * hd), d),
+            "wv": dense(k[3], (L, d, cfg.n_kv_heads * hd), d),
+            "wo": dense(k[4], (L, cfg.n_heads * hd, d), cfg.n_heads * hd),
+            "mlp_norm": jnp.ones((L, d), dtype=dt),
+            "w_gate": dense(k[5], (L, d, cfg.mlp_dim), d),
+            "w_up": dense(k[6], (L, d, cfg.mlp_dim), d),
+            "w_down": dense(k[7], (L, cfg.mlp_dim, d), cfg.mlp_dim),
+        },
+        "final_norm": jnp.ones((d,), dtype=dt),
+        "lm_head": dense(k[8], (d, cfg.vocab_size), d),
+    }
+    if cfg.tie_embeddings:
+        params.pop("lm_head")
+    return params
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: (B, S, H, D), positions: (B, S)."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B,S,D/2)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
+           positions: jax.Array, constrain) -> jax.Array:
+    lp = layer_params
+    b, s, d = x.shape
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    # Attention block.
+    y = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (y @ lp["wq"]).reshape(b, s, h, hd)
+    kk = (y @ lp["wk"]).reshape(b, s, kvh, hd)
+    vv = (y @ lp["wv"]).reshape(b, s, kvh, hd)
+    q = rope(q, positions, cfg.rope_theta)
+    kk = rope(kk, positions, cfg.rope_theta)
+    q = constrain(q, ("batch", "act_seq", "heads", None))
+    kk = constrain(kk, ("batch", "act_seq", "kv_heads", None))
+    if cfg.attention_impl == "ring":
+        from skypilot_tpu.parallel import ring_attention
+        attn = ring_attention.ring_attention_from_context(q, kk, vv)
+    else:
+        attn = attention_ops.attention(q, kk, vv, causal=True,
+                                       impl=cfg.attention_impl)
+    attn = attn.reshape(b, s, h * hd)
+    x = x + constrain(attn @ lp["wo"], ("batch", "act_seq", "act_embed"))
+    # MLP block (SwiGLU).
+    y = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(y @ lp["w_gate"])
+    up = y @ lp["w_up"]
+    mlp = constrain(gate * up, ("batch", "act_seq", "mlp"))
+    x = x + constrain(mlp @ lp["w_down"], ("batch", "act_seq", "act_embed"))
+    return x
+
+
+def forward(cfg: LlamaConfig, params: Params, tokens: jax.Array,
+            positions: Optional[jax.Array] = None,
+            constrain=lambda x, spec: x) -> jax.Array:
+    """Token ids (B, S) -> logits (B, S, vocab).
+
+    `constrain` is an optional callback (x, logical_axes) -> x used by the
+    trainer to inject with_sharding_constraint under a concrete mesh; the
+    default is identity so the model runs un-meshed (single device).
+    """
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    x = params["embed"][tokens]  # gather: (B, S, D)
+    x = constrain(x, ("batch", "act_seq", "act_embed"))
+
+    layer_fn = lambda carry, lp: (_layer(cfg, carry, lp, positions,
+                                         constrain), None)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
+    return constrain(logits, ("batch", "act_seq", "vocab"))

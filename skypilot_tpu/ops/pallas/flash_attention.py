@@ -1,0 +1,216 @@
+"""Blockwise (flash) attention as a Pallas TPU kernel.
+
+Forward pass is a Pallas kernel: online-softmax over KV blocks, working set
+held in VMEM, logits never materialized in HBM (O(S*D) traffic instead of
+O(S^2)). Backward pass is a custom VJP computed blockwise with `lax.scan`
+in plain XLA from the saved (q, k, v, o, lse): memory stays O(S*block_k)
+and every contraction is an MXU-shaped matmul. (A fully-Pallas backward is
+a later optimization; the fwd kernel is where the S^2 HBM win is.)
+
+Supports causal masking and GQA (n_heads % n_kv_heads == 0).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+
+
+LSE_PAD = 8  # trailing tile dim for the lse output (tiling constraint)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                scale: float, block_k: int, causal: bool, seq_len: int):
+    # Refs are rank-reduced by the None dims in the BlockSpecs:
+    # q_ref/o_ref: (block_q, d); k_ref/v_ref: (seq_len, d);
+    # lse_ref: (block_q, LSE_PAD)
+    qi = pl.program_id(2)
+    q = q_ref[...].astype(jnp.float32) * scale  # (bq, D)
+    bq, d = q.shape
+    q_start = qi * bq
+
+    if causal:
+        # Only KV blocks at or before the end of this Q block contribute.
+        n_blocks = lax.div(q_start + bq + block_k - 1, block_k)
+    else:
+        n_blocks = seq_len // block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = q_start + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            kpos = j * block_k + lax.broadcasted_iota(jnp.int32,
+                                                      (bq, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq, 1), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((bq, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((bq, d), dtype=jnp.float32)
+    m, l, acc = lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (acc / l).astype(o_ref.dtype)
+    # lse block is (block_q, LSE_PAD): broadcast across the pad dim, which
+    # exists only to satisfy the (8,128)-ish tiling constraint on outputs.
+    lse_ref[...] = jnp.broadcast_to(m + jnp.log(l),
+                                    (bq, lse_ref.shape[-1]))
+
+
+def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
+               causal: bool, scale: float,
+               block_q: int, block_k: int
+               ) -> Tuple[jax.Array, jax.Array]:
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    groups = h // kvh
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    grid = (b, h, s // block_q)
+
+    # Kernel operates in (B, H, S, D) layout so the last two dims of every
+    # block are MXU/VPU-tileable (S and D); XLA fuses the transposes into
+    # the surrounding projections.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
+                          causal=causal, seq_len=s),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, s, d),
+                         lambda bi, hi, qi: (bi, hi // groups, 0, 0)),
+            pl.BlockSpec((None, None, s, d),
+                         lambda bi, hi, qi: (bi, hi // groups, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q, LSE_PAD),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, LSE_PAD), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=jax.default_backend() == "cpu",
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3), lse[..., 0]
+
+
+def _bwd_blockwise(res, do, *, causal: bool, scale: float, block_k: int):
+    """Flash-style backward in XLA: scan over KV blocks, O(S*block_k) mem."""
+    q, k, v, o, lse = res
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    groups = h // kvh
+    block_k = min(block_k, s)
+    nk = s // block_k
+
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    # delta = rowsum(do * o): (B, S, H)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)
+    # expand kv heads to full heads for per-head math
+    kf = jnp.repeat(k.astype(jnp.float32), groups, axis=2)  # (B,S,H,D)
+    vf = jnp.repeat(v.astype(jnp.float32), groups, axis=2)
+
+    qpos = jnp.arange(s)
+
+    def block(j):
+        ks = jax.lax.dynamic_slice_in_dim(kf, j * block_k, block_k, axis=1)
+        vs = jax.lax.dynamic_slice_in_dim(vf, j * block_k, block_k, axis=1)
+        s_blk = jnp.einsum("bqhd,bkhd->bhqk", qf, ks) * scale
+        if causal:
+            kpos = j * block_k + jnp.arange(block_k)
+            mask = qpos[:, None] >= kpos[None, :]
+            s_blk = jnp.where(mask[None, None], s_blk, _NEG_INF)
+        p = jnp.exp(s_blk - lse[:, :, :, None])  # (B,H,Q,K)
+        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vs)
+        ds = p * (dp - delta.transpose(0, 2, 1)[:, :, :, None]) * scale
+        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+        dq_blk = jnp.einsum("bhqk,bkhd->bqhd", ds, ks)
+        return dq_blk, dk_blk, dv_blk
+
+    def body(carry, j):
+        dq = carry
+        dq_blk, dk_blk, dv_blk = block(j)
+        return dq + dq_blk, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros((b, s, h, d), dtype=jnp.float32)
+    dq, (dk_blocks, dv_blocks) = lax.scan(body, dq0, jnp.arange(nk))
+    # (nk, B, bk, H, D) -> (B, S, H, D)
+    dk = dk_blocks.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
+    dv = dv_blocks.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
+    # reduce grouped heads back to kv heads
+    dk = dk.reshape(b, s, kvh, groups, d).sum(axis=3)
+    dv = dv.reshape(b, s, kvh, groups, d).sum(axis=3)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, causal=causal, scale=scale,
+                        block_q=block_q, block_k=block_k)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
+    return _bwd_blockwise(res, do, causal=causal, scale=scale,
+                          block_k=block_k)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+    """Flash attention. q: (B,S,H,D); k,v: (B,S,KVH,D)."""
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if (k.shape[1] != s or s % block_q or s % block_k or h % k.shape[2] or
+            block_q % 8 or block_k % 8 or d % 8):
+        # Irregular/misaligned shapes: fall back to the XLA reference path
+        # (Mosaic requires 8-sublane-aligned blocks).
+        from skypilot_tpu.ops import attention as attention_ops
+        return attention_ops._reference_attention(q, k, v, causal=causal,
+                                                  scale=scale)
+    return _flash(q, k, v, causal, scale, block_q, block_k)

@@ -1,0 +1,163 @@
+"""Device-mesh construction and logical-axis sharding rules.
+
+TPU-first design: parallelism is expressed as a `jax.sharding.Mesh` with
+named axes plus a table of rules mapping *logical* tensor axes (batch, seq,
+embed, heads, ...) onto mesh axes. XLA inserts the collectives; recipes pick
+rules, not collectives.
+
+The reference framework has no parallelism math of its own -- it only ships
+the env-var scaffolding for torch DDP (reference:
+sky/backends/cloud_vm_ray_backend.py:570-636). Here the mesh/rules layer IS
+the native equivalent: dp/fsdp/tp/sp/ep/pp are all axis assignments over one
+mesh.
+
+Canonical mesh axes:
+  dp    data parallel (pure replication of params, batch-sharded)
+  fsdp  fully-sharded data parallel (batch- AND param-sharded)
+  pp    pipeline stage axis
+  tp    tensor (model) parallel axis; also hosts Megatron-style sequence
+        parallelism of activations outside attention/mlp blocks
+  sp    context/sequence parallelism for ring attention (long context)
+  ep    expert parallel axis for MoE (may alias onto dp/fsdp via rules)
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Mapping, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AxisName = Union[str, Sequence[str], None]
+
+DP = "dp"
+FSDP = "fsdp"
+PP = "pp"
+TP = "tp"
+SP = "sp"
+EP = "ep"
+
+
+def make_mesh(axes: Mapping[str, int],
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh with the given named axis sizes.
+
+    Axis sizes must multiply to the device count; an axis size of -1 is
+    inferred. Axis order follows insertion order of `axes`, which also
+    controls physical layout: put the fastest-communicating axis (tp/sp)
+    last so it lands on adjacent devices (ICI neighbors on a real slice).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    sizes = dict(axes)
+    unknown = [k for k, v in sizes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError(f"At most one axis may be -1, got {unknown}")
+    known = math.prod(v for v in sizes.values() if v != -1)
+    if unknown:
+        if n % known:
+            raise ValueError(
+                f"Device count {n} not divisible by fixed axes {sizes}")
+        sizes[unknown[0]] = n // known
+    if math.prod(sizes.values()) != n:
+        raise ValueError(
+            f"Mesh axes {sizes} do not multiply to device count {n}")
+    dev_array = np.asarray(devices).reshape(tuple(sizes.values()))
+    return Mesh(dev_array, tuple(sizes.keys()))
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """Logical-axis -> mesh-axis mapping.
+
+    Any logical axis not listed resolves to None (replicated). A mesh axis
+    named in a rule but absent from the mesh is dropped at resolution time,
+    so one rule set works across meshes of different shapes (e.g. the same
+    FSDP+TP rules on a ('dp','tp') mesh simply ignore 'fsdp').
+    """
+    rules: Mapping[str, AxisName]
+
+    def resolve_axis(self, logical: Optional[str],
+                     mesh: Mesh) -> AxisName:
+        if logical is None:
+            return None
+        axis = self.rules.get(logical)
+        if axis is None:
+            return None
+        names = (axis,) if isinstance(axis, str) else tuple(axis)
+        present = tuple(a for a in names if a in mesh.axis_names)
+        if not present:
+            return None
+        return present if len(present) > 1 else present[0]
+
+    def spec(self, logical_axes: Sequence[Optional[str]],
+             mesh: Mesh) -> P:
+        resolved = []
+        used: set = set()
+        for la in logical_axes:
+            axis = self.resolve_axis(la, mesh)
+            # A mesh axis can shard at most one tensor dim; later dims fall
+            # back to replicated rather than erroring (matches t5x behavior).
+            flat = ((axis,) if isinstance(axis, str) else
+                    tuple(axis) if axis else ())
+            if any(a in used for a in flat):
+                axis = None
+                flat = ()
+            used.update(flat)
+            resolved.append(axis)
+        while resolved and resolved[-1] is None:
+            resolved.pop()
+        return P(*resolved)
+
+    def sharding(self, logical_axes: Sequence[Optional[str]],
+                 mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(logical_axes, mesh))
+
+
+# Preset rule tables ---------------------------------------------------------
+
+# Llama-class dense model, DP/FSDP/TP (+ megatron-SP via 'act_seq').
+DEFAULT_RULES = ShardingRules(rules={
+    # activations
+    "batch": (DP, FSDP),
+    "act_seq": SP,          # ring/context parallel shards the sequence
+    "act_embed": None,
+    "heads": TP,
+    "kv_heads": TP,
+    # params
+    "embed": FSDP,
+    "mlp": TP,
+    "q_heads_x_dim": TP,
+    "kv_heads_x_dim": TP,
+    "vocab": TP,
+    # MoE
+    "expert": EP,
+    # pipeline: leading stacked-layer axis of stage-stacked params
+    "stage": PP,
+    "layers": None,
+})
+
+
+def resolve(rules: ShardingRules, mesh: Mesh,
+            logical_axes: Sequence[Optional[str]]) -> NamedSharding:
+    return rules.sharding(logical_axes, mesh)
+
+
+def constrain(x: jax.Array, mesh: Mesh, rules: ShardingRules,
+              logical_axes: Sequence[Optional[str]]) -> jax.Array:
+    """with_sharding_constraint by logical axis names."""
+    return jax.lax.with_sharding_constraint(
+        x, rules.sharding(logical_axes, mesh))
+
+
+def tree_shardings(mesh: Mesh, rules: ShardingRules,
+                   specs_tree: Any) -> Any:
+    """Map a pytree of logical-axis tuples to NamedShardings."""
+    return jax.tree.map(
+        lambda spec: rules.sharding(spec, mesh),
+        specs_tree,
+        is_leaf=lambda s: isinstance(s, tuple) and all(
+            a is None or isinstance(a, str) for a in s))

@@ -1,0 +1,35 @@
+"""Test harness: force an 8-device CPU platform so every sharding/mesh test
+runs hermetically without TPU hardware.
+
+Mirrors the reference's hermetic strategy (tests/common.py in the reference
+monkeypatches all clouds enabled + pinned catalogs); here the analog is a
+virtual 8-device CPU mesh for gang/sharding tests plus tmpdir-backed state
+DBs for orchestration tests.
+"""
+import os
+
+# jax may already be imported by the interpreter's sitecustomize (TPU
+# tunnel); the config update below still forces the CPU platform as long as
+# no backend has been instantiated yet. XLA_FLAGS is read at CPU-client
+# creation, which is also still ahead of us.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_state_dir(tmp_path, monkeypatch):
+    """Redirect all client-side state (~/.stpu) into a tmpdir."""
+    monkeypatch.setenv("STPU_HOME", str(tmp_path / ".stpu"))
+    from skypilot_tpu.utils import paths
+    paths.reset_for_tests()
+    yield tmp_path / ".stpu"
+    paths.reset_for_tests()

@@ -1,0 +1,75 @@
+"""Hermetic compute tests on the 8-device CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.train import trainer
+
+
+def test_mesh_construction():
+    m = mesh_lib.make_mesh({"dp": 2, "tp": 4})
+    assert m.shape == {"dp": 2, "tp": 4}
+    m2 = mesh_lib.make_mesh({"dp": -1, "tp": 2})
+    assert m2.shape["dp"] == 4
+
+
+def test_sharding_rules_drop_absent_axes():
+    m = mesh_lib.make_mesh({"dp": 2, "tp": 4})
+    rules = mesh_lib.DEFAULT_RULES
+    spec = rules.spec(("batch", "act_seq", "heads"), m)
+    # fsdp/sp absent from mesh -> batch maps to ('dp',), act_seq drops.
+    assert spec == jax.sharding.PartitionSpec("dp", None, "tp")
+
+
+def test_llama_forward_shapes():
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.key(0))
+    tokens = jnp.zeros((2, 16), dtype=jnp.int32)
+    logits = llama.forward(cfg, params, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_llama_causality():
+    """Changing a future token must not change past logits."""
+    cfg = llama.LlamaConfig.tiny()
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.key(0))
+    t1 = jnp.array([[1, 2, 3, 4, 5, 6, 7, 8]], dtype=jnp.int32)
+    t2 = t1.at[0, 5].set(9)
+    l1 = llama.forward(cfg, params, t1)
+    l2 = llama.forward(cfg, params, t2)
+    np.testing.assert_allclose(l1[0, :5], l2[0, :5], rtol=2e-2, atol=2e-3)
+    assert not np.allclose(l1[0, 5:], l2[0, 5:], atol=1e-4)
+
+
+def test_train_step_decreases_loss_sharded():
+    cfg = llama.LlamaConfig.tiny(vocab_size=64)
+    mesh = mesh_lib.make_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    rules = mesh_lib.DEFAULT_RULES
+    params = llama.init(cfg, jax.random.key(0))
+    tx = trainer.make_optimizer(trainer.TrainConfig(
+        learning_rate=1e-2, warmup_steps=1, total_steps=50))
+    state = trainer.init_train_state(params, tx)
+
+    shardings = trainer.state_shardings(
+        mesh, rules, llama.param_specs(cfg),
+        jax.eval_shape(lambda: state))
+    state = jax.device_put(state, shardings)
+
+    step = trainer.make_train_step(
+        lambda p, t, constrain: llama.forward(cfg, p, t,
+                                              constrain=constrain),
+        tx, mesh, rules)
+    tokens = jax.random.randint(jax.random.key(1), (4, 32), 0, 64)
+    batch = {"tokens": tokens}
+    state, m0 = step(state, batch)
+    for _ in range(10):
+        state, m = step(state, batch)
+    assert float(m["loss"]) < float(m0["loss"])
+    # params actually sharded: embed spec ("vocab","embed") -> (tp, fsdp).
+    emb_shard = state.params["embed"].sharding
+    assert emb_shard.spec == jax.sharding.PartitionSpec("tp", "fsdp")
