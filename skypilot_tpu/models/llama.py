@@ -146,12 +146,15 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
-           positions: jax.Array, constrain) -> jax.Array:
-    lp = layer_params
+def attention_block(cfg, x: jax.Array, lp: Params, positions: jax.Array,
+                    constrain) -> jax.Array:
+    """Pre-norm GQA attention residual block, shared by llama and mixtral.
+
+    `cfg` needs: n_heads, n_kv_heads, head_dim, norm_eps, rope_theta,
+    attention_impl.
+    """
     b, s, d = x.shape
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    # Attention block.
     y = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
     q = (y @ lp["wq"]).reshape(b, s, h, hd)
     kk = (y @ lp["wk"]).reshape(b, s, kvh, hd)
@@ -167,7 +170,29 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
         attn = attention_ops.attention(q, kk, vv, causal=True,
                                        impl=cfg.attention_impl)
     attn = attn.reshape(b, s, h * hd)
-    x = x + constrain(attn @ lp["wo"], ("batch", "act_seq", "act_embed"))
+    return x + constrain(attn @ lp["wo"],
+                         ("batch", "act_seq", "act_embed"))
+
+
+def embed_tokens(params: Params, tokens: jax.Array, constrain) -> jax.Array:
+    x = params["embed"][tokens]
+    return constrain(x, ("batch", "act_seq", "act_embed"))
+
+
+def lm_head(cfg, params: Params, x: jax.Array, constrain) -> jax.Array:
+    """Final norm + (tied or untied) output projection, fp32 logits."""
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
+    return constrain(logits, ("batch", "act_seq", "vocab"))
+
+
+def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
+           positions: jax.Array, constrain) -> jax.Array:
+    lp = layer_params
+    x = attention_block(cfg, x, lp, positions, constrain)
     # MLP block (SwiGLU).
     y = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     gate = jax.nn.silu(y @ lp["w_gate"])
@@ -189,18 +214,72 @@ def forward(cfg: LlamaConfig, params: Params, tokens: jax.Array,
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
-    x = params["embed"][tokens]  # gather: (B, S, D)
-    x = constrain(x, ("batch", "act_seq", "act_embed"))
+    x = embed_tokens(params, tokens, constrain)
 
     layer_fn = lambda carry, lp: (_layer(cfg, carry, lp, positions,
                                          constrain), None)
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    return lm_head(cfg, params, x, constrain)
 
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
-    return constrain(logits, ("batch", "act_seq", "vocab"))
+
+def forward_pipelined(cfg: LlamaConfig, params: Params, tokens: jax.Array,
+                      *, mesh, rules, num_microbatches: int,
+                      positions: Optional[jax.Array] = None) -> jax.Array:
+    """GPipe-pipelined forward: layer stack split into mesh.shape['pp']
+    stages, batch split into microbatches. Use with PIPELINE_RULES so the
+    stored layer stack is sharded over pp and the stage reshape is local.
+    """
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.parallel import pipeline as pipeline_lib
+
+    if cfg.attention_impl == "ring":
+        raise NotImplementedError(
+            "attention_impl='ring' is not supported under pipeline "
+            "parallelism: ring attention's shard_map over 'sp' cannot nest "
+            "inside the pipeline's shard_map over 'pp'. Use ring attention "
+            "with a dp/sp/tp mesh, or pipeline with impl='auto'.")
+    n_stages = mesh.shape.get(mesh_lib.PP, 1)
+    if cfg.n_layers % max(n_stages, 1):
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
+                         f"pp={n_stages}")
+    b, s = tokens.shape
+    m = num_microbatches
+    if b % m:
+        raise ValueError(f"batch={b} not divisible by microbatches={m}")
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+    def constrain(x, spec):
+        return mesh_lib.constrain(x, mesh, rules, spec)
+
+    x = embed_tokens(params, tokens, constrain)
+    d = x.shape[-1]
+
+    # (L, ...) -> (P, L/P, ...): local view change under PIPELINE_RULES.
+    def to_stages(a):
+        return a.reshape(n_stages, cfg.n_layers // n_stages, *a.shape[1:])
+    stage_params = jax.tree.map(to_stages, params["layers"])
+    stage_params = jax.tree.map(
+        lambda a: jax.lax.with_sharding_constraint(
+            a, rules.sharding(("stage", "layers") + (None,) * (a.ndim - 2),
+                              mesh)),
+        stage_params)
+
+    x_mb = x.reshape(m, b // m, s, d)
+    pos_mb = positions.reshape(m, b // m, s)
+
+    def stage_fn(lp, x_in, pos_in):
+        def layer_fn(carry, layer_p):
+            return _layer(cfg, carry, layer_p, pos_in,
+                          lambda a, _spec: a), None
+        if cfg.remat:
+            layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+        out, _ = jax.lax.scan(layer_fn, x_in, lp)
+        return out
+
+    x = pipeline_lib.gpipe(stage_fn, stage_params, x_mb, pos_mb,
+                           mesh=mesh, num_microbatches=m)
+    x = x.reshape(b, s, d)
+    return lm_head(cfg, params, x, constrain)

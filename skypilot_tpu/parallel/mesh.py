@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import threading
 from typing import Any, Mapping, Optional, Sequence, Union
 
 import jax
@@ -140,6 +141,10 @@ DEFAULT_RULES = ShardingRules(rules={
     "layers": None,
 })
 
+# Pipelined runs shard the stored (L, ...) layer stack over pp so the
+# in-jit reshape to (P, L/P, ...) is a purely local view change.
+PIPELINE_RULES = ShardingRules(rules={**DEFAULT_RULES.rules, "layers": PP})
+
 
 def resolve(rules: ShardingRules, mesh: Mesh,
             logical_axes: Sequence[Optional[str]]) -> NamedSharding:
@@ -151,6 +156,37 @@ def constrain(x: jax.Array, mesh: Mesh, rules: ShardingRules,
     """with_sharding_constraint by logical axis names."""
     return jax.lax.with_sharding_constraint(
         x, rules.sharding(logical_axes, mesh))
+
+
+_AMBIENT = threading.local()
+
+
+class use_mesh:
+    """Context manager installing (mesh, rules) as the ambient pair.
+
+    Trainers enter this around model forward so ops that need the concrete
+    mesh at trace time (ring attention's shard_map, MoE dispatch) can find
+    it without threading it through every model signature. Thread-local so
+    concurrent traces for different meshes don't cross-talk.
+    """
+
+    def __init__(self, mesh: Mesh, rules: ShardingRules):
+        self.pair = (mesh, rules)
+
+    def __enter__(self):
+        if not hasattr(_AMBIENT, "stack"):
+            _AMBIENT.stack = []
+        _AMBIENT.stack.append(self.pair)
+        return self.pair
+
+    def __exit__(self, *exc):
+        _AMBIENT.stack.pop()
+        return False
+
+
+def current_mesh_rules() -> Optional[tuple]:
+    stack = getattr(_AMBIENT, "stack", None)
+    return stack[-1] if stack else None
 
 
 def tree_shardings(mesh: Mesh, rules: ShardingRules,

@@ -131,10 +131,15 @@ def make_train_step(
         return mesh_lib.constrain(x, mesh, rules, logical_axes)
 
     def loss_fn(params, batch):
-        logits = forward_fn(params, batch["tokens"], constrain=constrain)
+        with mesh_lib.use_mesh(mesh, rules):
+            out = forward_fn(params, batch["tokens"], constrain=constrain)
+        # forward_fn may return logits or (logits, aux_loss) — MoE models
+        # surface their router load-balancing loss this way.
+        logits, aux = out if isinstance(out, tuple) else (out, 0.0)
         mask = batch.get("loss_mask")
-        return cross_entropy_loss(logits[:, :-1], batch["tokens"][:, 1:],
-                                  None if mask is None else mask[:, 1:])
+        ce = cross_entropy_loss(logits[:, :-1], batch["tokens"][:, 1:],
+                                None if mask is None else mask[:, 1:])
+        return ce + aux, (ce, aux)
 
     batch_sharding = NamedSharding(mesh, rules.spec(("batch", None), mesh))
 
@@ -142,11 +147,14 @@ def make_train_step(
         batch = jax.tree.map(
             lambda x: jax.lax.with_sharding_constraint(x, batch_sharding),
             batch)
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        (loss, (ce, aux)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params, batch)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {
-            "loss": loss,
+            "loss": ce,
+            "aux_loss": aux,
+            "total_loss": loss,
             "grad_norm": optax.global_norm(grads),
             "step": state.step,
         }
