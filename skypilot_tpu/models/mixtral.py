@@ -18,7 +18,6 @@ import jax
 import jax.numpy as jnp
 
 from skypilot_tpu.models import llama
-from skypilot_tpu.ops import attention as attention_ops
 
 Params = Dict[str, Any]
 
@@ -191,8 +190,12 @@ def _layer(cfg: MixtralConfig, x: jax.Array, lp: Params,
 def forward(cfg: MixtralConfig, params: Params, tokens: jax.Array,
             positions: Optional[jax.Array] = None,
             constrain=lambda x, spec: x,
-            with_aux: bool = False):
-    """Token ids (B, S) -> logits (B, S, vocab) [, router aux loss]."""
+            with_aux: bool = True):
+    """Token ids (B, S) -> (logits (B, S, vocab), router aux loss).
+
+    ``with_aux=True`` by default so the load-balancing loss can only be
+    dropped deliberately — training without it collapses the router.
+    """
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))

@@ -1,0 +1,229 @@
+"""Optimizer: pick the cheapest/fastest concrete placement for a DAG.
+
+Reference analog: sky/optimizer.py (optimize:105, _optimize_by_dp:373 for
+chains, _fill_in_launchable_resources:1201, egress accounting :73). The
+TPU-native candidate space is (slice type, zone, spot) rows straight from
+the catalog; feasibility = "slice offered in zone", with a blocklist fed
+back by the provisioner's failover loop so re-optimization after exhaustion
+skips known-bad placements (reference provision_with_retries:2030-2045).
+
+Chains use exact DP over (task, candidate) with inter-task egress cost;
+general DAGs fall back to per-task greedy min (the reference uses ILP there;
+its own tests cross-check ILP == DP on chains, and chains are the only shape
+the downstream jobs pipeline supports).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+
+
+class OptimizeTarget(enum.Enum):
+    COST = "cost"
+    TIME = "time"
+
+
+@dataclasses.dataclass(frozen=True)
+class Blocklist:
+    """Placements to skip: (accelerator|instance_type, zone|region) pairs.
+
+    ``None`` fields are wildcards: ("tpu-v5e-16", None) blocks everywhere;
+    (None, "us-central1-a") blocks the zone for everything.
+    """
+    entries: frozenset = frozenset()
+
+    def blocked(self, res: Resources) -> bool:
+        device = res.accelerator or res.instance_type
+        for (dev, where) in self.entries:
+            if dev is not None and dev != device:
+                continue
+            if where is None:
+                return True
+            if res.zone == where or res.region == where:
+                return True
+        return False
+
+    def add(self, device: Optional[str],
+            where: Optional[str]) -> "Blocklist":
+        return Blocklist(self.entries | {(device, where)})
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    resources: Resources         # concrete: has zone
+    hourly_price: float
+    runtime_seconds: float
+
+    @property
+    def cost(self) -> float:
+        return self.hourly_price * self.runtime_seconds / 3600.0
+
+
+def _expand_one(res: Resources) -> List[Resources]:
+    """All concrete zone placements of one Resources spec."""
+    if res.is_launchable:
+        return [res]
+    if res.accelerator is not None:
+        zones = catalog.tpu_zones(res.accelerator, region=res.region)
+        return [res.copy(zone=z, region=z.rsplit("-", 1)[0])
+                for z in zones]
+    itype = res.instance_type
+    if itype is None:
+        cpus, mem = res._cpu_mem_floor()
+        itype = catalog.default_vm_for(cpus, mem)
+    zones = catalog.vm_zones(itype, region=res.region)
+    if res.zone is not None:
+        # cpus/memory-floor resources carry the zone pin through expansion
+        # (an explicit zone must never be silently widened).
+        zones = [z for z in zones if z == res.zone]
+    return [res.copy(instance_type=itype, zone=z,
+                     region=z.rsplit("-", 1)[0]) for z in zones]
+
+
+def launchable_candidates(
+        task, blocklist: Optional[Blocklist] = None) -> List[Candidate]:
+    """Expand a task's resource set into priced, concrete candidates."""
+    blocklist = blocklist or Blocklist()
+    out: List[Candidate] = []
+    for res in task.resources:
+        for concrete in _expand_one(res):
+            if blocklist.blocked(concrete):
+                continue
+            price = concrete.hourly_price() * task.num_nodes
+            out.append(Candidate(
+                resources=concrete,
+                hourly_price=price,
+                runtime_seconds=task.estimate_runtime(concrete)))
+    return out
+
+
+class Optimizer:
+    """Static methods only, mirroring the reference's surface."""
+
+    @staticmethod
+    def optimize(dag: Dag,
+                 minimize: OptimizeTarget = OptimizeTarget.COST,
+                 blocklist: Optional[Blocklist] = None,
+                 quiet: bool = False) -> Dag:
+        """Set ``task.best_resources`` on every task in the dag."""
+        order = dag.topo_order()
+        if not order:
+            return dag
+
+        per_task: Dict[int, List[Candidate]] = {}
+        for task in order:
+            cands = launchable_candidates(task, blocklist)
+            if not cands:
+                raise exceptions.ResourcesUnavailableError(
+                    f"No launchable resources for {task}: all candidates "
+                    f"are infeasible or blocklisted.")
+            per_task[id(task)] = cands
+
+        if dag.is_chain():
+            plan = Optimizer._optimize_chain_dp(order, per_task, minimize)
+        else:
+            plan = {id(t): Optimizer._best(per_task[id(t)], minimize)
+                    for t in order}
+
+        for task in order:
+            task.best_resources = plan[id(task)].resources
+        if not quiet:
+            Optimizer.print_optimized_plan(dag, per_task, plan, minimize)
+        return dag
+
+    @staticmethod
+    def _objective(c: Candidate, minimize: OptimizeTarget) -> Tuple:
+        if minimize == OptimizeTarget.TIME:
+            return (c.runtime_seconds, c.cost)
+        return (c.cost, c.runtime_seconds)
+
+    @staticmethod
+    def _best(cands: Sequence[Candidate],
+              minimize: OptimizeTarget) -> Candidate:
+        return min(cands, key=lambda c: Optimizer._objective(c, minimize))
+
+    @staticmethod
+    def _egress_cost(parent, parent_cand: Candidate,
+                     child_cand: Candidate) -> float:
+        gb = float(getattr(parent, "estimated_output_gb", 0.0) or 0.0)
+        if gb == 0.0:
+            return 0.0
+        return gb * catalog.egress_cost_per_gb(
+            parent_cand.resources.region, child_cand.resources.region)
+
+    @staticmethod
+    def _optimize_chain_dp(
+            order, per_task: Dict[int, List[Candidate]],
+            minimize: OptimizeTarget) -> Dict[int, Candidate]:
+        """Exact DP over the chain with inter-task egress cost
+        (reference: sky/optimizer.py:373 _optimize_by_dp)."""
+        # dp[i][j] = best objective for prefix ending with task i using
+        # its candidate j.
+        INF = float("inf")
+        n = len(order)
+        cands0 = per_task[id(order[0])]
+        dp: List[List[float]] = [[0.0] * len(per_task[id(t)])
+                                 for t in order]
+        back: List[List[int]] = [[-1] * len(per_task[id(t)])
+                                 for t in order]
+        for j, c in enumerate(cands0):
+            dp[0][j] = Optimizer._objective(c, minimize)[0]
+        for i in range(1, n):
+            parent = order[i - 1]
+            pc = per_task[id(parent)]
+            cc = per_task[id(order[i])]
+            for j, child in enumerate(cc):
+                best, arg = INF, -1
+                base = Optimizer._objective(child, minimize)[0]
+                for pj, pcand in enumerate(pc):
+                    egress = Optimizer._egress_cost(parent, pcand, child)
+                    if minimize == OptimizeTarget.TIME:
+                        egress = 0.0  # egress is money, not time
+                    total = dp[i - 1][pj] + base + egress
+                    if total < best:
+                        best, arg = total, pj
+                dp[i][j] = best
+                back[i][j] = arg
+        j = min(range(len(dp[-1])), key=lambda j: dp[-1][j])
+        plan: Dict[int, Candidate] = {}
+        for i in range(n - 1, -1, -1):
+            plan[id(order[i])] = per_task[id(order[i])][j]
+            j = back[i][j]
+        return plan
+
+    @staticmethod
+    def print_optimized_plan(dag, per_task, plan, minimize) -> None:
+        try:
+            from rich.console import Console
+            from rich.table import Table
+        except ImportError:  # pragma: no cover
+            for task in dag.topo_order():
+                print(f"  {task.name or '<task>'} -> "
+                      f"{plan[id(task)].resources}")
+            return
+        table = Table(title=f"Optimized plan (minimize {minimize.value})")
+        for col in ("task", "nodes", "resources", "$/hr",
+                    "est. time (hr)", "est. cost ($)"):
+            table.add_column(col)
+        for task in dag.topo_order():
+            chosen = plan[id(task)]
+            table.add_row(
+                task.name or "<task>", str(task.num_nodes),
+                repr(chosen.resources),
+                f"{chosen.hourly_price:.2f}",
+                f"{chosen.runtime_seconds / 3600.0:.2f}",
+                f"{chosen.cost:.2f}")
+        Console().print(table)
+
+
+def optimize(dag: Dag,
+             minimize: OptimizeTarget = OptimizeTarget.COST,
+             blocklist: Optional[Blocklist] = None,
+             quiet: bool = False) -> Dag:
+    return Optimizer.optimize(dag, minimize, blocklist, quiet)

@@ -33,10 +33,15 @@ def _chunk_update(q, kc, vc, qpos, kpos, m, l, acc, *, causal, scale):
     q: (B, Sl, H, D); kc/vc: (B, Sl, KVH, D) fp32; m/l: (B, H, Sl, 1);
     acc: (B, H, Sl, D).
     """
-    groups = q.shape[2] // kc.shape[2]
-    kf = jnp.repeat(kc, groups, axis=2)  # (B, Sl, H, D)
-    vf = jnp.repeat(vc, groups, axis=2)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, kf) * scale
+    b, sl, h, d = q.shape
+    kvh = kc.shape[2]
+    groups = h // kvh
+    # Grouped-query form: keep K/V at KVH heads and fold the group axis
+    # into the einsum instead of materializing repeated K/V (which would
+    # multiply the hot loop's working set by `groups` at long context).
+    qg = q.reshape(b, sl, kvh, groups, d)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, kc) * scale
+    s = s.reshape(b, h, sl, kc.shape[1])  # head = kv_head*groups + g
     if causal:
         mask = qpos[:, None] >= kpos[None, :]
         s = jnp.where(mask[None, None], s, _NEG_INF)
@@ -45,7 +50,9 @@ def _chunk_update(q, kc, vc, qpos, kpos, m, l, acc, *, causal, scale):
     p = jnp.exp(s - m_new)
     alpha = jnp.exp(m - m_new)
     l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc_new = acc * alpha + jnp.einsum("bhqk,bkhd->bhqd", p, vf)
+    pg = p.reshape(b, kvh, groups, sl, kc.shape[1])
+    av = jnp.einsum("bkgqs,bskd->bkgqd", pg, vc).reshape(b, h, sl, d)
+    acc_new = acc * alpha + av
     return m_new, l_new, acc_new
 
 

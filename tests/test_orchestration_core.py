@@ -1,0 +1,272 @@
+"""Hermetic tests for catalog / Resources / Task / Dag / Optimizer.
+
+Mirrors the reference's dryrun test strategy (tests/test_optimizer_dryruns.py
+e.g. test_partial_tpu:134, test_invalid_cloud_tpu:147): no credentials, the
+static catalog is the world.
+"""
+import textwrap
+
+import pytest
+
+from skypilot_tpu import catalog, exceptions, optimizer
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+# --------------------------------------------------------------- catalog
+
+def test_slice_info_topology_math():
+    info = catalog.slice_info("tpu-v5p-64")
+    assert info.chips == 32
+    assert info.hosts == 8          # v5p: 4 chips/host
+    assert info.cores == 64
+    assert info.is_pod
+
+    v5e = catalog.slice_info("tpu-v5e-16")
+    assert v5e.chips == 16 and v5e.hosts == 2   # v5e: 8 chips/host
+
+    single = catalog.slice_info("tpu-v5e-8")
+    assert single.hosts == 1 and not single.is_pod
+
+    v6e = catalog.slice_info("tpu-v6e-16")
+    assert v6e.hosts == 4           # v6e: 4 chips/host
+
+
+def test_unknown_slice_has_helpful_error():
+    with pytest.raises(ValueError, match="Known v5p slices"):
+        catalog.slice_info("tpu-v5p-48")
+    with pytest.raises(ValueError, match="tpu-<gen>-<size>"):
+        catalog.slice_info("a100-8")
+
+
+def test_spot_cheaper_than_ondemand():
+    od = catalog.tpu_price("tpu-v5e-16", use_spot=False)
+    spot = catalog.tpu_price("tpu-v5e-16", use_spot=True)
+    assert spot < od
+
+
+def test_list_accelerators_filter():
+    rows = catalog.list_accelerators(name_filter="v5p-8$")
+    assert rows and all(r["accelerator"] == "tpu-v5p-8" for r in rows)
+
+
+def test_egress_cost_model():
+    assert catalog.egress_cost_per_gb("us-central1", "us-central1") == 0.0
+    assert catalog.egress_cost_per_gb("us-central1", "us-east5") > 0
+    assert (catalog.egress_cost_per_gb("us-central1", "europe-west4") >
+            catalog.egress_cost_per_gb("us-central1", "us-east5"))
+
+
+# ------------------------------------------------------------- resources
+
+def test_resources_validation():
+    r = Resources(accelerator="tpu-v5e-16", zone="us-west4-a")
+    assert r.region == "us-west4"
+    assert r.num_hosts == 2
+    assert r.is_launchable
+
+    with pytest.raises(exceptions.InvalidTaskError, match="not offered"):
+        Resources(accelerator="tpu-v4-8", region="us-west4")
+
+    with pytest.raises(exceptions.InvalidTaskError,
+                       match="mutually exclusive"):
+        Resources(accelerator="tpu-v5e-8", instance_type="n2-standard-8")
+
+    with pytest.raises(exceptions.InvalidTaskError):
+        Resources(accelerator="nvidia-a100")
+
+
+def test_resources_from_yaml_count_must_be_one():
+    with pytest.raises(exceptions.InvalidTaskError, match="bigger"):
+        Resources.from_yaml_config({"accelerators": {"tpu-v5e-8": 4}})
+    r = Resources.from_yaml_config({"accelerators": {"tpu-v5e-8": 1}})
+    assert r.accelerator == "tpu-v5e-8"
+
+
+def test_resources_pricing_and_spot_cleanup():
+    spot = Resources(accelerator="tpu-v5e-16", use_spot=True)
+    od = Resources(accelerator="tpu-v5e-16")
+    assert spot.hourly_price() < od.hourly_price()
+    assert spot.need_cleanup_after_preemption()
+    assert not od.need_cleanup_after_preemption()
+    assert od.get_cost(3600) == pytest.approx(od.hourly_price())
+
+
+def test_resources_runtime_version_defaults():
+    assert Resources(accelerator="tpu-v5p-8").tpu_runtime_version == \
+        "v2-alpha-tpuv5"
+    assert Resources(accelerator="tpu-v5e-8",
+                     runtime_version="custom").tpu_runtime_version == \
+        "custom"
+
+
+def test_less_demanding_than():
+    want = Resources(accelerator="tpu-v5e-16")
+    have = Resources(accelerator="tpu-v5e-16", zone="us-west4-a")
+    assert want.less_demanding_than(have)
+    assert not Resources(accelerator="tpu-v5e-32").less_demanding_than(have)
+    assert not Resources(accelerator="tpu-v5e-16",
+                         use_spot=True).less_demanding_than(have)
+
+
+def test_resources_yaml_roundtrip():
+    r = Resources(accelerator="tpu-v5p-32", region="us-east5",
+                  use_spot=True, ports=("8888",))
+    r2 = Resources.from_yaml_config(r.to_yaml_config())
+    assert r2 == r
+
+
+# ------------------------------------------------------------ task / dag
+
+def test_task_from_yaml(tmp_path):
+    yaml_path = tmp_path / "task.yaml"
+    yaml_path.write_text(textwrap.dedent("""\
+        name: train
+        resources:
+          accelerators: tpu-v5e-16
+          use_spot: true
+        num_nodes: 2
+        envs:
+          MODEL: llama3
+        setup: pip install -e .
+        run: python train.py --model $MODEL
+        """))
+    task = Task.from_yaml(str(yaml_path))
+    assert task.name == "train"
+    assert task.num_nodes == 2
+    assert task.resources[0].accelerator == "tpu-v5e-16"
+    assert task.resources[0].use_spot
+    assert task.envs["MODEL"] == "llama3"
+    # Round-trip.
+    task2 = Task.from_yaml_config(task.to_yaml_config())
+    assert task2.to_yaml_config() == task.to_yaml_config()
+
+
+def test_task_yaml_rejects_unknown_fields():
+    with pytest.raises(exceptions.InvalidTaskError, match="run_cmd"):
+        Task.from_yaml_config({"run_cmd": "echo hi"})
+
+
+def test_task_env_none_requires_override():
+    cfg = {"envs": {"HF_TOKEN": None}, "run": "echo $HF_TOKEN"}
+    with pytest.raises(exceptions.InvalidTaskError, match="HF_TOKEN"):
+        Task.from_yaml_config(cfg)
+    task = Task.from_yaml_config(cfg, env_overrides={"HF_TOKEN": "x"})
+    assert task.envs["HF_TOKEN"] == "x"
+
+
+def test_task_any_of_resources():
+    task = Task.from_yaml_config({
+        "resources": {
+            "use_spot": True,
+            "any_of": [{"accelerators": "tpu-v5e-16"},
+                       {"accelerators": "tpu-v6e-16"}],
+        },
+        "run": "echo hi",
+    })
+    assert len(task.resources) == 2
+    assert all(r.use_spot for r in task.resources)
+
+
+def test_dag_chain_and_cycle():
+    with Dag() as d:
+        a = Task("a", run="echo a")
+        b = Task("b", run="echo b")
+        c = Task("c", run="echo c")
+        a >> b >> c
+    assert d.is_chain()
+    assert [t.name for t in d.topo_order()] == ["a", "b", "c"]
+
+    with Dag() as d2:
+        x = Task("x")
+        y = Task("y")
+        z = Task("z")
+        x >> z
+        y >> z
+    assert not d2.is_chain()
+    assert [t.name for t in d2.topo_order()][-1] == "z"
+
+    d2.add_edge(z, x)
+    with pytest.raises(exceptions.DagError, match="cycle"):
+        d2.topo_order()
+
+
+# -------------------------------------------------------------- optimizer
+
+def _single_task_dag(**task_kw):
+    with Dag() as d:
+        t = Task("t", run="echo hi", **task_kw)
+    return d, t
+
+
+def test_optimizer_picks_cheapest_zone():
+    d, t = _single_task_dag()
+    t.set_resources(Resources(accelerator="tpu-v5e-16"))
+    optimizer.Optimizer.optimize(d, quiet=True)
+    best = t.best_resources
+    assert best.is_launchable
+    # us-* zones have the 1.0 price multiplier -> must win over eu/asia.
+    assert best.zone.startswith("us-")
+
+
+def test_optimizer_respects_blocklist_and_exhaustion():
+    d, t = _single_task_dag()
+    t.set_resources(Resources(accelerator="tpu-v4-8"))  # only us-central2-b
+    bl = optimizer.Blocklist().add("tpu-v4-8", "us-central2-b")
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        optimizer.Optimizer.optimize(d, blocklist=bl, quiet=True)
+
+
+def test_optimizer_any_of_picks_cheaper_option():
+    d, t = _single_task_dag()
+    t.set_resources((Resources(accelerator="tpu-v5e-16", use_spot=True),
+                     Resources(accelerator="tpu-v5p-32", use_spot=True)))
+    optimizer.Optimizer.optimize(d, quiet=True)
+    assert t.best_resources.accelerator == "tpu-v5e-16"
+
+
+def test_optimizer_num_nodes_scales_cost():
+    d1, t1 = _single_task_dag(num_nodes=1)
+    t1.set_resources(Resources(accelerator="tpu-v5e-8"))
+    d2, t2 = _single_task_dag(num_nodes=4)
+    t2.set_resources(Resources(accelerator="tpu-v5e-8"))
+    c1 = optimizer.launchable_candidates(t1)[0].hourly_price
+    c2 = optimizer.launchable_candidates(t2)[0].hourly_price
+    assert c2 == pytest.approx(4 * c1)
+
+
+def test_optimizer_chain_egress_keeps_same_region():
+    with Dag() as d:
+        a = Task("producer", run="make data")
+        b = Task("consumer", run="train")
+        a >> b
+    # Producer only exists in europe-west4: v3 in europe + us-central1.
+    a.set_resources(Resources(accelerator="tpu-v3-8",
+                              region="europe-west4"))
+    a.estimated_output_gb = 10000.0  # huge egress penalty
+    b.set_resources(Resources(accelerator="tpu-v2-8"))
+    optimizer.Optimizer.optimize(d, quiet=True)
+    # v2 is offered in europe-west4-a; egress should dominate the ~10%
+    # regional price premium and keep the consumer in europe.
+    assert b.best_resources.region == "europe-west4"
+
+    # Without egress, the consumer goes to the cheaper us region.
+    a.estimated_output_gb = 0.0
+    optimizer.Optimizer.optimize(d, quiet=True)
+    assert b.best_resources.region.startswith("us-")
+
+
+def test_optimizer_time_vs_cost_target():
+    d, t = _single_task_dag()
+    t.set_resources((Resources(accelerator="tpu-v5e-16"),
+                     Resources(accelerator="tpu-v5p-64")))
+    # Bigger slice is 4x faster but much more expensive.
+    t.set_time_estimator(
+        lambda r: 900.0 if r.accelerator == "tpu-v5p-64" else 3600.0)
+    optimizer.Optimizer.optimize(
+        d, minimize=optimizer.OptimizeTarget.COST, quiet=True)
+    assert t.best_resources.accelerator == "tpu-v5e-16"
+    optimizer.Optimizer.optimize(
+        d, minimize=optimizer.OptimizeTarget.TIME, quiet=True)
+    assert t.best_resources.accelerator == "tpu-v5p-64"
