@@ -1,0 +1,35 @@
+"""The env-var contract between the framework and user workloads.
+
+Reference analog: sky/skylet/constants.py:258-261 (SKYPILOT_NODE_RANK /
+NODE_IPS / NUM_NODES / NUM_GPUS_PER_NODE). We keep the same names so
+reference-style recipes port unchanged, and add the TPU-native
+coordinator/slice variables that feed ``jax.distributed.initialize`` over
+ICI/DCN instead of NCCL's MASTER_ADDR.
+"""
+
+# Reference-compatible contract (host granularity).
+NODE_RANK = "SKYPILOT_NODE_RANK"
+NODE_IPS = "SKYPILOT_NODE_IPS"           # newline-separated, rank order
+NUM_NODES = "SKYPILOT_NUM_NODES"          # total hosts across all slices
+TASK_ID = "SKYPILOT_TASK_ID"
+CLUSTER_NAME = "SKYPILOT_CLUSTER_INFO_CLUSTER_NAME"
+NUM_CHIPS_PER_NODE = "SKYPILOT_NUM_TPU_CHIPS_PER_NODE"
+
+# TPU-native additions.
+COORDINATOR_ADDR = "SKYPILOT_COORDINATOR_ADDR"   # head_ip:port for
+                                                 # jax.distributed
+COORDINATOR_PORT = 8476
+NUM_SLICES = "SKYPILOT_NUM_SLICES"
+SLICE_INDEX = "SKYPILOT_SLICE_INDEX"             # which slice this host
+                                                 # belongs to
+# Multi-slice (DCN-spanning) jax runs read MEGASCALE_* from these.
+MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"
+
+# On-host layout (under the host's $HOME).
+AGENT_DIR = ".stpu_agent"
+JOBS_DB = f"{AGENT_DIR}/jobs.db"
+LOGS_DIR = "stpu_logs"
+WORKDIR = "stpu_workdir"
+
+# Job queue statuses considered terminal.
+TERMINAL = ("SUCCEEDED", "FAILED", "FAILED_SETUP", "CANCELLED")

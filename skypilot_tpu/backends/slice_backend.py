@@ -1,0 +1,482 @@
+"""SliceBackend: the execution engine for TPU slice clusters.
+
+Reference analog: sky/backends/cloud_vm_ray_backend.py — but with the Ray
+substrate removed. The mapping:
+
+  RetryingVmProvisioner (:1121)      -> _provision_with_failover below
+  RayCodeGen + placement group (:211) -> agent.gang_exec (slice IS the gang)
+  _exec_code_on_head / ray job submit -> spec.json + detached gang_exec
+  JobLibCodeGen over SSH (:803)       -> agent.job_lib in-process (local) /
+                                         `python3 -m ...job_cli` (ssh)
+  stable_cluster_internal_ips rank    -> ClusterInfo.ordered_instances()
+
+Gang semantics: a slice's hosts provision/fail/cancel atomically; the
+first failed host cancels the gang with rc 137 (gang_exec).
+"""
+from __future__ import annotations
+
+import getpass
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import filelock
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import provision as provision_api
+from skypilot_tpu.agent import constants as agent_constants
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.backends import backend as backend_lib
+from skypilot_tpu.provision.common import ClusterInfo
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.utils import command_runner as runner_lib
+from skypilot_tpu.utils import paths
+
+
+class SliceHandle(backend_lib.ResourceHandle):
+    """Pickled into the state DB; everything needed to reach the cluster."""
+
+    def __init__(self, cluster_name: str, launched_resources: Resources,
+                 num_slices: int, cluster_info: ClusterInfo):
+        self.cluster_name = cluster_name
+        self.launched_resources = launched_resources
+        self.num_slices = num_slices
+        self.cluster_info = cluster_info
+
+    def get_cluster_name(self) -> str:
+        return self.cluster_name
+
+    @property
+    def provider_name(self) -> str:
+        return self.cluster_info.provider_name
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.cluster_info.instances)
+
+    @property
+    def head_home(self) -> Optional[str]:
+        """Local provider: the head host's fake $HOME dir; else None."""
+        head = self.cluster_info.get_head_instance()
+        if head is not None and self.provider_name == "local":
+            return head.tags["host_dir"]
+        return None
+
+    def get_command_runners(self) -> List[runner_lib.CommandRunner]:
+        runners: List[runner_lib.CommandRunner] = []
+        info = self.cluster_info
+        for inst in info.ordered_instances():
+            if info.provider_name == "local":
+                runners.append(runner_lib.LocalCommandRunner(
+                    inst.instance_id, inst.tags["host_dir"]))
+            else:
+                runners.append(runner_lib.SSHCommandRunner(
+                    inst.instance_id,
+                    inst.external_ip or inst.internal_ip,
+                    ssh_user=info.ssh_user,
+                    ssh_key_path=info.ssh_key_path or "~/.ssh/id_rsa",
+                    port=inst.ssh_port,
+                    proxy_command=info.provider_config.get(
+                        "ssh_proxy_command")))
+        return runners
+
+    def __repr__(self) -> str:
+        return (f"SliceHandle({self.cluster_name}: "
+                f"{self.launched_resources} x{self.num_slices}, "
+                f"{self.num_hosts} hosts)")
+
+
+def _cluster_lock(cluster_name: str) -> filelock.FileLock:
+    return filelock.FileLock(
+        str(paths.locks_dir() / f"cluster.{cluster_name}.lock"))
+
+
+class SliceBackend(backend_lib.Backend[SliceHandle]):
+    NAME = "slice"
+
+    # ------------------------------------------------------------ provision
+    def _provision(self, task, to_provision: Optional[Resources], dryrun,
+                   stream_logs, cluster_name, retry_until_up):
+        if cluster_name is None:
+            cluster_name = f"stpu-{getpass.getuser()}"
+        if to_provision is None:
+            to_provision = task.best_resources or task.resources[0]
+        if dryrun:
+            print(f"[dryrun] would provision {cluster_name}: "
+                  f"{to_provision} x{task.num_nodes}")
+            return None
+        with _cluster_lock(cluster_name):
+            record = global_user_state.get_cluster_from_name(cluster_name)
+            if record is not None and record["handle"] is not None:
+                handle = record["handle"]
+                if record["status"] == ClusterStatus.UP:
+                    self.check_resources_fit_cluster(handle, task)
+                    return handle
+                if record["status"] == ClusterStatus.STOPPED:
+                    return self._restart_cluster(handle)
+            return self._provision_with_failover(
+                task, to_provision, cluster_name, retry_until_up)
+
+    def _provision_with_failover(self, task, to_provision: Resources,
+                                 cluster_name: str,
+                                 retry_until_up: bool) -> SliceHandle:
+        """Zone→region failover with blocklist feedback into the optimizer
+        (reference: provision_with_retries, cloud_vm_ray_backend.py:1900).
+        """
+        blocklist = optimizer_lib.Blocklist()
+        history: List[Exception] = []
+        while True:
+            saved = task.resources
+            try:
+                task.set_resources(to_provision)
+                candidates = optimizer_lib.launchable_candidates(
+                    task, blocklist)
+            finally:
+                task.resources = saved
+            candidates.sort(key=lambda c: c.cost)
+            if not candidates:
+                if retry_until_up:
+                    time.sleep(5)
+                    blocklist = optimizer_lib.Blocklist()
+                    continue
+                raise exceptions.ResourcesUnavailableError(
+                    f"Failed to provision {to_provision} in any zone.",
+                    failover_history=history)
+            for cand in candidates:
+                res = cand.resources
+                try:
+                    return self._provision_once(task, res, cluster_name)
+                except exceptions.ProvisionError as e:
+                    history.append(e)
+                    device = res.accelerator or res.instance_type
+                    if e.blocklist_region:
+                        blocklist = blocklist.add(device,
+                                                  e.blocklist_region)
+                    elif e.blocklist_zone:
+                        blocklist = blocklist.add(device, e.blocklist_zone)
+                    else:
+                        blocklist = blocklist.add(device, res.zone)
+                    # Clean any partial creation before moving on.
+                    try:
+                        provision_api.terminate_instances(
+                            res.provider_name, cluster_name, {})
+                    except Exception:
+                        pass
+            if not retry_until_up:
+                raise exceptions.ResourcesUnavailableError(
+                    f"All zones failed for {to_provision}. "
+                    f"Failover history: "
+                    f"{[str(e) for e in history]}",
+                    failover_history=history)
+
+    def _provision_once(self, task, res: Resources,
+                        cluster_name: str) -> SliceHandle:
+        provider = res.provider_name
+        info = res.slice_info()
+        provider_config: Dict[str, Any] = {
+            "num_slices": task.num_nodes,
+            "accelerator": res.accelerator,
+            "instance_type": res.instance_type,
+            "runtime_version": res.tpu_runtime_version,
+            "use_spot": res.use_spot,
+            "disk_size": res.disk_size,
+            "hosts_per_slice": info.hosts if info else int(
+                (res.labels or {}).get("hosts_per_slice", 1)),
+            "chips_per_host": info.chips_per_host if info else 0,
+            "labels": res.labels or {},
+        }
+        global_user_state.add_or_update_cluster(
+            cluster_name, handle=None, requested_resources=res,
+            ready=False)
+        provision_api.run_instances(provider, res.region, res.zone,
+                                    cluster_name, provider_config)
+        provision_api.wait_instances(provider, res.region, cluster_name,
+                                     "running")
+        cluster_info = provision_api.get_cluster_info(
+            provider, res.region, cluster_name, provider_config)
+        handle = SliceHandle(cluster_name, res, task.num_nodes,
+                             cluster_info)
+        self._post_provision_setup(handle)
+        global_user_state.add_or_update_cluster(
+            cluster_name, handle=handle, requested_resources=res,
+            ready=True)
+        return handle
+
+    def _post_provision_setup(self, handle: SliceHandle) -> None:
+        """Wait for SSH + install the agent runtime on real clouds.
+
+        Local provider hosts are plain dirs — nothing to wait for."""
+        if handle.provider_name == "local":
+            return
+        from skypilot_tpu.provision import provisioner
+        provisioner.wait_for_ssh(handle.cluster_info)
+        provisioner.setup_agent_runtime(handle.cluster_info)
+
+    def _restart_cluster(self, handle: SliceHandle) -> SliceHandle:
+        provider = handle.provider_name
+        res = handle.launched_resources
+        provider_config = {"num_slices": handle.num_slices}
+        provision_api.run_instances(provider, res.region, res.zone,
+                                    handle.cluster_name, provider_config)
+        provision_api.wait_instances(provider, res.region,
+                                     handle.cluster_name, "running")
+        handle.cluster_info = provision_api.get_cluster_info(
+            provider, res.region, handle.cluster_name, provider_config)
+        self._post_provision_setup(handle)
+        global_user_state.add_or_update_cluster(
+            handle.cluster_name, handle=handle, ready=True)
+        return handle
+
+    def check_resources_fit_cluster(self, handle: SliceHandle,
+                                    task) -> None:
+        for res in task.resources:
+            if res.less_demanding_than(handle.launched_resources):
+                return
+        raise exceptions.ResourcesMismatchError(
+            f"Task requires {task.resources}; cluster "
+            f"{handle.cluster_name} has {handle.launched_resources}")
+
+    # ------------------------------------------------------------ sync/setup
+    def _sync_workdir(self, handle: SliceHandle, workdir: str) -> None:
+        src = os.path.abspath(os.path.expanduser(workdir))
+        if not src.endswith("/"):
+            src += "/"
+        for runner in handle.get_command_runners():
+            runner.rsync(src, f"~/{agent_constants.WORKDIR}/", up=True,
+                         delete=True)
+
+    def _sync_file_mounts(self, handle, all_file_mounts,
+                          storage_mounts) -> None:
+        for dst, src in (all_file_mounts or {}).items():
+            if src.startswith(("gs://", "s3://", "http://", "https://")):
+                cmd = self._download_cmd(src, dst)
+                for runner in handle.get_command_runners():
+                    rc = runner.run(cmd)
+                    runner.check_returncode(rc, cmd,
+                                            f"download {src} failed")
+            else:
+                src_abs = os.path.abspath(os.path.expanduser(src))
+                for runner in handle.get_command_runners():
+                    runner.rsync(src_abs, dst, up=True)
+        for dst, store in (storage_mounts or {}).items():
+            cmd = store.mount_command(dst)
+            for runner in handle.get_command_runners():
+                rc = runner.run(cmd)
+                runner.check_returncode(rc, cmd, f"mount {dst} failed")
+
+    @staticmethod
+    def _download_cmd(src: str, dst: str) -> str:
+        q = f"mkdir -p $(dirname {dst}) && "
+        if src.startswith("gs://"):
+            return q + f"gsutil -m cp -r {src} {dst}"
+        if src.startswith("s3://"):
+            return q + f"aws s3 cp --recursive {src} {dst}"
+        return q + f"curl -L -o {dst} {src}"
+
+    def _setup(self, handle: SliceHandle, task, detach_setup) -> None:
+        del detach_setup
+        if task.setup is None:
+            return
+        setup_cmd = (f"cd ~/{agent_constants.WORKDIR} 2>/dev/null; "
+                     + task.setup)
+        import concurrent.futures as cf
+        runners = handle.get_command_runners()
+        log_dir = paths.logs_dir() / handle.cluster_name
+        log_dir.mkdir(parents=True, exist_ok=True)
+
+        def do_setup(idx_runner):
+            idx, runner = idx_runner
+            env = dict(task.envs)
+            env["SKYPILOT_SETUP_NODE_RANK"] = str(idx)
+            return runner.run(setup_cmd, env=env,
+                              log_path=str(log_dir / f"setup-{idx}.log"))
+        with cf.ThreadPoolExecutor(max_workers=min(
+                len(runners), 32)) as pool:
+            rcs = list(pool.map(do_setup, enumerate(runners)))
+        for idx, rc in enumerate(rcs):
+            if rc != 0:
+                raise exceptions.CommandError(
+                    rc, "setup", f"Setup failed on host {idx}; see "
+                    f"{log_dir}/setup-{idx}.log")
+
+    # ------------------------------------------------------------ execute
+    def _execute(self, handle: SliceHandle, task, detach_run,
+                 dryrun=False) -> Optional[int]:
+        if dryrun:
+            print(f"[dryrun] would run on {handle.cluster_name}: "
+                  f"{task.run!r}")
+            return None
+        if task.run is None:
+            return None
+        global_user_state.add_or_update_cluster(
+            handle.cluster_name, handle=handle, ready=True,
+            is_launch=False)
+
+        run_timestamp = time.strftime("%Y-%m-%d-%H-%M-%S")
+        head_home = handle.head_home
+        job_id = job_lib.add_job(
+            task.name or "stpu-job", getpass.getuser(), run_timestamp,
+            log_dir="", home=head_home)
+        log_dir = self._job_log_dir(handle, job_id)
+
+        info = handle.cluster_info
+        instances = info.ordered_instances()
+        res = handle.launched_resources
+        slice_shape = res.slice_info()
+        run_cmd = (f"cd ~/{agent_constants.WORKDIR} 2>/dev/null; "
+                   + task.run)
+
+        hosts = []
+        slice_order = []
+        for inst in instances:
+            if inst.slice_id not in slice_order:
+                slice_order.append(inst.slice_id)
+            slice_index = slice_order.index(inst.slice_id)
+            if handle.provider_name == "local":
+                hosts.append({"kind": "local",
+                              "host_dir": inst.tags["host_dir"],
+                              "slice_index": slice_index})
+            else:
+                hosts.append({
+                    "kind": "ssh",
+                    "ip": inst.external_ip or inst.internal_ip,
+                    "ssh_user": info.ssh_user,
+                    "ssh_key_path": info.ssh_key_path,
+                    "ssh_port": inst.ssh_port,
+                    "proxy_command": info.provider_config.get(
+                        "ssh_proxy_command"),
+                    "slice_index": slice_index,
+                })
+        spec = {
+            "job_id": job_id,
+            "task_id": f"{handle.cluster_name}-{job_id}-{run_timestamp}",
+            "cluster_name": handle.cluster_name,
+            "node_ips": [i.internal_ip for i in instances],
+            "num_slices": handle.num_slices,
+            "hosts_per_slice": slice_shape.hosts if slice_shape else 1,
+            "chips_per_host":
+                slice_shape.chips_per_host if slice_shape else 0,
+            "envs": dict(task.envs),
+            "run_cmd": run_cmd,
+            "log_dir": str(log_dir),
+            "hosts": hosts,
+            "agent_home": head_home,
+        }
+        spec_dir = paths.generated_dir() / handle.cluster_name
+        spec_dir.mkdir(parents=True, exist_ok=True)
+        spec_path = spec_dir / f"job-{job_id}.json"
+        spec_path.write_text(json.dumps(spec, indent=2))
+
+        # The gang driver runs detached so the client can exit; job state
+        # lands in the head's job DB either way.
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "skypilot_tpu.agent.gang_exec",
+             str(spec_path)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True)
+        if not detach_run:
+            self.tail_logs(handle, job_id, follow=True)
+            proc.wait()
+        return job_id
+
+    def _job_log_dir(self, handle: SliceHandle,
+                     job_id: int) -> pathlib.Path:
+        base = (pathlib.Path(handle.head_home)
+                if handle.head_home else paths.logs_dir())
+        return base / agent_constants.LOGS_DIR / f"job-{job_id}"
+
+    # ------------------------------------------------------------ job ops
+    def queue(self, handle: SliceHandle) -> List[Dict[str, Any]]:
+        return job_lib.queue(home=handle.head_home)
+
+    def cancel_jobs(self, handle: SliceHandle,
+                    job_ids: Optional[List[int]] = None) -> List[int]:
+        return job_lib.cancel_jobs(job_ids, home=handle.head_home)
+
+    def job_status(self, handle: SliceHandle,
+                   job_id: int) -> Optional[str]:
+        job = job_lib.get_job(job_id, home=handle.head_home)
+        return job["status"] if job else None
+
+    def tail_logs(self, handle: SliceHandle, job_id: Optional[int],
+                  follow: bool = True, node_rank: int = 0) -> int:
+        if job_id is None:
+            jobs = job_lib.queue(home=handle.head_home)
+            if not jobs:
+                print("No jobs on cluster.")
+                return 1
+            job_id = jobs[0]["job_id"]
+        log_path = self._job_log_dir(handle, job_id) / \
+            f"node-{node_rank}.log"
+        # Wait for the file to appear (job may still be INIT).
+        deadline = time.time() + 30
+        while not log_path.exists():
+            if time.time() > deadline or not follow:
+                print(f"(no logs yet at {log_path})")
+                return 1
+            time.sleep(0.2)
+        with open(log_path, "r", errors="replace") as f:
+            while True:
+                line = f.readline()
+                if line:
+                    print(line, end="", flush=True)
+                    continue
+                job = job_lib.get_job(job_id, home=handle.head_home)
+                done = job is None or job_lib.JobStatus(
+                    job["status"]).is_terminal()
+                if not follow or done:
+                    # Drain anything written between readline and check.
+                    rest = f.read()
+                    if rest:
+                        print(rest, end="", flush=True)
+                    break
+                time.sleep(0.2)
+        job = job_lib.get_job(job_id, home=handle.head_home)
+        if job and job["status"] == job_lib.JobStatus.SUCCEEDED.value:
+            return 0
+        return 1
+
+    # ------------------------------------------------------------ teardown
+    def _teardown(self, handle: SliceHandle, terminate: bool,
+                  purge: bool = False) -> None:
+        with _cluster_lock(handle.cluster_name):
+            try:
+                if terminate:
+                    provision_api.terminate_instances(
+                        handle.provider_name, handle.cluster_name,
+                        handle.cluster_info.provider_config)
+                else:
+                    res = handle.launched_resources
+                    sinfo = res.slice_info()
+                    if sinfo is not None and sinfo.is_pod:
+                        raise exceptions.NotSupportedError(
+                            f"TPU pod slices cannot be stopped, only "
+                            f"terminated (multi-host slice "
+                            f"{sinfo.accelerator}). Use `down`.")
+                    provision_api.stop_instances(
+                        handle.provider_name, handle.cluster_name,
+                        handle.cluster_info.provider_config)
+            except exceptions.NotSupportedError:
+                raise
+            except Exception:
+                if not purge:
+                    raise
+            if terminate:
+                global_user_state.remove_cluster(handle.cluster_name,
+                                                 terminate=True)
+            else:
+                global_user_state.update_cluster_status(
+                    handle.cluster_name, ClusterStatus.STOPPED)
+
+    def set_autostop(self, handle: SliceHandle, idle_minutes: int,
+                     down: bool = False) -> None:
+        global_user_state.set_cluster_autostop(
+            handle.cluster_name, idle_minutes, down)

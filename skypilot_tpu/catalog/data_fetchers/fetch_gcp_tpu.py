@@ -110,21 +110,33 @@ def _mult(zone: str) -> float:
 def _topology(gen: TpuGen, chips: int) -> str:
     """Approximate physical topology string (2D for v2/v3/v5e/v6e; 3D for
     v4/v5p). Only used for display + host math cross-checks."""
+    def prime_factors(n: int):
+        fs, p = [], 2
+        while p * p <= n:
+            while n % p == 0:
+                fs.append(p)
+                n //= p
+            p += 1
+        if n > 1:
+            fs.append(n)
+        return fs
+
     if gen.name in ("v4", "v5p"):
-        # Factor chips into x*y*z with dims as equal as possible, powers
-        # of 2 (matches public AxBxC topologies).
+        # Factor chips into x*y*z as equal as possible: feed prime
+        # factors (largest first) to the smallest dim. Handles
+        # non-power-of-two slices (e.g. 6144 chips -> 16x16x24).
         dims = [1, 1, 1]
-        i, c = 0, chips
-        while c > 1:
-            dims[i % 3] *= 2
-            c //= 2
-            i += 1
+        for f in sorted(prime_factors(chips), reverse=True):
+            dims.sort()
+            dims[0] *= f
         dims.sort()
         return "x".join(str(d) for d in dims)
-    x = 1
-    while x * x < chips:
-        x *= 2
-    return f"{x}x{max(chips // x, 1)}"
+    dims = [1, 1]
+    for f in sorted(prime_factors(chips), reverse=True):
+        dims.sort()
+        dims[0] *= f
+    dims.sort()
+    return f"{dims[0]}x{dims[1]}"
 
 
 def build_tpu_rows() -> List[Dict]:

@@ -1,0 +1,37 @@
+"""Credential probing: which providers can we actually use?
+
+Reference analog: sky/check.py (check:18 — probes each cloud, persists
+the enabled set to the state DB).
+"""
+from __future__ import annotations
+
+import shutil
+import subprocess
+from typing import List
+
+from skypilot_tpu import global_user_state
+
+
+def _gcp_ok() -> bool:
+    """True if gcloud credentials (or ADC) appear usable."""
+    if shutil.which("gcloud") is None:
+        return False
+    try:
+        proc = subprocess.run(
+            ["gcloud", "auth", "list",
+             "--filter=status:ACTIVE", "--format=value(account)"],
+            capture_output=True, text=True, timeout=20)
+        return proc.returncode == 0 and bool(proc.stdout.strip())
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def check(quiet: bool = False) -> List[str]:
+    enabled = ["local"]  # the hermetic provider always works
+    if _gcp_ok():
+        enabled.append("gcp")
+    elif not quiet:
+        print("GCP: no active gcloud credentials "
+              "(run `gcloud auth login`); TPU provisioning disabled.")
+    global_user_state.set_enabled_clouds(enabled)
+    return enabled

@@ -1,0 +1,268 @@
+"""CLI: the `stpu` command.
+
+Reference analog: sky/cli.py (click groups for launch/exec/status/stop/
+down/autostop/queue/logs/cancel/check/show-gpus + jobs/serve subcommands,
+sky/cli.py:928,3337,3418). Every command parses args then calls the SDK —
+no business logic lives here.
+"""
+from __future__ import annotations
+
+import sys
+from typing import List, Optional, Tuple
+
+import click
+
+from skypilot_tpu import exceptions
+
+
+def _parse_env(env: Tuple[str, ...]) -> dict:
+    out = {}
+    for item in env:
+        if "=" not in item:
+            raise click.UsageError(f"--env {item!r} must be KEY=VALUE")
+        k, v = item.split("=", 1)
+        out[k] = v
+    return out
+
+
+def _load_task(entrypoint: str, env: Tuple[str, ...], overrides: dict):
+    from skypilot_tpu.task import Task
+    try:
+        task = Task.from_yaml(entrypoint, env_overrides=_parse_env(env))
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    for key, value in overrides.items():
+        if value is None:
+            continue
+        if key == "num_nodes":
+            task.num_nodes = value
+        else:
+            # Apply to every candidate so any_of fallbacks survive.
+            task.set_resources(tuple(
+                r.copy(**{key: value}) for r in task.resources))
+    return task
+
+
+@click.group()
+@click.version_option(message="%(version)s")
+def cli():
+    """stpu: launch, manage, and serve AI workloads on TPU slices."""
+
+
+@cli.command()
+@click.argument("entrypoint", required=True)
+@click.option("--cluster", "-c", default=None, help="Cluster name.")
+@click.option("--env", multiple=True, help="KEY=VALUE env overrides.")
+@click.option("--num-nodes", type=int, default=None,
+              help="Override number of slices.")
+@click.option("--accelerator", "--gpus", "-t", default=None,
+              help="Override slice type, e.g. tpu-v5e-16.")
+@click.option("--use-spot/--no-use-spot", default=None)
+@click.option("--zone", default=None)
+@click.option("--region", default=None)
+@click.option("--cloud", default=None)
+@click.option("--dryrun", is_flag=True)
+@click.option("--down", is_flag=True,
+              help="Tear down the cluster when the job finishes.")
+@click.option("--detach-run", "-d", is_flag=True)
+@click.option("--idle-minutes-to-autostop", "-i", type=int, default=None)
+@click.option("--retry-until-up", is_flag=True)
+@click.option("--no-setup", is_flag=True)
+def launch(entrypoint, cluster, env, num_nodes, accelerator, use_spot,
+           zone, region, cloud, dryrun, down, detach_run,
+           idle_minutes_to_autostop, retry_until_up, no_setup):
+    """Launch a task YAML on a (new or existing) slice cluster."""
+    from skypilot_tpu import execution
+    task = _load_task(entrypoint, env, {
+        "num_nodes": num_nodes, "accelerator": accelerator,
+        "use_spot": use_spot, "zone": zone, "region": region,
+        "cloud": cloud,
+    })
+    try:
+        job_id, handle = execution.launch(
+            task, cluster_name=cluster, dryrun=dryrun, down=down,
+            detach_run=detach_run,
+            idle_minutes_to_autostop=idle_minutes_to_autostop,
+            retry_until_up=retry_until_up, no_setup=no_setup)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    if job_id is not None:
+        click.echo(f"Job submitted: {job_id} "
+                   f"(cluster {handle.cluster_name})")
+
+
+@cli.command(name="exec")
+@click.argument("cluster", required=True)
+@click.argument("entrypoint", required=True)
+@click.option("--env", multiple=True)
+@click.option("--detach-run", "-d", is_flag=True)
+def exec_cmd(cluster, entrypoint, env, detach_run):
+    """Run a task on an existing cluster (skip provision/setup)."""
+    from skypilot_tpu import execution
+    task = _load_task(entrypoint, env, {})
+    try:
+        job_id, _ = execution.exec(task, cluster, detach_run=detach_run)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f"Job submitted: {job_id} (cluster {cluster})")
+
+
+@cli.command()
+@click.option("--refresh", "-r", is_flag=True,
+              help="Reconcile with provider truth.")
+def status(refresh):
+    """List clusters."""
+    from skypilot_tpu import core
+    records = core.status(refresh=refresh)
+    if not records:
+        click.echo("No existing clusters.")
+        return
+    fmt = "{:<20} {:<28} {:<8} {:<10} {:>9}"
+    click.echo(fmt.format("NAME", "RESOURCES", "NODES", "STATUS",
+                          "AUTOSTOP"))
+    for r in records:
+        handle = r["handle"]
+        res = getattr(handle, "launched_resources", None)
+        click.echo(fmt.format(
+            r["name"], repr(res) if res else "-",
+            getattr(handle, "num_slices", "-"),
+            r["status"].value,
+            f"{r['autostop']}m" if r["autostop"] >= 0 else "-"))
+
+
+@cli.command()
+@click.argument("clusters", nargs=-1, required=True)
+def stop(clusters):
+    """Stop cluster(s) (single-host slices only; pods are down-only)."""
+    from skypilot_tpu import core
+    for name in clusters:
+        try:
+            core.stop(name)
+            click.echo(f"Stopped {name}.")
+        except exceptions.SkyTpuError as e:
+            raise click.ClickException(str(e)) from e
+
+
+@cli.command()
+@click.argument("clusters", nargs=-1, required=True)
+def start(clusters):
+    """Restart stopped cluster(s)."""
+    from skypilot_tpu import core
+    for name in clusters:
+        core.start(name)
+        click.echo(f"Started {name}.")
+
+
+@cli.command()
+@click.argument("clusters", nargs=-1, required=True)
+@click.option("--purge", is_flag=True,
+              help="Remove state even if cloud teardown fails.")
+@click.option("--yes", "-y", is_flag=True)
+def down(clusters, purge, yes):
+    """Terminate cluster(s)."""
+    from skypilot_tpu import core
+    if not yes:
+        click.confirm(f"Terminate {', '.join(clusters)}?", abort=True)
+    for name in clusters:
+        core.down(name, purge=purge)
+        click.echo(f"Terminated {name}.")
+
+
+@cli.command()
+@click.argument("cluster", required=True)
+@click.option("--idle-minutes", "-i", type=int, required=True,
+              help="Idle minutes before stopping; -1 cancels.")
+@click.option("--down", "down_after", is_flag=True,
+              help="Terminate instead of stop.")
+def autostop(cluster, idle_minutes, down_after):
+    """Schedule automatic stop/teardown on idleness."""
+    from skypilot_tpu import core
+    core.autostop(cluster, idle_minutes, down_after=down_after)
+    if idle_minutes < 0:
+        click.echo(f"Autostop cancelled for {cluster}.")
+    else:
+        click.echo(f"{cluster}: autostop after {idle_minutes} idle "
+                   f"minutes ({'down' if down_after else 'stop'}).")
+
+
+@cli.command()
+@click.argument("cluster", required=True)
+@click.option("--all-jobs", "-a", is_flag=True, default=False,
+              help="Include finished jobs.")
+def queue(cluster, all_jobs):
+    """Show the cluster's job queue."""
+    from skypilot_tpu import core
+    jobs = core.queue(cluster, all_jobs=all_jobs)
+    fmt = "{:<6} {:<20} {:<12} {:<10}"
+    click.echo(fmt.format("ID", "NAME", "STATUS", "USER"))
+    for j in jobs:
+        click.echo(fmt.format(j["job_id"], j["job_name"] or "-",
+                              j["status"], j["username"] or "-"))
+
+
+@cli.command()
+@click.argument("cluster", required=True)
+@click.argument("job_id", required=False, type=int)
+@click.option("--no-follow", is_flag=True)
+def logs(cluster, job_id, no_follow):
+    """Tail a job's logs (latest job if no id given)."""
+    from skypilot_tpu import core
+    sys.exit(core.tail_logs(cluster, job_id, follow=not no_follow))
+
+
+@cli.command()
+@click.argument("cluster", required=True)
+@click.argument("job_ids", nargs=-1, type=int)
+@click.option("--all", "-a", "all_jobs", is_flag=True)
+def cancel(cluster, job_ids, all_jobs):
+    """Cancel job(s)."""
+    from skypilot_tpu import core
+    done = core.cancel(cluster, list(job_ids) or None, all_jobs=all_jobs)
+    click.echo(f"Cancelled jobs: {done or 'none'}")
+
+
+@cli.command(name="show-tpus")
+@click.argument("name_filter", required=False)
+@click.option("--region", default=None)
+def show_tpus(name_filter, region):
+    """List TPU slice types, zones and prices (analog: sky show-gpus)."""
+    from skypilot_tpu import catalog
+    rows = catalog.list_accelerators(name_filter=name_filter,
+                                     region_filter=region)
+    fmt = "{:<14} {:>6} {:>6} {:<18} {:>12} {:>12}"
+    click.echo(fmt.format("SLICE", "CHIPS", "HOSTS", "ZONE", "$/HR",
+                          "SPOT $/HR"))
+    for r in rows:
+        click.echo(fmt.format(
+            r["accelerator"], r["chips"], r["hosts"], r["zone"],
+            f"{r['price']:.2f}", f"{r['spot_price']:.2f}"))
+
+
+@cli.command()
+def check():
+    """Probe cloud credentials and record enabled clouds."""
+    from skypilot_tpu import check as check_lib
+    enabled = check_lib.check()
+    click.echo(f"Enabled clouds: {', '.join(enabled) or 'none'}")
+
+
+@cli.command(name="cost-report")
+def cost_report():
+    """Accumulated cost per cluster from recorded usage."""
+    from skypilot_tpu import core
+    fmt = "{:<24} {:<10} {:>10} {:>10}"
+    click.echo(fmt.format("NAME", "STATUS", "HOURS", "COST ($)"))
+    for r in core.cost_report():
+        click.echo(fmt.format(
+            r["name"],
+            r["status"].value if r["status"] else "-",
+            f"{r['duration_seconds'] / 3600:.2f}",
+            f"{r['cost']:.2f}"))
+
+
+def main():
+    cli()
+
+
+if __name__ == "__main__":
+    main()

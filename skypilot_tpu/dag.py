@@ -82,7 +82,9 @@ class Dag:
             return False
         seen = 0
         node = id(roots[0])
-        while True:
+        # Bounded walk: a cycle revisits nodes, so > len(tasks) steps
+        # means not-a-chain rather than an infinite loop.
+        while seen <= len(self.tasks):
             seen += 1
             children = self._edges[node]
             if not children:

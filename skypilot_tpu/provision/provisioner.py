@@ -1,0 +1,87 @@
+"""Post-provision orchestration: SSH wait + agent runtime bring-up.
+
+Reference analog: sky/provision/provisioner.py (_post_provision_setup:402:
+wait SSH → file mounts → runtime setup → ray start → skylet) and
+sky/provision/instance_setup.py. The TPU replacement for "ray start" is
+installing + starting the host agent on every host of the slice; TPU VMs
+of a slice boot together, so there is no autoscaler-style staggered join.
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+import subprocess
+import time
+from typing import List
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.common import ClusterInfo
+from skypilot_tpu.utils import command_runner as runner_lib
+
+SSH_WAIT_TIMEOUT_SECONDS = 300
+
+# Commands that bring up the on-host runtime. The wheel is rsynced by
+# setup_agent_runtime; the agent daemon is started under nohup, one per
+# host, with the head running the job DB.
+_AGENT_START_CMD = (
+    "mkdir -p ~/.stpu_agent && "
+    "nohup python3 -m skypilot_tpu.agent.daemon "
+    "  > ~/.stpu_agent/daemon.log 2>&1 & "
+    "echo started")
+
+
+def _ssh_runner(info: ClusterInfo, inst) -> runner_lib.SSHCommandRunner:
+    return runner_lib.SSHCommandRunner(
+        inst.instance_id, inst.external_ip or inst.internal_ip,
+        ssh_user=info.ssh_user,
+        ssh_key_path=info.ssh_key_path or "~/.ssh/id_rsa",
+        port=inst.ssh_port,
+        proxy_command=info.provider_config.get("ssh_proxy_command"))
+
+
+def wait_for_ssh(info: ClusterInfo,
+                 timeout: int = SSH_WAIT_TIMEOUT_SECONDS) -> None:
+    """Block until every host of every slice accepts SSH (reference:
+    provisioner.wait_for_ssh:363)."""
+    deadline = time.time() + timeout
+    # One runner per host for the whole wait: reuses the multiplexed
+    # ControlMaster connection and its temp dir across polls.
+    pending = [(inst, _ssh_runner(info, inst))
+               for inst in info.ordered_instances()]
+    while pending and time.time() < deadline:
+        still: List = []
+        for inst, runner in pending:
+            try:
+                rc = runner.run("true")
+            except (OSError, subprocess.SubprocessError):
+                rc = 255
+            if rc != 0:
+                still.append((inst, runner))
+        pending = still
+        if pending:
+            time.sleep(5)
+    if pending:
+        raise exceptions.ProvisionError(
+            f"SSH not reachable on {len(pending)} host(s) of "
+            f"{info.cluster_name} after {timeout}s",
+            retryable_in_zone=True)
+
+
+def setup_agent_runtime(info: ClusterInfo) -> None:
+    """Ship the framework wheel + start the host agent on all hosts in
+    parallel (reference: instance_setup.setup_runtime_on_cluster:173 +
+    start_skylet_on_head_node:407)."""
+    from skypilot_tpu.utils import wheel_utils
+    wheel_path = wheel_utils.build_wheel()
+    instances = info.ordered_instances()
+
+    def bring_up(inst):
+        runner = _ssh_runner(info, inst)
+        runner.rsync(str(wheel_path), "~/.stpu_wheels/", up=True)
+        rc = runner.run(
+            "pip install -q --user ~/.stpu_wheels/*.whl && "
+            + _AGENT_START_CMD)
+        runner.check_returncode(rc, "agent bring-up",
+                                f"host {inst.instance_id}")
+    with cf.ThreadPoolExecutor(max_workers=min(32,
+                                               len(instances))) as pool:
+        list(pool.map(bring_up, instances))

@@ -39,8 +39,13 @@ class Resources:
       * ``instance_type``: an explicit CPU VM type.
       * ``cpus``/``memory``: floors; the cheapest VM meeting them is chosen
         at optimization time (reference: Resources(cpus='4+')).
+
+    ``cloud``: provisioning provider. None means the default real cloud
+    ("gcp"); "local" targets the hermetic subprocess provider (no catalog,
+    price 0) used by tests and `stpu local` workflows.
     """
     accelerator: Optional[str] = None
+    cloud: Optional[str] = None
     instance_type: Optional[str] = None
     cpus: Optional[Union[int, str]] = None      # 4 or "4+"
     memory: Optional[Union[float, str]] = None  # GB, 16 or "16+"
@@ -58,6 +63,11 @@ class Resources:
 
     # ------------------------------------------------------------------
     def __post_init__(self):
+        if self.cloud is not None and self.cloud not in ("gcp", "local"):
+            raise exceptions.InvalidTaskError(
+                f"Unknown cloud {self.cloud!r}; supported: gcp, local")
+        if self.cloud == "local":
+            return  # no catalog validation for the hermetic provider
         if self.accelerator is not None:
             if not catalog.is_tpu(self.accelerator):
                 raise exceptions.InvalidTaskError(
@@ -123,9 +133,15 @@ class Resources:
         return _DEFAULT_RUNTIME[self.slice_info().generation]
 
     @property
+    def provider_name(self) -> str:
+        return self.cloud or "gcp"
+
+    @property
     def is_launchable(self) -> bool:
         """Concrete enough to hand to the provisioner: needs a zone and a
-        concrete device/VM."""
+        concrete device/VM (local provider needs neither)."""
+        if self.cloud == "local":
+            return True
         return (self.zone is not None and
                 (self.accelerator is not None or
                  self.instance_type is not None))
@@ -139,6 +155,8 @@ class Resources:
     # ------------------------------------------------------------------
     def hourly_price(self) -> float:
         """Price of this (concrete) resource per hour."""
+        if self.cloud == "local":
+            return 0.0
         if self.accelerator is not None:
             return catalog.tpu_price(self.accelerator, zone=self.zone,
                                      region=self.region,
@@ -196,14 +214,18 @@ class Resources:
             "accelerator", "accelerators", "instance_type", "cpus",
             "memory", "region", "zone", "use_spot", "spot_recovery",
             "disk_size", "image_id", "runtime_version", "ports", "labels",
-            "autostop", "job_recovery", "any_of",
+            "autostop", "job_recovery", "any_of", "cloud",
         }
         unknown = set(config) - known
         if unknown:
             raise exceptions.InvalidTaskError(
                 f"Unknown resources fields: {sorted(unknown)}")
-        acc = config.pop("accelerators", None) or config.pop(
-            "accelerator", None)
+        acc_plural = config.pop("accelerators", None)
+        acc_singular = config.pop("accelerator", None)
+        if acc_plural is not None and acc_singular is not None:
+            raise exceptions.InvalidTaskError(
+                "Specify either 'accelerators' or 'accelerator', not both.")
+        acc = acc_plural if acc_plural is not None else acc_singular
         if isinstance(acc, dict):
             if len(acc) != 1:
                 raise exceptions.InvalidTaskError(
@@ -225,9 +247,10 @@ class Resources:
         out: Dict[str, Any] = {}
         if self.accelerator is not None:
             out["accelerators"] = self.accelerator
-        for field in ("instance_type", "cpus", "memory", "region", "zone",
-                      "spot_recovery", "image_id", "runtime_version",
-                      "labels", "autostop", "job_recovery"):
+        for field in ("cloud", "instance_type", "cpus", "memory", "region",
+                      "zone", "spot_recovery", "image_id",
+                      "runtime_version", "labels", "autostop",
+                      "job_recovery"):
             val = getattr(self, field)
             if val is not None:
                 out[field] = val

@@ -1,0 +1,197 @@
+"""Remote command execution + file transfer.
+
+Reference analog: sky/utils/command_runner.py (CommandRunner:153,
+SSHCommandRunner:392 with ControlMaster/ProxyCommand, rsync:598). Two
+implementations:
+
+  * SSHCommandRunner — TPU-VM hosts over SSH with connection multiplexing.
+  * LocalCommandRunner — a "host" that is a local directory + subprocess;
+    powers the hermetic local cloud (`provision/local.py`), the analog of
+    the reference's Kind-based `sky local up` path.
+"""
+from __future__ import annotations
+
+import os
+import pathlib
+import shlex
+import subprocess
+import tempfile
+from typing import Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import exceptions
+
+SSH_COMMON_OPTS = [
+    "-o", "StrictHostKeyChecking=no",
+    "-o", "UserKnownHostsFile=/dev/null",
+    "-o", "IdentitiesOnly=yes",
+    "-o", "ConnectTimeout=30",
+    "-o", "ServerAliveInterval=20",
+    "-o", "ServerAliveCountMax=3",
+    "-o", "LogLevel=ERROR",
+]
+
+
+def _run_with_log(cmd: List[str], *, log_path: Optional[str],
+                  stream_logs: bool, env: Optional[Dict[str, str]] = None,
+                  cwd: Optional[str] = None) -> int:
+    """Run, teeing stdout/stderr to log_path; returns returncode."""
+    if log_path is None and stream_logs:
+        proc = subprocess.run(cmd, env=env, cwd=cwd)
+        return proc.returncode
+    log_f = open(log_path, "ab") if log_path else subprocess.DEVNULL
+    try:
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, env=env, cwd=cwd)
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            if log_path:
+                log_f.write(line)
+                log_f.flush()
+            if stream_logs:
+                print(line.decode(errors="replace"), end="", flush=True)
+        return proc.wait()
+    finally:
+        if log_path:
+            log_f.close()
+
+
+class CommandRunner:
+    """Abstract: run a shell command on a host / rsync files to it."""
+
+    def __init__(self, node_id: str, internal_ip: str):
+        self.node_id = node_id
+        self.internal_ip = internal_ip
+
+    def run(self, cmd: Union[str, List[str]], *,
+            env: Optional[Dict[str, str]] = None,
+            log_path: Optional[str] = None,
+            stream_logs: bool = False,
+            require_outputs: bool = False,
+            ) -> Union[int, Tuple[int, str, str]]:
+        raise NotImplementedError
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              delete: bool = False,
+              log_path: Optional[str] = None) -> None:
+        """``delete=True`` mirrors (removes extraneous remote files) —
+        only safe for the workdir sync, never for arbitrary mounts."""
+        raise NotImplementedError
+
+    def check_returncode(self, rc: int, cmd: str,
+                         error_msg: str = "") -> None:
+        if rc != 0:
+            raise exceptions.CommandError(rc, cmd, error_msg)
+
+
+class SSHCommandRunner(CommandRunner):
+    """SSH with ControlMaster multiplexing; rsync-over-ssh transfers."""
+
+    def __init__(self, node_id: str, ip: str, *, ssh_user: str,
+                 ssh_key_path: str, port: int = 22,
+                 proxy_command: Optional[str] = None):
+        super().__init__(node_id, ip)
+        self.ip = ip
+        self.ssh_user = ssh_user
+        self.ssh_key_path = os.path.expanduser(ssh_key_path)
+        self.port = port
+        self.proxy_command = proxy_command
+        self._control_dir = tempfile.mkdtemp(prefix="stpu-ssh-")
+
+    def _ssh_base(self) -> List[str]:
+        opts = list(SSH_COMMON_OPTS)
+        opts += ["-o", f"ControlPath={self._control_dir}/%C",
+                 "-o", "ControlMaster=auto",
+                 "-o", "ControlPersist=120s"]
+        if self.proxy_command:
+            opts += ["-o", f"ProxyCommand={self.proxy_command}"]
+        return (["ssh"] + opts +
+                ["-i", self.ssh_key_path, "-p", str(self.port)])
+
+    def run(self, cmd, *, env=None, log_path=None, stream_logs=False,
+            require_outputs=False):
+        if isinstance(cmd, list):
+            cmd = " ".join(shlex.quote(c) for c in cmd)
+        env_prefix = ""
+        if env:
+            env_prefix = " ".join(
+                f"export {k}={shlex.quote(str(v))};" for k, v in
+                env.items()) + " "
+        # Login shell so PATH includes user installs (reference runs
+        # everything under `bash --login -c`, sky/skylet/log_lib.py:261).
+        remote = f"bash --login -c {shlex.quote(env_prefix + cmd)}"
+        full = self._ssh_base() + [f"{self.ssh_user}@{self.ip}", remote]
+        if require_outputs:
+            proc = subprocess.run(full, capture_output=True, text=True)
+            return proc.returncode, proc.stdout, proc.stderr
+        return _run_with_log(full, log_path=log_path,
+                             stream_logs=stream_logs)
+
+    def rsync(self, source, target, *, up, delete=False, log_path=None):
+        ssh_cmd = " ".join(self._ssh_base())
+        rsync_cmd = ["rsync", "-avz"]
+        if delete:
+            rsync_cmd.append("--delete")
+        rsync_cmd += [
+            "--exclude", ".git/",
+            "-e", ssh_cmd,
+        ]
+        if up:
+            rsync_cmd += [source, f"{self.ssh_user}@{self.ip}:{target}"]
+        else:
+            rsync_cmd += [f"{self.ssh_user}@{self.ip}:{source}", target]
+        rc = _run_with_log(rsync_cmd, log_path=log_path, stream_logs=False)
+        self.check_returncode(rc, " ".join(rsync_cmd),
+                              "rsync failed")
+
+
+class LocalCommandRunner(CommandRunner):
+    """A fake host rooted at a local directory.
+
+    ``~`` inside commands maps to the host root dir via $HOME so multi-host
+    semantics (per-host file trees, per-host logs) hold on one machine.
+    """
+
+    def __init__(self, node_id: str, host_dir: str):
+        super().__init__(node_id, "127.0.0.1")
+        self.host_dir = pathlib.Path(host_dir)
+        self.host_dir.mkdir(parents=True, exist_ok=True)
+
+    def run(self, cmd, *, env=None, log_path=None, stream_logs=False,
+            require_outputs=False):
+        if isinstance(cmd, list):
+            cmd = " ".join(shlex.quote(c) for c in cmd)
+        full_env = dict(os.environ)
+        full_env["HOME"] = str(self.host_dir)
+        if env:
+            full_env.update({k: str(v) for k, v in env.items()})
+        argv = ["bash", "-c", cmd]
+        if require_outputs:
+            proc = subprocess.run(argv, capture_output=True, text=True,
+                                  env=full_env, cwd=str(self.host_dir))
+            return proc.returncode, proc.stdout, proc.stderr
+        return _run_with_log(argv, log_path=log_path,
+                             stream_logs=stream_logs, env=full_env,
+                             cwd=str(self.host_dir))
+
+    def rsync(self, source, target, *, up, delete=False, log_path=None):
+        # Pure-python copy: the dev image may lack the rsync binary, and
+        # local "hosts" are just directories anyway.
+        import shutil
+        del log_path
+        target = target.replace("~", str(self.host_dir), 1) if up else \
+            target
+        source = source if up else \
+            source.replace("~", str(self.host_dir), 1)
+        dst = pathlib.Path(target).expanduser()
+        src = pathlib.Path(source).expanduser()
+        try:
+            if src.is_dir():
+                dst.mkdir(parents=True, exist_ok=True)
+                shutil.copytree(src, dst, dirs_exist_ok=True,
+                                ignore=shutil.ignore_patterns(".git"))
+            else:
+                dst.parent.mkdir(parents=True, exist_ok=True)
+                shutil.copy2(src, dst)
+        except OSError as e:
+            raise exceptions.CommandError(
+                1, f"copy {src} -> {dst}", str(e)) from e

@@ -16,6 +16,7 @@ _RESOURCES_SCHEMA = {
     "type": "object",
     "additionalProperties": False,
     "properties": {
+        "cloud": {"type": "string"},
         "accelerator": {"type": "string"},
         "accelerators": {
             "anyOf": [{"type": "string"},

@@ -1,0 +1,55 @@
+"""Build the framework wheel for shipping to clusters.
+
+Reference analog: sky/backends/wheel_utils.py (~/.sky/wheels/<hash>/ —
+every cluster runs the same version the client launched with). Cached by
+content hash of the package tree; rebuilds only when sources change.
+"""
+from __future__ import annotations
+
+import hashlib
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import filelock
+
+from skypilot_tpu.utils import paths
+
+_PKG_ROOT = pathlib.Path(__file__).resolve().parent.parent
+_REPO_ROOT = _PKG_ROOT.parent
+
+
+def _tree_hash() -> str:
+    h = hashlib.sha256()
+    for p in sorted(_PKG_ROOT.rglob("*.py")):
+        h.update(p.read_bytes())
+    return h.hexdigest()[:16]
+
+
+def wheel_dir() -> pathlib.Path:
+    d = paths.home() / "wheels"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def build_wheel() -> pathlib.Path:
+    """Returns the path to the built wheel, building if stale."""
+    tag = _tree_hash()
+    out_dir = wheel_dir() / tag
+    lock = filelock.FileLock(str(paths.locks_dir() / "wheel.lock"))
+    with lock:
+        existing = list(out_dir.glob("*.whl"))
+        if existing:
+            return existing[0]
+        if out_dir.exists():
+            shutil.rmtree(out_dir)
+        out_dir.mkdir(parents=True)
+        subprocess.run(
+            [sys.executable, "-m", "pip", "wheel", "--no-deps",
+             "--wheel-dir", str(out_dir), str(_REPO_ROOT)],
+            check=True, capture_output=True)
+        wheels = list(out_dir.glob("*.whl"))
+        if not wheels:
+            raise RuntimeError("wheel build produced no artifact")
+        return wheels[0]

@@ -1,0 +1,77 @@
+"""CLI surface tests via click's runner (reference: tests/test_cli.py)."""
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu import cli
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+def test_help_lists_commands(runner):
+    result = runner.invoke(cli.cli, ["--help"])
+    assert result.exit_code == 0
+    for cmd in ("launch", "exec", "status", "stop", "down", "autostop",
+                "queue", "logs", "cancel", "check", "show-tpus",
+                "cost-report"):
+        assert cmd in result.output
+
+
+def test_show_tpus_filter(runner):
+    result = runner.invoke(cli.cli, ["show-tpus", "v5p-64"])
+    assert result.exit_code == 0
+    assert "tpu-v5p-64" in result.output
+    assert "us-east5-a" in result.output
+
+
+def test_launch_dryrun(runner, tmp_state_dir, tmp_path):
+    yaml_path = tmp_path / "t.yaml"
+    yaml_path.write_text(
+        "resources:\n  accelerators: tpu-v5e-8\nrun: echo hi\n")
+    result = runner.invoke(
+        cli.cli, ["launch", str(yaml_path), "--dryrun", "-c", "dry"])
+    assert result.exit_code == 0, result.output
+    assert "would provision" in result.output
+
+
+def test_launch_local_end_to_end(runner, tmp_state_dir):
+    result = runner.invoke(cli.cli, [
+        "launch", "examples/local_smoke.yaml", "-c", "smoke",
+        "--detach-run"])
+    assert result.exit_code == 0, result.output
+    assert "Job submitted: 1" in result.output
+
+    result = runner.invoke(cli.cli, ["status"])
+    assert "smoke" in result.output
+
+    result = runner.invoke(cli.cli, ["queue", "smoke", "-a"])
+    assert result.exit_code == 0, result.output
+
+    # Wait for the job then read its logs.
+    import time
+    from skypilot_tpu import core
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        jobs = core.queue("smoke")
+        if jobs and jobs[0]["status"] in ("SUCCEEDED", "FAILED"):
+            break
+        time.sleep(0.2)
+    result = runner.invoke(cli.cli, ["logs", "smoke", "1", "--no-follow"])
+    assert "host rank 0 / 4" in result.output
+
+    result = runner.invoke(cli.cli, ["down", "smoke", "-y"])
+    assert result.exit_code == 0, result.output
+    result = runner.invoke(cli.cli, ["status"])
+    assert "No existing clusters" in result.output
+
+
+def test_env_override_required(runner, tmp_state_dir, tmp_path):
+    yaml_path = tmp_path / "t.yaml"
+    yaml_path.write_text(
+        "envs:\n  TOKEN:\nrun: echo $TOKEN\n"
+        "resources:\n  cloud: local\n")
+    result = runner.invoke(cli.cli, ["launch", str(yaml_path), "--dryrun"])
+    assert result.exit_code != 0
+    assert "TOKEN" in result.output

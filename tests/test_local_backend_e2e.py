@@ -1,0 +1,192 @@
+"""End-to-end launch/exec/queue/cancel/teardown on the local provider.
+
+The hermetic multi-host harness SURVEY.md §4 calls for: each "host" is a
+directory + subprocess, so gang execution, the env contract, job state,
+logs, and teardown are exercised for real — no cloud, no TPU.
+"""
+import time
+
+import pytest
+
+from skypilot_tpu import core, execution, exceptions, global_user_state
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.task import Task
+
+
+def _local_res(hosts_per_slice=1):
+    return Resources(cloud="local",
+                     labels={"hosts_per_slice": str(hosts_per_slice)})
+
+
+def _wait_job(handle, job_id, timeout=30):
+    from skypilot_tpu.backends import slice_backend
+    backend = slice_backend.SliceBackend()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = backend.job_status(handle, job_id)
+        if st and job_lib.JobStatus(st).is_terminal():
+            return st
+        time.sleep(0.2)
+    raise TimeoutError(f"job {job_id} still {st}")
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_launch_end_to_end_env_contract():
+    """2 slices x 2 hosts: every host sees the full rank/env contract."""
+    task = Task("envcheck", run=(
+        'echo "rank=$SKYPILOT_NODE_RANK nodes=$SKYPILOT_NUM_NODES '
+        'slice=$SKYPILOT_SLICE_INDEX coord=$SKYPILOT_COORDINATOR_ADDR" '
+        '> ~/env_out.txt'), num_nodes=2)
+    task.set_resources(_local_res(hosts_per_slice=2))
+    job_id, handle = execution.launch(task, cluster_name="t-env",
+                                      detach_run=True, stream_logs=False)
+    assert job_id == 1
+    status = _wait_job(handle, job_id)
+    assert status == "SUCCEEDED"
+
+    # Check each host's env file: ranks 0..3, slice = rank // 2.
+    insts = handle.cluster_info.ordered_instances()
+    assert len(insts) == 4
+    for rank, inst in enumerate(insts):
+        content = open(inst.tags["host_dir"] + "/env_out.txt").read()
+        assert f"rank={rank} " in content
+        assert "nodes=4" in content
+        assert f"slice={rank // 2}" in content
+        assert ":8476" in content
+
+    record = global_user_state.get_cluster_from_name("t-env")
+    assert record["status"] == ClusterStatus.UP
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_gang_failure_cancels_all_hosts():
+    """One host failing must take down the gang (rc-137 semantics)."""
+    task = Task("gangfail", run=(
+        'if [ "$SKYPILOT_NODE_RANK" = "1" ]; then exit 3; fi; '
+        'sleep 60'), num_nodes=3)
+    task.set_resources(_local_res())
+    t0 = time.time()
+    job_id, handle = execution.launch(task, cluster_name="t-gang",
+                                      detach_run=True, stream_logs=False)
+    status = _wait_job(handle, job_id, timeout=30)
+    assert status == "FAILED"
+    # Far faster than the 60s sleep: survivors were force-cancelled.
+    assert time.time() - t0 < 30
+    # The cancelled node's log is annotated with the gang rc.
+    from skypilot_tpu.backends import slice_backend
+    backend = slice_backend.SliceBackend()
+    log_dir = backend._job_log_dir(handle, job_id)
+    combined = "".join(
+        p.read_text() for p in log_dir.glob("node-*.log"))
+    assert "rc=137" in combined
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_exec_reuse_queue_cancel_and_logs(capsys):
+    task = Task("first", run="echo hello-from-run", num_nodes=1)
+    task.set_resources(_local_res())
+    job_id, handle = execution.launch(task, cluster_name="t-reuse",
+                                      detach_run=True, stream_logs=False)
+    assert _wait_job(handle, job_id) == "SUCCEEDED"
+
+    # exec on the same cluster: no re-provision; job id increments.
+    task2 = Task("second", run="sleep 30")
+    task2.set_resources(_local_res())
+    job_id2, _ = execution.exec(task2, "t-reuse", detach_run=True,
+                                stream_logs=False)
+    assert job_id2 == 2
+
+    jobs = core.queue("t-reuse")
+    assert [j["job_id"] for j in jobs] == [2, 1]
+
+    cancelled = core.cancel("t-reuse", job_ids=[job_id2])
+    assert cancelled == [job_id2]
+    st = core.job_status("t-reuse", [job_id2])[job_id2]
+    assert st == "CANCELLED"
+
+    # tail_logs of the finished first job prints its output.
+    rc = core.tail_logs("t-reuse", job_id, follow=False)
+    out = capsys.readouterr().out
+    assert "hello-from-run" in out
+    assert rc == 0
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_exec_on_missing_or_mismatched_cluster():
+    task = Task("t", run="true")
+    task.set_resources(_local_res())
+    with pytest.raises(exceptions.ClusterNotUpError):
+        execution.exec(task, "nope", stream_logs=False)
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_workdir_and_setup(tmp_path):
+    wd = tmp_path / "proj"
+    wd.mkdir()
+    (wd / "data.txt").write_text("payload-42")
+    task = Task("wd", workdir=str(wd),
+                setup="cp ~/stpu_workdir/data.txt ~/setup_saw_it.txt",
+                run="cat data.txt > ~/run_saw_it.txt", num_nodes=2)
+    task.set_resources(_local_res())
+    job_id, handle = execution.launch(task, cluster_name="t-wd",
+                                      detach_run=True, stream_logs=False)
+    assert _wait_job(handle, job_id) == "SUCCEEDED"
+    for inst in handle.cluster_info.ordered_instances():
+        host = inst.tags["host_dir"]
+        assert open(host + "/setup_saw_it.txt").read() == "payload-42"
+        assert open(host + "/run_saw_it.txt").read() == "payload-42"
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_stop_down_and_cost_report():
+    task = Task("life", run="true")
+    task.set_resources(_local_res())
+    job_id, handle = execution.launch(task, cluster_name="t-life",
+                                      detach_run=True, stream_logs=False)
+    _wait_job(handle, job_id)
+
+    core.stop("t-life")
+    record = global_user_state.get_cluster_from_name("t-life")
+    assert record["status"] == ClusterStatus.STOPPED
+
+    # status(refresh=True) agrees with provider truth.
+    records = core.status(refresh=True)
+    assert records[0]["status"] == ClusterStatus.STOPPED
+
+    core.down("t-life")
+    assert global_user_state.get_cluster_from_name("t-life") is None
+
+    report = core.cost_report()
+    names = [r["name"] for r in report]
+    assert "t-life (terminated)" in names
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_autostop_roundtrip():
+    task = Task("auto", run="true")
+    task.set_resources(_local_res())
+    _, handle = execution.launch(task, cluster_name="t-auto",
+                                 detach_run=True, stream_logs=False,
+                                 idle_minutes_to_autostop=5)
+    record = global_user_state.get_cluster_from_name("t-auto")
+    assert record["autostop"] == 5
+    core.autostop("t-auto", 10, down_after=True)
+    record = global_user_state.get_cluster_from_name("t-auto")
+    assert record["autostop"] == 10 and record["to_down"]
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_tpu_pod_cannot_stop():
+    """Multi-host slices are terminate-only (mirrors TPU VM semantics)."""
+    from skypilot_tpu.backends import slice_backend
+    task = Task("podstop", run="true")
+    task.set_resources(_local_res())
+    _, handle = execution.launch(task, cluster_name="t-pod",
+                                 detach_run=True, stream_logs=False)
+    # Fake a pod-sized launched resource on the handle.
+    handle.launched_resources = Resources(accelerator="tpu-v5p-64")
+    backend = slice_backend.SliceBackend()
+    with pytest.raises(exceptions.NotSupportedError, match="terminate"):
+        backend.teardown(handle, terminate=False)
