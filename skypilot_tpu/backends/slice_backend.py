@@ -448,6 +448,13 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
     def _teardown(self, handle: SliceHandle, terminate: bool,
                   purge: bool = False) -> None:
         with _cluster_lock(handle.cluster_name):
+            if terminate and handle.provider_name == "local":
+                # Kill any live gang before the host dirs vanish, so no
+                # orphan process outlives its (simulated) slice.
+                try:
+                    job_lib.cancel_jobs(None, home=handle.head_home)
+                except Exception:
+                    pass
             try:
                 if terminate:
                     provision_api.terminate_instances(

@@ -260,6 +260,59 @@ def cost_report():
             f"{r['cost']:.2f}"))
 
 
+@cli.group()
+def jobs():
+    """Managed jobs: preemption-recovering task execution."""
+
+
+@jobs.command(name="launch")
+@click.argument("entrypoint", required=True)
+@click.option("--name", "-n", default=None, help="Managed job name.")
+@click.option("--env", multiple=True, help="KEY=VALUE env overrides.")
+@click.option("--detach-run", "-d", is_flag=True)
+def jobs_launch(entrypoint, name, env, detach_run):
+    """Launch a managed job from a task YAML."""
+    from skypilot_tpu import jobs as jobs_sdk
+    from skypilot_tpu.jobs import core as jobs_core
+    task = _load_task(entrypoint, env, {})
+    job_id = jobs_sdk.launch(task, name=name)
+    click.echo(f"Managed job {job_id} submitted.")
+    if not detach_run:
+        sys.exit(jobs_core.tail_logs(job_id, follow=True))
+
+
+@jobs.command(name="queue")
+@click.option("--skip-finished", "-s", is_flag=True)
+def jobs_queue(skip_finished):
+    """List managed jobs."""
+    from skypilot_tpu.jobs import core as jobs_core
+    fmt = "{:<5} {:<20} {:<18} {:>9} {:<24}"
+    click.echo(fmt.format("ID", "NAME", "STATUS", "#RECOVER", "CLUSTER"))
+    for j in jobs_core.queue(skip_finished=skip_finished):
+        click.echo(fmt.format(
+            j["job_id"], (j["job_name"] or "-")[:20], j["status"],
+            j["recovery_count"], j["cluster_name"] or "-"))
+
+
+@jobs.command(name="cancel")
+@click.argument("job_ids", nargs=-1, type=int)
+@click.option("--all", "-a", "all_jobs", is_flag=True)
+def jobs_cancel(job_ids, all_jobs):
+    """Cancel managed job(s)."""
+    from skypilot_tpu.jobs import core as jobs_core
+    done = jobs_core.cancel(list(job_ids) or None, all_jobs=all_jobs)
+    click.echo(f"Cancelling managed jobs: {done or 'none'}")
+
+
+@jobs.command(name="logs")
+@click.argument("job_id", required=False, type=int)
+@click.option("--no-follow", is_flag=True)
+def jobs_logs(job_id, no_follow):
+    """Stream a managed job's task logs."""
+    from skypilot_tpu.jobs import core as jobs_core
+    sys.exit(jobs_core.tail_logs(job_id, follow=not no_follow))
+
+
 def main():
     cli()
 

@@ -1,0 +1,160 @@
+"""Managed jobs SDK: launch/queue/cancel/tail_logs.
+
+Reference analog: sky/jobs/core.py (launch:30 wraps the user DAG into a
+controller task; queue/cancel/tail_logs shell out to the controller via
+codegen). Here the controller is a detached local process
+(`python -m skypilot_tpu.jobs.controller`), and state is read directly
+from the managed-jobs DB.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import dag_utils
+from skypilot_tpu.utils import paths
+
+
+def launch(entrypoint: Union[Task, dag_lib.Dag],
+           name: Optional[str] = None,
+           detach: bool = True) -> int:
+    """Start a managed job; returns its managed-job id.
+
+    ``detach=False`` runs the controller inline (blocking) — used by
+    hermetic tests and debugging; the default spawns it detached.
+    """
+    dag = dag_utils.convert_entrypoint_to_dag(entrypoint)
+    if not dag.is_chain():
+        raise exceptions.NotSupportedError(
+            "Managed jobs support single tasks or chain pipelines only.")
+    dag.name = name or dag.name or dag.tasks[0].name or "unnamed"
+
+    resources_str = ", ".join(
+        str(res) for task in dag.tasks for res in task.resources)
+    jobs_dir = paths.generated_dir() / "managed_jobs"
+    jobs_dir.mkdir(parents=True, exist_ok=True)
+    job_id = jobs_state.add_job(dag.name, "", resources_str,
+                                num_tasks=len(dag.tasks))
+    dag_yaml_path = str(jobs_dir / f"job-{job_id}.yaml")
+    dag_utils.dump_chain_dag_to_yaml(dag, dag_yaml_path)
+    with jobs_state._conn() as conn:  # noqa: SLF001
+        conn.execute(
+            "UPDATE managed_jobs SET dag_yaml_path=? WHERE job_id=?",
+            (dag_yaml_path, job_id))
+    jobs_state.set_status(job_id, ManagedJobStatus.SUBMITTED)
+
+    if detach:
+        log_dir = paths.logs_dir() / "managed_jobs"
+        log_dir.mkdir(parents=True, exist_ok=True)
+        with open(log_dir / f"controller-{job_id}.log", "ab") as log_f:
+            subprocess.Popen(
+                [sys.executable, "-m", "skypilot_tpu.jobs.controller",
+                 "--job-id", str(job_id), dag_yaml_path],
+                stdout=log_f, stderr=subprocess.STDOUT,
+                start_new_session=True, env=dict(os.environ))
+    else:
+        from skypilot_tpu.jobs import controller
+        controller.run_controller(job_id, dag_yaml_path)
+    return job_id
+
+
+def queue(skip_finished: bool = False) -> List[Dict[str, Any]]:
+    """List managed jobs (reference: sky jobs queue)."""
+    return jobs_state.queue(skip_finished=skip_finished)
+
+
+def cancel(job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    """Cancel managed jobs: signal their controllers; each controller
+    cancels its cluster job and tears the cluster down. A job whose
+    controller died is finalized here (incl. orphaned-cluster teardown)."""
+    if job_ids is None and not all_jobs:
+        raise exceptions.SkyTpuError(
+            "Specify managed job ids to cancel, or all_jobs=True "
+            "(`stpu jobs cancel --all`).")
+    jobs = jobs_state.queue(skip_finished=True)
+    if not all_jobs:
+        jobs = [j for j in jobs if j["job_id"] in job_ids]
+    cancelled = []
+    for job in jobs:
+        pid = job.get("controller_pid")
+        # CANCELLING is observed by the controller at its next poll even
+        # if it never received our signal (e.g. pid not yet recorded).
+        jobs_state.set_status(job["job_id"], ManagedJobStatus.CANCELLING)
+        if pid:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                _finalize_dead_controller(job)
+        cancelled.append(job["job_id"])
+    return cancelled
+
+
+def _finalize_dead_controller(job: Dict[str, Any]) -> None:
+    """The controller died without cleaning up: tear down its orphaned
+    task cluster and mark the job CANCELLED."""
+    cluster_name = job.get("cluster_name")
+    if cluster_name:
+        record = global_user_state.get_cluster_from_name(cluster_name)
+        if record is not None and record["handle"] is not None:
+            backend = slice_backend.SliceBackend()
+            try:
+                backend.teardown(record["handle"], terminate=True,
+                                 purge=True)
+            except Exception:  # noqa: BLE001 — already gone
+                global_user_state.remove_cluster(cluster_name,
+                                                 terminate=True)
+    jobs_state.set_status(job["job_id"], ManagedJobStatus.CANCELLED)
+
+
+def tail_logs(job_id: Optional[int] = None, follow: bool = True) -> int:
+    """Stream the task logs of a managed job via its current cluster."""
+    if job_id is None:
+        jobs = jobs_state.queue()
+        if not jobs:
+            print("No managed jobs.")
+            return 1
+        job_id = jobs[0]["job_id"]
+    job = jobs_state.get_job(job_id)
+    if job is None:
+        raise exceptions.SkyTpuError(f"Managed job {job_id} not found.")
+    deadline = time.time() + 30
+    while True:
+        job = jobs_state.get_job(job_id)
+        cluster_name = job.get("cluster_name")
+        if cluster_name:
+            record = global_user_state.get_cluster_from_name(cluster_name)
+            if record is not None and record["handle"] is not None:
+                backend = slice_backend.SliceBackend()
+                return backend.tail_logs(record["handle"], None,
+                                         follow=follow)
+        if (ManagedJobStatus(job["status"]).is_terminal() or
+                time.time() > deadline or not follow):
+            print(f"Managed job {job_id} is {job['status']}; "
+                  f"no live cluster to stream from.")
+            return 0 if job["status"] == "SUCCEEDED" else 1
+        time.sleep(0.5)
+
+
+def wait(job_id: int, timeout: float = 300.0) -> ManagedJobStatus:
+    """Block until the managed job reaches a terminal state."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        status = jobs_state.get_status(job_id)
+        if status is not None and status.is_terminal():
+            return status
+        time.sleep(0.3)
+    raise TimeoutError(
+        f"Managed job {job_id} not terminal after {timeout}s "
+        f"(status={jobs_state.get_status(job_id)})")

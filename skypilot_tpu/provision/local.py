@@ -102,6 +102,20 @@ def get_cluster_info(region, cluster_name: str,
         provider_config=provider_config or {})
 
 
+def simulate_preemption(cluster_name: str) -> None:
+    """Test hook: mark all instances preempted, the way a spot TPU slice
+    dies — the provider's status flips but nothing on-host announces it
+    (reference: spot preemption only visible via cloud API,
+    sky/jobs/controller.py:236-262)."""
+    meta_path = _meta_path(cluster_name)
+    if not meta_path.exists():
+        return
+    meta = json.loads(meta_path.read_text())
+    for info in meta["instances"].values():
+        info["status"] = "preempted"
+    meta_path.write_text(json.dumps(meta, indent=2))
+
+
 def stop_instances(cluster_name: str, provider_config: dict) -> None:
     del provider_config
     meta_path = _meta_path(cluster_name)
