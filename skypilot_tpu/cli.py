@@ -271,11 +271,17 @@ def jobs():
 @click.option("--env", multiple=True, help="KEY=VALUE env overrides.")
 @click.option("--detach-run", "-d", is_flag=True)
 def jobs_launch(entrypoint, name, env, detach_run):
-    """Launch a managed job from a task YAML."""
+    """Launch a managed job from a task YAML (single task or multi-doc
+    chain pipeline)."""
     from skypilot_tpu import jobs as jobs_sdk
     from skypilot_tpu.jobs import core as jobs_core
-    task = _load_task(entrypoint, env, {})
-    job_id = jobs_sdk.launch(task, name=name)
+    from skypilot_tpu.utils import dag_utils
+    try:
+        dag = dag_utils.load_chain_dag_from_yaml(
+            entrypoint, env_overrides=_parse_env(env))
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    job_id = jobs_sdk.launch(dag, name=name)
     click.echo(f"Managed job {job_id} submitted.")
     if not detach_run:
         sys.exit(jobs_core.tail_logs(job_id, follow=True))
@@ -311,6 +317,51 @@ def jobs_logs(job_id, no_follow):
     """Stream a managed job's task logs."""
     from skypilot_tpu.jobs import core as jobs_core
     sys.exit(jobs_core.tail_logs(job_id, follow=not no_follow))
+
+
+@cli.group()
+def serve():
+    """Autoscaled serving: one endpoint, N replicas."""
+
+
+@serve.command(name="up")
+@click.argument("entrypoint", required=True)
+@click.option("--service-name", "-n", default=None)
+@click.option("--env", multiple=True, help="KEY=VALUE env overrides.")
+def serve_up(entrypoint, service_name, env):
+    """Start a service from a task YAML with a `service:` section."""
+    from skypilot_tpu.serve import core as serve_core
+    task = _load_task(entrypoint, env, {})
+    name, endpoint = serve_core.up(task, service_name)
+    click.echo(f"Service {name} starting; endpoint: {endpoint}")
+
+
+@serve.command(name="down")
+@click.argument("service_names", nargs=-1)
+@click.option("--all", "-a", "all_services", is_flag=True)
+def serve_down(service_names, all_services):
+    """Tear down service(s)."""
+    from skypilot_tpu.serve import core as serve_core
+    done = serve_core.down(list(service_names) or None,
+                           all_services=all_services)
+    click.echo(f"Tearing down: {', '.join(done) or 'none'}")
+
+
+@serve.command(name="status")
+@click.argument("service_names", nargs=-1)
+def serve_status(service_names):
+    """Show services and their replicas."""
+    from skypilot_tpu.serve import core as serve_core
+    fmt = "{:<20} {:<16} {:<24} {:<8}"
+    click.echo(fmt.format("SERVICE", "STATUS", "ENDPOINT", "#READY"))
+    for svc in serve_core.status(list(service_names) or None):
+        n_ready = sum(1 for r in svc["replicas"]
+                      if r["status"].value == "READY")
+        click.echo(fmt.format(svc["service_name"], svc["status"].value,
+                              svc["endpoint"], n_ready))
+        for r in svc["replicas"]:
+            click.echo(f"  replica {r['replica_id']:<3} "
+                       f"{r['status'].value:<14} {r['url'] or '-'}")
 
 
 def main():

@@ -112,14 +112,15 @@ class JobsController:
         strategy = recovery_strategy.StrategyExecutor.make(
             cluster_name, task, retry_gap_seconds=min(
                 _poll_seconds(), recovery_strategy.RETRY_INIT_GAP_SECONDS))
-        jobs_state.set_status(self.job_id, ManagedJobStatus.STARTING)
-        cluster_job_id = strategy.launch()
-        jobs_state.set_status(self.job_id, ManagedJobStatus.RUNNING)
         try:
+            jobs_state.set_status(self.job_id, ManagedJobStatus.STARTING)
+            cluster_job_id = strategy.launch()
+            jobs_state.set_status(self.job_id, ManagedJobStatus.RUNNING)
             self._watch(strategy, cluster_name, cluster_job_id)
         finally:
-            # Task done (or cancelled/failed): the task cluster must not
-            # outlive its managed job (reference: controller.py cleanup).
+            # Task done (or cancelled/failed/launch half-succeeded): the
+            # task cluster must not outlive its managed job (reference:
+            # controller.py cleanup).
             self._teardown_cluster(cluster_name)
 
     def _watch(self, strategy, cluster_name: str,

@@ -48,10 +48,7 @@ def launch(entrypoint: Union[Task, dag_lib.Dag],
                                 num_tasks=len(dag.tasks))
     dag_yaml_path = str(jobs_dir / f"job-{job_id}.yaml")
     dag_utils.dump_chain_dag_to_yaml(dag, dag_yaml_path)
-    with jobs_state._conn() as conn:  # noqa: SLF001
-        conn.execute(
-            "UPDATE managed_jobs SET dag_yaml_path=? WHERE job_id=?",
-            (dag_yaml_path, job_id))
+    jobs_state.set_dag_yaml_path(job_id, dag_yaml_path)
     jobs_state.set_status(job_id, ManagedJobStatus.SUBMITTED)
 
     if detach:
@@ -97,6 +94,10 @@ def cancel(job_ids: Optional[List[int]] = None,
                 os.kill(pid, signal.SIGTERM)
             except (ProcessLookupError, PermissionError):
                 _finalize_dead_controller(job)
+        elif time.time() - (job.get("submitted_at") or 0) > 60:
+            # No pid a minute after submission: the controller died on
+            # startup and will never observe CANCELLING — finalize here.
+            _finalize_dead_controller(job)
         cancelled.append(job["job_id"])
     return cancelled
 

@@ -128,6 +128,13 @@ def set_recovering(job_id: int) -> None:
             (ManagedJobStatus.RECOVERING.value, time.time(), job_id))
 
 
+def set_dag_yaml_path(job_id: int, dag_yaml_path: str) -> None:
+    with _conn() as conn:
+        conn.execute(
+            "UPDATE managed_jobs SET dag_yaml_path=? WHERE job_id=?",
+            (dag_yaml_path, job_id))
+
+
 def set_cluster_name(job_id: int, cluster_name: str) -> None:
     with _conn() as conn:
         conn.execute(

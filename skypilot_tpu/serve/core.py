@@ -1,0 +1,142 @@
+"""Serve SDK: up/down/status.
+
+Reference analog: sky/serve/core.py (up:94 launches the controller;
+down/status manage it). The controller here is a detached local process
+(see serve/service.py for the deployment-mapping note).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import paths
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def up(task: Task, service_name: Optional[str] = None
+       ) -> Tuple[str, str]:
+    """Start a service; returns (service_name, endpoint URL)."""
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            "Task YAML needs a `service:` section for `serve up`.")
+    service_name = service_name or task.name or "service"
+    lb_port = _free_port()
+
+    serve_dir = paths.generated_dir() / "serve"
+    serve_dir.mkdir(parents=True, exist_ok=True)
+    task_yaml_path = str(serve_dir / f"{service_name}.yaml")
+    task.to_yaml(task_yaml_path)
+
+    import json
+    ok = serve_state.add_service(
+        service_name, json.dumps(task.service.to_yaml_config()),
+        task_yaml_path, lb_port)
+    if not ok:
+        raise exceptions.SkyTpuError(
+            f"Service {service_name!r} already exists; "
+            f"`stpu serve down {service_name}` first.")
+
+    log_dir = paths.logs_dir() / "serve"
+    log_dir.mkdir(parents=True, exist_ok=True)
+    with open(log_dir / f"{service_name}.log", "ab") as log_f:
+        subprocess.Popen(
+            [sys.executable, "-m", "skypilot_tpu.serve.service",
+             "--service-name", service_name,
+             "--task-yaml", task_yaml_path,
+             "--lb-port", str(lb_port)],
+            stdout=log_f, stderr=subprocess.STDOUT,
+            start_new_session=True, env=dict(os.environ))
+    return service_name, f"http://127.0.0.1:{lb_port}"
+
+
+def down(service_names: Optional[List[str]] = None,
+         all_services: bool = False, timeout: float = 60.0) -> List[str]:
+    """Tear down service(s): signal the controller and wait for it to
+    clean up its replicas; finalize orphans if the controller is dead."""
+    if service_names is None and not all_services:
+        raise exceptions.SkyTpuError(
+            "Specify service names or all_services=True.")
+    services = serve_state.get_services()
+    if not all_services:
+        services = [s for s in services
+                    if s["service_name"] in service_names]
+    done = []
+    for svc in services:
+        name = svc["service_name"]
+        pid = svc.get("controller_pid")
+        alive = False
+        if pid:
+            try:
+                os.kill(pid, signal.SIGTERM)
+                alive = True
+            except (ProcessLookupError, PermissionError):
+                pass
+        if alive:
+            deadline = time.time() + timeout
+            while (serve_state.get_service(name) is not None and
+                   time.time() < deadline):
+                time.sleep(0.2)
+        if serve_state.get_service(name) is not None:
+            _finalize_dead_service(name)
+        done.append(name)
+    return done
+
+
+def _finalize_dead_service(service_name: str) -> None:
+    backend = slice_backend.SliceBackend()
+    for rep in serve_state.get_replicas(service_name):
+        record = global_user_state.get_cluster_from_name(
+            rep["cluster_name"])
+        if record is not None and record["handle"] is not None:
+            try:
+                backend.teardown(record["handle"], terminate=True,
+                                 purge=True)
+            except Exception:  # noqa: BLE001
+                global_user_state.remove_cluster(rep["cluster_name"],
+                                                 terminate=True)
+    serve_state.remove_service(service_name)
+
+
+def status(service_names: Optional[List[str]] = None
+           ) -> List[Dict[str, Any]]:
+    services = serve_state.get_services()
+    if service_names is not None:
+        services = [s for s in services
+                    if s["service_name"] in service_names]
+    for svc in services:
+        svc["replicas"] = serve_state.get_replicas(svc["service_name"])
+        svc["endpoint"] = f"http://127.0.0.1:{svc['lb_port']}"
+    return services
+
+
+def wait_ready(service_name: str, timeout: float = 120.0) -> str:
+    """Block until the service is READY; returns the endpoint URL."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        svc = serve_state.get_service(service_name)
+        if svc is not None:
+            if svc["status"] == ServiceStatus.READY:
+                return f"http://127.0.0.1:{svc['lb_port']}"
+            if svc["status"] == ServiceStatus.FAILED:
+                raise exceptions.SkyTpuError(
+                    f"Service {service_name} FAILED; see controller log.")
+        time.sleep(0.3)
+    raise TimeoutError(
+        f"Service {service_name} not READY after {timeout}s "
+        f"(status={svc['status'] if svc else 'missing'})")

@@ -45,9 +45,14 @@ def load_chain_dag_from_yaml(
         path: str,
         env_overrides: Optional[Dict[str, str]] = None) -> dag_lib.Dag:
     with open(os.path.expanduser(path)) as f:
-        docs = list(yaml.safe_load_all(f))
+        docs = [d for d in yaml.safe_load_all(f) if d is not None]
     if not docs:
         raise exceptions.InvalidTaskError(f"{path} is empty")
+    for doc in docs:
+        if not isinstance(doc, dict):
+            raise exceptions.InvalidTaskError(
+                f"{path}: every YAML document must be a mapping, "
+                f"got {type(doc).__name__}")
     dag_name = None
     if set(docs[0].keys()) <= {"name"}:
         dag_name = docs[0].get("name")

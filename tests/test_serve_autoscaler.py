@@ -1,0 +1,70 @@
+"""RequestRateAutoscaler unit tests with synthetic request timestamps.
+
+Reference analog: tests/test_serve_autoscaler.py.
+"""
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+
+def _spec(**kw):
+    base = dict(min_replicas=1, max_replicas=5, target_qps_per_replica=1.0,
+                qps_window_seconds=10, upscale_delay_seconds=5,
+                downscale_delay_seconds=20)
+    base.update(kw)
+    return SkyServiceSpec(**base)
+
+
+def test_static_spec_uses_base_autoscaler():
+    spec = SkyServiceSpec(min_replicas=3)
+    a = autoscalers.Autoscaler.from_spec(spec)
+    assert type(a) is autoscalers.Autoscaler
+    assert a.evaluate_scaling().target_num_replicas == 3
+
+
+def test_request_rate_upscale_after_delay():
+    a = autoscalers.RequestRateAutoscaler(_spec())
+    t0 = 1000.0
+    # Sustained 3 qps from t0-10 through t0+6: every 10s window sees 30
+    # requests -> raw target 3.
+    a.collect_request_information(
+        [t0 - 10 + k / 3.0 for k in range(48)])
+    # Immediately: hysteresis holds at min.
+    assert a.evaluate_scaling(now=t0).target_num_replicas == 1
+    # Before the upscale delay: still held.
+    assert a.evaluate_scaling(now=t0 + 2).target_num_replicas == 1
+    # After the delay with sustained load: scales to 3.
+    assert a.evaluate_scaling(now=t0 + 6).target_num_replicas == 3
+
+
+def test_request_rate_respects_max_replicas():
+    a = autoscalers.RequestRateAutoscaler(_spec(max_replicas=2))
+    t0 = 1000.0
+    for dt in (0, 6):
+        a.collect_request_information(
+            [t0 + dt - i * 0.01 for i in range(500)])
+        a.evaluate_scaling(now=t0 + dt)
+    assert a.target_num_replicas == 2
+
+
+def test_request_rate_downscale_slow():
+    a = autoscalers.RequestRateAutoscaler(_spec())
+    t0 = 1000.0
+    a.collect_request_information(
+        [t0 - 10 + k / 3.0 for k in range(48)])
+    a.evaluate_scaling(now=t0)
+    a.evaluate_scaling(now=t0 + 6)
+    assert a.target_num_replicas == 3
+    # Traffic stops; downscale only after downscale_delay (20s).
+    assert a.evaluate_scaling(now=t0 + 16).target_num_replicas == 3
+    assert a.evaluate_scaling(now=t0 + 25).target_num_replicas == 3
+    assert a.evaluate_scaling(now=t0 + 37).target_num_replicas == 1
+
+
+def test_burst_does_not_upscale():
+    a = autoscalers.RequestRateAutoscaler(_spec())
+    t0 = 1000.0
+    a.collect_request_information([t0 - i * 0.1 for i in range(100)])
+    a.evaluate_scaling(now=t0)           # burst starts the candidate clock
+    # Burst is over; window drains before the upscale delay passes.
+    assert a.evaluate_scaling(now=t0 + 12).target_num_replicas == 1
+    assert a._upscale_candidate_since is None
