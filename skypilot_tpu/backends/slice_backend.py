@@ -255,8 +255,9 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
 
     def _sync_file_mounts(self, handle, all_file_mounts,
                           storage_mounts) -> None:
+        from skypilot_tpu.data import cloud_stores
         for dst, src in (all_file_mounts or {}).items():
-            if src.startswith(("gs://", "s3://", "http://", "https://")):
+            if cloud_stores.is_cloud_store_url(src):
                 cmd = self._download_cmd(src, dst)
                 for runner in handle.get_command_runners():
                     rc = runner.run(cmd)
@@ -267,6 +268,10 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
                 for runner in handle.get_command_runners():
                     runner.rsync(src_abs, dst, up=True)
         for dst, store in (storage_mounts or {}).items():
+            if store.source:
+                # Client-side: create bucket + upload source (reference:
+                # Task.sync_storage_mounts, sky/task.py:951).
+                store.sync()
             cmd = store.mount_command(dst)
             for runner in handle.get_command_runners():
                 rc = runner.run(cmd)
@@ -274,12 +279,9 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
 
     @staticmethod
     def _download_cmd(src: str, dst: str) -> str:
-        q = f"mkdir -p $(dirname {dst}) && "
-        if src.startswith("gs://"):
-            return q + f"gsutil -m cp -r {src} {dst}"
-        if src.startswith("s3://"):
-            return q + f"aws s3 cp --recursive {src} {dst}"
-        return q + f"curl -L -o {dst} {src}"
+        from skypilot_tpu.data import cloud_stores
+        return cloud_stores.get_storage_from_path(
+            src).make_download_command(src, dst)
 
     def _setup(self, handle: SliceHandle, task, detach_setup) -> None:
         del detach_setup

@@ -55,7 +55,7 @@ _STORAGE_SCHEMA = {
         "source": {"anyOf": [{"type": "string"},
                              {"type": "array",
                               "items": {"type": "string"}}]},
-        "store": {"type": "string", "enum": ["gcs", "s3"]},
+        "store": {"type": "string", "enum": ["gcs", "s3", "local"]},
         "persistent": {"type": "boolean"},
         "mode": {"type": "string", "enum": ["MOUNT", "COPY"]},
     },

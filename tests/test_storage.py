@@ -1,0 +1,125 @@
+"""Storage layer: YAML round trip, command generation, and a real
+COPY/MOUNT end-to-end through the local provider + LocalStore.
+
+Reference analog: tests/test_storage.py (hermetic parts).
+"""
+import pytest
+
+from skypilot_tpu import execution, global_user_state
+from skypilot_tpu.data import cloud_stores
+from skypilot_tpu.data import mounting_utils
+from skypilot_tpu.data import storage as storage_lib
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+def test_storage_yaml_roundtrip():
+    s = storage_lib.Storage.from_yaml_config({
+        "name": "my-bucket", "store": "gcs", "mode": "COPY",
+        "source": "./data"})
+    assert s.name == "my-bucket"
+    assert s.mode == storage_lib.StorageMode.COPY
+    s2 = storage_lib.Storage.from_yaml_config(s.to_yaml_config())
+    assert s2.name == s.name and s2.mode == s.mode
+    assert s2.store_type == storage_lib.StoreType.GCS
+
+
+def test_storage_name_derived_from_source():
+    s = storage_lib.Storage(source="/tmp/MyData")
+    assert s.name == "mydata"
+
+
+def test_storage_requires_name_or_source():
+    with pytest.raises(Exception):
+        storage_lib.Storage()
+
+
+def test_gcs_command_generation():
+    s = storage_lib.GcsStore("bkt")
+    assert "gsutil -m rsync -r gs://bkt /data" in s.fetch_command("/data")
+    mount = s.mount_fuse_command("/data")
+    assert "gcsfuse" in mount and "/data" in mount
+    assert "mountpoint -q" in mount  # idempotent
+
+
+def test_s3_command_generation():
+    s = storage_lib.S3Store("bkt")
+    assert "aws s3 sync s3://bkt /data" in s.fetch_command("/data")
+    assert "goofys" in s.mount_fuse_command("/data")
+
+
+def test_cloud_stores_registry():
+    assert "gsutil" in cloud_stores.get_storage_from_path(
+        "gs://b/x").make_download_command("gs://b/x", "/d/x")
+    assert "aws s3" in cloud_stores.get_storage_from_path(
+        "s3://b/x").make_download_command("s3://b/x", "/d/x")
+    assert "curl" in cloud_stores.get_storage_from_path(
+        "https://h/x").make_download_command("https://h/x", "/d/x")
+    assert cloud_stores.is_cloud_store_url("gs://b")
+    assert not cloud_stores.is_cloud_store_url("/local/path")
+    with pytest.raises(ValueError):
+        cloud_stores.get_storage_from_path("ftp://nope")
+
+
+def test_unmount_command():
+    cmd = mounting_utils.get_unmount_command("/data")
+    assert "fusermount -u" in cmd
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_local_store_copy_and_mount_e2e(tmp_path):
+    """Upload a source dir into a LocalStore bucket; a 2-host task sees
+    COPY and MOUNT contents on every host."""
+    src = tmp_path / "srcdata"
+    src.mkdir()
+    (src / "hello.txt").write_text("storage-e2e")
+
+    copy_store = storage_lib.Storage(
+        name="bkt-copy", source=str(src), store="local", mode="COPY")
+    mount_store = storage_lib.Storage(
+        name="bkt-mount", source=str(src), store="local", mode="MOUNT")
+
+    task = Task("storagecheck", run=(
+        'cat ./data_copy/hello.txt ./data_mount/hello.txt '
+        '> ~/storage_out.txt'), num_nodes=2)
+    task.set_resources(Resources(cloud="local"))
+    task.set_storage_mounts({"./data_copy": copy_store,
+                             "./data_mount": mount_store})
+
+    job_id, handle = execution.launch(task, cluster_name="t-storage",
+                                      detach_run=False, stream_logs=False)
+    from skypilot_tpu.agent import job_lib
+    job = job_lib.get_job(job_id, home=handle.head_home)
+    assert job["status"] == "SUCCEEDED"
+    for inst in handle.cluster_info.ordered_instances():
+        content = open(inst.tags["host_dir"] + "/storage_out.txt").read()
+        assert content == "storage-e2estorage-e2e"
+
+    # Registered in client state; delete removes bucket + record.
+    names = {s["name"] for s in global_user_state.get_storage()}
+    assert {"bkt-copy", "bkt-mount"} <= names
+    copy_store.delete()
+    assert "bkt-copy" not in {
+        s["name"] for s in global_user_state.get_storage()}
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_storage_mount_via_task_yaml(tmp_path):
+    """file_mounts with a storage spec goes through Task.from_yaml_config
+    into a working mount."""
+    src = tmp_path / "ydata"
+    src.mkdir()
+    (src / "f.txt").write_text("yaml-store")
+    task = Task.from_yaml_config({
+        "name": "yamlstore",
+        "resources": {"cloud": "local"},
+        "file_mounts": {
+            "./mnt": {"name": "bkt-yaml", "source": str(src),
+                      "store": "local", "mode": "COPY"},
+        },
+        "run": "cp ./mnt/f.txt ~/got.txt",
+    })
+    job_id, handle = execution.launch(task, cluster_name="t-ystore",
+                                      detach_run=False, stream_logs=False)
+    head = handle.cluster_info.get_head_instance()
+    assert open(head.tags["host_dir"] + "/got.txt").read() == "yaml-store"
