@@ -25,6 +25,18 @@ SLICE_INDEX = "SKYPILOT_SLICE_INDEX"             # which slice this host
 # Multi-slice (DCN-spanning) jax runs read MEGASCALE_* from these.
 MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"
 
+# Gang-agent coordination (native host-agent core, agent/native.py):
+# the gang driver runs a coordinator; each host's job wrapper connects,
+# barriers before exec (reference pg.ready() semantics) and heartbeats
+# during the run. For SSH hosts the coordinator is reached through an SSH
+# reverse tunnel bound on this fixed remote port.
+GANG_COORD_ADDR = "STPU_GANG_COORD_ADDR"         # host:port for the wrapper
+GANG_BARRIER_TIMEOUT_SECONDS = 600               # slowest-host allowance
+HEARTBEAT_TIMEOUT_MS = 15_000
+# Exit code recorded for ranks force-cancelled because the gang failed
+# (reference get_or_fail semantics, cloud_vm_ray_backend.py:296-331).
+GANG_FAILED_RC = 137
+
 # On-host layout (under the host's $HOME).
 AGENT_DIR = ".stpu_agent"
 JOBS_DB = f"{AGENT_DIR}/jobs.db"

@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import os
 import pathlib
+import shlex
 import signal
 import subprocess
 import sys
@@ -30,7 +31,7 @@ from typing import Dict, List, Optional
 from skypilot_tpu.agent import constants
 from skypilot_tpu.agent import job_lib
 
-GANG_FAILED_RC = 137
+GANG_FAILED_RC = constants.GANG_FAILED_RC
 
 
 def _build_env(spec: Dict, rank: int) -> Dict[str, str]:
@@ -60,12 +61,29 @@ class _HostProc:
     """One host's command, run via the appropriate transport."""
 
     def __init__(self, host: Dict, rank: int, cmd: str,
-                 env: Dict[str, str], log_path: str):
+                 env: Dict[str, str], log_path: str,
+                 coord_port: Optional[int] = None):
         self.rank = rank
         self.host = host
         self.returncode: Optional[int] = None
         log_f = open(log_path, "ab")
         if host["kind"] == "local":
+            if coord_port is not None:
+                env = dict(env)
+                env[constants.GANG_COORD_ADDR] = \
+                    f"127.0.0.1:{coord_port}"
+                # The wrapper runs with cwd=host_dir; make the package
+                # importable from wherever this driver imported it.
+                import skypilot_tpu
+                pkg_root = os.path.dirname(
+                    os.path.dirname(skypilot_tpu.__file__))
+                existing = env.get("PYTHONPATH") or \
+                    os.environ.get("PYTHONPATH", "")
+                env["PYTHONPATH"] = (
+                    f"{pkg_root}:{existing}" if existing else pkg_root)
+                cmd = (f"{sys.executable} -m "
+                       f"skypilot_tpu.agent.host_wrapper "
+                       f"{shlex.quote(cmd)}")
             full_env = dict(os.environ)
             full_env["HOME"] = host["host_dir"]
             full_env.update(env)
@@ -74,11 +92,25 @@ class _HostProc:
                 stderr=subprocess.STDOUT, env=full_env,
                 cwd=host["host_dir"], start_new_session=True)
         else:  # ssh
-            import shlex
             from skypilot_tpu.utils import command_runner
             opts = list(command_runner.SSH_COMMON_OPTS)
             if host.get("proxy_command"):
                 opts += ["-o", f"ProxyCommand={host['proxy_command']}"]
+            if coord_port is not None:
+                # The coordinator lives in this (driver) process; hosts
+                # reach it through an SSH reverse tunnel so NAT between
+                # driver and slice doesn't matter. The remote tunnel port
+                # reuses the coordinator's (OS-assigned, driver-unique)
+                # port number so concurrent gangs don't collide; a bind
+                # failure must kill the ssh (fail fast) rather than
+                # silently cross-wire two gangs.
+                env = dict(env)
+                env[constants.GANG_COORD_ADDR] = \
+                    f"127.0.0.1:{coord_port}"
+                opts += ["-o", "ExitOnForwardFailure=yes",
+                         "-R", f"{coord_port}:127.0.0.1:{coord_port}"]
+                cmd = (f"python3 -m skypilot_tpu.agent.host_wrapper "
+                       f"{shlex.quote(cmd)}")
             env_prefix = " ".join(
                 f"export {k}={shlex.quote(str(v))};"
                 for k, v in env.items())
@@ -118,6 +150,22 @@ def run_gang(spec: Dict) -> int:
     job_lib.set_pid(job_id, os.getpid(), home)
     job_lib.set_status(job_id, job_lib.JobStatus.RUNNING, home)
 
+    # Gang coordinator (native host-agent core): every host's wrapper
+    # barriers here before exec — no host runs until all are up
+    # (reference pg.ready()) — and heartbeats during the run so a hung
+    # host is detected, not just an exited one.
+    coord = None
+    coord_port = None
+    if spec.get("use_gang_agent", True) and len(spec["hosts"]) > 1:
+        from skypilot_tpu.agent import native
+        try:
+            coord = native.Coordinator(
+                len(spec["hosts"]),
+                heartbeat_timeout_ms=constants.HEARTBEAT_TIMEOUT_MS)
+            coord_port = coord.port
+        except OSError:
+            coord = None
+
     procs: List[_HostProc] = []
     cancelled = threading.Event()
 
@@ -131,11 +179,13 @@ def run_gang(spec: Dict) -> int:
     for rank, host in enumerate(spec["hosts"]):
         env = _build_env(spec, rank)
         procs.append(_HostProc(host, rank, spec["run_cmd"], env,
-                               str(log_dir / f"node-{rank}.log")))
+                               str(log_dir / f"node-{rank}.log"),
+                               coord_port=coord_port))
 
     # Wait with gang semantics: first failure cancels the rest.
     failed_rank: Optional[int] = None
     lock = threading.Lock()
+    all_done = threading.Event()
 
     def waiter(p: _HostProc):
         nonlocal failed_rank
@@ -147,12 +197,39 @@ def run_gang(spec: Dict) -> int:
                     if other is not p and other.returncode is None:
                         other.terminate()
 
+    def agent_monitor():
+        """Heartbeat-based failure detection: catches hosts that hang or
+        lose connectivity without their ssh process exiting."""
+        nonlocal failed_rank
+        while not all_done.wait(0.5):
+            if coord is None:
+                return
+            dead = coord.failed_rank
+            if dead >= 0 and not cancelled.is_set():
+                with lock:
+                    if failed_rank is None:
+                        failed_rank = dead if dead < len(procs) else 0
+                        for p in procs:
+                            if p.returncode is None:
+                                p.terminate()
+                return
+
     threads = [threading.Thread(target=waiter, args=(p,), daemon=True)
                for p in procs]
+    if coord is not None:
+        threads.append(threading.Thread(target=agent_monitor,
+                                        daemon=True))
     for t in threads:
         t.start()
-    for t in threads:
+    for t in threads[:len(procs)]:
         t.join()
+    all_done.set()
+    # Join the monitor BEFORE closing the coordinator: it reads
+    # coord.failed_rank and must never race the native destroy.
+    for t in threads[len(procs):]:
+        t.join()
+    if coord is not None:
+        coord.close()
 
     if cancelled.is_set():
         job_lib.set_status(job_id, job_lib.JobStatus.CANCELLED, home)

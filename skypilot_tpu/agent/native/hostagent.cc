@@ -1,0 +1,498 @@
+// Host-agent core: gang membership, rank barrier, heartbeat failure
+// detection over TCP. The native replacement for the coordination slice of
+// Ray that the reference leans on (STRICT_SPREAD placement-group ready +
+// node liveness; reference: sky/backends/cloud_vm_ray_backend.py:361-505).
+//
+// One coordinator runs next to the gang driver (head host); one client runs
+// in each host's job wrapper. Protocol: fixed 16-byte little-endian
+// messages over TCP:
+//   { uint32 magic; uint32 type; int32 rank; int32 arg; }
+// Types: REGISTER(1: rank), ACK(2), BARRIER_REQ(3: generation),
+//        BARRIER_REL(4: generation), HEARTBEAT(5), FAIL(6: failed rank).
+//
+// Failure semantics (slice-atomic, reference get_or_fail rc-137): the
+// coordinator declares a rank dead on connection EOF/reset or missed
+// heartbeats, then broadcasts FAIL to every client; blocked barriers
+// return an error and stpu_*_failed_rank() reports the rank.
+//
+// Exposed as a C ABI for ctypes (skypilot_tpu/agent/native.py); a
+// pure-Python protocol twin exists for hosts without a toolchain.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <set>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x53545055;  // "STPU"
+
+enum MsgType : uint32_t {
+  kRegister = 1,
+  kAck = 2,
+  kBarrierReq = 3,
+  kBarrierRel = 4,
+  kHeartbeat = 5,
+  kFail = 6,
+  kGoodbye = 7,  // clean departure: subsequent EOF is not a failure
+};
+
+struct Msg {
+  uint32_t magic;
+  uint32_t type;
+  int32_t rank;
+  int32_t arg;
+};
+
+using Clock = std::chrono::steady_clock;
+
+bool SendAll(int fd, const void* buf, size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool RecvAll(int fd, void* buf, size_t len) {
+  char* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool SendMsg(int fd, uint32_t type, int32_t rank, int32_t arg) {
+  Msg m{kMagic, type, rank, arg};
+  return SendAll(fd, &m, sizeof(m));
+}
+
+// ---------------------------------------------------------------------------
+// Coordinator
+// ---------------------------------------------------------------------------
+
+class Coordinator {
+ public:
+  Coordinator(int port, int num_hosts, int heartbeat_timeout_ms)
+      : num_hosts_(num_hosts),
+        heartbeat_timeout_ms_(heartbeat_timeout_ms),
+        failed_rank_(-1),
+        stop_(false) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, num_hosts + 8) != 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port_ = ntohs(addr.sin_port);
+    accept_thread_ = std::thread(&Coordinator::AcceptLoop, this);
+    monitor_thread_ = std::thread(&Coordinator::MonitorLoop, this);
+  }
+
+  ~Coordinator() {
+    stop_.store(true);
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    if (monitor_thread_.joinable()) monitor_thread_.join();
+    std::vector<std::thread> readers;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (auto& kv : conns_) ::shutdown(kv.second.fd, SHUT_RDWR);
+      readers.swap(reader_threads_);
+    }
+    for (auto& t : readers)
+      if (t.joinable()) t.join();
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : conns_) ::close(kv.second.fd);
+  }
+
+  bool ok() const { return listen_fd_ >= 0; }
+  int port() const { return port_; }
+  int failed_rank() const { return failed_rank_.load(); }
+
+  int registered_count() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return static_cast<int>(conns_.size());
+  }
+
+  // Blocks until all hosts registered, a failure, or timeout.
+  // 0 = ready; -1 = timeout; -2-r = rank r failed.
+  int WaitReady(int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    bool done = cv_.wait_for(
+        lk, std::chrono::milliseconds(timeout_ms), [&] {
+          return failed_rank_.load() >= 0 ||
+                 static_cast<int>(conns_.size()) == num_hosts_;
+        });
+    int fr = failed_rank_.load();
+    if (fr >= 0) return -2 - fr;
+    if (!done) return -1;
+    return 0;
+  }
+
+ private:
+  struct Conn {
+    int fd;
+    Clock::time_point last_heartbeat;
+  };
+
+  void AcceptLoop() {
+    while (!stop_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (stop_.load()) return;
+        if (errno == EINTR) continue;
+        return;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(mu_);
+      reader_threads_.emplace_back(&Coordinator::ReaderLoop, this, fd);
+    }
+  }
+
+  void ReaderLoop(int fd) {
+    Msg m{};
+    if (!RecvAll(fd, &m, sizeof(m)) || m.magic != kMagic ||
+        m.type != kRegister) {
+      ::close(fd);
+      return;
+    }
+    int rank = m.rank;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (rank < 0 || rank >= num_hosts_ || conns_.count(rank)) {
+        ::close(fd);
+        return;
+      }
+      conns_[rank] = Conn{fd, Clock::now()};
+    }
+    SendMsg(fd, kAck, rank, 0);
+    cv_.notify_all();
+    while (!stop_.load()) {
+      if (!RecvAll(fd, &m, sizeof(m)) || m.magic != kMagic) {
+        if (!stop_.load()) DeclareFailed(rank);
+        return;
+      }
+      if (m.type == kHeartbeat) {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto it = conns_.find(rank);
+        if (it != conns_.end()) it->second.last_heartbeat = Clock::now();
+      } else if (m.type == kBarrierReq) {
+        OnBarrierReq(rank, m.arg);
+      } else if (m.type == kGoodbye) {
+        // Clean departure (host's command finished): stop tracking;
+        // EOF that follows is not a failure.
+        std::lock_guard<std::mutex> lk(mu_);
+        auto it = conns_.find(rank);
+        if (it != conns_.end()) {
+          ::close(it->second.fd);
+          conns_.erase(it);
+        }
+        return;
+      }
+    }
+  }
+
+  void OnBarrierReq(int rank, int gen) {
+    std::lock_guard<std::mutex> lk(mu_);
+    // Set semantics: a retried BARRIER_REQ from the same rank must not
+    // double-count (matches the Python twin).
+    barrier_waiters_[gen].insert(rank);
+    if (static_cast<int>(barrier_waiters_[gen].size()) == num_hosts_) {
+      for (auto& kv : conns_) SendMsg(kv.second.fd, kBarrierRel, -1, gen);
+      barrier_waiters_.erase(gen);
+    }
+  }
+
+  void MonitorLoop() {
+    while (!stop_.load()) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(
+          std::min(heartbeat_timeout_ms_ / 4 + 1, 500)));
+      if (heartbeat_timeout_ms_ <= 0) continue;
+      int dead = -1;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto now = Clock::now();
+        for (auto& kv : conns_) {
+          auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                        now - kv.second.last_heartbeat)
+                        .count();
+          if (ms > heartbeat_timeout_ms_) {
+            dead = kv.first;
+            break;
+          }
+        }
+      }
+      if (dead >= 0) DeclareFailed(dead);
+    }
+  }
+
+  void DeclareFailed(int rank) {
+    int expected = -1;
+    if (!failed_rank_.compare_exchange_strong(expected, rank)) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : conns_) {
+      if (kv.first != rank) SendMsg(kv.second.fd, kFail, rank, 0);
+    }
+    cv_.notify_all();
+  }
+
+  int num_hosts_;
+  int heartbeat_timeout_ms_;
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<int> failed_rank_;
+  std::atomic<bool> stop_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<int, Conn> conns_;
+  std::map<int, std::set<int>> barrier_waiters_;
+  std::vector<std::thread> reader_threads_;
+  std::thread accept_thread_;
+  std::thread monitor_thread_;
+};
+
+// ---------------------------------------------------------------------------
+// Client
+// ---------------------------------------------------------------------------
+
+class Client {
+ public:
+  Client(const char* host, int port, int rank, int timeout_ms,
+         int heartbeat_interval_ms)
+      : rank_(rank),
+        heartbeat_interval_ms_(heartbeat_interval_ms),
+        failed_rank_(-1),
+        registered_(false),
+        stop_(false) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      Close();
+      return;
+    }
+    auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+    while (::connect(fd_, reinterpret_cast<sockaddr*>(&addr),
+                     sizeof(addr)) != 0) {
+      ::close(fd_);
+      if (Clock::now() >= deadline) {
+        fd_ = -1;
+        return;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    }
+    int one = 1;
+    ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (!SendMsg(fd_, kRegister, rank_, 0)) {
+      Close();
+      return;
+    }
+    reader_thread_ = std::thread(&Client::ReaderLoop, this);
+    {
+      // Registration ack gates success.
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_.wait_until(lk, deadline,
+                     [&] { return registered_ || fd_ < 0; });
+      if (!registered_) {
+        lk.unlock();
+        Close();
+        return;
+      }
+    }
+    heartbeat_thread_ = std::thread(&Client::HeartbeatLoop, this);
+  }
+
+  ~Client() {
+    stop_.store(true);
+    if (fd_ >= 0) SendMsg(fd_, kGoodbye, rank_, 0);
+    Close();
+    if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
+    if (reader_thread_.joinable()) reader_thread_.join();
+  }
+
+  bool ok() const { return fd_ >= 0; }
+  int failed_rank() const { return failed_rank_.load(); }
+
+  // Dirty close — no goodbye; the coordinator will declare this rank
+  // failed (test hook simulating host death).
+  void Abort() { Close(); }
+
+  // 0 = released; -1 = timeout/disconnect; -2-r = rank r failed.
+  int Barrier(int gen, int timeout_ms) {
+    if (fd_ < 0) return -1;
+    if (!SendMsg(fd_, kBarrierReq, rank_, gen)) return -1;
+    std::unique_lock<std::mutex> lk(mu_);
+    bool done = cv_.wait_for(
+        lk, std::chrono::milliseconds(timeout_ms), [&] {
+          return released_.count(gen) > 0 || failed_rank_.load() >= 0 ||
+                 fd_ < 0;
+        });
+    // A released barrier is a success even if a failure arrived right
+    // after: all ranks did reach this generation.
+    if (released_.count(gen)) return 0;
+    int fr = failed_rank_.load();
+    if (fr >= 0) return -2 - fr;
+    if (!done) return -1;
+    return -1;
+  }
+
+ private:
+  void Close() {
+    int fd = fd_;
+    fd_ = -1;
+    if (fd >= 0) {
+      ::shutdown(fd, SHUT_RDWR);
+      ::close(fd);
+    }
+    cv_.notify_all();
+  }
+
+  void ReaderLoop() {
+    Msg m{};
+    while (!stop_.load() && fd_ >= 0) {
+      if (!RecvAll(fd_, &m, sizeof(m)) || m.magic != kMagic) {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (fd_ >= 0 && !stop_.load()) {
+          // Coordinator vanished: treat as gang failure, rank unknown.
+          int expected = -1;
+          failed_rank_.compare_exchange_strong(expected, INT32_MAX);
+        }
+        cv_.notify_all();
+        return;
+      }
+      std::lock_guard<std::mutex> lk(mu_);
+      if (m.type == kAck) {
+        registered_ = true;
+      } else if (m.type == kBarrierRel) {
+        released_.insert(m.arg);
+      } else if (m.type == kFail) {
+        int expected = -1;
+        failed_rank_.compare_exchange_strong(expected, m.rank);
+      }
+      cv_.notify_all();
+    }
+  }
+
+  void HeartbeatLoop() {
+    while (!stop_.load() && fd_ >= 0) {
+      if (!SendMsg(fd_, kHeartbeat, rank_, 0)) return;
+      std::this_thread::sleep_for(
+          std::chrono::milliseconds(heartbeat_interval_ms_));
+    }
+  }
+
+  int rank_;
+  int heartbeat_interval_ms_;
+  std::atomic<int> fd_{-1};
+  std::atomic<int> failed_rank_;
+  bool registered_;
+  std::atomic<bool> stop_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::set<int> released_;
+  std::thread reader_thread_;
+  std::thread heartbeat_thread_;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* stpu_coord_create(int port, int num_hosts,
+                        int heartbeat_timeout_ms) {
+  auto* c = new Coordinator(port, num_hosts, heartbeat_timeout_ms);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+int stpu_coord_port(void* h) {
+  return static_cast<Coordinator*>(h)->port();
+}
+
+int stpu_coord_wait_ready(void* h, int timeout_ms) {
+  return static_cast<Coordinator*>(h)->WaitReady(timeout_ms);
+}
+
+int stpu_coord_registered_count(void* h) {
+  return static_cast<Coordinator*>(h)->registered_count();
+}
+
+int stpu_coord_failed_rank(void* h) {
+  return static_cast<Coordinator*>(h)->failed_rank();
+}
+
+void stpu_coord_destroy(void* h) { delete static_cast<Coordinator*>(h); }
+
+void* stpu_client_connect(const char* host, int port, int rank,
+                          int timeout_ms, int heartbeat_interval_ms) {
+  auto* c = new Client(host, port, rank, timeout_ms,
+                       heartbeat_interval_ms);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+int stpu_client_barrier(void* h, int gen, int timeout_ms) {
+  return static_cast<Client*>(h)->Barrier(gen, timeout_ms);
+}
+
+int stpu_client_failed_rank(void* h) {
+  return static_cast<Client*>(h)->failed_rank();
+}
+
+void stpu_client_abort(void* h) { static_cast<Client*>(h)->Abort(); }
+
+void stpu_client_destroy(void* h) { delete static_cast<Client*>(h); }
+
+}  // extern "C"

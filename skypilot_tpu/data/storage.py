@@ -168,9 +168,12 @@ class LocalStore(AbstractStore):
                 f"cp -r {q(str(self.bucket_dir))}/. {q(dst)}/")
 
     def mount_fuse_command(self, dst: str) -> str:
+        # rm -rf first: if dst already exists as a real directory,
+        # `ln -s` would create the link *inside* it at the wrong path.
+        # (On a symlink, rm -rf removes only the link.)
         q = shlex.quote
-        return (f"mkdir -p $(dirname {q(dst)}) && "
-                f"ln -sfn {q(str(self.bucket_dir))} {q(dst)}")
+        return (f"mkdir -p $(dirname {q(dst)}) && rm -rf {q(dst)} && "
+                f"ln -s {q(str(self.bucket_dir))} {q(dst)}")
 
 
 _STORE_CLASSES = {

@@ -1,0 +1,213 @@
+"""Native host-agent core: barrier, heartbeat failure detection, clean
+departure — for BOTH the C++ library (built with g++ on first use) and the
+pure-Python protocol twin, which must interoperate.
+
+Reference analog: the coordination behaviors the reference gets from Ray
+placement groups + node liveness (cloud_vm_ray_backend.py:296-505); TSAN
+note in SURVEY §5 — the C++ core is also exercised here under load.
+"""
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu.agent import native
+from skypilot_tpu.agent.native import _PyClient, _PyCoordinator
+
+
+def _native_pair():
+    if not native.native_available():
+        pytest.skip("no g++ toolchain for the native agent")
+    return native._NativeCoordinator, native._NativeClient
+
+
+IMPLS = [
+    pytest.param("native", id="native"),
+    pytest.param("python", id="python"),
+]
+
+
+def _impl(kind):
+    if kind == "native":
+        return _native_pair()
+    return _PyCoordinator, _PyClient
+
+
+@pytest.mark.parametrize("kind", IMPLS)
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_barrier_all_ranks(kind):
+    Coordinator, Client = _impl(kind)
+    coord = Coordinator(4, heartbeat_timeout_ms=5000)
+    results = {}
+
+    def worker(rank):
+        c = Client("127.0.0.1", coord.port, rank, timeout_ms=5000)
+        results[rank] = c.barrier(0, timeout_ms=5000)
+        c.close()
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    coord.close()
+    assert results == {0: 0, 1: 0, 2: 0, 3: 0}
+
+
+@pytest.mark.parametrize("kind", IMPLS)
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_barrier_blocks_until_all_arrive(kind):
+    """A host must not pass the barrier before the slowest host is up."""
+    Coordinator, Client = _impl(kind)
+    coord = Coordinator(2, heartbeat_timeout_ms=5000)
+    t_done = {}
+
+    def fast():
+        c = Client("127.0.0.1", coord.port, 0, timeout_ms=5000)
+        assert c.barrier(0, timeout_ms=5000) == 0
+        t_done[0] = time.time()
+        c.close()
+
+    th = threading.Thread(target=fast)
+    th.start()
+    time.sleep(0.6)  # slow host arrives late
+    c1 = Client("127.0.0.1", coord.port, 1, timeout_ms=5000)
+    t1_start = time.time()
+    assert c1.barrier(0, timeout_ms=5000) == 0
+    th.join()
+    c1.close()
+    coord.close()
+    assert t_done[0] >= t1_start - 0.05  # rank 0 released only after 1
+
+
+@pytest.mark.parametrize("kind", IMPLS)
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_dead_rank_fails_barrier_and_gang(kind):
+    Coordinator, Client = _impl(kind)
+    coord = Coordinator(3, heartbeat_timeout_ms=3000)
+    clients = [Client("127.0.0.1", coord.port, r, timeout_ms=5000)
+               for r in range(3)]
+    assert coord.wait_ready(5000) == 0
+    clients[1].abort()  # dirty death, no goodbye
+    assert clients[0].barrier(1, timeout_ms=5000) == -3  # -2 - rank1
+    assert coord.failed_rank == 1
+    assert clients[2].failed_rank == 1
+    for c in clients:
+        c.close()
+    coord.close()
+
+
+@pytest.mark.parametrize("kind", IMPLS)
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_clean_goodbye_is_not_failure(kind):
+    Coordinator, Client = _impl(kind)
+    coord = Coordinator(2, heartbeat_timeout_ms=2000)
+    c0 = Client("127.0.0.1", coord.port, 0, timeout_ms=5000)
+    c1 = Client("127.0.0.1", coord.port, 1, timeout_ms=5000)
+    assert coord.wait_ready(5000) == 0
+    c0.close()  # clean departure
+    time.sleep(1.0)
+    assert coord.failed_rank == -1
+    assert c1.failed_rank == -1
+    c1.close()
+    coord.close()
+
+
+@pytest.mark.parametrize("kind", IMPLS)
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_wait_ready_times_out_without_all_hosts(kind):
+    Coordinator, Client = _impl(kind)
+    coord = Coordinator(2, heartbeat_timeout_ms=5000)
+    c0 = Client("127.0.0.1", coord.port, 0, timeout_ms=5000)
+    assert coord.wait_ready(300) == -1
+    assert coord.registered_count == 1
+    c0.close()
+    coord.close()
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_native_and_python_interoperate():
+    """Mixed gang: native coordinator, python client (and vice versa) —
+    same wire protocol."""
+    if not native.native_available():
+        pytest.skip("no g++ toolchain")
+    coord = native._NativeCoordinator(2, heartbeat_timeout_ms=5000)
+    results = {}
+
+    def py_worker():
+        c = _PyClient("127.0.0.1", coord.port, 0, timeout_ms=5000)
+        results["py"] = c.barrier(0, timeout_ms=5000)
+        c.close()
+
+    def native_worker():
+        c = native._NativeClient("127.0.0.1", coord.port, 1,
+                                 timeout_ms=5000)
+        results["native"] = c.barrier(0, timeout_ms=5000)
+        c.close()
+
+    ts = [threading.Thread(target=py_worker),
+          threading.Thread(target=native_worker)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    coord.close()
+    assert results == {"py": 0, "native": 0}
+
+    coord = _PyCoordinator(1, heartbeat_timeout_ms=5000)
+    c = native._NativeClient("127.0.0.1", coord.port, 0, timeout_ms=5000)
+    assert c.barrier(0, timeout_ms=5000) == 0
+    c.close()
+    coord.close()
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_heartbeat_timeout_detects_hang():
+    """A rank that stops heartbeating (hung host) is declared failed even
+    though its connection stays open."""
+    coord = _PyCoordinator(2, heartbeat_timeout_ms=800)
+    c0 = _PyClient("127.0.0.1", coord.port, 0, timeout_ms=5000,
+                   heartbeat_interval_ms=200)
+    c1 = _PyClient("127.0.0.1", coord.port, 1, timeout_ms=5000,
+                   heartbeat_interval_ms=200)
+    assert coord.wait_ready(5000) == 0
+    c1._stop = True  # freeze rank 1's heartbeat thread, socket stays open
+    deadline = time.time() + 5
+    while coord.failed_rank < 0 and time.time() < deadline:
+        time.sleep(0.1)
+    assert coord.failed_rank == 1
+    assert c0.failed_rank == 1
+    c0.close()
+    c1.close()
+    coord.close()
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_gang_exec_uses_barrier_for_synchronized_start(tmp_path):
+    """End-to-end: a 3-host local gang starts all hosts within a tight
+    window even when the driver staggers process creation."""
+    import time as time_mod
+
+    from skypilot_tpu import execution
+    from skypilot_tpu.agent import job_lib
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+
+    task = Task("barriercheck",
+                run="date +%s.%N > ~/start_ts; sleep 0.2", num_nodes=3)
+    task.set_resources(Resources(cloud="local"))
+    job_id, handle = execution.launch(task, cluster_name="t-barrier",
+                                      detach_run=True, stream_logs=False)
+    deadline = time_mod.time() + 60
+    while time_mod.time() < deadline:
+        job = job_lib.get_job(job_id, home=handle.head_home)
+        if job and job_lib.JobStatus(job["status"]).is_terminal():
+            break
+        time_mod.sleep(0.2)
+    assert job["status"] == "SUCCEEDED"
+    stamps = []
+    for inst in handle.cluster_info.ordered_instances():
+        stamps.append(float(
+            open(inst.tags["host_dir"] + "/start_ts").read().strip()))
+    spread = max(stamps) - min(stamps)
+    assert spread < 2.0, f"start spread too wide: {stamps}"
