@@ -52,13 +52,20 @@ def _build_lib() -> Optional[pathlib.Path]:
     so_path = out_dir / f"libstpu_agent_{src_mtime}.so"
     if so_path.exists():
         return so_path
+    # pid-unique temp: concurrent first-use builds must not interleave
+    # g++ output or clobber each other's os.replace.
+    tmp_path = f"{so_path}.{os.getpid()}.tmp"
     proc = subprocess.run(
         ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-         "-o", str(so_path) + ".tmp", str(_SRC), "-lpthread"],
+         "-o", tmp_path, str(_SRC), "-lpthread"],
         capture_output=True, text=True)
     if proc.returncode != 0:
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
         return None
-    os.replace(str(so_path) + ".tmp", so_path)
+    os.replace(tmp_path, so_path)
     return so_path
 
 
@@ -70,13 +77,22 @@ def _load_lib() -> Optional[ctypes.CDLL]:
         _lib_tried = True
         if os.environ.get("STPU_FORCE_PY_AGENT"):
             return None
+        so_path = None
         try:
             so_path = _build_lib()
+            if so_path is None:
+                return None
+            lib = ctypes.CDLL(str(so_path))
         except (OSError, subprocess.SubprocessError):
-            so_path = None
-        if so_path is None:
+            # Corrupt/unloadable artifact: fall back to the Python twin
+            # rather than surfacing a spurious gang failure — and remove
+            # the bad cache entry so the next run rebuilds it.
+            if so_path is not None:
+                try:
+                    os.unlink(so_path)
+                except OSError:
+                    pass
             return None
-        lib = ctypes.CDLL(str(so_path))
         lib.stpu_coord_create.restype = ctypes.c_void_p
         lib.stpu_coord_create.argtypes = [ctypes.c_int, ctypes.c_int,
                                           ctypes.c_int]
@@ -203,7 +219,9 @@ class _PyCoordinator:
         self._barrier_waiters: Dict[int, set] = {}
         self._listen = socket.socket()
         self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listen.bind(("0.0.0.0", port))
+        # Loopback only (matches hostagent.cc): the protocol is
+        # unauthenticated; remote hosts come in via SSH reverse tunnel.
+        self._listen.bind(("127.0.0.1", port))
         self._listen.listen(num_hosts + 8)
         self.port = self._listen.getsockname()[1]
         threading.Thread(target=self._accept_loop, daemon=True).start()
@@ -258,10 +276,15 @@ class _PyCoordinator:
                              daemon=True).start()
 
     def _reader_loop(self, conn: socket.socket) -> None:
-        msg = _recv_msg(conn)
+        conn.settimeout(10.0)  # bound the registration read
+        try:
+            msg = _recv_msg(conn)
+        except OSError:
+            msg = None
         if msg is None or msg[0] != _REGISTER:
             conn.close()
             return
+        conn.settimeout(None)  # liveness is heartbeat-based from here on
         rank = msg[1]
         with self._cond:
             if rank < 0 or rank >= self.num_hosts or rank in self._conns:

@@ -41,6 +41,7 @@
 namespace {
 
 constexpr uint32_t kMagic = 0x53545055;  // "STPU"
+constexpr int kRegisterTimeoutSec = 10;
 
 enum MsgType : uint32_t {
   kRegister = 1,
@@ -110,7 +111,10 @@ class Coordinator {
     ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
-    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    // Loopback only: local hosts and SSH hosts both reach the coordinator
+    // via 127.0.0.1 (reverse tunnel, gang_exec.py); the protocol is
+    // unauthenticated so it must not be reachable from the network.
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
     addr.sin_port = htons(static_cast<uint16_t>(port));
     if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
                sizeof(addr)) != 0 ||
@@ -136,12 +140,16 @@ class Coordinator {
     {
       std::lock_guard<std::mutex> lk(mu_);
       for (auto& kv : conns_) ::shutdown(kv.second.fd, SHUT_RDWR);
+      // Connections that never completed REGISTER would otherwise park a
+      // reader in RecvAll forever and deadlock the joins below.
+      for (int fd : pending_fds_) ::shutdown(fd, SHUT_RDWR);
       readers.swap(reader_threads_);
     }
     for (auto& t : readers)
       if (t.joinable()) t.join();
     std::lock_guard<std::mutex> lk(mu_);
     for (auto& kv : conns_) ::close(kv.second.fd);
+    for (int fd : pending_fds_) ::close(fd);
   }
 
   bool ok() const { return listen_fd_ >= 0; }
@@ -184,27 +192,44 @@ class Coordinator {
       }
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      // A peer that connects but never sends REGISTER must not hold a
+      // reader forever: bound the registration read.
+      timeval tv{};
+      tv.tv_sec = kRegisterTimeoutSec;
+      ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
       std::lock_guard<std::mutex> lk(mu_);
+      pending_fds_.insert(fd);
       reader_threads_.emplace_back(&Coordinator::ReaderLoop, this, fd);
     }
+  }
+
+  void DropPending(int fd) {
+    std::lock_guard<std::mutex> lk(mu_);
+    pending_fds_.erase(fd);
   }
 
   void ReaderLoop(int fd) {
     Msg m{};
     if (!RecvAll(fd, &m, sizeof(m)) || m.magic != kMagic ||
         m.type != kRegister) {
+      DropPending(fd);
       ::close(fd);
       return;
     }
     int rank = m.rank;
     {
       std::lock_guard<std::mutex> lk(mu_);
+      pending_fds_.erase(fd);
       if (rank < 0 || rank >= num_hosts_ || conns_.count(rank)) {
         ::close(fd);
         return;
       }
       conns_[rank] = Conn{fd, Clock::now()};
     }
+    // Registered: post-registration reads are bounded by heartbeats, not
+    // the socket timeout.
+    timeval tv{};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     SendMsg(fd, kAck, rank, 0);
     cv_.notify_all();
     while (!stop_.load()) {
@@ -285,6 +310,7 @@ class Coordinator {
   std::mutex mu_;
   std::condition_variable cv_;
   std::map<int, Conn> conns_;
+  std::set<int> pending_fds_;  // accepted, not yet registered
   std::map<int, std::set<int>> barrier_waiters_;
   std::vector<std::thread> reader_threads_;
   std::thread accept_thread_;
@@ -380,13 +406,19 @@ class Client {
 
  private:
   void Close() {
-    int fd = fd_;
-    fd_ = -1;
+    int fd;
+    {
+      // Hold mu_ across the state change + notify so a Barrier() waiter
+      // can't evaluate its predicate between them and miss the wakeup.
+      std::lock_guard<std::mutex> lk(mu_);
+      fd = fd_;
+      fd_ = -1;
+      cv_.notify_all();
+    }
     if (fd >= 0) {
       ::shutdown(fd, SHUT_RDWR);
       ::close(fd);
     }
-    cv_.notify_all();
   }
 
   void ReaderLoop() {

@@ -88,7 +88,10 @@ def cancel(job_ids: Optional[List[int]] = None,
         pid = job.get("controller_pid")
         # CANCELLING is observed by the controller at its next poll even
         # if it never received our signal (e.g. pid not yet recorded).
-        jobs_state.set_status(job["job_id"], ManagedJobStatus.CANCELLING)
+        # Conditional: a controller that just reached a terminal status
+        # must keep it — and such a job needs no cancelling at all.
+        if not jobs_state.set_cancelling(job["job_id"]):
+            continue
         if pid:
             try:
                 os.kill(pid, signal.SIGTERM)
@@ -116,7 +119,10 @@ def _finalize_dead_controller(job: Dict[str, Any]) -> None:
             except Exception:  # noqa: BLE001 — already gone
                 global_user_state.remove_cluster(cluster_name,
                                                  terminate=True)
-    jobs_state.set_status(job["job_id"], ManagedJobStatus.CANCELLED)
+    # Conditional: the controller may have exited normally between our
+    # queue() snapshot and the kill — a just-reached SUCCEEDED/FAILED
+    # must not be overwritten with CANCELLED.
+    jobs_state.finalize_status(job["job_id"], ManagedJobStatus.CANCELLED)
 
 
 def tail_logs(job_id: Optional[int] = None, follow: bool = True) -> int:

@@ -120,6 +120,41 @@ def set_status(job_id: int, status: ManagedJobStatus,
                 (status.value, job_id))
 
 
+def set_cancelling(job_id: int) -> bool:
+    """Move a job to CANCELLING unless it already reached a terminal
+    status (the controller may finish between the caller's queue()
+    snapshot and this write). Returns True iff the row was updated."""
+    with _conn() as conn:
+        cur = conn.execute(
+            "UPDATE managed_jobs SET status=? "
+            "WHERE job_id=? AND status NOT IN (%s)" %
+            ",".join("?" * len(_TERMINAL)),
+            (ManagedJobStatus.CANCELLING.value, job_id,
+             *[s.value for s in _TERMINAL]))
+        return cur.rowcount > 0
+
+
+def finalize_status(job_id: int, status: ManagedJobStatus,
+                    failure_reason: Optional[str] = None) -> bool:
+    """Set a terminal status only if the job is not already terminal.
+
+    Used when finalizing a dead controller: if the controller exited
+    normally between the caller's queue() snapshot and the signal (job
+    just reached SUCCEEDED/FAILED), that terminal status must win.
+    Returns True iff the row was updated.
+    """
+    assert status.is_terminal(), status
+    with _conn() as conn:
+        cur = conn.execute(
+            "UPDATE managed_jobs SET status=?, end_at=?, "
+            "failure_reason=COALESCE(?, failure_reason) "
+            "WHERE job_id=? AND status NOT IN (%s)" %
+            ",".join("?" * len(_TERMINAL)),
+            (status.value, time.time(), failure_reason, job_id,
+             *[s.value for s in _TERMINAL]))
+        return cur.rowcount > 0
+
+
 def set_recovering(job_id: int) -> None:
     with _conn() as conn:
         conn.execute(

@@ -50,6 +50,9 @@ class ReplicaInfo:
         self.launched_at = time.time()
         self.first_ready_at: Optional[float] = None
         self.consecutive_failures = 0
+        # In-flight _launch_replica thread; _terminate_replica joins it so
+        # teardown never races a half-finished execution.launch.
+        self.launch_thread: Optional[threading.Thread] = None
 
 
 class SkyPilotReplicaManager:
@@ -86,6 +89,7 @@ class SkyPilotReplicaManager:
             self._persist(info)
             t = threading.Thread(target=self._launch_replica,
                                  args=(info,), daemon=True)
+            info.launch_thread = t
             t.start()
             self._threads.append(t)
 
@@ -153,6 +157,14 @@ class SkyPilotReplicaManager:
 
     def _terminate_replica(self, info: ReplicaInfo,
                            keep_record: bool = False) -> None:
+        # Never tear down under a replica whose launch is still in flight:
+        # execution.launch would finish re-creating the cluster after our
+        # teardown and leak it (the replica is popped below, so nothing
+        # would track it). SHUTTING_DOWN is already set, so waiting is
+        # safe and the launch epilogue won't flip the status back.
+        lt = info.launch_thread
+        if lt is not None and lt is not threading.current_thread():
+            lt.join()
         record = global_user_state.get_cluster_from_name(info.cluster_name)
         if record is not None and record["handle"] is not None:
             try:
@@ -277,6 +289,12 @@ class SkyPilotReplicaManager:
         return [i.replica_id for i in alive]
 
     def _persist(self, info: ReplicaInfo) -> None:
-        serve_state.upsert_replica(self.service_name, info.replica_id,
-                                   info.cluster_name, info.status,
-                                   info.url)
+        # Membership check + upsert under one lock hold (RLock): a
+        # straggler probe racing _terminate_replica's pop/remove must not
+        # re-insert the deleted row after the check passes.
+        with self._lock:
+            if info.replica_id not in self.replicas:
+                return
+            serve_state.upsert_replica(self.service_name, info.replica_id,
+                                       info.cluster_name, info.status,
+                                       info.url)

@@ -91,6 +91,10 @@ def test_dead_rank_fails_barrier_and_gang(kind):
     clients[1].abort()  # dirty death, no goodbye
     assert clients[0].barrier(1, timeout_ms=5000) == -3  # -2 - rank1
     assert coord.failed_rank == 1
+    # The FAIL broadcast reaches client 2's reader asynchronously.
+    deadline = time.time() + 5
+    while clients[2].failed_rank < 0 and time.time() < deadline:
+        time.sleep(0.05)
     assert clients[2].failed_rank == 1
     for c in clients:
         c.close()
@@ -123,6 +127,45 @@ def test_wait_ready_times_out_without_all_hosts(kind):
     assert coord.registered_count == 1
     c0.close()
     coord.close()
+
+
+@pytest.mark.parametrize("kind", IMPLS)
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_stray_connection_does_not_hang_close(kind):
+    """A peer that connects but never registers (port scanner, health
+    check) must not leave a reader blocked forever: close() returns
+    promptly and registered hosts still work."""
+    import socket as socket_mod
+
+    Coordinator, Client = _impl(kind)
+    coord = Coordinator(2, heartbeat_timeout_ms=5000)
+    stray = socket_mod.create_connection(("127.0.0.1", coord.port))
+    c0 = Client("127.0.0.1", coord.port, 0, timeout_ms=5000)
+    c1 = Client("127.0.0.1", coord.port, 1, timeout_ms=5000)
+    assert coord.wait_ready(5000) == 0
+    c0.close()
+    c1.close()
+    t0 = time.time()
+    coord.close()  # must not join a reader stuck on the stray fd
+    assert time.time() - t0 < 5.0
+    stray.close()
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_coordinator_binds_loopback_only():
+    """The unauthenticated protocol must not be reachable from the
+    network: both implementations bind 127.0.0.1."""
+    import socket as socket_mod
+
+    for Coordinator, _ in (_impl("python"),) + (
+            (_impl("native"),) if native.native_available() else ()):
+        coord = Coordinator(1, heartbeat_timeout_ms=5000)
+        hostname_ip = socket_mod.gethostbyname(socket_mod.gethostname())
+        if hostname_ip != "127.0.0.1":
+            with pytest.raises(OSError):
+                socket_mod.create_connection((hostname_ip, coord.port),
+                                             timeout=1).close()
+        coord.close()
 
 
 @pytest.mark.usefixtures("tmp_state_dir")

@@ -131,6 +131,23 @@ def test_managed_pipeline_chain(tmp_path):
 
 
 @pytest.mark.usefixtures("tmp_state_dir")
+def test_finalize_status_does_not_clobber_terminal():
+    """Finalizing a dead controller must not overwrite a terminal status
+    the controller reached between snapshot and kill."""
+    job_id = jobs_state.add_job("fin", "/dev/null", "local", 1)
+    jobs_state.set_status(job_id, ManagedJobStatus.SUCCEEDED)
+    assert not jobs_state.finalize_status(job_id,
+                                          ManagedJobStatus.CANCELLED)
+    assert jobs_state.get_status(job_id) == ManagedJobStatus.SUCCEEDED
+    # A non-terminal job IS finalized.
+    job_id2 = jobs_state.add_job("fin2", "/dev/null", "local", 1)
+    jobs_state.set_status(job_id2, ManagedJobStatus.RUNNING)
+    assert jobs_state.finalize_status(job_id2,
+                                      ManagedJobStatus.CANCELLED)
+    assert jobs_state.get_status(job_id2) == ManagedJobStatus.CANCELLED
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
 def test_jobs_queue_lists_jobs():
     task = Task("mj-q", run="echo q")
     task.set_resources(_local_res())
