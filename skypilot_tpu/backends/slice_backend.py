@@ -183,6 +183,8 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
         info = res.slice_info()
         provider_config: Dict[str, Any] = {
             "num_slices": task.num_nodes,
+            "region": res.region,
+            "zone": res.zone,
             "accelerator": res.accelerator,
             "instance_type": res.instance_type,
             "runtime_version": res.tpu_runtime_version,

@@ -1,37 +1,78 @@
 """Credential probing: which providers can we actually use?
 
-Reference analog: sky/check.py (check:18 — probes each cloud, persists
-the enabled set to the state DB).
+Reference analog: sky/check.py (check:18 — probes each cloud's
+credentials AND its per-capability readiness, persists the enabled set to
+the state DB so the optimizer only plans over reachable clouds). Here a
+"cloud" is a provision provider; each probe returns (ok, reason) and the
+enabled set is persisted via global_user_state.set_enabled_clouds.
 """
 from __future__ import annotations
 
 import shutil
 import subprocess
-from typing import List
-
-from skypilot_tpu import global_user_state
+from typing import Callable, Dict, List, Tuple
 
 
-def _gcp_ok() -> bool:
-    """True if gcloud credentials (or ADC) appear usable."""
+def _probe_local() -> Tuple[bool, str]:
+    return True, "hermetic provider (always available)"
+
+
+def _probe_gcp() -> Tuple[bool, str]:
+    """Usable = gcloud exists + active credentials + a project is set.
+
+    The TPU API itself is only reachable with network access; like the
+    reference we treat credential presence as 'enabled' and surface API
+    errors at provision time with failover semantics."""
     if shutil.which("gcloud") is None:
-        return False
+        return False, "gcloud CLI not installed"
     try:
         proc = subprocess.run(
             ["gcloud", "auth", "list",
              "--filter=status:ACTIVE", "--format=value(account)"],
             capture_output=True, text=True, timeout=20)
-        return proc.returncode == 0 and bool(proc.stdout.strip())
-    except (subprocess.SubprocessError, OSError):
-        return False
+        if proc.returncode != 0 or not proc.stdout.strip():
+            return False, ("no active gcloud credentials "
+                           "(run `gcloud auth login`)")
+        proc = subprocess.run(
+            ["gcloud", "config", "get-value", "project"],
+            capture_output=True, text=True, timeout=20)
+        project = proc.stdout.strip()
+        if proc.returncode != 0 or not project or project == "(unset)":
+            return False, ("no GCP project configured "
+                           "(run `gcloud config set project ...`)")
+        return True, f"project {project}"
+    except (subprocess.SubprocessError, OSError) as e:
+        return False, f"gcloud probe failed: {e}"
+
+
+_PROBES: Dict[str, Callable[[], Tuple[bool, str]]] = {
+    "local": _probe_local,
+    "gcp": _probe_gcp,
+}
 
 
 def check(quiet: bool = False) -> List[str]:
-    enabled = ["local"]  # the hermetic provider always works
-    if _gcp_ok():
-        enabled.append("gcp")
-    elif not quiet:
-        print("GCP: no active gcloud credentials "
-              "(run `gcloud auth login`); TPU provisioning disabled.")
+    """Probe every provider, persist and return the enabled set."""
+    from skypilot_tpu import global_user_state
+    enabled = []
+    for name, probe in _PROBES.items():
+        ok, reason = probe()
+        if ok:
+            enabled.append(name)
+        if not quiet:
+            mark = "✓" if ok else "✗"
+            print(f"  {mark} {name}: {reason}")
     global_user_state.set_enabled_clouds(enabled)
+    if not quiet:
+        print(f"Enabled providers: {', '.join(enabled) or '(none)'}")
     return enabled
+
+
+def get_cached_enabled_clouds() -> List[str]:
+    """Enabled set from the last `check` run (state DB); runs a fresh
+    check if none has ever been persisted."""
+    from skypilot_tpu import global_user_state
+    cached = global_user_state.get_enabled_clouds()
+    if cached:
+        return cached
+    return check(quiet=True)

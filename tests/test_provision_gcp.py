@@ -1,0 +1,318 @@
+"""Hermetic tests for the GCP TPU provisioner.
+
+All HTTP is intercepted at ``gcp.rest`` by an in-memory fake of the Cloud
+TPU v2 API (nodes + queuedResources), so these cover the full SPI —
+create / wait / query / stop-refusal / terminate / preemption / failover
+error parsing — with zero credentials, the way the reference's dryrun
+harness fakes all clouds (tests/common.py:11 enable_all_clouds).
+"""
+from __future__ import annotations
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import gcp
+
+ZONE = "us-east5-a"
+PARENT = f"projects/testproj/locations/{ZONE}"
+
+
+class FakeTpuService:
+    """In-memory twin of tpu.googleapis.com/v2 nodes + queuedResources."""
+
+    def __init__(self):
+        self.nodes = {}            # node_id -> node dict
+        self.queued = {}           # qr_id -> qr dict
+        self.calls = []            # (method, path)
+        self.create_error = None   # (status, body) to inject on create
+        self.hosts_per_node = 1    # networkEndpoints fan-out
+
+    # -- helpers -------------------------------------------------------
+    def _endpoints(self, n):
+        return [{"ipAddress": f"10.0.0.{i+1}",
+                 "accessConfig": {"externalIp": f"34.0.0.{i+1}"}}
+                for i in range(n)]
+
+    def make_ready(self, node_id=None):
+        for nid, node in self.nodes.items():
+            if node_id in (None, nid):
+                node["state"] = "READY"
+        for qr in self.queued.values():
+            qr["state"] = {"state": "ACTIVE"}
+
+    def preempt(self, node_id=None):
+        for nid, node in self.nodes.items():
+            if node_id in (None, nid):
+                node["state"] = "PREEMPTED"
+
+    # -- the rest() twin ----------------------------------------------
+    def __call__(self, method, path, body=None, params=None):
+        self.calls.append((method, path))
+        params = params or {}
+        if method == "POST" and path.endswith("/nodes"):
+            if self.create_error:
+                raise gcp.GcpApiError(*self.create_error,
+                                      context="create node")
+            nid = params["nodeId"]
+            self.nodes[nid] = dict(
+                body, name=f"{PARENT}/nodes/{nid}", state="CREATING",
+                networkEndpoints=self._endpoints(self.hosts_per_node))
+            return {"name": f"{PARENT}/operations/op-{nid}"}
+        if method == "POST" and path.endswith("/queuedResources"):
+            if self.create_error:
+                raise gcp.GcpApiError(*self.create_error,
+                                      context="create qr")
+            qid = params["queuedResourceId"]
+            spec = body["tpu"]["nodeSpec"][0]
+            nid = spec["nodeId"]
+            self.queued[qid] = {
+                "name": f"{PARENT}/queuedResources/{qid}",
+                "state": {"state": "PROVISIONING"}}
+            self.nodes[nid] = dict(
+                spec["node"], name=f"{PARENT}/nodes/{nid}",
+                state="CREATING",
+                networkEndpoints=self._endpoints(self.hosts_per_node))
+            return {"name": f"{PARENT}/operations/op-{qid}"}
+        if method == "GET" and path.endswith("/nodes"):
+            return {"nodes": list(self.nodes.values())}
+        if method == "GET" and path.endswith("/queuedResources"):
+            return {"queuedResources": list(self.queued.values())}
+        if method == "POST" and path.endswith(":start"):
+            nid = path.rsplit("/", 1)[-1].split(":")[0]
+            self.nodes[nid]["state"] = "READY"
+            return {}
+        if method == "POST" and path.endswith(":stop"):
+            nid = path.rsplit("/", 1)[-1].split(":")[0]
+            self.nodes[nid]["state"] = "STOPPED"
+            return {}
+        if method == "DELETE" and "/nodes/" in path:
+            nid = path.rsplit("/", 1)[-1]
+            if nid not in self.nodes:
+                raise gcp.GcpApiError(404, {"error": {
+                    "status": "NOT_FOUND", "message": "no node"}})
+            del self.nodes[nid]
+            return {}
+        if method == "DELETE" and "/queuedResources/" in path:
+            qid = path.rsplit("/", 1)[-1]
+            self.queued.pop(qid, None)
+            return {}
+        raise AssertionError(f"unexpected call {method} {path}")
+
+
+@pytest.fixture()
+def fake(monkeypatch):
+    svc = FakeTpuService()
+    monkeypatch.setattr(gcp, "rest", svc)
+    monkeypatch.setattr(gcp, "_gcloud_project", lambda: "testproj")
+    return svc
+
+
+def _config(accelerator="tpu-v5e-8", hosts_per_slice=1, num_slices=1,
+            **kw):
+    base = dict(accelerator=accelerator, hosts_per_slice=hosts_per_slice,
+                num_slices=num_slices,
+                runtime_version="v2-alpha-tpuv5-lite",
+                use_spot=False, project_id="testproj", zone=ZONE)
+    base.update(kw)
+    return base
+
+
+# ---------------------------------------------------------------- create
+def test_create_single_host_uses_node_api(fake):
+    rec = gcp.run_instances("us-east5", ZONE, "c1", _config())
+    assert rec.created_instance_ids == ["c1-s0"]
+    assert ("POST", f"{PARENT}/nodes") in fake.calls
+    assert not any("queuedResources" in p for _, p in fake.calls)
+    node = fake.nodes["c1-s0"]
+    assert node["acceleratorType"] == "v5litepod-8"
+    assert node["labels"]["stpu-cluster"] == "c1"
+
+
+def test_create_pod_uses_queued_resources(fake):
+    fake.hosts_per_node = 4
+    rec = gcp.run_instances(
+        "us-east5", ZONE, "c1",
+        _config(accelerator="tpu-v5e-16", hosts_per_slice=4))
+    assert rec.created_instance_ids == ["c1-s0"]
+    assert ("POST", f"{PARENT}/queuedResources") in fake.calls
+    assert "c1-s0" in fake.queued
+
+
+def test_multislice_creates_one_node_per_slice(fake):
+    gcp.run_instances("us-east5", ZONE, "c1", _config(num_slices=3))
+    assert set(fake.nodes) == {"c1-s0", "c1-s1", "c1-s2"}
+
+
+def test_accelerator_type_translation():
+    assert gcp._gcp_accelerator_type("tpu-v4-8") == "v4-16"
+    assert gcp._gcp_accelerator_type("tpu-v5e-16") == "v5litepod-16"
+    assert gcp._gcp_accelerator_type("tpu-v5p-64") == "v5p-64"
+    assert gcp._gcp_accelerator_type("tpu-v6e-8") == "v6e-8"
+
+
+def test_spot_sets_scheduling_config(fake):
+    gcp.run_instances("us-east5", ZONE, "c1", _config(use_spot=True))
+    assert fake.nodes["c1-s0"]["schedulingConfig"] == {
+        "preemptible": True}
+
+
+# ------------------------------------------------------------------ wait
+def test_wait_returns_when_ready(fake, monkeypatch):
+    monkeypatch.setattr(gcp, "_POLL_INTERVAL_SECONDS", 0)
+    gcp.run_instances("us-east5", ZONE, "c1", _config(zone=ZONE))
+    fake.make_ready()
+    monkeypatch.setattr(
+        gcp, "_zone_project_from_state", lambda name: (ZONE, "testproj"))
+    gcp.wait_instances("us-east5", "c1", "running")  # no raise
+
+
+def test_wait_raises_blocklist_on_failed_queued_resource(fake,
+                                                         monkeypatch):
+    monkeypatch.setattr(gcp, "_POLL_INTERVAL_SECONDS", 0)
+    fake.hosts_per_node = 4
+    gcp.run_instances("us-east5", ZONE, "c1",
+                      _config(accelerator="tpu-v5e-16", hosts_per_slice=4))
+    fake.queued["c1-s0"]["state"] = {"state": "FAILED"}
+    monkeypatch.setattr(
+        gcp, "_zone_project_from_state", lambda name: (ZONE, "testproj"))
+    with pytest.raises(exceptions.ProvisionError) as exc:
+        gcp.wait_instances("us-east5", "c1", "running")
+    assert exc.value.blocklist_zone == ZONE
+
+
+# ----------------------------------------------------------------- query
+def test_query_maps_states_per_host(fake):
+    fake.hosts_per_node = 2
+    gcp.run_instances("us-east5", ZONE, "c1",
+                      _config(accelerator="tpu-v5e-8", hosts_per_slice=2))
+    fake.make_ready()
+    statuses = gcp.query_instances("c1", _config())
+    assert statuses == {"c1-s0-w0": "running", "c1-s0-w1": "running"}
+    fake.preempt()
+    statuses = gcp.query_instances("c1", _config())
+    assert set(statuses.values()) == {"preempted"}
+
+
+def test_query_ignores_other_clusters(fake):
+    gcp.run_instances("us-east5", ZONE, "c1", _config())
+    gcp.run_instances("us-east5", ZONE, "c2", _config())
+    assert set(gcp.query_instances("c1", _config())) == {"c1-s0-w0"}
+
+
+# ---------------------------------------------------------- cluster info
+def test_get_cluster_info_rank_order(fake):
+    fake.hosts_per_node = 4
+    gcp.run_instances("us-east5", ZONE, "c1",
+                      _config(accelerator="tpu-v5e-16", hosts_per_slice=4))
+    fake.make_ready()
+    info = gcp.get_cluster_info("us-east5", "c1", _config())
+    insts = info.ordered_instances()
+    assert [i.instance_id for i in insts] == [
+        f"c1-s0-w{i}" for i in range(4)]
+    assert [i.internal_ip for i in insts] == [
+        f"10.0.0.{i+1}" for i in range(4)]
+    assert insts[0].external_ip == "34.0.0.1"
+    assert info.head_instance_id == "c1-s0-w0"
+
+
+# ---------------------------------------------------------- stop / down
+def test_stop_single_host(fake):
+    gcp.run_instances("us-east5", ZONE, "c1", _config())
+    fake.make_ready()
+    gcp.stop_instances("c1", _config())
+    assert fake.nodes["c1-s0"]["state"] == "STOPPED"
+
+
+def test_stop_refused_for_pod(fake):
+    fake.hosts_per_node = 4
+    gcp.run_instances("us-east5", ZONE, "c1",
+                      _config(accelerator="tpu-v5e-16", hosts_per_slice=4))
+    fake.make_ready()
+    with pytest.raises(exceptions.NotSupportedError):
+        gcp.stop_instances("c1", _config())
+
+
+def test_resume_stopped_node_calls_start(fake):
+    gcp.run_instances("us-east5", ZONE, "c1", _config())
+    fake.nodes["c1-s0"]["state"] = "STOPPED"
+    rec = gcp.run_instances("us-east5", ZONE, "c1", _config())
+    assert rec.resumed_instance_ids == ["c1-s0"]
+    assert fake.nodes["c1-s0"]["state"] == "READY"
+
+
+def test_rerun_is_idempotent_while_ready(fake):
+    gcp.run_instances("us-east5", ZONE, "c1", _config())
+    fake.make_ready()
+    rec = gcp.run_instances("us-east5", ZONE, "c1", _config())
+    assert rec.created_instance_ids == []
+    assert rec.resumed_instance_ids == ["c1-s0"]
+
+
+def test_preempted_husk_recreated(fake):
+    """Spot slice preempted → husk deleted and a fresh slice created
+    (reference: need_cleanup_after_preemption, sky/resources.py:595)."""
+    gcp.run_instances("us-east5", ZONE, "c1", _config(use_spot=True))
+    fake.preempt()
+    rec = gcp.run_instances("us-east5", ZONE, "c1", _config(use_spot=True))
+    assert rec.created_instance_ids == ["c1-s0"]
+    assert fake.nodes["c1-s0"]["state"] == "CREATING"
+
+
+def test_terminate_deletes_nodes_and_queued(fake):
+    fake.hosts_per_node = 4
+    gcp.run_instances("us-east5", ZONE, "c1",
+                      _config(accelerator="tpu-v5e-16", hosts_per_slice=4))
+    gcp.terminate_instances("c1", _config())
+    assert fake.nodes == {}
+    assert fake.queued == {}
+    assert gcp.query_instances("c1", _config()) == {}
+
+
+# -------------------------------------------------------- error parsing
+def _err(status, code, message):
+    return (status, {"error": {"status": code, "code": code,
+                               "message": message}})
+
+
+def test_stockout_blocklists_zone(fake):
+    fake.create_error = _err(
+        429, "RESOURCE_EXHAUSTED",
+        f'There is no more capacity in the zone "{ZONE}"')
+    with pytest.raises(exceptions.ProvisionError) as exc:
+        gcp.run_instances("us-east5", ZONE, "c1", _config())
+    assert exc.value.blocklist_zone == ZONE
+    assert exc.value.blocklist_region is None
+
+
+def test_region_quota_blocklists_region(fake):
+    fake.create_error = _err(
+        429, "RESOURCE_EXHAUSTED",
+        "Quota 'TPUV5sPodPerProjectPerRegionForTPUAPI' exhausted. "
+        "Limit 32 in region us-east5")
+    with pytest.raises(exceptions.ProvisionError) as exc:
+        gcp.run_instances("us-east5", ZONE, "c1", _config())
+    assert exc.value.blocklist_region == "us-east5"
+
+
+def test_preempted_during_creation_blocklists_zone(fake):
+    fake.create_error = (400, {"error": {
+        "code": 3,
+        "message": "update is not supported while in state PREEMPTED"}})
+    with pytest.raises(exceptions.ProvisionError) as exc:
+        gcp.run_instances("us-east5", ZONE, "c1", _config())
+    assert exc.value.blocklist_zone == ZONE
+
+
+def test_permission_denied_raises_no_access(fake):
+    fake.create_error = _err(403, "PERMISSION_DENIED",
+                             "Cloud TPU API has not been used")
+    with pytest.raises(exceptions.NoCloudAccessError):
+        gcp.run_instances("us-east5", ZONE, "c1", _config())
+
+
+def test_transient_error_retryable_in_zone(fake):
+    fake.create_error = _err(503, "UNAVAILABLE", "backend unavailable")
+    with pytest.raises(exceptions.ProvisionError) as exc:
+        gcp.run_instances("us-east5", ZONE, "c1", _config())
+    assert exc.value.retryable_in_zone
+    assert exc.value.blocklist_zone is None
