@@ -53,6 +53,10 @@ def _build_env(spec: Dict, rank: int) -> Dict[str, str]:
     if spec.get("num_slices", 1) > 1:
         env[constants.MEGASCALE_COORDINATOR] = \
             f"{ips[0]}:{constants.COORDINATOR_PORT + 1}"
+    if host.get("kind") == "local":
+        # Simulated slice hosts have no /dev/accel*; the TPU health gate
+        # (host_wrapper) only makes sense on real TPU VMs.
+        env["STPU_SKIP_HEALTH_PROBE"] = "1"
     env.update(spec.get("envs", {}))
     return env
 

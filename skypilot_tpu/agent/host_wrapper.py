@@ -36,6 +36,35 @@ def main() -> int:
     coord_addr = os.environ.get(constants.GANG_COORD_ADDR)
     rank = int(os.environ.get(constants.NODE_RANK, "0"))
 
+    # Topology/health gate (the nvidia-smi analog): a host with missing
+    # TPU devices fails the gang deterministically BEFORE the barrier
+    # instead of hanging the collective later. Probe result is recorded
+    # for the daemon/debugging.
+    expected_chips = int(
+        os.environ.get(constants.NUM_CHIPS_PER_NODE, "0") or 0)
+    if expected_chips > 0 and \
+            os.environ.get("STPU_SKIP_HEALTH_PROBE") != "1":
+        from skypilot_tpu.agent import tpu_health
+        report = tpu_health.probe(expected_chips)
+        try:
+            tpu_health.write_report(report)
+        except OSError:
+            pass
+        if not report["ok"]:
+            print(f"[wrapper rank {rank}] TPU health check failed: "
+                  f"{report['detail']}", file=sys.stderr, flush=True)
+            if coord_addr:
+                from skypilot_tpu.agent import native
+                host, port = coord_addr.rsplit(":", 1)
+                try:
+                    bad = native.Client(host, int(port), rank,
+                                        timeout_ms=5000)
+                    bad.abort()
+                    bad.close()
+                except OSError:
+                    pass
+            return GANG_FAILED_RC
+
     client = None
     if coord_addr:
         from skypilot_tpu.agent import native

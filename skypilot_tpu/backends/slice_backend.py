@@ -213,14 +213,79 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
         return handle
 
     def _post_provision_setup(self, handle: SliceHandle) -> None:
-        """Wait for SSH + install the agent runtime on real clouds.
-
-        Local provider hosts are plain dirs — nothing to wait for."""
+        """Wait for SSH + install the agent runtime on real clouds; for
+        local-provider hosts (plain dirs) just record the cluster identity
+        and start the head daemon in-place."""
         if handle.provider_name == "local":
+            head_home = handle.head_home
+            if head_home is not None:
+                self._write_cluster_identity(handle, head_home)
+                self._start_local_daemon(head_home)
             return
         from skypilot_tpu.provision import provisioner
         provisioner.wait_for_ssh(handle.cluster_info)
-        provisioner.setup_agent_runtime(handle.cluster_info)
+        provisioner.setup_agent_runtime(handle.cluster_info,
+                                        self._cluster_identity(handle))
+
+    def _cluster_identity(self, handle: SliceHandle) -> Dict[str, Any]:
+        """The daemon's view of who it is + how to stop itself
+        (agent/daemon.py cluster.json)."""
+        res = handle.launched_resources
+        sinfo = res.slice_info()
+        identity: Dict[str, Any] = {
+            "cluster_name": handle.cluster_name,
+            "provider_name": handle.provider_name,
+            "provider_config": handle.cluster_info.provider_config,
+            "chips_per_host": sinfo.chips_per_host if sinfo else 0,
+            # Whether the daemon's host holds the job DB (and can thus
+            # observe idleness for autostop). True for the local provider,
+            # whose "head host" home is where gang_exec records jobs.
+            "job_db_on_host": handle.provider_name == "local",
+        }
+        if handle.provider_name == "local":
+            # provision.local resolves cluster metadata under the
+            # client's STPU_HOME; the daemon needs the same root.
+            identity["stpu_home"] = str(paths.home())
+        return identity
+
+    def _write_cluster_identity(self, handle: SliceHandle,
+                                head_home: str) -> None:
+        agent_dir = pathlib.Path(head_home) / ".stpu_agent"
+        agent_dir.mkdir(parents=True, exist_ok=True)
+        (agent_dir / "cluster.json").write_text(
+            json.dumps(self._cluster_identity(handle), indent=2))
+
+    @staticmethod
+    def _start_local_daemon(head_home: str) -> None:
+        """Spawn the head daemon detached, once (skylet analog). Disabled
+        via STPU_DISABLE_DAEMON=1 (hermetic tests that don't exercise
+        autostop)."""
+        if os.environ.get("STPU_DISABLE_DAEMON") == "1":
+            return
+        pid_path = pathlib.Path(head_home) / ".stpu_agent" / "daemon.pid"
+        if pid_path.exists():
+            try:
+                os.kill(int(pid_path.read_text().strip()), 0)
+                return  # already running
+            except (OSError, ValueError):
+                pass
+        cmd = [sys.executable, "-m", "skypilot_tpu.agent.daemon",
+               "--home", head_home]
+        interval = os.environ.get("STPU_DAEMON_INTERVAL")
+        if interval:
+            cmd += ["--interval", interval]
+        subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                         stderr=subprocess.DEVNULL, start_new_session=True)
+
+    @staticmethod
+    def _kill_local_daemon(head_home: Optional[str]) -> None:
+        if head_home is None:
+            return
+        pid_path = pathlib.Path(head_home) / ".stpu_agent" / "daemon.pid"
+        try:
+            os.kill(int(pid_path.read_text().strip()), 15)
+        except (OSError, ValueError):
+            pass
 
     def _restart_cluster(self, handle: SliceHandle) -> SliceHandle:
         provider = handle.provider_name
@@ -459,6 +524,7 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
                     job_lib.cancel_jobs(None, home=handle.head_home)
                 except Exception:
                     pass
+                self._kill_local_daemon(handle.head_home)
             try:
                 if terminate:
                     provision_api.terminate_instances(
@@ -489,5 +555,23 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
 
     def set_autostop(self, handle: SliceHandle, idle_minutes: int,
                      down: bool = False) -> None:
+        """Record autostop client-side AND ship it to the head daemon,
+        which enforces it (reference: AutostopCodeGen over SSH feeding
+        skylet's AutostopEvent, sky/skylet/autostop_lib.py:55)."""
         global_user_state.set_cluster_autostop(
             handle.cluster_name, idle_minutes, down)
+        cfg = json.dumps({"idle_minutes": idle_minutes, "down": down,
+                          "set_at": time.time()})
+        head_home = handle.head_home
+        if head_home is not None:
+            agent_dir = pathlib.Path(head_home) / ".stpu_agent"
+            agent_dir.mkdir(parents=True, exist_ok=True)
+            (agent_dir / "autostop.json").write_text(cfg)
+            return
+        import shlex
+        runner = handle.get_command_runners()[0]
+        rc = runner.run(
+            "mkdir -p ~/.stpu_agent && "
+            f"printf '%s' {shlex.quote(cfg)} > ~/.stpu_agent/autostop.json")
+        runner.check_returncode(rc, "set_autostop",
+                                f"host {handle.cluster_name}")

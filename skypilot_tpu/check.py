@@ -66,13 +66,3 @@ def check(quiet: bool = False) -> List[str]:
     if not quiet:
         print(f"Enabled providers: {', '.join(enabled) or '(none)'}")
     return enabled
-
-
-def get_cached_enabled_clouds() -> List[str]:
-    """Enabled set from the last `check` run (state DB); runs a fresh
-    check if none has ever been persisted."""
-    from skypilot_tpu import global_user_state
-    cached = global_user_state.get_enabled_clouds()
-    if cached:
-        return cached
-    return check(quiet=True)

@@ -301,18 +301,25 @@ def _create_slice(project: str, zone: str, cluster_name: str,
              params={"nodeId": node_id})
 
 
-def _list_cluster_nodes(project: str, zone: str,
-                        cluster_name: str) -> Dict[str, dict]:
+def _list_cluster_nodes(project: str, zone: str, cluster_name: str,
+                        lenient_auth: bool = True) -> Dict[str, dict]:
     """All TPU nodes of this cluster in the zone, keyed by short node id.
 
     Server-side filtering is not supported for labels on the nodes.list
     API, so filter client-side like the reference
-    (instance_utils.py:1285-1303)."""
+    (instance_utils.py:1285-1303). ``lenient_auth`` maps 403/404 to "no
+    nodes" (status queries must not crash on unauthorized regions,
+    reference :1270-1276); destructive paths pass False so a credential
+    failure cannot masquerade as a successful teardown."""
     try:
         resp = rest("GET", f"{_parent(project, zone)}/nodes")
     except GcpApiError as e:
-        if e.status in (403, 404):
+        if e.status == 404 or (lenient_auth and e.status == 403):
             return {}
+        if e.status == 403:
+            raise exceptions.NoCloudAccessError(
+                f"TPU API access denied listing nodes in {zone}: "
+                f"{e.message}") from e
         raise
     out = {}
     for node in resp.get("nodes", []):
@@ -494,5 +501,6 @@ def terminate_instances(cluster_name: str, provider_config: dict) -> None:
             zone, project = _zone_project_from_state(cluster_name)
         except exceptions.ProvisionError:
             return  # nothing recorded → nothing to clean
-    for node_id in _list_cluster_nodes(project, zone, cluster_name):
+    for node_id in _list_cluster_nodes(project, zone, cluster_name,
+                                       lenient_auth=False):
         _delete_node(project, zone, node_id)

@@ -9,9 +9,10 @@ of a slice boot together, so there is no autoscaler-style staggered join.
 from __future__ import annotations
 
 import concurrent.futures as cf
+import json
 import subprocess
 import time
-from typing import List
+from typing import List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.provision.common import ClusterInfo
@@ -20,12 +21,13 @@ from skypilot_tpu.utils import command_runner as runner_lib
 SSH_WAIT_TIMEOUT_SECONDS = 300
 
 # Commands that bring up the on-host runtime. The wheel is rsynced by
-# setup_agent_runtime; the agent daemon is started under nohup, one per
-# host, with the head running the job DB.
+# setup_agent_runtime; the agent daemon (skypilot_tpu/agent/daemon.py,
+# the skylet analog) is started under nohup on the head host, which also
+# runs the job DB and enforces autostop.
 _AGENT_START_CMD = (
     "mkdir -p ~/.stpu_agent && "
     "nohup python3 -m skypilot_tpu.agent.daemon "
-    "  > ~/.stpu_agent/daemon.log 2>&1 & "
+    "  > ~/.stpu_agent/daemon.out 2>&1 & "
     "echo started")
 
 
@@ -66,20 +68,35 @@ def wait_for_ssh(info: ClusterInfo,
             retryable_in_zone=True)
 
 
-def setup_agent_runtime(info: ClusterInfo) -> None:
-    """Ship the framework wheel + start the host agent on all hosts in
-    parallel (reference: instance_setup.setup_runtime_on_cluster:173 +
-    start_skylet_on_head_node:407)."""
+def setup_agent_runtime(info: ClusterInfo,
+                        cluster_identity: Optional[dict] = None) -> None:
+    """Ship the framework wheel, record the cluster identity, and start
+    the head daemon — all hosts in parallel (reference:
+    instance_setup.setup_runtime_on_cluster:173 +
+    start_skylet_on_head_node:407). ``cluster_identity`` is the daemon's
+    cluster.json (who am I + provider config for self-stop)."""
+    import shlex
+
     from skypilot_tpu.utils import wheel_utils
     wheel_path = wheel_utils.build_wheel()
     instances = info.ordered_instances()
+    identity_json = json.dumps(cluster_identity or {
+        "cluster_name": info.cluster_name,
+        "provider_name": info.provider_name,
+        "provider_config": info.provider_config,
+    })
 
     def bring_up(inst):
         runner = _ssh_runner(info, inst)
         runner.rsync(str(wheel_path), "~/.stpu_wheels/", up=True)
-        rc = runner.run(
-            "pip install -q --user ~/.stpu_wheels/*.whl && "
-            + _AGENT_START_CMD)
+        cmd = ("pip install -q --user ~/.stpu_wheels/*.whl && "
+               "mkdir -p ~/.stpu_agent && "
+               f"printf '%s' {shlex.quote(identity_json)} "
+               "> ~/.stpu_agent/cluster.json")
+        # Only the head runs the daemon (job DB + autostop live there).
+        if inst.instance_id == info.head_instance_id:
+            cmd += " && " + _AGENT_START_CMD
+        rc = runner.run(cmd)
         runner.check_returncode(rc, "agent bring-up",
                                 f"host {inst.instance_id}")
     with cf.ThreadPoolExecutor(max_workers=min(32,

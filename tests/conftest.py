@@ -22,6 +22,10 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Don't spawn the on-host daemon for every local cluster the suite
+# launches; daemon/autostop tests opt back in via monkeypatch.
+os.environ.setdefault("STPU_DISABLE_DAEMON", "1")
+
 import pytest  # noqa: E402
 
 

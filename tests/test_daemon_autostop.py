@@ -1,0 +1,176 @@
+"""On-host daemon (skylet analog): autostop enforcement + job
+reconciliation, end-to-end on the local provider.
+
+The headline behavior (VERDICT r1 item 3): a cluster launched with
+``-i 0`` stops ITSELF after its job finishes, with zero client
+involvement — the daemon is a detached process, exactly like the
+reference's AutostopEvent (sky/skylet/events.py:90).
+"""
+import json
+import os
+import pathlib
+import time
+
+import pytest
+
+from skypilot_tpu import core, execution, global_user_state
+from skypilot_tpu.agent import daemon as daemon_lib
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.agent import tpu_health
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.task import Task
+
+
+def _local_res():
+    return Resources(cloud="local")
+
+
+def _wait(pred, timeout=20, interval=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def live_daemon(tmp_state_dir, monkeypatch):
+    """Enable the real detached daemon with a fast event loop."""
+    monkeypatch.setenv("STPU_DISABLE_DAEMON", "0")
+    monkeypatch.setenv("STPU_DAEMON_INTERVAL", "0.2")
+    yield tmp_state_dir
+
+
+# --------------------------------------------------------------- e2e
+def test_autostop_stops_idle_cluster_without_client(live_daemon):
+    """launch -i 0 → job finishes → cluster reaches STOPPED by itself."""
+    task = Task("quick", run="echo done")
+    task.set_resources(_local_res())
+    job_id, handle = execution.launch(
+        task, cluster_name="t-auto", detach_run=True, stream_logs=False,
+        idle_minutes_to_autostop=0)
+    # Daemon process exists on the head host.
+    pid_path = pathlib.Path(handle.head_home) / ".stpu_agent" / \
+        "daemon.pid"
+    assert _wait(pid_path.exists)
+
+    # No further client calls: the daemon notices idleness and stops the
+    # cluster via the provider API.
+    from skypilot_tpu.provision import local as local_provider
+
+    def provider_stopped():
+        statuses = local_provider.query_instances("t-auto", {})
+        return statuses and set(statuses.values()) == {"stopped"}
+    assert _wait(provider_stopped, timeout=30), \
+        "daemon never stopped the idle cluster"
+
+    # Client discovers it through normal status refresh (provider truth).
+    records = core.status(["t-auto"], refresh=True)
+    assert records[0]["status"] == ClusterStatus.STOPPED
+    # Daemon exits once its cluster is down.
+    assert _wait(lambda: not pid_path.exists(), timeout=10)
+
+
+def test_autostop_down_terminates_cluster(live_daemon):
+    """-i 0 --down → the cluster removes itself entirely."""
+    task = Task("quick", run="echo done")
+    task.set_resources(_local_res())
+    _, handle = execution.launch(
+        task, cluster_name="t-down", detach_run=True, stream_logs=False,
+        idle_minutes_to_autostop=0, down=True)
+    cluster_dir = pathlib.Path(handle.head_home).parent
+    assert _wait(lambda: not cluster_dir.exists(), timeout=30), \
+        "daemon never terminated the idle cluster"
+    records = core.status(["t-down"], refresh=True)
+    assert records == [] or records[0]["status"] is None
+
+
+def test_no_autostop_without_config(live_daemon):
+    """Without -i the daemon must leave the cluster alone."""
+    task = Task("quick", run="echo done")
+    task.set_resources(_local_res())
+    _, handle = execution.launch(
+        task, cluster_name="t-stay", detach_run=True, stream_logs=False)
+    pid_path = pathlib.Path(handle.head_home) / ".stpu_agent" / \
+        "daemon.pid"
+    assert _wait(pid_path.exists)
+    time.sleep(1.5)  # several daemon ticks
+    from skypilot_tpu.provision import local as local_provider
+    statuses = local_provider.query_instances("t-stay", {})
+    assert set(statuses.values()) == {"running"}
+    core.down("t-stay")
+    assert _wait(lambda: not pid_path.exists(), timeout=10)
+
+
+# ------------------------------------------------- in-process daemon units
+def _make_agent_home(tmp_path, cluster="c1"):
+    home = tmp_path / "host0"
+    agent = home / ".stpu_agent"
+    agent.mkdir(parents=True)
+    (agent / "cluster.json").write_text(json.dumps({
+        "cluster_name": cluster, "provider_name": "local",
+        "stpu_home": os.environ.get("STPU_HOME", str(tmp_path / ".stpu")),
+    }))
+    return home
+
+
+def test_daemon_waits_while_job_running(tmp_state_dir, tmp_path):
+    home = _make_agent_home(tmp_path)
+    (home / ".stpu_agent" / "autostop.json").write_text(
+        json.dumps({"idle_minutes": 0, "down": False,
+                    "set_at": time.time() - 60}))
+    jid = job_lib.add_job("j", "u", "ts", "", home=str(home))
+    job_lib.set_status(jid, job_lib.JobStatus.RUNNING, home=str(home))
+    d = daemon_lib.Daemon(home=str(home), interval=0.1)
+    assert d.check_autostop() is False  # busy cluster: no stop
+
+    job_lib.set_status(jid, job_lib.JobStatus.SUCCEEDED, home=str(home))
+    # With idle_minutes=5 it must NOT fire right after the job ends:
+    # the recent end_at resets the idle clock.
+    (home / ".stpu_agent" / "autostop.json").write_text(
+        json.dumps({"idle_minutes": 5, "down": False,
+                    "set_at": time.time() - 600}))
+    assert d.check_autostop() is False
+
+
+def test_daemon_reconciles_dead_gang_driver(tmp_state_dir, tmp_path):
+    """RUNNING job whose driver pid is gone → FAILED (skylet's job-state
+    reconciliation)."""
+    home = _make_agent_home(tmp_path)
+    jid = job_lib.add_job("j", "u", "ts", "", home=str(home))
+    job_lib.set_status(jid, job_lib.JobStatus.RUNNING, home=str(home))
+    job_lib.set_pid(jid, 2 ** 22 + 12345, home=str(home))  # surely dead
+    d = daemon_lib.Daemon(home=str(home), interval=0.1)
+    d.reconcile_jobs()
+    assert job_lib.get_job(jid, home=str(home))["status"] == "FAILED"
+
+
+def test_daemon_leaves_live_jobs_alone(tmp_state_dir, tmp_path):
+    home = _make_agent_home(tmp_path)
+    jid = job_lib.add_job("j", "u", "ts", "", home=str(home))
+    job_lib.set_status(jid, job_lib.JobStatus.RUNNING, home=str(home))
+    job_lib.set_pid(jid, os.getpid(), home=str(home))  # alive
+    d = daemon_lib.Daemon(home=str(home), interval=0.1)
+    d.reconcile_jobs()
+    assert job_lib.get_job(jid, home=str(home))["status"] == "RUNNING"
+
+
+# ----------------------------------------------------------- health probe
+def test_health_probe_cpu_host_passes():
+    report = tpu_health.probe(expected_chips=0)
+    assert report["ok"]
+
+
+def test_health_probe_missing_chips_fails(monkeypatch):
+    monkeypatch.setattr(tpu_health, "count_local_chips", lambda: 0)
+    report = tpu_health.probe(expected_chips=4)
+    assert not report["ok"]
+    assert "expected 4" in report["detail"]
+
+
+def test_health_report_written(tmp_path):
+    path = tpu_health.write_report(tpu_health.probe(0),
+                                   home=str(tmp_path))
+    assert json.loads(path.read_text())["ok"]

@@ -310,6 +310,23 @@ def test_permission_denied_raises_no_access(fake):
         gcp.run_instances("us-east5", ZONE, "c1", _config())
 
 
+def test_terminate_surfaces_auth_failure(fake, monkeypatch):
+    """A 403 while tearing down must NOT read as 'nothing to delete' —
+    the slices would keep billing behind a removed cluster record."""
+    gcp.run_instances("us-east5", ZONE, "c1", _config())
+
+    def deny(method, path, body=None, params=None):
+        if method == "GET" and path.endswith("/nodes"):
+            raise gcp.GcpApiError(403, {"error": {
+                "status": "PERMISSION_DENIED", "message": "denied"}})
+        return fake(method, path, body=body, params=params)
+    monkeypatch.setattr(gcp, "rest", deny)
+    with pytest.raises(exceptions.NoCloudAccessError):
+        gcp.terminate_instances("c1", _config())
+    # Status queries stay lenient: unauthorized region reads as absent.
+    assert gcp.query_instances("c1", _config()) == {}
+
+
 def test_transient_error_retryable_in_zone(fake):
     fake.create_error = _err(503, "UNAVAILABLE", "backend unavailable")
     with pytest.raises(exceptions.ProvisionError) as exc:
