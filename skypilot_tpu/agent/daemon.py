@@ -63,8 +63,13 @@ class Daemon:
 
     def log(self, msg: str) -> None:
         stamp = time.strftime("%Y-%m-%d %H:%M:%S")
-        with open(self.agent_dir / "daemon.log", "a") as f:
-            f.write(f"[{stamp}] {msg}\n")
+        try:
+            with open(self.agent_dir / "daemon.log", "a") as f:
+                f.write(f"[{stamp}] {msg}\n")
+        except OSError:
+            # After autostop --down the terminate path may have deleted
+            # agent_dir itself (local provider); exit quietly.
+            pass
 
     # -------------------------------------------------------------- events
     def reconcile_jobs(self) -> None:

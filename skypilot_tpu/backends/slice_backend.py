@@ -298,6 +298,11 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
         handle.cluster_info = provision_api.get_cluster_info(
             provider, res.region, handle.cluster_name, provider_config)
         self._post_provision_setup(handle)
+        # A restart disables any previous autostop (reference `sky start`
+        # semantics): otherwise the restarted daemon reads the stale
+        # autostop.json, sees only old terminal jobs, and stops the
+        # cluster again while the new job is still being submitted.
+        self.set_autostop(handle, -1, down=False)
         global_user_state.add_or_update_cluster(
             handle.cluster_name, handle=handle, ready=True)
         return handle

@@ -146,6 +146,17 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def lora_dense(y: jax.Array, lp: Params, name: str) -> jax.Array:
+    """y @ W, plus the low-rank LoRA path y @ A @ B when the layer params
+    carry `<name>_lora_a`/`<name>_lora_b` adapters (recipes/llama_lora.py
+    injects them; base checkpoints don't have the keys and skip it)."""
+    out = y @ lp[name]
+    a = lp.get(name + "_lora_a")
+    if a is not None:
+        out = out + (y @ a) @ lp[name + "_lora_b"]
+    return out
+
+
 def attention_block(cfg, x: jax.Array, lp: Params, positions: jax.Array,
                     constrain) -> jax.Array:
     """Pre-norm GQA attention residual block, shared by llama and mixtral.
@@ -156,9 +167,9 @@ def attention_block(cfg, x: jax.Array, lp: Params, positions: jax.Array,
     b, s, d = x.shape
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     y = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (y @ lp["wq"]).reshape(b, s, h, hd)
-    kk = (y @ lp["wk"]).reshape(b, s, kvh, hd)
-    vv = (y @ lp["wv"]).reshape(b, s, kvh, hd)
+    q = lora_dense(y, lp, "wq").reshape(b, s, h, hd)
+    kk = lora_dense(y, lp, "wk").reshape(b, s, kvh, hd)
+    vv = lora_dense(y, lp, "wv").reshape(b, s, kvh, hd)
     q = rope(q, positions, cfg.rope_theta)
     kk = rope(kk, positions, cfg.rope_theta)
     q = constrain(q, ("batch", "act_seq", "heads", None))
@@ -170,7 +181,7 @@ def attention_block(cfg, x: jax.Array, lp: Params, positions: jax.Array,
         attn = attention_ops.attention(q, kk, vv, causal=True,
                                        impl=cfg.attention_impl)
     attn = attn.reshape(b, s, h * hd)
-    return x + constrain(attn @ lp["wo"],
+    return x + constrain(lora_dense(attn, lp, "wo"),
                          ("batch", "act_seq", "act_embed"))
 
 

@@ -483,8 +483,10 @@ def stop_instances(cluster_name: str, provider_config: dict) -> None:
     project = _project_of(provider_config)
     if zone is None:
         zone, project = _zone_project_from_state(cluster_name)
-    for node_id, node in _list_cluster_nodes(project, zone,
-                                             cluster_name).items():
+    # Destructive-path listing: a 403 must raise, not return {} — an empty
+    # loop here would report "stopped" while the nodes keep billing.
+    for node_id, node in _list_cluster_nodes(project, zone, cluster_name,
+                                             lenient_auth=False).items():
         if len(node.get("networkEndpoints") or []) > 1:
             raise exceptions.NotSupportedError(
                 f"TPU pod slice {node_id} cannot be stopped; only "

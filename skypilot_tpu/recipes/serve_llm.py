@@ -1,0 +1,155 @@
+"""Minimal TPU LLM inference server — the JetStream/vLLM-TPU serve config.
+
+Reference analog: llm/vllm/serve.yaml and llm/mixtral/serve.yaml (the
+reference points SkyServe at a vLLM container). Native version: a
+stdlib-http server around models/llama.py greedy decoding, exposing the
+endpoints SkyServe probes and balances:
+
+    GET  /health    -> 200 once the model is compiled (readiness probe)
+    POST /generate  {"prompt": [ids...], "max_tokens": N} -> {"tokens": [...]}
+
+Decoding is a jitted lax.scan over a preallocated KV cache (static shapes,
+one compile per bucket) — the shape a real TPU decode loop takes; batching,
+streaming, and continuous scheduling live above this in SkyServe's LB.
+
+    python -m skypilot_tpu.recipes.serve_llm --model tiny --port 8080
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.train import distributed
+
+
+# Request limits: prompt/decode lengths are padded to buckets so the jit
+# cache stays bounded (≤ len(buckets) × len(mt buckets) compiles) and a
+# hostile request cannot trigger unbounded allocation or a giant scan.
+PROMPT_BUCKET = 64
+MAX_PROMPT_TOKENS = 1024
+MAX_GEN_TOKENS = 256
+GEN_BUCKET = 16
+
+
+def _ceil_to(n: int, b: int) -> int:
+    return ((n + b - 1) // b) * b
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def _greedy_decode(cfg: llama.LlamaConfig, params, buf: jax.Array,
+                   start: jax.Array, mt_pad: int) -> jax.Array:
+    """Greedy continuation over a padded buffer.
+
+    buf: (s_pad + mt_pad,) int32 with the prompt in [0, start); generation
+    writes [start, start + mt_pad). Shapes are bucket sizes and the true
+    prompt length is a dynamic scalar, so all prompts in a bucket share
+    one compile. Recomputes the prefix each step (O(S^2) but simple);
+    serving throughput work (paged KV cache as a Pallas kernel) layers on
+    without changing the HTTP surface.
+    """
+
+    def step(carry, t):
+        buf = carry
+        i = start + t
+        logits = llama.forward(cfg, params, buf[None, :])[0]
+        nxt = jnp.argmax(logits[i - 1]).astype(jnp.int32)
+        buf = buf.at[i].set(nxt)
+        return buf, nxt
+
+    _, toks = jax.lax.scan(step, buf,
+                           jnp.arange(mt_pad, dtype=jnp.int32))
+    return toks
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_ctx = None  # set by serve()
+
+    def log_message(self, *args):
+        pass
+
+    def _json(self, code: int, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path in ("/", "/health"):
+            ready = self.server_ctx["ready"].is_set()
+            self._json(200 if ready else 503,
+                       {"status": "ok" if ready else "warming"})
+        else:
+            self._json(404, {"error": "not found"})
+
+    def do_POST(self):
+        if self.path != "/generate":
+            self._json(404, {"error": "not found"})
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            req = json.loads(self.rfile.read(length) or b"{}")
+            prompt = [int(t) for t in req["prompt"]]
+            if not 1 <= len(prompt) <= MAX_PROMPT_TOKENS:
+                raise ValueError(
+                    f"prompt length must be in [1, {MAX_PROMPT_TOKENS}]")
+            mt = min(max(int(req.get("max_tokens", 16)), 1),
+                     MAX_GEN_TOKENS)
+            ctx = self.server_ctx
+            s = len(prompt)
+            s_pad = _ceil_to(s, PROMPT_BUCKET)
+            mt_pad = _ceil_to(mt, GEN_BUCKET)
+            buf = jnp.zeros((s_pad + mt_pad,), jnp.int32).at[:s].set(
+                jnp.asarray(prompt, dtype=jnp.int32))
+            with ctx["lock"]:
+                toks = _greedy_decode(ctx["cfg"], ctx["params"], buf,
+                                      jnp.int32(s), mt_pad)
+            self._json(200, {"tokens": [int(t) for t in toks[:mt]]})
+        except (KeyError, ValueError, TypeError) as e:
+            self._json(400, {"error": str(e)})
+
+
+def serve(cfg: llama.LlamaConfig, params, port: int,
+          ready_event: threading.Event = None) -> ThreadingHTTPServer:
+    ctx = {"cfg": cfg, "params": params, "lock": threading.Lock(),
+           "ready": ready_event or threading.Event()}
+
+    handler = type("Handler", (_Handler,), {"server_ctx": ctx})
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+
+    def warmup():
+        buf = jnp.zeros((PROMPT_BUCKET + GEN_BUCKET,), jnp.int32)
+        _greedy_decode(cfg, params, buf, jnp.int32(8),
+                       GEN_BUCKET).block_until_ready()
+        ctx["ready"].set()
+
+    threading.Thread(target=warmup, daemon=True).start()
+    return httpd
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", choices=["tiny", "8b"], default="tiny")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    distributed.initialize_from_env()
+    cfg = (llama.LlamaConfig.llama3_8b() if args.model == "8b"
+           else llama.LlamaConfig.tiny())
+    params = llama.init(cfg, jax.random.PRNGKey(args.seed))
+    httpd = serve(cfg, params, args.port)
+    print(f"serve_llm: listening on :{args.port}", flush=True)
+    httpd.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+"""Recipe tree tests: convergence in-process, checkpoint/resume, and the
+2-node DDP recipe end-to-end on the local provider — the first real
+consumer of the SKYPILOT_COORDINATOR_ADDR env contract (reference analog:
+the smoke tests running examples/torch_ddp_benchmark on real clouds)."""
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from skypilot_tpu import execution
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+def test_mnist_converges():
+    from skypilot_tpu.recipes import mnist
+    metrics = mnist.main(["--steps", "120"])
+    assert metrics["test_accuracy"] > 0.8
+
+
+def test_glue_imdb_converges():
+    from skypilot_tpu.recipes import glue_imdb
+    metrics = glue_imdb.main(["--steps", "160"])
+    assert metrics["test_accuracy"] > 0.75
+
+
+def test_mixtral_ep_recipe_runs():
+    from skypilot_tpu.recipes import mixtral_ep
+    metrics = mixtral_ep.main(["--steps", "2", "--batch-size", "2",
+                               "--seq-len", "32"])
+    assert metrics["final_loss"] > 0
+    # The ep axis actually sharded over the virtual 8-device mesh.
+    assert metrics["mesh"]["ep"] > 1
+
+
+def test_llama_lora_checkpoint_resume(tmp_path):
+    from skypilot_tpu.recipes import llama_lora
+    ck = str(tmp_path / "ck")
+    m1 = llama_lora.main(["--model", "tiny", "--steps", "6",
+                          "--save-every", "3", "--batch-size", "2",
+                          "--seq-len", "32", "--checkpoint-dir", ck])
+    assert m1["resumed_from"] == 0
+    assert m1["lora_params"] > 0
+    # Relaunch (the preemption-recovery shape): picks up at step 6.
+    m2 = llama_lora.main(["--model", "tiny", "--steps", "10",
+                          "--save-every", "3", "--batch-size", "2",
+                          "--seq-len", "32", "--checkpoint-dir", ck])
+    assert m2["resumed_from"] == 6
+
+
+def test_serve_llm_endpoints():
+    import jax
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.recipes import serve_llm
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    httpd = serve_llm.serve(cfg, params, 0)  # ephemeral port
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        deadline = time.time() + 120
+        status = None
+        while time.time() < deadline:
+            try:
+                status = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=2).status
+                break
+            except urllib.error.HTTPError as e:
+                status = e.code  # 503 while warming
+            except OSError:
+                pass
+            time.sleep(0.5)
+        assert status == 200
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"prompt": [1, 2, 3], "max_tokens": 4}
+                            ).encode())
+        out = json.loads(urllib.request.urlopen(req, timeout=120).read())
+        assert len(out["tokens"]) == 4
+        # Bad request -> 400, not a crash.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=b'{"nope": 1}')
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=10)
+        assert err.value.code == 400
+    finally:
+        httpd.shutdown()
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_resnet_ddp_two_nodes_end_to_end():
+    """Launch the DDP recipe on 2 local-provider hosts: each host process
+    reads the env contract, rank 1 connects to rank 0's coordination
+    service, gradients are mean-allreduced every step, and both ranks end
+    with bit-identical params."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    task = Task(
+        "ddp2", num_nodes=2,
+        run=(f"{sys.executable} -m skypilot_tpu.recipes.resnet_ddp "
+             f"--steps 3 --tiny --batch-size 4 --out-file ~/ddp_out.json"),
+        envs={"PYTHONPATH": repo_root, "JAX_PLATFORMS": "cpu",
+              # Pytest's conftest exports an 8-device XLA_FLAGS; the host
+              # processes model 1 device per host.
+              "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    task.set_resources(Resources(cloud="local"))
+    job_id, handle = execution.launch(task, cluster_name="t-ddp",
+                                      detach_run=True, stream_logs=False)
+
+    from skypilot_tpu.agent import job_lib
+    from skypilot_tpu.backends import slice_backend
+    backend = slice_backend.SliceBackend()
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        st = backend.job_status(handle, job_id)
+        if st and job_lib.JobStatus(st).is_terminal():
+            break
+        time.sleep(0.5)
+    assert st == "SUCCEEDED", backend.job_status(handle, job_id)
+
+    digests = []
+    for inst in handle.cluster_info.ordered_instances():
+        out = json.load(open(inst.tags["host_dir"] + "/ddp_out.json"))
+        assert out["num_nodes"] == 2
+        digests.append(out["param_digest"])
+    assert digests[0] == digests[1]
