@@ -354,14 +354,15 @@ def serve_status(service_names):
     from skypilot_tpu.serve import core as serve_core
     fmt = "{:<20} {:<16} {:<24} {:<8}"
     click.echo(fmt.format("SERVICE", "STATUS", "ENDPOINT", "#READY"))
+    # serve_core.status() normalizes statuses to plain strings.
     for svc in serve_core.status(list(service_names) or None):
         n_ready = sum(1 for r in svc["replicas"]
-                      if r["status"].value == "READY")
-        click.echo(fmt.format(svc["service_name"], svc["status"].value,
+                      if r["status"] == "READY")
+        click.echo(fmt.format(svc["service_name"], svc["status"],
                               svc["endpoint"], n_ready))
         for r in svc["replicas"]:
             click.echo(f"  replica {r['replica_id']:<3} "
-                       f"{r['status'].value:<14} {r['url'] or '-'}")
+                       f"{r['status']:<14} {r['url'] or '-'}")
 
 
 def main():

@@ -1,13 +1,28 @@
 """Managed jobs SDK: launch/queue/cancel/tail_logs.
 
 Reference analog: sky/jobs/core.py (launch:30 wraps the user DAG into a
-controller task; queue/cancel/tail_logs shell out to the controller via
-codegen). Here the controller is a detached local process
-(`python -m skypilot_tpu.jobs.controller`), and state is read directly
-from the managed-jobs DB.
+controller task launched on the jobs-controller cluster; queue/cancel/
+tail_logs reach the controller via codegen over SSH). Same architecture
+here: by default (`controller mode: cluster`) the job's controller process
+runs **on the stpu-jobs-controller cluster** — the client can exit and
+preemption recovery keeps running — and the client SDK proxies state reads
+through the controller head. `mode: local` keeps the controller as a
+client-local process (controller-logic unit tests, debugging).
+
+This module doubles as the controller-side RPC surface:
+
+    python -m skypilot_tpu.jobs.core submit --dag-yaml P --name N
+    python -m skypilot_tpu.jobs.core queue [--skip-finished]
+    python -m skypilot_tpu.jobs.core cancel (--ids 1,2 | --all)
+    python -m skypilot_tpu.jobs.core status --job-id N
+
+each printing one JSON document (the remote-RPC convention; reference:
+ManagedJobCodeGen, sky/jobs/utils.py).
 """
 from __future__ import annotations
 
+import argparse
+import json
 import os
 import signal
 import subprocess
@@ -22,17 +37,23 @@ from skypilot_tpu.backends import slice_backend
 from skypilot_tpu.jobs import state as jobs_state
 from skypilot_tpu.jobs.state import ManagedJobStatus
 from skypilot_tpu.task import Task
+from skypilot_tpu.utils import controller_utils
 from skypilot_tpu.utils import dag_utils
 from skypilot_tpu.utils import paths
+
+_JOBS = controller_utils.Controllers.JOBS
 
 
 def launch(entrypoint: Union[Task, dag_lib.Dag],
            name: Optional[str] = None,
-           detach: bool = True) -> int:
+           detach: bool = True,
+           controller: Optional[str] = None) -> int:
     """Start a managed job; returns its managed-job id.
 
-    ``detach=False`` runs the controller inline (blocking) — used by
-    hermetic tests and debugging; the default spawns it detached.
+    controller='cluster' (default, via config jobs.controller.mode) runs
+    the job's controller process on the self-hosted controller cluster;
+    'local' keeps it on the client. ``detach=False`` with 'local' runs the
+    controller inline (blocking) — hermetic tests and debugging.
     """
     dag = dag_utils.convert_entrypoint_to_dag(entrypoint)
     if not dag.is_chain():
@@ -40,6 +61,32 @@ def launch(entrypoint: Union[Task, dag_lib.Dag],
             "Managed jobs support single tasks or chain pipelines only.")
     dag.name = name or dag.name or dag.tasks[0].name or "unnamed"
 
+    mode = controller or controller_utils.controller_mode(_JOBS)
+    if mode == "local" or not detach:
+        return _launch_local(dag, detach)
+
+    # Self-hosted path: ship the DAG to the controller cluster and submit
+    # there; the controller process outlives this client.
+    handle = controller_utils.ensure_controller_up(_JOBS)
+    stamp = f"{dag.name}-{int(time.time()*1000)}-{os.getpid()}"
+    inbox = f"~/.stpu/jobs_inbox/{stamp}.yaml"
+    local_yaml = paths.generated_dir() / "managed_jobs" / f"{stamp}.yaml"
+    local_yaml.parent.mkdir(parents=True, exist_ok=True)
+    dag_utils.dump_chain_dag_to_yaml(dag, str(local_yaml))
+    runner = handle.get_command_runners()[0]
+    runner.run("mkdir -p ~/.stpu/jobs_inbox")
+    runner.rsync(str(local_yaml), inbox, up=True)
+    out = controller_utils.run_on_controller(
+        handle, controller_utils.module_command(
+            "skypilot_tpu.jobs.core", "submit", "--dag-yaml", inbox,
+            "--name", dag.name))
+    return int(out["job_id"])
+
+
+def _launch_local(dag: dag_lib.Dag, detach: bool) -> int:
+    """Register + spawn the controller process on *this* host. Runs on the
+    client in 'local' mode and on the controller head in 'cluster' mode
+    (invoked there by the `submit` RPC)."""
     resources_str = ", ".join(
         str(res) for task in dag.tasks for res in task.resources)
     jobs_dir = paths.generated_dir() / "managed_jobs"
@@ -66,23 +113,65 @@ def launch(entrypoint: Union[Task, dag_lib.Dag],
     return job_id
 
 
+# ---------------------------------------------------------------- queries
+def _proxy() -> Optional[Any]:
+    """Controller-cluster handle when jobs state is self-hosted."""
+    return controller_utils.controller_handle(_JOBS)
+
+
 def queue(skip_finished: bool = False) -> List[Dict[str, Any]]:
     """List managed jobs (reference: sky jobs queue)."""
-    return jobs_state.queue(skip_finished=skip_finished)
+    handle = _proxy()
+    if handle is None:
+        return jobs_state.queue(skip_finished=skip_finished)
+    args = ["queue"] + (["--skip-finished"] if skip_finished else [])
+    return controller_utils.run_on_controller(
+        handle, controller_utils.module_command(
+            "skypilot_tpu.jobs.core", *args))
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    handle = _proxy()
+    if handle is None:
+        return jobs_state.get_job(job_id)
+    out = controller_utils.run_on_controller(
+        handle, controller_utils.module_command(
+            "skypilot_tpu.jobs.core", "status", "--job-id", str(job_id)))
+    return out or None
+
+
+def get_status(job_id: int) -> Optional[ManagedJobStatus]:
+    job = get_job(job_id)
+    return ManagedJobStatus(job["status"]) if job else None
 
 
 def cancel(job_ids: Optional[List[int]] = None,
            all_jobs: bool = False) -> List[int]:
     """Cancel managed jobs: signal their controllers; each controller
     cancels its cluster job and tears the cluster down. A job whose
-    controller died is finalized here (incl. orphaned-cluster teardown)."""
-    if job_ids is None and not all_jobs:
+    controller died is finalized (incl. orphaned-cluster teardown)."""
+    if not job_ids and not all_jobs:
         raise exceptions.SkyTpuError(
             "Specify managed job ids to cancel, or all_jobs=True "
             "(`stpu jobs cancel --all`).")
+    handle = _proxy()
+    if handle is None:
+        return _cancel_local(job_ids, all_jobs)
+    args = ["cancel"]
+    args += ["--all"] if all_jobs else ["--ids", ",".join(
+        str(i) for i in (job_ids or []))]
+    out = controller_utils.run_on_controller(
+        handle, controller_utils.module_command(
+            "skypilot_tpu.jobs.core", *args))
+    return list(out["cancelled"])
+
+
+def _cancel_local(job_ids: Optional[List[int]],
+                  all_jobs: bool) -> List[int]:
+    """Cancel on this host (controller pids are local here)."""
     jobs = jobs_state.queue(skip_finished=True)
     if not all_jobs:
-        jobs = [j for j in jobs if j["job_id"] in job_ids]
+        jobs = [j for j in jobs if j["job_id"] in (job_ids or [])]
     cancelled = []
     for job in jobs:
         pid = job.get("controller_pid")
@@ -127,6 +216,21 @@ def _finalize_dead_controller(job: Dict[str, Any]) -> None:
 
 def tail_logs(job_id: Optional[int] = None, follow: bool = True) -> int:
     """Stream the task logs of a managed job via its current cluster."""
+    handle = _proxy()
+    if handle is not None:
+        args = ["tail"]
+        if job_id is not None:
+            args += ["--job-id", str(job_id)]
+        if not follow:
+            args += ["--no-follow"]
+        rc = controller_utils.run_on_controller(
+            handle, controller_utils.module_command(
+                "skypilot_tpu.jobs.core", *args), stream=True)
+        return int(rc)
+    return _tail_logs_local(job_id, follow)
+
+
+def _tail_logs_local(job_id: Optional[int], follow: bool) -> int:
     if job_id is None:
         jobs = jobs_state.queue()
         if not jobs:
@@ -157,11 +261,64 @@ def tail_logs(job_id: Optional[int] = None, follow: bool = True) -> int:
 def wait(job_id: int, timeout: float = 300.0) -> ManagedJobStatus:
     """Block until the managed job reaches a terminal state."""
     deadline = time.time() + timeout
+    # Proxied polls spawn a controller-side interpreter per call; use a
+    # gentler interval than the local sqlite path.
+    interval = 0.3 if _proxy() is None else 1.5
+    status = None
     while time.time() < deadline:
-        status = jobs_state.get_status(job_id)
+        status = get_status(job_id)
         if status is not None and status.is_terminal():
             return status
-        time.sleep(0.3)
+        time.sleep(interval)
     raise TimeoutError(
         f"Managed job {job_id} not terminal after {timeout}s "
-        f"(status={jobs_state.get_status(job_id)})")
+        f"(status={status})")
+
+
+# ------------------------------------------------------- controller-side RPC
+def main() -> None:
+    parser = argparse.ArgumentParser(prog="skypilot_tpu.jobs.core")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("submit")
+    p.add_argument("--dag-yaml", required=True)
+    p.add_argument("--name", required=True)
+
+    p = sub.add_parser("queue")
+    p.add_argument("--skip-finished", action="store_true")
+
+    p = sub.add_parser("cancel")
+    p.add_argument("--ids", default=None)
+    p.add_argument("--all", action="store_true", dest="all_jobs")
+
+    p = sub.add_parser("status")
+    p.add_argument("--job-id", type=int, required=True)
+
+    p = sub.add_parser("tail")
+    p.add_argument("--job-id", type=int, default=None)
+    p.add_argument("--no-follow", action="store_true")
+
+    args = parser.parse_args()
+    if args.cmd == "submit":
+        dag = dag_utils.load_chain_dag_from_yaml(
+            os.path.expanduser(args.dag_yaml))
+        dag.name = args.name
+        job_id = _launch_local(dag, detach=True)
+        print(json.dumps({"job_id": job_id}))
+    elif args.cmd == "queue":
+        print(json.dumps(jobs_state.queue(
+            skip_finished=args.skip_finished)))
+    elif args.cmd == "cancel":
+        ids = ([int(i) for i in args.ids.split(",") if i]
+               if args.ids else None)
+        print(json.dumps(
+            {"cancelled": _cancel_local(ids, args.all_jobs)}))
+    elif args.cmd == "status":
+        print(json.dumps(jobs_state.get_job(args.job_id)))
+    elif args.cmd == "tail":
+        raise SystemExit(_tail_logs_local(args.job_id,
+                                          follow=not args.no_follow))
+
+
+if __name__ == "__main__":
+    main()

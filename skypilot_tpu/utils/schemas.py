@@ -139,7 +139,10 @@ CONFIG_SCHEMA = {
             "properties": {
                 "controller": {
                     "type": "object",
-                    "properties": {"resources": _RESOURCES_SCHEMA},
+                    "properties": {
+                        "resources": _RESOURCES_SCHEMA,
+                        "mode": {"enum": ["cluster", "local"]},
+                    },
                 },
             },
         },
@@ -149,7 +152,10 @@ CONFIG_SCHEMA = {
             "properties": {
                 "controller": {
                     "type": "object",
-                    "properties": {"resources": _RESOURCES_SCHEMA},
+                    "properties": {
+                        "resources": _RESOURCES_SCHEMA,
+                        "mode": {"enum": ["cluster", "local"]},
+                    },
                 },
             },
         },

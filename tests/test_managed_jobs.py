@@ -73,7 +73,7 @@ def test_managed_job_preemption_recovery(tmp_path):
         f'echo $((n+1)) > {marker}; '
         f'if [ "$n" -ge 1 ]; then echo recovered-ok; else sleep 120; fi'))
     task.set_resources(_local_res(use_spot=True))
-    job_id = jobs.launch(task, detach=True)
+    job_id = jobs.launch(task, detach=True, controller="local")
 
     _wait_status(job_id, {ManagedJobStatus.RUNNING}, timeout=30)
     # Wait for attempt 1 to actually start (marker written).
@@ -98,7 +98,7 @@ def test_managed_job_preemption_recovery(tmp_path):
 def test_managed_job_cancel():
     task = Task("mj-cancel", run="sleep 120")
     task.set_resources(_local_res())
-    job_id = jobs.launch(task, detach=True)
+    job_id = jobs.launch(task, detach=True, controller="local")
     _wait_status(job_id, {ManagedJobStatus.RUNNING}, timeout=30)
     cancelled = jobs.cancel([job_id])
     assert cancelled == [job_id]

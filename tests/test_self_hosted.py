@@ -1,0 +1,160 @@
+"""Self-hosted control plane, end-to-end on the local provider.
+
+The architecture under test (reference: sky/jobs/core.py:30 +
+templates/jobs-controller.yaml.j2): managed-job and serve controllers run
+on launched controller *clusters*, not on the client. The defining
+property — verified here — is that the client process can exit after
+submission and spot-preemption recovery still happens, driven entirely by
+the controller cluster.
+"""
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from skypilot_tpu import global_user_state
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.serve import core as serve_core
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import controller_utils
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fast_ticks(monkeypatch):
+    monkeypatch.setenv("STPU_JOBS_POLL_SECONDS", "0.2")
+    monkeypatch.setenv("STPU_SERVE_TICK_SECONDS", "0.3")
+
+
+def _controller_host_home(kind: controller_utils.Controllers
+                          ) -> pathlib.Path:
+    record = global_user_state.get_cluster_from_name(kind.cluster_name)
+    assert record is not None and record["handle"] is not None
+    head = record["handle"].cluster_info.get_head_instance()
+    return pathlib.Path(head.tags["host_dir"])
+
+
+def _wait_status(job_id, statuses, timeout=120):
+    deadline = time.time() + timeout
+    st = None
+    while time.time() < deadline:
+        st = jobs_core.get_status(job_id)
+        if st in statuses:
+            return st
+        time.sleep(0.3)
+    raise TimeoutError(f"managed job {job_id} stuck at {st}")
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_jobs_survive_client_exit_and_recover(tmp_path):
+    """Submit from a client process that then EXITS; preempt the task
+    cluster (provider-truth flip on the controller host); recovery must
+    complete with no client involvement."""
+    marker = tmp_path / "attempts"
+    run_cmd = (f'n=$(cat {marker} 2>/dev/null || echo 0); '
+               f'echo $((n+1)) > {marker}; '
+               f'if [ "$n" -ge 1 ]; then echo recovered-ok; '
+               f'else sleep 120; fi')
+    client_code = f"""
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+task = Task("sh-rec", run={run_cmd!r})
+task.set_resources(Resources(cloud="local", use_spot=True))
+print(jobs_core.launch(task, name="sh-rec"))
+"""
+    env = {**os.environ, "PYTHONPATH": REPO_ROOT}
+    proc = subprocess.run([sys.executable, "-c", client_code],
+                          capture_output=True, text=True, env=env,
+                          timeout=180)
+    assert proc.returncode == 0, proc.stderr
+    job_id = int(proc.stdout.strip().splitlines()[-1])
+    # The submitting client is gone. Managed-job state lives on the
+    # controller cluster, NOT in the client DB:
+    assert jobs_state.queue() == []
+    assert jobs_core.get_status(job_id) is not None  # via controller RPC
+
+    _wait_status(job_id, {ManagedJobStatus.RUNNING})
+    deadline = time.time() + 60
+    while not marker.exists() and time.time() < deadline:
+        time.sleep(0.1)
+    assert marker.exists()
+
+    # Preemption: flip provider truth for the task cluster, which the
+    # controller provisioned under ITS OWN state dir on the controller
+    # host (the nested-recursive structure of the reference).
+    job = jobs_core.get_job(job_id)
+    ctrl_home = _controller_host_home(controller_utils.Controllers.JOBS)
+    meta_path = (ctrl_home / ".stpu" / "local_clusters" /
+                 job["cluster_name"] / "metadata.json")
+    assert meta_path.exists(), f"task cluster not under controller home"
+    meta = json.loads(meta_path.read_text())
+    for info in meta["instances"].values():
+        info["status"] = "preempted"
+    meta_path.write_text(json.dumps(meta))
+
+    status = _wait_status(
+        job_id, {ManagedJobStatus.SUCCEEDED, ManagedJobStatus.FAILED,
+                 ManagedJobStatus.FAILED_CONTROLLER}, timeout=120)
+    assert status == ManagedJobStatus.SUCCEEDED
+    assert jobs_core.get_job(job_id)["recovery_count"] >= 1
+    assert marker.read_text().strip() == "2"
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_jobs_self_hosted_cancel_and_queue():
+    task = Task("sh-cancel", run="sleep 120")
+    task.set_resources(Resources(cloud="local"))
+    job_id = jobs_core.launch(task)  # default mode: cluster
+    _wait_status(job_id, {ManagedJobStatus.RUNNING})
+
+    q = jobs_core.queue()  # proxied to the controller
+    assert [j["job_id"] for j in q] == [job_id]
+
+    cancelled = jobs_core.cancel([job_id])
+    assert cancelled == [job_id]
+    status = _wait_status(job_id, {ManagedJobStatus.CANCELLED})
+    assert status == ManagedJobStatus.CANCELLED
+    # Task cluster torn down on the controller host.
+    job = jobs_core.get_job(job_id)
+    ctrl_home = _controller_host_home(controller_utils.Controllers.JOBS)
+    assert not (ctrl_home / ".stpu" / "local_clusters" /
+                job["cluster_name"]).exists()
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_serve_self_hosted_up_status_down():
+    task = Task("sh-svc", run=(
+        'cd $(mktemp -d) && echo "hello-from-replica" > index.html && '
+        'exec python3 -m http.server $SKYPILOT_SERVE_REPLICA_PORT'))
+    task.set_resources(Resources(cloud="local"))
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    task.service = SkyServiceSpec(readiness_path="/",
+                                  initial_delay_seconds=60,
+                                  min_replicas=1)
+
+    name, endpoint = serve_core.up(task, "svc-sh")  # default: cluster
+    try:
+        got = serve_core.wait_ready(name, timeout=120)
+        assert got == endpoint
+        with urllib.request.urlopen(endpoint + "/", timeout=5) as resp:
+            assert resp.status == 200
+            assert "hello-from-replica" in resp.read().decode()
+        # Service state lives on the controller cluster, not the client.
+        assert serve_state.get_services() == []
+        svcs = serve_core.status([name])  # proxied dump
+        assert svcs and svcs[0]["service_name"] == name
+        assert svcs[0]["replicas"]
+    finally:
+        assert serve_core.down([name], timeout=90) == [name]
+    assert serve_core.status([name]) == []

@@ -43,7 +43,8 @@ def _get(url, timeout=5):
 
 @pytest.mark.usefixtures("tmp_state_dir")
 def test_serve_up_scale_replace_down():
-    name, endpoint = serve_core.up(_server_task(replicas=2), "svc-e2e")
+    name, endpoint = serve_core.up(_server_task(replicas=2), "svc-e2e",
+                                    controller="local")
     try:
         got = serve_core.wait_ready(name, timeout=90)
         assert got == endpoint
@@ -101,7 +102,7 @@ def test_serve_lb_503_before_ready():
     task = _server_task(replicas=1)
     # Slow server: nothing listens for a while.
     task.run = ("sleep 300")
-    name, endpoint = serve_core.up(task, "svc-slow")
+    name, endpoint = serve_core.up(task, "svc-slow", controller="local")
     try:
         deadline = time.time() + 30
         got = None
