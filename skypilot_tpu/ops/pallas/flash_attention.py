@@ -1,13 +1,18 @@
-"""Blockwise (flash) attention as a Pallas TPU kernel.
+"""Blockwise (flash) attention as Pallas TPU kernels, fwd and bwd.
 
-Forward pass is a Pallas kernel: online-softmax over KV blocks, working set
-held in VMEM, logits never materialized in HBM (O(S*D) traffic instead of
-O(S^2)). Backward pass is a custom VJP computed blockwise with `lax.scan`
-in plain XLA from the saved (q, k, v, o, lse): memory stays O(S*block_k)
-and every contraction is an MXU-shaped matmul. (A fully-Pallas backward is
-a later optimization; the fwd kernel is where the S^2 HBM win is.)
+Forward: online-softmax over KV blocks, working set held in VMEM, logits
+never materialized in HBM (O(S*D) traffic instead of O(S^2)).
 
-Supports causal masking and GQA (n_heads % n_kv_heads == 0).
+Backward: two Pallas kernels from the saved (q, k, v, o, lse) — a dq
+kernel gridded over Q blocks (inner loop over KV blocks) and a dk/dv
+kernel gridded over KV blocks (inner loop over Q blocks), the standard
+flash-attention-2 split so each output block has a single writer and no
+cross-block reduction. delta = rowsum(do*o) is recomputed in-kernel from
+the o/do blocks. Causal runs skip fully-masked block pairs on both sides.
+
+Supports causal masking and GQA (n_heads % n_kv_heads == 0): the backward
+computes per-query-head dk/dv and the group-sum back to KV heads happens
+in XLA outside the kernel.
 """
 from __future__ import annotations
 
@@ -78,7 +83,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
 def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
                causal: bool, scale: float,
-               block_q: int, block_k: int
+               block_q: int, block_k: int,
+               keep_lse_pad: bool = False
                ) -> Tuple[jax.Array, jax.Array]:
     b, s, h, d = q.shape
     kvh = k.shape[2]
@@ -120,58 +126,164 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=jax.default_backend() == "cpu",
     )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3), lse[..., 0]
+    # keep_lse_pad: the (B,H,S,LSE_PAD) layout feeds the bwd kernels
+    # directly (already lane-tileable); [..., 0] is the logical value.
+    return out.transpose(0, 2, 1, 3), (lse if keep_lse_pad
+                                       else lse[..., 0])
 
 
-def _bwd_blockwise(res, do, *, causal: bool, scale: float, block_k: int):
-    """Flash-style backward in XLA: scan over KV blocks, O(S*block_k) mem."""
-    q, k, v, o, lse = res
+def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
+               scale: float, block_k: int, causal: bool, seq_len: int):
+    # q/o/do/dq_ref: (block_q, d); k/v_ref: (seq_len, d);
+    # lse_ref: (block_q, LSE_PAD)
+    qi = pl.program_id(2)
+    q = q_ref[...].astype(jnp.float32) * scale
+    do = do_ref[...].astype(jnp.float32)
+    o = o_ref[...].astype(jnp.float32)
+    lse = lse_ref[...][:, 0:1]                       # (bq, 1)
+    delta = jnp.sum(do * o, axis=-1, keepdims=True)  # (bq, 1)
+    bq, d = q.shape
+    q_start = qi * bq
+    if causal:
+        n_blocks = lax.div(q_start + bq + block_k - 1, block_k)
+    else:
+        n_blocks = seq_len // block_k
+
+    def body(j, dq):
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = q_start + lax.broadcasted_iota(jnp.int32, (bq, block_k),
+                                                  0)
+            kpos = j * block_k + lax.broadcasted_iota(jnp.int32,
+                                                      (bq, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((bq, d), dtype=jnp.float32)
+    dq = lax.fori_loop(0, n_blocks, body, dq0)
+    dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                dk_ref, dv_ref, *, scale: float, block_q: int,
+                causal: bool, seq_len: int):
+    # k/v/dk/dv_ref: (block_k, d); q/o/do_ref: (seq_len, d);
+    # lse_ref: (seq_len, LSE_PAD)
+    ki = pl.program_id(2)
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    bk, d = k.shape
+    k_start = ki * bk
+    nq = seq_len // block_q
+    i0 = lax.div(k_start, block_q) if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32) * scale
+        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        o = o_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(i * block_q, block_q), :][:, 0:1]
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            kpos = k_start + lax.broadcasted_iota(jnp.int32,
+                                                  (block_q, bk), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        # dv += p^T @ do ; dk += ds^T @ (q*scale)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros((bk, d), dtype=jnp.float32)
+    dk, dv = lax.fori_loop(i0, nq, body, (z, z))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, do, *, causal: bool, scale: float,
+               block_q: int, block_k: int):
+    q, k, v, o, lse_pad = res
     b, s, h, d = q.shape
     kvh = k.shape[2]
     groups = h // kvh
+    block_q = min(block_q, s)
     block_k = min(block_k, s)
-    nk = s // block_k
+    interpret = jax.default_backend() == "cpu"
 
-    qf = q.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    # delta = rowsum(do * o): (B, S, H)
-    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)
-    # expand kv heads to full heads for per-head math
-    kf = jnp.repeat(k.astype(jnp.float32), groups, axis=2)  # (B,S,H,D)
-    vf = jnp.repeat(v.astype(jnp.float32), groups, axis=2)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    ot = o.transpose(0, 2, 1, 3)
+    dot_ = do.transpose(0, 2, 1, 3)
 
-    qpos = jnp.arange(s)
+    qspec = pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, i: (bi, hi, i, 0))
+    full_q = pl.BlockSpec((None, None, s, d),
+                          lambda bi, hi, i: (bi, hi, 0, 0))
+    kv_full = pl.BlockSpec((None, None, s, d),
+                           lambda bi, hi, i: (bi, hi // groups, 0, 0))
+    lse_q = pl.BlockSpec((None, None, block_q, LSE_PAD),
+                         lambda bi, hi, i: (bi, hi, i, 0))
+    lse_full = pl.BlockSpec((None, None, s, LSE_PAD),
+                            lambda bi, hi, i: (bi, hi, 0, 0))
 
-    def block(j):
-        ks = jax.lax.dynamic_slice_in_dim(kf, j * block_k, block_k, axis=1)
-        vs = jax.lax.dynamic_slice_in_dim(vf, j * block_k, block_k, axis=1)
-        s_blk = jnp.einsum("bqhd,bkhd->bhqk", qf, ks) * scale
-        if causal:
-            kpos = j * block_k + jnp.arange(block_k)
-            mask = qpos[:, None] >= kpos[None, :]
-            s_blk = jnp.where(mask[None, None], s_blk, _NEG_INF)
-        p = jnp.exp(s_blk - lse[:, :, :, None])  # (B,H,Q,K)
-        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vs)
-        ds = p * (dp - delta.transpose(0, 2, 1)[:, :, :, None]) * scale
-        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
-        dq_blk = jnp.einsum("bhqk,bkhd->bqhd", ds, ks)
-        return dq_blk, dk_blk, dv_blk
+    dqt = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, block_k=block_k,
+                          causal=causal, seq_len=s),
+        grid=(b, h, s // block_q),
+        in_specs=[qspec, kv_full, kv_full, qspec, qspec, lse_q],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, ot, dot_, lse_pad)
 
-    def body(carry, j):
-        dq = carry
-        dq_blk, dk_blk, dv_blk = block(j)
-        return dq + dq_blk, (dk_blk, dv_blk)
+    kvspec = pl.BlockSpec((None, None, block_k, d),
+                          lambda bi, hi, i: (bi, hi // groups, i, 0))
+    dkv_out = pl.BlockSpec((None, None, block_k, d),
+                           lambda bi, hi, i: (bi, hi, i, 0))
+    dkt, dvt = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, block_q=block_q,
+                          causal=causal, seq_len=s),
+        grid=(b, h, s // block_k),
+        in_specs=[full_q, kvspec, kvspec, full_q, full_q, lse_full],
+        out_specs=[dkv_out, dkv_out],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, ot, dot_, lse_pad)
 
-    dq0 = jnp.zeros((b, s, h, d), dtype=jnp.float32)
-    dq, (dk_blocks, dv_blocks) = lax.scan(body, dq0, jnp.arange(nk))
-    # (nk, B, bk, H, D) -> (B, S, H, D)
-    dk = dk_blocks.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
-    dv = dv_blocks.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
-    # reduce grouped heads back to kv heads
-    dk = dk.reshape(b, s, kvh, groups, d).sum(axis=3)
-    dv = dv.reshape(b, s, kvh, groups, d).sum(axis=3)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    dq = dqt.transpose(0, 2, 1, 3)
+    # Per-query-head dk/dv -> sum each GQA group back to its KV head.
+    dk = dkt.transpose(0, 2, 1, 3).reshape(b, s, kvh, groups, d).sum(3)
+    dv = dvt.transpose(0, 2, 1, 3).reshape(b, s, kvh, groups, d).sum(3)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -182,14 +294,15 @@ def _flash(q, k, v, causal, scale, block_q, block_k):
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, causal=causal, scale=scale,
-                          block_q=block_q, block_k=block_k)
-    return out, (q, k, v, out, lse)
+    out, lse_pad = _flash_fwd(q, k, v, causal=causal, scale=scale,
+                              block_q=block_q, block_k=block_k,
+                              keep_lse_pad=True)
+    return out, (q, k, v, out, lse_pad)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
-    return _bwd_blockwise(res, do, causal=causal, scale=scale,
-                          block_k=block_k)
+    return _flash_bwd(res, do, causal=causal, scale=scale,
+                      block_q=block_q, block_k=block_k)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
