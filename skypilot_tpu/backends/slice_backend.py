@@ -537,12 +537,14 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
                         handle.cluster_info.provider_config)
                 else:
                     res = handle.launched_resources
-                    sinfo = res.slice_info()
-                    if sinfo is not None and sinfo.is_pod:
-                        raise exceptions.NotSupportedError(
-                            f"TPU pod slices cannot be stopped, only "
-                            f"terminated (multi-host slice "
-                            f"{sinfo.accelerator}). Use `down`.")
+                    # Capability check: pods are terminate-only (routed
+                    # through the cloud object, reference
+                    # check_features_are_supported, sky/clouds/cloud.py:524)
+                    from skypilot_tpu import clouds as clouds_lib
+                    clouds_lib.get_cloud(
+                        handle.provider_name).check_features_are_supported(
+                            res, [clouds_lib.CloudImplementationFeatures
+                                  .STOP])
                     provision_api.stop_instances(
                         handle.provider_name, handle.cluster_name,
                         handle.cluster_info.provider_config)
@@ -563,6 +565,14 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
         """Record autostop client-side AND ship it to the head daemon,
         which enforces it (reference: AutostopCodeGen over SSH feeding
         skylet's AutostopEvent, sky/skylet/autostop_lib.py:55)."""
+        if idle_minutes >= 0 and not down:
+            # Autostop-to-STOPPED needs the stop capability (pods are
+            # terminate-only; they must use autostop --down).
+            from skypilot_tpu import clouds as clouds_lib
+            clouds_lib.get_cloud(
+                handle.provider_name).check_features_are_supported(
+                    handle.launched_resources,
+                    [clouds_lib.CloudImplementationFeatures.AUTOSTOP])
         global_user_state.set_cluster_autostop(
             handle.cluster_name, idle_minutes, down)
         cfg = json.dumps({"idle_minutes": idle_minutes, "down": down,

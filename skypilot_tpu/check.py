@@ -8,55 +8,17 @@ enabled set is persisted via global_user_state.set_enabled_clouds.
 """
 from __future__ import annotations
 
-import shutil
-import subprocess
-from typing import Callable, Dict, List, Tuple
-
-
-def _probe_local() -> Tuple[bool, str]:
-    return True, "hermetic provider (always available)"
-
-
-def _probe_gcp() -> Tuple[bool, str]:
-    """Usable = gcloud exists + active credentials + a project is set.
-
-    The TPU API itself is only reachable with network access; like the
-    reference we treat credential presence as 'enabled' and surface API
-    errors at provision time with failover semantics."""
-    if shutil.which("gcloud") is None:
-        return False, "gcloud CLI not installed"
-    try:
-        proc = subprocess.run(
-            ["gcloud", "auth", "list",
-             "--filter=status:ACTIVE", "--format=value(account)"],
-            capture_output=True, text=True, timeout=20)
-        if proc.returncode != 0 or not proc.stdout.strip():
-            return False, ("no active gcloud credentials "
-                           "(run `gcloud auth login`)")
-        proc = subprocess.run(
-            ["gcloud", "config", "get-value", "project"],
-            capture_output=True, text=True, timeout=20)
-        project = proc.stdout.strip()
-        if proc.returncode != 0 or not project or project == "(unset)":
-            return False, ("no GCP project configured "
-                           "(run `gcloud config set project ...`)")
-        return True, f"project {project}"
-    except (subprocess.SubprocessError, OSError) as e:
-        return False, f"gcloud probe failed: {e}"
-
-
-_PROBES: Dict[str, Callable[[], Tuple[bool, str]]] = {
-    "local": _probe_local,
-    "gcp": _probe_gcp,
-}
+from typing import List
 
 
 def check(quiet: bool = False) -> List[str]:
-    """Probe every provider, persist and return the enabled set."""
+    """Probe every registered cloud's credentials, persist and return
+    the enabled set (consumed by the optimizer's candidate filter)."""
+    from skypilot_tpu import clouds as clouds_lib
     from skypilot_tpu import global_user_state
     enabled = []
-    for name, probe in _PROBES.items():
-        ok, reason = probe()
+    for name in clouds_lib.registered_names():
+        ok, reason = clouds_lib.get_cloud(name).check_credentials()
         if ok:
             enabled.append(name)
         if not quiet:

@@ -64,6 +64,18 @@ def _execute(
         # cluster's resources.
         optimizer_lib.Optimizer.optimize(dag, quiet=not stream_logs)
 
+    if idle_minutes_to_autostop is not None and not down:
+        # Pre-flight the autostop capability BEFORE provisioning: a pod
+        # slice cannot autostop-to-STOPPED, and finding that out after a
+        # multi-host slice came up would leave it running with no
+        # autostop — the exact idle-burn the flag exists to prevent.
+        from skypilot_tpu import clouds as clouds_lib
+        planned = task.best_resources or task.resources[0]
+        clouds_lib.get_cloud(
+            planned.provider_name).check_features_are_supported(
+                planned,
+                [clouds_lib.CloudImplementationFeatures.AUTOSTOP])
+
     handle = None
     if Stage.PROVISION in stages:
         handle = backend.provision(

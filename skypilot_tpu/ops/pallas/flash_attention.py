@@ -175,10 +175,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                 dk_ref, dv_ref, *, scale: float, block_q: int,
-                causal: bool, seq_len: int):
+                causal: bool, seq_len: int, groups: int):
     # k/v/dk/dv_ref: (block_k, d); q/o/do_ref: (seq_len, d);
-    # lse_ref: (seq_len, LSE_PAD)
-    ki = pl.program_id(2)
+    # lse_ref: (seq_len, LSE_PAD). Grid is (batch, kv_block, head) with
+    # head fastest, so the `groups` query heads of one KV head hit the
+    # same (bi, hi // groups, ki) output block on consecutive steps and
+    # the GQA group-sum happens by accumulating into the resident block
+    # — no per-query-head (B,H,S,D) gradient ever reaches HBM.
+    ki = pl.program_id(1)
+    hi = pl.program_id(2)
     k = k_ref[...].astype(jnp.float32)
     v = v_ref[...].astype(jnp.float32)
     bk, d = k.shape
@@ -217,8 +222,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     z = jnp.zeros((bk, d), dtype=jnp.float32)
     dk, dv = lax.fori_loop(i0, nq, body, (z, z))
-    dk_ref[...] = dk.astype(dk_ref.dtype)
-    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+    first_in_group = hi % groups == 0
+
+    @pl.when(first_in_group)
+    def _():
+        dk_ref[...] = dk
+        dv_ref[...] = dv
+
+    @pl.when(jnp.logical_not(first_in_group))
+    def _():
+        dk_ref[...] += dk
+        dv_ref[...] += dv
 
 
 def _flash_bwd(res, do, *, causal: bool, scale: float,
@@ -239,14 +254,10 @@ def _flash_bwd(res, do, *, causal: bool, scale: float,
 
     qspec = pl.BlockSpec((None, None, block_q, d),
                          lambda bi, hi, i: (bi, hi, i, 0))
-    full_q = pl.BlockSpec((None, None, s, d),
-                          lambda bi, hi, i: (bi, hi, 0, 0))
     kv_full = pl.BlockSpec((None, None, s, d),
                            lambda bi, hi, i: (bi, hi // groups, 0, 0))
     lse_q = pl.BlockSpec((None, None, block_q, LSE_PAD),
                          lambda bi, hi, i: (bi, hi, i, 0))
-    lse_full = pl.BlockSpec((None, None, s, LSE_PAD),
-                            lambda bi, hi, i: (bi, hi, 0, 0))
 
     dqt = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, block_k=block_k,
@@ -260,29 +271,32 @@ def _flash_bwd(res, do, *, causal: bool, scale: float,
         interpret=interpret,
     )(qt, kt, vt, ot, dot_, lse_pad)
 
+    # Grid (batch, kv_block, head), head fastest: the group's heads
+    # accumulate into the same resident (B,KVH,S,D) output block.
     kvspec = pl.BlockSpec((None, None, block_k, d),
-                          lambda bi, hi, i: (bi, hi // groups, i, 0))
-    dkv_out = pl.BlockSpec((None, None, block_k, d),
-                           lambda bi, hi, i: (bi, hi, i, 0))
+                          lambda bi, i, hi: (bi, hi // groups, i, 0))
+    fullq_h = pl.BlockSpec((None, None, s, d),
+                           lambda bi, i, hi: (bi, hi, 0, 0))
+    lse_h = pl.BlockSpec((None, None, s, LSE_PAD),
+                         lambda bi, i, hi: (bi, hi, 0, 0))
     dkt, dvt = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, block_q=block_q,
-                          causal=causal, seq_len=s),
-        grid=(b, h, s // block_k),
-        in_specs=[full_q, kvspec, kvspec, full_q, full_q, lse_full],
-        out_specs=[dkv_out, dkv_out],
+                          causal=causal, seq_len=s, groups=groups),
+        grid=(b, s // block_k, h),
+        in_specs=[fullq_h, kvspec, kvspec, fullq_h, fullq_h, lse_h],
+        out_specs=[kvspec, kvspec],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, kvh, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, kvh, s, d), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt, ot, dot_, lse_pad)
 
     dq = dqt.transpose(0, 2, 1, 3)
-    # Per-query-head dk/dv -> sum each GQA group back to its KV head.
-    dk = dkt.transpose(0, 2, 1, 3).reshape(b, s, kvh, groups, d).sum(3)
-    dv = dvt.transpose(0, 2, 1, 3).reshape(b, s, kvh, groups, d).sum(3)
+    dk = dkt.transpose(0, 2, 1, 3)
+    dv = dvt.transpose(0, 2, 1, 3)
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 

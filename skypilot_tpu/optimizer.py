@@ -86,14 +86,63 @@ def _expand_one(res: Resources) -> List[Resources]:
                      region=z.rsplit("-", 1)[0]) for z in zones]
 
 
+def _required_features(task, res):
+    """Capability features this (task, resources) pair needs."""
+    from skypilot_tpu import clouds as clouds_lib
+    F = clouds_lib.CloudImplementationFeatures
+    feats = []
+    if res.use_spot:
+        feats.append(F.SPOT_INSTANCE)
+    if res.ports:
+        feats.append(F.OPEN_PORTS)
+    if res.image_id:
+        feats.append(F.IMAGE_ID)
+    if task.num_nodes > 1:
+        feats.append(F.MULTI_NODE)
+    return feats
+
+
 def launchable_candidates(
-        task, blocklist: Optional[Blocklist] = None) -> List[Candidate]:
-    """Expand a task's resource set into priced, concrete candidates."""
+        task, blocklist: Optional[Blocklist] = None,
+        drop_reasons: Optional[List[str]] = None) -> List[Candidate]:
+    """Expand a task's resource set into priced, concrete candidates,
+    dropping placements whose cloud lacks a required capability or was
+    not enabled by `stpu check` (reference:
+    _fill_in_launchable_resources, sky/optimizer.py:1201).
+
+    `drop_reasons`, if given, collects one human-readable line per
+    dropped candidate so an empty result can explain itself.
+    """
+    from skypilot_tpu import clouds as clouds_lib
+    from skypilot_tpu import global_user_state
     blocklist = blocklist or Blocklist()
+    # Empty set = `stpu check` never ran; plan over all registered clouds
+    # (hermetic tests and first-run UX).
+    enabled = set(global_user_state.get_enabled_clouds())
+
+    def drop(concrete, why: str) -> None:
+        if drop_reasons is not None:
+            drop_reasons.append(f"{concrete}: {why}")
+
     out: List[Candidate] = []
     for res in task.resources:
         for concrete in _expand_one(res):
             if blocklist.blocked(concrete):
+                drop(concrete, "blocklisted after provision failure")
+                continue
+            if enabled and concrete.provider_name not in enabled:
+                drop(concrete,
+                     f"cloud {concrete.provider_name!r} not enabled "
+                     f"(run `stpu check`)")
+                continue
+            cloud = clouds_lib.get_cloud(concrete.provider_name)
+            unsupported = cloud.unsupported_features_for_resources(
+                concrete)
+            bad = [f for f in _required_features(task, concrete)
+                   if f in unsupported]
+            if bad:
+                drop(concrete, "; ".join(
+                    f"{f.value}: {unsupported[f]}" for f in bad))
                 continue
             price = concrete.hourly_price() * task.num_nodes
             out.append(Candidate(
@@ -118,11 +167,13 @@ class Optimizer:
 
         per_task: Dict[int, List[Candidate]] = {}
         for task in order:
-            cands = launchable_candidates(task, blocklist)
+            reasons: List[str] = []
+            cands = launchable_candidates(task, blocklist, reasons)
             if not cands:
+                detail = "".join(f"\n  - {r}" for r in reasons[:20])
                 raise exceptions.ResourcesUnavailableError(
                     f"No launchable resources for {task}: all candidates "
-                    f"are infeasible or blocklisted.")
+                    f"are infeasible or blocklisted.{detail}")
             per_task[id(task)] = cands
 
         if dag.is_chain():

@@ -63,9 +63,12 @@ class Resources:
 
     # ------------------------------------------------------------------
     def __post_init__(self):
-        if self.cloud is not None and self.cloud not in ("gcp", "local"):
-            raise exceptions.InvalidTaskError(
-                f"Unknown cloud {self.cloud!r}; supported: gcp, local")
+        if self.cloud is not None:
+            from skypilot_tpu import clouds as clouds_lib
+            if self.cloud not in clouds_lib.CLOUD_REGISTRY:
+                raise exceptions.InvalidTaskError(
+                    f"Unknown cloud {self.cloud!r}; supported: "
+                    f"{', '.join(clouds_lib.registered_names())}")
         if self.cloud == "local":
             return  # no catalog validation for the hermetic provider
         if self.accelerator is not None:

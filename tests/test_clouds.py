@@ -1,0 +1,97 @@
+"""Cloud capability layer: feature tables, backend/optimizer routing.
+
+Reference analog: tests for CloudImplementationFeatures /
+check_features_are_supported (sky/clouds/cloud.py:27,524).
+"""
+import pytest
+
+from skypilot_tpu import clouds as clouds_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import CloudImplementationFeatures as F
+from skypilot_tpu.resources import Resources
+
+
+def test_registry_and_unknown_cloud():
+    assert clouds_lib.registered_names() == ["gcp", "local"]
+    assert clouds_lib.get_cloud("gcp").NAME == "gcp"
+    with pytest.raises(exceptions.SkyTpuError, match="Unknown cloud"):
+        clouds_lib.get_cloud("aws")
+    with pytest.raises(exceptions.InvalidTaskError, match="Unknown cloud"):
+        Resources(cloud="aws")
+
+
+def test_pod_slices_cannot_stop_or_autostop():
+    gcp = clouds_lib.get_cloud("gcp")
+    pod = Resources(accelerator="tpu-v5p-64")
+    single = Resources(accelerator="tpu-v5e-8")
+    assert not gcp.supports(pod, F.STOP)
+    assert not gcp.supports(pod, F.AUTOSTOP)
+    assert gcp.supports(single, F.STOP)
+    with pytest.raises(exceptions.NotSupportedError, match="terminate"):
+        gcp.check_features_are_supported(pod, [F.STOP])
+    # Pods can still autostop --down (terminate path needs no STOP).
+    gcp.check_features_are_supported(pod, [F.SPOT_INSTANCE, F.MULTI_NODE])
+
+
+def test_gcp_feature_table():
+    gcp = clouds_lib.get_cloud("gcp")
+    res = Resources(accelerator="tpu-v5e-8")
+    assert gcp.supports(res, F.SPOT_INSTANCE)
+    assert gcp.supports(res, F.MULTI_NODE)
+    assert not gcp.supports(res, F.OPEN_PORTS)
+    assert not gcp.supports(res, F.IMAGE_ID)
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_optimizer_drops_unsupported_feature_candidates():
+    from skypilot_tpu import optimizer as optimizer_lib
+    from skypilot_tpu.task import Task
+
+    # ports on GCP: unsupported -> no candidates survive.
+    from skypilot_tpu.utils import dag_utils
+    task = Task("t", run="true")
+    task.set_resources(Resources(accelerator="tpu-v5e-8", ports=(8080,)))
+    assert optimizer_lib.launchable_candidates(task) == []
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        optimizer_lib.Optimizer.optimize(
+            dag_utils.convert_entrypoint_to_dag(task))
+
+    # Same resources without ports: plenty of candidates.
+    task2 = Task("t2", run="true")
+    task2.set_resources(Resources(accelerator="tpu-v5e-8"))
+    assert optimizer_lib.launchable_candidates(task2)
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_optimizer_respects_enabled_clouds():
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu import optimizer as optimizer_lib
+    from skypilot_tpu.task import Task
+
+    task = Task("t", run="true")
+    task.set_resources(Resources(accelerator="tpu-v5e-8"))
+    # No check ever ran: all clouds planable.
+    assert optimizer_lib.launchable_candidates(task)
+    # Only 'local' enabled: gcp candidates disappear.
+    global_user_state.set_enabled_clouds(["local"])
+    assert optimizer_lib.launchable_candidates(task) == []
+    global_user_state.set_enabled_clouds(["local", "gcp"])
+    assert optimizer_lib.launchable_candidates(task)
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_backend_autostop_refuses_pod_stop():
+    from skypilot_tpu import execution
+    from skypilot_tpu.backends import slice_backend
+    from skypilot_tpu.task import Task
+
+    task = Task("cap", run="true")
+    task.set_resources(Resources(cloud="local"))
+    _, handle = execution.launch(task, cluster_name="t-cap",
+                                 detach_run=True, stream_logs=False)
+    handle.launched_resources = Resources(accelerator="tpu-v5p-64")
+    backend = slice_backend.SliceBackend()
+    with pytest.raises(exceptions.NotSupportedError, match="terminate"):
+        backend.set_autostop(handle, 5, down=False)
+    # --down path is allowed for pods.
+    backend.set_autostop(handle, 5, down=True)
