@@ -320,6 +320,145 @@ def jobs_logs(job_id, no_follow):
 
 
 @cli.group()
+def bench():
+    """Benchmark a task across candidate TPU types ($/step report)."""
+
+
+@bench.command(name="launch")
+@click.argument("entrypoint", required=True)
+@click.option("--benchmark", "-b", required=True, help="Benchmark name.")
+@click.option("--candidate", "-c", "candidates", multiple=True,
+              required=True,
+              help="Accelerator per candidate (repeatable), e.g. "
+                   "-c tpu-v5e-8 -c tpu-v5p-8.")
+@click.option("--env", multiple=True, help="KEY=VALUE env overrides.")
+def bench_launch(entrypoint, benchmark, candidates, env):
+    """Launch one cluster per candidate running ENTRYPOINT with step
+    callbacks armed."""
+    from skypilot_tpu.benchmark import benchmark_utils
+    task = _load_task(entrypoint, env, {})
+    try:
+        res_candidates = [
+            task.resources[0].copy(accelerator=acc, instance_type=None)
+            for acc in candidates]
+        names = benchmark_utils.launch_benchmark(task, res_candidates,
+                                                 benchmark)
+    except (ValueError, exceptions.SkyTpuError) as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f"Benchmark {benchmark}: launched {', '.join(names)}")
+
+
+@bench.command(name="show")
+@click.argument("benchmark", required=True)
+def bench_show(benchmark):
+    """Refresh and show a benchmark's per-candidate results."""
+    from skypilot_tpu.benchmark import benchmark_utils
+    rows = benchmark_utils.update_benchmark(benchmark)
+    if not rows:
+        click.echo(f"No results for benchmark {benchmark!r}.")
+        return
+    fmt = "{:<26} {:<28} {:<10} {:>7} {:>12} {:>12}"
+    click.echo(fmt.format("CLUSTER", "RESOURCES", "STATUS", "STEPS",
+                          "SEC/STEP", "$/STEP"))
+    for r in rows:
+        sps = r.get("seconds_per_step")
+        dps = r.get("dollars_per_step")
+        click.echo(fmt.format(
+            r["cluster_name"], r["resources_str"][:28], r["status"],
+            r["num_steps"] if r["num_steps"] is not None else "-",
+            f"{sps:.3f}" if sps else "-",
+            f"{dps:.6f}" if dps else "-"))
+
+
+@bench.command(name="down")
+@click.argument("benchmark", required=True)
+def bench_down(benchmark):
+    """Tear down a benchmark's candidate clusters (results kept)."""
+    from skypilot_tpu.benchmark import benchmark_utils
+    benchmark_utils.update_benchmark(benchmark)
+    benchmark_utils.teardown_benchmark(benchmark)
+    click.echo(f"Benchmark {benchmark}: clusters torn down.")
+
+
+@bench.command(name="delete")
+@click.argument("benchmark", required=True)
+def bench_delete(benchmark):
+    """Delete a benchmark's records."""
+    from skypilot_tpu.benchmark import benchmark_state
+    benchmark_state.delete_benchmark(benchmark)
+    click.echo(f"Benchmark {benchmark} deleted.")
+
+
+@cli.group()
+def storage():
+    """Storage objects: buckets synced/mounted onto clusters."""
+
+
+@storage.command(name="ls")
+def storage_ls():
+    """List registered storage objects."""
+    from skypilot_tpu import core
+    records = core.storage_ls()
+    if not records:
+        click.echo("No storage objects.")
+        return
+    fmt = "{:<28} {:<8} {:<10} {}"
+    click.echo(fmt.format("NAME", "STORE", "STATUS", "SOURCE"))
+    for r in records:
+        handle = r["handle"] or {}
+        click.echo(fmt.format(r["name"], handle.get("store", "?"),
+                              r["status"] or "?",
+                              handle.get("source") or "-"))
+
+
+@storage.command(name="delete")
+@click.argument("names", nargs=-1, required=True)
+@click.option("--yes", "-y", is_flag=True, help="Skip confirmation.")
+def storage_delete(names, yes):
+    """Delete storage object(s): the bucket AND its registry row."""
+    from skypilot_tpu import core
+    for name in names:
+        if not yes:
+            click.confirm(f"Delete storage {name!r} (bucket contents "
+                          f"included)?", abort=True)
+        try:
+            core.storage_delete(name)
+            click.echo(f"Deleted storage {name}.")
+        except exceptions.SkyTpuError as e:
+            raise click.ClickException(str(e)) from e
+
+
+@storage.command(name="transfer")
+@click.argument("src", required=True)
+@click.argument("dst", required=True)
+def storage_transfer(src, dst):
+    """Transfer SRC bucket to DST bucket (e.g. s3://b1 gcs://b2).
+
+    s3->gcs runs cloud-side via GCP Storage Transfer Service; gcs->s3
+    via gsutil rsync.
+    """
+    from skypilot_tpu.data import data_transfer
+
+    def parse(uri):
+        if "://" not in uri:
+            raise click.ClickException(
+                f"{uri!r}: want store://bucket (gcs://, s3://, local://)")
+        store, bucket = uri.split("://", 1)
+        return store.replace("gs", "gcs") if store == "gs" else store, \
+            bucket.rstrip("/")
+
+    (src_store, src_bucket), (dst_store, dst_bucket) = parse(src), \
+        parse(dst)
+    try:
+        data_transfer.transfer(src_store, src_bucket, dst_store,
+                               dst_bucket)
+    except (exceptions.StorageError,
+            exceptions.NotSupportedError) as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f"Transferred {src} -> {dst}.")
+
+
+@cli.group()
 def serve():
     """Autoscaled serving: one endpoint, N replicas."""
 

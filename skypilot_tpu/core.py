@@ -164,3 +164,22 @@ def cost_report() -> List[Dict[str, Any]]:
             "cost": hist["total_cost"],
         })
     return out
+
+
+def storage_ls() -> List[Dict[str, Any]]:
+    """Registered storage objects (reference: sky/core.py storage_ls)."""
+    return global_user_state.get_storage()
+
+
+def storage_delete(name: str) -> None:
+    """Delete a registered bucket + its registry row (reference:
+    sky/core.py storage_delete)."""
+    from skypilot_tpu.data import storage as storage_lib
+    records = {r["name"]: r for r in global_user_state.get_storage()}
+    if name not in records:
+        raise exceptions.SkyTpuError(f"Storage {name!r} not found.")
+    handle = records[name]["handle"] or {}
+    store = storage_lib.Storage(
+        name=name, store=handle.get("store", "gcs"),
+        persistent=handle.get("persistent", True))
+    store.delete()

@@ -19,6 +19,7 @@ import numpy as np
 import optax
 from flax import linen as nn
 
+from skypilot_tpu import callbacks as sky_callback
 from skypilot_tpu.recipes import synthetic_data
 from skypilot_tpu.train import distributed
 
@@ -73,12 +74,15 @@ def main(argv=None) -> dict:
     def accuracy(params, x, y):
         return jnp.mean(jnp.argmax(model.apply(params, x), -1) == y)
 
+    sky_callback.init(total_steps=args.steps)
     t0 = time.time()
     loss = None
-    for x, y in synthetic_data.batches((images, labels), args.batch_size,
-                                       args.seed, args.steps):
+    for x, y in sky_callback.step_iterator(
+            synthetic_data.batches((images, labels), args.batch_size,
+                                   args.seed, args.steps)):
         params, opt_state, loss = step(params, opt_state, x, y)
     loss.block_until_ready()
+    sky_callback.flush()
 
     acc = float(accuracy(params, test_x, test_y))
     metrics = {

@@ -1,0 +1,94 @@
+"""Benchmark harness + step callbacks (reference analog:
+sky/benchmark/benchmark_utils.py:73, sky/callbacks/sky_callback)."""
+import json
+import os
+import sys
+import time
+
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu import callbacks as sky_callback
+from skypilot_tpu import cli as cli_mod
+from skypilot_tpu.benchmark import benchmark_state, benchmark_utils
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_callbacks_noop_without_env(monkeypatch):
+    monkeypatch.delenv(sky_callback.ENV_LOG_DIR, raising=False)
+    assert sky_callback.init() is False
+    # All calls are safe no-ops.
+    sky_callback.step_begin()
+    sky_callback.step_end()
+    assert list(sky_callback.step_iterator([1, 2])) == [1, 2]
+
+
+def test_callbacks_write_summary(tmp_path):
+    assert sky_callback.init(total_steps=5, log_dir=str(tmp_path))
+    for _ in sky_callback.step_iterator(range(5)):
+        time.sleep(0.01)
+    sky_callback.flush()
+    summary = json.loads((tmp_path / sky_callback.SUMMARY_NAME
+                          ).read_text())
+    assert summary["num_steps"] == 5
+    assert summary["total_steps"] == 5
+    assert summary["seconds_per_step"] > 0
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_benchmark_end_to_end_local():
+    """Two local candidates run a tiny callback-armed workload; the
+    harness collects summaries and derives sec/step."""
+    script = (
+        "import time; from skypilot_tpu import callbacks as cb; "
+        "cb.init(total_steps=4); "
+        "[(cb.step_begin(), time.sleep(0.05), cb.step_end()) "
+        " for _ in range(4)]; cb.flush()")
+    task = Task("bench-task",
+                run=f"{sys.executable} -c {script!r}",
+                envs={"PYTHONPATH": REPO_ROOT})
+    task.set_resources(Resources(cloud="local"))
+
+    names = benchmark_utils.launch_benchmark(
+        task, [Resources(cloud="local"), Resources(cloud="local")],
+        "b1")
+    assert names == ["stpu-bench-b1-0", "stpu-bench-b1-1"]
+    with pytest.raises(ValueError, match="already exists"):
+        benchmark_utils.launch_benchmark(task, [], "b1")
+
+    deadline = time.time() + 60
+    rows = []
+    while time.time() < deadline:
+        rows = benchmark_utils.update_benchmark("b1")
+        if all(r["status"] == "FINISHED" for r in rows):
+            break
+        time.sleep(0.5)
+    assert len(rows) == 2
+    for r in rows:
+        assert r["status"] == "FINISHED", rows
+        assert r["num_steps"] == 4
+        assert r["seconds_per_step"] > 0
+        assert "dollars_per_step" in r
+        assert r["total_steps"] == 4
+        assert "estimated_total_cost" in r
+
+    benchmark_utils.teardown_benchmark("b1")
+    from skypilot_tpu import global_user_state
+    assert all(
+        global_user_state.get_cluster_from_name(n) is None
+        for n in names)
+    # Results survive teardown.
+    kept = benchmark_state.get_results("b1")
+    assert all(r["status"] == "TERMINATED" and r["num_steps"] == 4
+               for r in kept)
+
+    runner = CliRunner()
+    out = runner.invoke(cli_mod.cli, ["bench", "show", "b1"])
+    assert out.exit_code == 0, out.output
+    assert "stpu-bench-b1-0" in out.output
+    out = runner.invoke(cli_mod.cli, ["bench", "delete", "b1"])
+    assert out.exit_code == 0
+    assert benchmark_state.get_results("b1") == []

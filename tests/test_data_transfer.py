@@ -1,0 +1,104 @@
+"""Cross-cloud transfer + storage CLI group (hermetic: local stores and
+a mocked Storage Transfer Service; reference analog:
+sky/data/data_transfer.py:39 + sky/cli.py:3852)."""
+import json
+
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu import cli as cli_mod
+from skypilot_tpu import core, exceptions, global_user_state
+from skypilot_tpu.data import data_transfer
+from skypilot_tpu.data import storage as storage_lib
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_local_transfer_and_registry(tmp_path):
+    src_dir = tmp_path / "data"
+    src_dir.mkdir()
+    (src_dir / "a.txt").write_text("payload")
+    st = storage_lib.Storage(name="bkt-src", source=str(src_dir),
+                             store="local")
+    st.sync()
+    assert [r["name"] for r in core.storage_ls()] == ["bkt-src"]
+
+    data_transfer.transfer("local", "bkt-src", "local", "bkt-dst")
+    from skypilot_tpu.utils import paths
+    assert (paths.home() / "buckets" / "bkt-dst" / "a.txt"
+            ).read_text() == "payload"
+
+    with pytest.raises(exceptions.NotSupportedError, match="route"):
+        data_transfer.transfer("gcs", "a", "local", "b")
+    with pytest.raises(exceptions.StorageError, match="not found"):
+        data_transfer.local_to_local("missing", "x")
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_s3_to_gcs_via_fake_sts(monkeypatch):
+    """The STS flow: create job -> poll operations -> done."""
+    calls = []
+
+    def fake_rest(method, path, body=None):
+        calls.append((method, path))
+        if method == "POST" and path == "transferJobs":
+            assert body["transferSpec"]["awsS3DataSource"][
+                "bucketName"] == "src-s3"
+            assert body["transferSpec"]["gcsDataSink"][
+                "bucketName"] == "dst-gcs"
+            return {"name": "transferJobs/12345"}
+        if method == "GET" and path.startswith("transferOperations"):
+            done = len(calls) > 2  # first poll: running; second: done
+            return {"operations": [{"done": done}]}
+        raise AssertionError(f"unexpected call {method} {path}")
+
+    monkeypatch.setattr(data_transfer, "rest", fake_rest)
+    data_transfer.s3_to_gcs(
+        "src-s3", "dst-gcs", project_id="proj",
+        aws_access_key_id="AK", aws_secret_access_key="SK",
+        poll_seconds=0.01)
+    assert calls[0] == ("POST", "transferJobs")
+    assert len(calls) >= 3
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_s3_to_gcs_propagates_operation_error(monkeypatch):
+    def fake_rest(method, path, body=None):
+        if method == "POST":
+            return {"name": "transferJobs/x"}
+        return {"operations": [
+            {"done": True, "error": {"code": 7, "message": "denied"}}]}
+
+    monkeypatch.setattr(data_transfer, "rest", fake_rest)
+    with pytest.raises(exceptions.StorageError, match="denied"):
+        data_transfer.s3_to_gcs("a", "b", project_id="p",
+                                aws_access_key_id="AK",
+                                aws_secret_access_key="SK",
+                                poll_seconds=0.01)
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_storage_cli_ls_delete_transfer(tmp_path):
+    src_dir = tmp_path / "d"
+    src_dir.mkdir()
+    (src_dir / "f").write_text("x")
+    storage_lib.Storage(name="bkt-cli", source=str(src_dir),
+                        store="local").sync()
+
+    runner = CliRunner()
+    out = runner.invoke(cli_mod.cli, ["storage", "ls"])
+    assert out.exit_code == 0 and "bkt-cli" in out.output
+
+    out = runner.invoke(cli_mod.cli, [
+        "storage", "transfer", "local://bkt-cli", "local://bkt2"])
+    assert out.exit_code == 0, out.output
+
+    out = runner.invoke(cli_mod.cli,
+                        ["storage", "delete", "bkt-cli", "--yes"])
+    assert out.exit_code == 0, out.output
+    assert core.storage_ls() == []
+    from skypilot_tpu.utils import paths
+    assert not (paths.home() / "buckets" / "bkt-cli").exists()
+
+    out = runner.invoke(cli_mod.cli,
+                        ["storage", "delete", "nope", "--yes"])
+    assert out.exit_code != 0
