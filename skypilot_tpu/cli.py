@@ -319,6 +319,16 @@ def jobs_logs(job_id, no_follow):
     sys.exit(jobs_core.tail_logs(job_id, follow=not no_follow))
 
 
+@jobs.command(name="dashboard")
+@click.option("--port", default=None, type=int)
+@click.option("--host", default=None)
+def jobs_dashboard(port, host):
+    """Serve an auto-refreshing HTML view of the managed-jobs queue."""
+    from skypilot_tpu.jobs import dashboard
+    dashboard.run(port or dashboard.DEFAULT_PORT,
+                  host or dashboard.DEFAULT_HOST)
+
+
 @cli.group()
 def bench():
     """Benchmark a task across candidate TPU types ($/step report)."""

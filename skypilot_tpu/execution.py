@@ -15,6 +15,7 @@ from skypilot_tpu import optimizer as optimizer_lib
 from skypilot_tpu.backends import slice_backend
 from skypilot_tpu.status_lib import ClusterStatus
 from skypilot_tpu.task import Task
+from skypilot_tpu.utils import usage_lib
 
 
 class Stage(enum.Enum):
@@ -114,6 +115,7 @@ def _execute(
     return job_id, handle
 
 
+@usage_lib.entrypoint
 def launch(
     task: Union[Task, dag_lib.Dag],
     cluster_name: Optional[str] = None,
@@ -139,6 +141,7 @@ def launch(
         retry_until_up=retry_until_up, no_setup=no_setup)
 
 
+@usage_lib.entrypoint
 def exec(  # noqa: A001  (mirrors the reference name sky.exec)
     task: Union[Task, dag_lib.Dag],
     cluster_name: str,

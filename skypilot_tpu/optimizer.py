@@ -8,9 +8,10 @@ back by the provisioner's failover loop so re-optimization after exhaustion
 skips known-bad placements (reference provision_with_retries:2030-2045).
 
 Chains use exact DP over (task, candidate) with inter-task egress cost;
-general DAGs fall back to per-task greedy min (the reference uses ILP there;
-its own tests cross-check ILP == DP on chains, and chains are the only shape
-the downstream jobs pipeline supports).
+general DAGs use exact enumeration of the assignment space with per-edge
+egress (the role the reference's ILP plays, sky/optimizer.py:434 — no ILP
+solver in this image), falling back to per-task greedy min with a warning
+only above GENERAL_DAG_MAX_SPACE.
 """
 from __future__ import annotations
 
@@ -179,8 +180,8 @@ class Optimizer:
         if dag.is_chain():
             plan = Optimizer._optimize_chain_dp(order, per_task, minimize)
         else:
-            plan = {id(t): Optimizer._best(per_task[id(t)], minimize)
-                    for t in order}
+            plan = Optimizer._optimize_general(dag, order, per_task,
+                                               minimize)
 
         for task in order:
             task.best_resources = plan[id(task)].resources
@@ -247,6 +248,63 @@ class Optimizer:
             plan[id(order[i])] = per_task[id(order[i])][j]
             j = back[i][j]
         return plan
+
+    # Exhaustive general-DAG search caps the assignment-space size; above
+    # it we fall back to per-task independent choice (the pre-exact
+    # behavior). The reference solves this case with an ILP
+    # (sky/optimizer.py:434 _optimize_by_ilp via PuLP); this image has no
+    # ILP solver, and real DAGs are small, so exact enumeration fills the
+    # same role and is cross-checked against the chain DP in tests.
+    GENERAL_DAG_MAX_SPACE = 200_000
+
+    @staticmethod
+    def _optimize_general(dag, order, per_task: Dict[int, List[Candidate]],
+                          minimize: OptimizeTarget
+                          ) -> Dict[int, Candidate]:
+        """Exact plan for a general DAG with per-edge egress cost.
+
+        COST: sum of node costs + egress over every edge. TIME: critical-
+        path runtime (longest path), cost as tie-break.
+        """
+        import itertools
+        import math
+        import sys
+        space = math.prod(len(per_task[id(t)]) for t in order)
+        if space > Optimizer.GENERAL_DAG_MAX_SPACE:
+            print(f"optimizer: DAG assignment space ({space:,}) exceeds "
+                  f"{Optimizer.GENERAL_DAG_MAX_SPACE:,}; placing each "
+                  f"task independently — inter-task egress cost is NOT "
+                  f"optimized. Pin regions to co-locate tasks.",
+                  file=sys.stderr)
+            return {id(t): Optimizer._best(per_task[id(t)], minimize)
+                    for t in order}
+
+        parents = {id(t): dag.parents(t) for t in order}
+        edges = [(parent, child) for child in order
+                 for parent in parents[id(child)]]
+        best_key, best_plan = None, None
+        for combo in itertools.product(
+                *[per_task[id(t)] for t in order]):
+            sel = {id(t): c for t, c in zip(order, combo)}
+            cost = sum(c.cost for c in combo)
+            for parent, child in edges:
+                cost += Optimizer._egress_cost(parent, sel[id(parent)],
+                                               sel[id(child)])
+            if minimize == OptimizeTarget.TIME:
+                # Longest path through the DAG under this assignment.
+                finish: Dict[int, float] = {}
+                for t in order:  # topo order
+                    start = max(
+                        (finish[id(p)] for p in parents[id(t)]),
+                        default=0.0)
+                    finish[id(t)] = start + sel[id(t)].runtime_seconds
+                key = (max(finish.values()), cost)
+            else:
+                key = (cost,
+                       sum(c.runtime_seconds for c in combo))
+            if best_key is None or key < best_key:
+                best_key, best_plan = key, sel
+        return best_plan
 
     @staticmethod
     def print_optimized_plan(dag, per_task, plan, minimize) -> None:

@@ -72,12 +72,13 @@ class Resources:
         if self.cloud == "local":
             return  # no catalog validation for the hermetic provider
         if self.accelerator is not None:
-            if not catalog.is_tpu(self.accelerator):
-                raise exceptions.InvalidTaskError(
-                    f"Unknown accelerator {self.accelerator!r}: this "
-                    f"framework schedules TPU slices (tpu-v5e-8, "
-                    f"tpu-v5p-64, ...).")
-            catalog.slice_info(self.accelerator)  # validates name + size
+            # Normalize user spellings (V5E-8, tpu_v5e_8, v5litepod-8)
+            # to the canonical catalog name, validating against it.
+            from skypilot_tpu.utils import accelerator_registry
+            object.__setattr__(
+                self, "accelerator",
+                accelerator_registry.canonicalize_accelerator_name(
+                    self.accelerator))
             if self.instance_type is not None:
                 raise exceptions.InvalidTaskError(
                     "accelerator and instance_type are mutually exclusive "

@@ -1,0 +1,136 @@
+"""Aux-subsystem gaps: general-DAG optimizer, accelerator registry,
+jobs dashboard, usage telemetry."""
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu.optimizer import OptimizeTarget, Optimizer
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import accelerator_registry
+
+
+def _tpu_task(name, acc="tpu-v5e-8", out_gb=0.0):
+    t = Task(name, run="true")
+    t.set_resources(Resources(accelerator=acc))
+    if out_gb:
+        t.estimated_output_gb = out_gb
+    return t
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_general_dag_matches_chain_dp():
+    """A chain routed through the general-DAG solver must match the
+    chain DP exactly (the reference cross-checks DP vs ILP the same
+    way, tests/test_optimizer_random_dag.py)."""
+    for minimize in (OptimizeTarget.COST, OptimizeTarget.TIME):
+        tasks_a = [_tpu_task(f"a{i}", out_gb=50.0) for i in range(3)]
+        with dag_lib.Dag() as chain:
+            for t in tasks_a:
+                chain.add(t)
+            chain.add_edge(tasks_a[0], tasks_a[1])
+            chain.add_edge(tasks_a[1], tasks_a[2])
+        assert chain.is_chain()
+        Optimizer.optimize(chain, minimize=minimize, quiet=True)
+
+        per_task = {id(t): optimizer_lib.launchable_candidates(t)
+                    for t in chain.topo_order()}
+        general = Optimizer._optimize_general(
+            chain, chain.topo_order(), per_task, minimize)
+        for t in tasks_a:
+            assert general[id(t)].resources.zone == \
+                t.best_resources.zone, minimize
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_general_dag_egress_aware():
+    """Diamond DAG: the solver must co-locate tasks to avoid egress."""
+    a = _tpu_task("a", out_gb=1000.0)
+    b = _tpu_task("b", out_gb=1000.0)
+    c = _tpu_task("c", out_gb=1000.0)
+    d = _tpu_task("d")
+    with dag_lib.Dag() as dag:
+        for t in (a, b, c, d):
+            dag.add(t)
+        dag.add_edge(a, b)
+        dag.add_edge(a, c)
+        dag.add_edge(b, d)
+        dag.add_edge(c, d)
+    assert not dag.is_chain()
+    Optimizer.optimize(dag, quiet=True)
+    regions = {t.best_resources.region for t in (a, b, c, d)}
+    assert len(regions) == 1, f"egress not avoided: {regions}"
+
+
+def test_accelerator_canonicalization():
+    can = accelerator_registry.canonicalize_accelerator_name
+    assert can("tpu-v5e-8") == "tpu-v5e-8"
+    assert can("V5E-8") == "tpu-v5e-8"
+    assert can("tpu_v5p_64") == "tpu-v5p-64"
+    assert can("v5litepod-8") == "tpu-v5e-8"
+    with pytest.raises(exceptions.InvalidTaskError, match="Did you mean"):
+        can("tpu-v5e-9")
+    assert accelerator_registry.is_schedulable_non_gpu_accelerator(
+        "tpu-v5e-8")
+    assert not accelerator_registry.is_schedulable_non_gpu_accelerator(
+        "A100")
+    # Resources normalizes on construction.
+    assert Resources(accelerator="V5E-8").accelerator == "tpu-v5e-8"
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_jobs_dashboard_serves_queue():
+    from skypilot_tpu.jobs import dashboard
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.jobs.state import ManagedJobStatus
+
+    job_id = jobs_state.add_job("dash-job", "/dev/null", "local", 1)
+    jobs_state.set_status(job_id, ManagedJobStatus.RUNNING)
+
+    httpd = dashboard.serve(0)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=10).read().decode()
+        assert "dash-job" in page and "RUNNING" in page
+        api = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api", timeout=10).read())
+        assert api["jobs"][0]["job_name"] == "dash-job"
+    finally:
+        httpd.shutdown()
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_usage_records_entrypoints(monkeypatch):
+    from skypilot_tpu.utils import paths, usage_lib
+
+    @usage_lib.entrypoint
+    def sample(x):
+        if x < 0:
+            raise ValueError("nope")
+        return x * 2
+
+    assert sample(3) == 6
+    with pytest.raises(ValueError):
+        sample(-1)
+    lines = [json.loads(line) for line in
+             (paths.home() / "usage" / "usage.jsonl"
+              ).read_text().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["outcome"] == "ok"
+    assert lines[1]["outcome"] == "error"
+    assert lines[1]["exception"] == "ValueError"
+    assert "sample" in lines[0]["entrypoint"]
+
+    # Opt-out: nothing new recorded.
+    monkeypatch.setenv(usage_lib.DISABLE_ENV, "1")
+    sample(1)
+    lines2 = (paths.home() / "usage" / "usage.jsonl"
+              ).read_text().splitlines()
+    assert len(lines2) == 2
