@@ -116,6 +116,7 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
         with _cluster_lock(cluster_name):
             record = global_user_state.get_cluster_from_name(cluster_name)
             if record is not None and record["handle"] is not None:
+                global_user_state.check_owner_identity(record)
                 handle = record["handle"]
                 if record["status"] == ClusterStatus.UP:
                     self.check_resources_fit_cluster(handle, task)
@@ -210,6 +211,10 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
         global_user_state.add_or_update_cluster(
             cluster_name, handle=handle, requested_resources=res,
             ready=True)
+        # `ssh <cluster>` convenience entries (reference SSHConfigHelper,
+        # backend_utils.py:398); no-op for the local provider.
+        from skypilot_tpu.utils import ssh_config
+        ssh_config.add_cluster(handle)
         return handle
 
     def _post_provision_setup(self, handle: SliceHandle) -> None:

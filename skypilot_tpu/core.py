@@ -19,6 +19,7 @@ def _get_handle(cluster_name: str) -> slice_backend.SliceHandle:
     if record is None or record["handle"] is None:
         raise exceptions.ClusterNotUpError(
             f"Cluster {cluster_name!r} not found.")
+    global_user_state.check_owner_identity(record)
     return record["handle"]
 
 

@@ -161,6 +161,9 @@ def exec(  # noqa: A001  (mirrors the reference name sky.exec)
         raise exceptions.ClusterNotUpError(
             f"Cluster {cluster_name!r} is {record['status'].value}, "
             f"not UP.", cluster_status=record["status"])
+    # exec runs code on the cluster — it must be identity-guarded like
+    # every other operation on an existing cluster.
+    global_user_state.check_owner_identity(record)
     dag = _to_dag(task)
     the_task = dag.tasks[0]
     handle = record["handle"]

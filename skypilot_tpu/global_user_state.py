@@ -37,7 +37,13 @@ def _create_tables(conn: sqlite3.Connection) -> None:
         autostop INTEGER DEFAULT -1,
         to_down INTEGER DEFAULT 0,
         usage_intervals BLOB,
-        requested_resources BLOB)""")
+        requested_resources BLOB,
+        owner TEXT)""")
+    # Migration for pre-owner DBs.
+    try:
+        conn.execute("ALTER TABLE clusters ADD COLUMN owner TEXT")
+    except sqlite3.OperationalError:
+        pass
     conn.execute("""CREATE TABLE IF NOT EXISTS cluster_history (
         cluster_hash TEXT,
         name TEXT,
@@ -78,27 +84,33 @@ def add_or_update_cluster(cluster_name: str, handle: Any,
             launched_at = row[1] or now
         if is_launch and (not intervals or intervals[-1][1] is not None):
             intervals.append((now, None))
+        from skypilot_tpu.utils import usage_lib
+        # Ownership is claimed only at launch; later status updates must
+        # not let a different identity adopt a legacy (NULL-owner) row.
+        owner = usage_lib.user_identity() if is_launch else None
         conn.execute(
             """INSERT INTO clusters
                (name, launched_at, handle, last_use, status, autostop,
-                to_down, usage_intervals, requested_resources)
+                to_down, usage_intervals, requested_resources, owner)
                VALUES (?, ?, ?, ?, ?,
                        COALESCE((SELECT autostop FROM clusters
                                  WHERE name=?), -1),
                        COALESCE((SELECT to_down FROM clusters
-                                 WHERE name=?), 0), ?, ?)
+                                 WHERE name=?), 0), ?, ?, ?)
                ON CONFLICT(name) DO UPDATE SET
                  handle=excluded.handle, last_use=excluded.last_use,
                  status=excluded.status,
                  usage_intervals=excluded.usage_intervals,
                  requested_resources=COALESCE(
                      excluded.requested_resources,
-                     clusters.requested_resources)""",
+                     clusters.requested_resources),
+                 owner=COALESCE(clusters.owner, excluded.owner)""",
             (cluster_name, launched_at, pickle.dumps(handle),
              json.dumps({"ts": now}), status.value, cluster_name,
              cluster_name, pickle.dumps(intervals),
              pickle.dumps(requested_resources)
-             if requested_resources is not None else None))
+             if requested_resources is not None else None,
+             owner))
 
 
 def update_cluster_status(cluster_name: str,
@@ -161,6 +173,11 @@ def remove_cluster(cluster_name: str, terminate: bool) -> None:
              duration, pickle.dumps(launched),
              getattr(handle, "num_slices", 1), cost))
         conn.execute("DELETE FROM clusters WHERE name=?", (cluster_name,))
+    # All terminate paths (backend teardown, status reconciler, jobs
+    # recovery, serve) funnel through here — drop the `ssh <cluster>`
+    # alias so a recycled IP can't be reached via a stale Host block.
+    from skypilot_tpu.utils import ssh_config
+    ssh_config.remove_cluster(cluster_name)
 
 
 def get_cluster_from_name(
@@ -168,7 +185,7 @@ def get_cluster_from_name(
     with _conn() as conn:
         row = conn.execute(
             "SELECT name, launched_at, handle, last_use, status, autostop, "
-            "to_down, usage_intervals FROM clusters WHERE name=?",
+            "to_down, usage_intervals, owner FROM clusters WHERE name=?",
             (cluster_name,)).fetchone()
     return _row_to_record(row) if row else None
 
@@ -177,14 +194,35 @@ def get_clusters() -> List[Dict[str, Any]]:
     with _conn() as conn:
         rows = conn.execute(
             "SELECT name, launched_at, handle, last_use, status, autostop, "
-            "to_down, usage_intervals FROM clusters "
+            "to_down, usage_intervals, owner FROM clusters "
             "ORDER BY launched_at DESC").fetchall()
     return [_row_to_record(r) for r in rows]
 
 
+def check_owner_identity(record: Dict[str, Any]) -> None:
+    """Refuse to operate on a cluster created by a different user
+    identity (reference: check_owner_identity,
+    sky/backends/backend_utils.py:1536). Override with
+    STPU_SKIP_IDENTITY_CHECK=1 (intentional handover)."""
+    import os
+    if os.environ.get("STPU_SKIP_IDENTITY_CHECK") == "1":
+        return
+    owner = record.get("owner")
+    if owner is None:
+        return  # record predates owner tracking
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.utils import usage_lib
+    me = usage_lib.user_identity()
+    if owner != me:
+        raise exceptions.ClusterOwnerIdentityMismatchError(
+            f"Cluster {record['name']!r} was created by identity "
+            f"{owner!r}; current identity is {me!r}. Set "
+            f"STPU_SKIP_IDENTITY_CHECK=1 to override.")
+
+
 def _row_to_record(row) -> Dict[str, Any]:
     (name, launched_at, handle, last_use, status, autostop, to_down,
-     intervals) = row
+     intervals, owner) = row
     return {
         "name": name,
         "launched_at": launched_at,
@@ -194,6 +232,7 @@ def _row_to_record(row) -> Dict[str, Any]:
         "autostop": autostop,
         "to_down": bool(to_down),
         "usage_intervals": pickle.loads(intervals) if intervals else [],
+        "owner": owner,
     }
 
 

@@ -16,7 +16,6 @@ import hashlib
 import json
 import os
 import time
-import traceback
 import uuid
 from typing import Any, Callable
 
@@ -30,7 +29,18 @@ def _enabled() -> bool:
 
 
 def _user_hash() -> str:
-    return hashlib.md5(getpass.getuser().encode()).hexdigest()[:8]
+    try:
+        user = getpass.getuser()
+    except (KeyError, OSError):
+        # No passwd entry / no USER env (bare-UID containers).
+        user = f"uid-{os.getuid()}"
+    return hashlib.md5(user.encode()).hexdigest()[:8]
+
+
+def user_identity() -> str:
+    """Stable identity for cluster ownership checks (reference:
+    check_owner_identity, sky/backends/backend_utils.py:1536)."""
+    return _user_hash()
 
 
 def _record(payload: dict) -> None:
@@ -73,7 +83,3 @@ def entrypoint(fn: Callable) -> Callable:
                 pass  # usage recording must never break the call
 
     return wrapper
-
-
-def last_exception_context() -> str:
-    return traceback.format_exc(limit=3)

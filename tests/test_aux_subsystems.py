@@ -134,3 +134,64 @@ def test_usage_records_entrypoints(monkeypatch):
     lines2 = (paths.home() / "usage" / "usage.jsonl"
               ).read_text().splitlines()
     assert len(lines2) == 2
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_owner_identity_check(monkeypatch):
+    from skypilot_tpu import core, execution, global_user_state
+    from skypilot_tpu.utils import usage_lib
+
+    t = Task("own", run="true")
+    t.set_resources(Resources(cloud="local"))
+    execution.launch(t, cluster_name="t-own", detach_run=True,
+                     stream_logs=False)
+    record = global_user_state.get_cluster_from_name("t-own")
+    assert record["owner"] == usage_lib.user_identity()
+    core.queue("t-own")  # same identity: fine
+
+    monkeypatch.setattr(usage_lib, "user_identity", lambda: "someone")
+    with pytest.raises(
+            exceptions.ClusterOwnerIdentityMismatchError,
+            match="created by identity"):
+        core.stop("t-own")
+    # Override for intentional handover.
+    monkeypatch.setenv("STPU_SKIP_IDENTITY_CHECK", "1")
+    core.down("t-own")
+    assert global_user_state.get_cluster_from_name("t-own") is None
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_ssh_config_helper(tmp_path, monkeypatch):
+    from skypilot_tpu.provision.common import ClusterInfo, InstanceInfo
+    from skypilot_tpu.utils import ssh_config
+
+    user_cfg = tmp_path / "sshconfig"
+    monkeypatch.setenv("STPU_SSH_CONFIG", str(user_cfg))
+
+    instances = {
+        f"h{i}": InstanceInfo(
+            instance_id=f"h{i}", internal_ip=f"10.0.0.{i}",
+            external_ip=f"34.1.2.{i}", slice_id="slice-0",
+            host_index=i, tags={})
+        for i in range(2)
+    }
+    info = ClusterInfo(cluster_name="c1", provider_name="gcp",
+                       region="us-central1", zone="us-central1-a",
+                       instances=instances, head_instance_id="h0",
+                       provider_config={})
+
+    class FakeHandle:
+        cluster_name = "c1"
+        cluster_info = info
+
+    ssh_config.add_cluster(FakeHandle())
+    block = ssh_config.cluster_config_path("c1").read_text()
+    assert "Host c1\n" in block and "HostName 34.1.2.0" in block
+    assert "Host c1-1\n" in block and "HostName 34.1.2.1" in block
+    # Include line prepended exactly once, idempotently.
+    ssh_config.add_cluster(FakeHandle())
+    assert user_cfg.read_text().count("Include") == 1
+
+    ssh_config.remove_cluster("c1")
+    assert ssh_config.cluster_config_path("c1") is None
+    ssh_config.remove_cluster("c1")  # idempotent
