@@ -211,11 +211,20 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
         global_user_state.add_or_update_cluster(
             cluster_name, handle=handle, requested_resources=res,
             ready=True)
-        # `ssh <cluster>` convenience entries (reference SSHConfigHelper,
-        # backend_utils.py:398); no-op for the local provider.
-        from skypilot_tpu.utils import ssh_config
-        ssh_config.add_cluster(handle)
+        self._write_ssh_config(handle)
         return handle
+
+    @staticmethod
+    def _write_ssh_config(handle) -> None:
+        """`ssh <cluster>` convenience entries (reference SSHConfigHelper,
+        backend_utils.py:398); best-effort — an unwritable ~/.ssh must
+        not fail a launch whose cluster is already up and billing."""
+        from skypilot_tpu.utils import ssh_config
+        try:
+            ssh_config.add_cluster(handle)
+        except OSError as e:
+            print(f"warning: could not write ssh config for "
+                  f"{handle.cluster_name}: {e}", file=sys.stderr)
 
     def _post_provision_setup(self, handle: SliceHandle) -> None:
         """Wait for SSH + install the agent runtime on real clouds; for
@@ -303,6 +312,8 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
         handle.cluster_info = provision_api.get_cluster_info(
             provider, res.region, handle.cluster_name, provider_config)
         self._post_provision_setup(handle)
+        # Restarted hosts may have new IPs: refresh the ssh aliases.
+        self._write_ssh_config(handle)
         # A restart disables any previous autostop (reference `sky start`
         # semantics): otherwise the restarted daemon reads the stale
         # autostop.json, sees only old terminal jobs, and stops the

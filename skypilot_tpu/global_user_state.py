@@ -85,9 +85,10 @@ def add_or_update_cluster(cluster_name: str, handle: Any,
         if is_launch and (not intervals or intervals[-1][1] is not None):
             intervals.append((now, None))
         from skypilot_tpu.utils import usage_lib
-        # Ownership is claimed only at launch; later status updates must
-        # not let a different identity adopt a legacy (NULL-owner) row.
-        owner = usage_lib.user_identity() if is_launch else None
+        # Ownership is claimed exactly once, at row creation; restarts
+        # and status updates must never let a different identity adopt
+        # an existing (possibly legacy NULL-owner) row.
+        owner = usage_lib.user_identity() if row is None else None
         conn.execute(
             """INSERT INTO clusters
                (name, launched_at, handle, last_use, status, autostop,

@@ -1,18 +1,22 @@
 """Blockwise (flash) attention as Pallas TPU kernels, fwd and bwd.
 
-Forward: online-softmax over KV blocks, working set held in VMEM, logits
-never materialized in HBM (O(S*D) traffic instead of O(S^2)).
+Forward: online-softmax with the KV loop as a *grid dimension* — each
+step stages one (block_k, d) tile into VMEM and carries (m, l, acc) in
+VMEM scratch, so the working set is O(block) regardless of sequence
+length (64k+ sequences compile; an in-kernel full-K load would blow VMEM
+past ~8k). Logits never touch HBM.
 
-Backward: two Pallas kernels from the saved (q, k, v, o, lse) — a dq
-kernel gridded over Q blocks (inner loop over KV blocks) and a dk/dv
-kernel gridded over KV blocks (inner loop over Q blocks), the standard
-flash-attention-2 split so each output block has a single writer and no
-cross-block reduction. delta = rowsum(do*o) is recomputed in-kernel from
-the o/do blocks. Causal runs skip fully-masked block pairs on both sides.
+Backward: two kernels from the saved (q, k, v, o, lse) — a dq kernel
+gridded (batch, head, q_block, kv_block) and a dk/dv kernel gridded
+(batch, kv_block, head, q_block), the flash-attention-2 split so each
+output block has a single writer. delta = rowsum(do*o) is recomputed
+in-kernel. Causal runs skip fully-masked block pairs via predicated
+compute on the grid.
 
-Supports causal masking and GQA (n_heads % n_kv_heads == 0): the backward
-computes per-query-head dk/dv and the group-sum back to KV heads happens
-in XLA outside the kernel.
+GQA (n_heads % n_kv_heads == 0): the dk/dv kernel orders the grid so one
+KV head's query-head group and all q blocks are consecutive steps; the
+group-sum accumulates in VMEM scratch and writes (B, KVH, S, D) once —
+no per-query-head gradient reaches HBM.
 """
 from __future__ import annotations
 
@@ -29,11 +33,306 @@ _NEG_INF = -1e30
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 
+LSE_PAD = 8    # trailing tile dim for the lse output (tiling constraint)
+_STAT = 128    # lane width for the (m, l) scratch carries
 
-LSE_PAD = 8  # trailing tile dim for the lse output (tiling constraint)
+
+def _causal_mask(s, q_start, k_start):
+    bq, bk = s.shape
+    qpos = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = k_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(qpos >= kpos, s, _NEG_INF)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale: float, causal: bool):
+    # Blocks: q/o (bq, d); k/v (bk, d); lse (bq, LSE_PAD).
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+    bq, d = q_ref.shape
+    bk = k_ref.shape[0]
+    q_start = qi * bq
+    k_start = ki * bk
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Causal: a KV block right of the Q block's last row contributes
+    # nothing — skip its compute (the fetch already happened).
+    run = (k_start <= q_start + bq - 1) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, q_start, k_start)
+        m_prev = m_scr[...][:, 0:1]
+        l_prev = l_scr[...][:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[...][:, 0:1], 1e-30)
+        o_ref[...] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[...] = jnp.broadcast_to(
+            m_scr[...][:, 0:1] + jnp.log(l), lse_ref.shape)
+
+
+def _flash_fwd_streamed(q: jax.Array, k: jax.Array, v: jax.Array, *,
+               causal: bool, scale: float,
+               block_q: int, block_k: int,
+               keep_lse_pad: bool = False
+               ) -> Tuple[jax.Array, jax.Array]:
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    groups = h // kvh
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    grid = (b, h, s // block_q, s // block_k)
+
+    # Kernel operates in (B, H, S, D) layout so the last two dims of every
+    # block are MXU/VPU-tileable (S and D); XLA fuses the transposes into
+    # the surrounding projections.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // groups, ki, 0)),
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // groups, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q, LSE_PAD),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, LSE_PAD), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _STAT), jnp.float32),
+            pltpu.VMEM((block_q, _STAT), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=jax.default_backend() == "cpu",
+    )(qt, kt, vt)
+    # keep_lse_pad: the (B,H,S,LSE_PAD) layout feeds the bwd kernels
+    # directly (already lane-tileable); [..., 0] is the logical value.
+    return out.transpose(0, 2, 1, 3), (lse if keep_lse_pad
+                                       else lse[..., 0])
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+               dq_scr, delta_scr, *, scale: float, causal: bool):
+    # Blocks: q/o/do/dq (bq, d); k/v (bk, d); lse (bq, LSE_PAD).
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+    bq, d = q_ref.shape
+    bk = k_ref.shape[0]
+    q_start = qi * bq
+    k_start = ki * bk
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+        # delta depends only on the q block — compute once, not per
+        # KV step (nk can be 256+ on the long-context path).
+        do = do_ref[...].astype(jnp.float32)
+        o = o_ref[...].astype(jnp.float32)
+        delta_scr[...] = jnp.broadcast_to(
+            jnp.sum(do * o, axis=-1, keepdims=True), delta_scr.shape)
+
+    run = (k_start <= q_start + bq - 1) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...][:, 0:1]
+        delta = delta_scr[...][:, 0:1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, q_start, k_start)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[...] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                causal: bool, groups: int):
+    # Grid (batch, kv_block, head, q_block): for one KV-head group the
+    # `groups * nq` innermost steps hit the same (bi, hi//groups, ki)
+    # output block; dk/dv accumulate in scratch (the GQA group-sum) and
+    # write once at the group's final step.
+    ki, hi, qi = pl.program_id(1), pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+    bq, d = q_ref.shape
+    bk = k_ref.shape[0]
+    q_start = qi * bq
+    k_start = ki * bk
+
+    first = jnp.logical_and(hi % groups == 0, qi == 0)
+
+    @pl.when(first)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    run = (q_start + bq - 1 >= k_start) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        o = o_ref[...].astype(jnp.float32)
+        lse = lse_ref[...][:, 0:1]
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, q_start, k_start)
+        p = jnp.exp(s - lse)
+        # dv += p^T @ do ; dk += ds^T @ (q*scale)
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    last = jnp.logical_and(hi % groups == groups - 1, qi == nq - 1)
+
+    @pl.when(last)
+    def _finish():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_streamed(res, do, *, causal: bool, scale: float,
+               block_q: int, block_k: int):
+    q, k, v, o, lse_pad = res
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    groups = h // kvh
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    nq, nk = s // block_q, s // block_k
+    interpret = jax.default_backend() == "cpu"
+
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    ot = o.transpose(0, 2, 1, 3)
+    dot_ = do.transpose(0, 2, 1, 3)
+
+    qspec = pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    kvspec = pl.BlockSpec((None, None, block_k, d),
+                          lambda bi, hi, qi, ki: (bi, hi // groups, ki, 0))
+    lse_q = pl.BlockSpec((None, None, block_q, LSE_PAD),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+
+    dqt = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal),
+        grid=(b, h, nq, nk),
+        in_specs=[qspec, kvspec, kvspec, qspec, qspec, lse_q],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, _STAT), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, ot, dot_, lse_pad)
+
+    # Grid (batch, kv_block, head, q_block): head × q_block innermost so
+    # one KV head's whole group accumulates into the resident output.
+    q_h = pl.BlockSpec((None, None, block_q, d),
+                       lambda bi, ki, hi, qi: (bi, hi, qi, 0))
+    kv_h = pl.BlockSpec((None, None, block_k, d),
+                        lambda bi, ki, hi, qi: (bi, hi // groups, ki, 0))
+    lse_h = pl.BlockSpec((None, None, block_q, LSE_PAD),
+                         lambda bi, ki, hi, qi: (bi, hi, qi, 0))
+    dkt, dvt = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          groups=groups),
+        grid=(b, nk, h, nq),
+        in_specs=[q_h, kv_h, kv_h, q_h, q_h, lse_h],
+        out_specs=[kv_h, kv_h],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kvh, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, kvh, s, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, ot, dot_, lse_pad)
+
+    dq = dqt.transpose(0, 2, 1, 3)
+    dk = dkt.transpose(0, 2, 1, 3)
+    dv = dvt.transpose(0, 2, 1, 3)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+
+# --------------------------------------------------------------------------
+# Resident-KV kernel family: K/V (fwd, dq) and Q/O/dO (dkv) are staged into
+# VMEM once per head and reused across the in-kernel block loop — fastest
+# for short/medium sequences, but the full-sequence staging caps length.
+# The streamed family above keeps O(block) VMEM and scales to 64k+.
+# --------------------------------------------------------------------------
+
+def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                 scale: float, block_k: int, causal: bool, seq_len: int):
     # Refs are rank-reduced by the None dims in the BlockSpecs:
     # q_ref/o_ref: (block_q, d); k_ref/v_ref: (seq_len, d);
@@ -81,7 +380,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                                     (bq, lse_ref.shape[-1]))
 
 
-def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
+def _flash_fwd_resident(q: jax.Array, k: jax.Array, v: jax.Array, *,
                causal: bool, scale: float,
                block_q: int, block_k: int,
                keep_lse_pad: bool = False
@@ -101,7 +400,7 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
     vt = v.transpose(0, 2, 1, 3)
 
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
+        functools.partial(_fwd_kernel_resident, scale=scale, block_k=block_k,
                           causal=causal, seq_len=s),
         grid=grid,
         in_specs=[
@@ -132,7 +431,7 @@ def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
                                        else lse[..., 0])
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
+def _dq_kernel_resident(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
                scale: float, block_k: int, causal: bool, seq_len: int):
     # q/o/do/dq_ref: (block_q, d); k/v_ref: (seq_len, d);
     # lse_ref: (block_q, LSE_PAD)
@@ -173,7 +472,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
     dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+def _dkv_kernel_resident(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                 dk_ref, dv_ref, *, scale: float, block_q: int,
                 causal: bool, seq_len: int, groups: int):
     # k/v/dk/dv_ref: (block_k, d); q/o/do_ref: (seq_len, d);
@@ -236,7 +535,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_ref[...] += dv
 
 
-def _flash_bwd(res, do, *, causal: bool, scale: float,
+def _flash_bwd_resident(res, do, *, causal: bool, scale: float,
                block_q: int, block_k: int):
     q, k, v, o, lse_pad = res
     b, s, h, d = q.shape
@@ -260,7 +559,7 @@ def _flash_bwd(res, do, *, causal: bool, scale: float,
                          lambda bi, hi, i: (bi, hi, i, 0))
 
     dqt = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, block_k=block_k,
+        functools.partial(_dq_kernel_resident, scale=scale, block_k=block_k,
                           causal=causal, seq_len=s),
         grid=(b, h, s // block_q),
         in_specs=[qspec, kv_full, kv_full, qspec, qspec, lse_q],
@@ -280,7 +579,7 @@ def _flash_bwd(res, do, *, causal: bool, scale: float,
     lse_h = pl.BlockSpec((None, None, s, LSE_PAD),
                          lambda bi, i, hi: (bi, hi, 0, 0))
     dkt, dvt = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, block_q=block_q,
+        functools.partial(_dkv_kernel_resident, scale=scale, block_q=block_q,
                           causal=causal, seq_len=s, groups=groups),
         grid=(b, s // block_k, h),
         in_specs=[fullq_h, kvspec, kvspec, fullq_h, fullq_h, lse_h],
@@ -298,6 +597,37 @@ def _flash_bwd(res, do, *, causal: bool, scale: float,
     dk = dkt.transpose(0, 2, 1, 3)
     dv = dvt.transpose(0, 2, 1, 3)
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+
+
+# Streamed kernels stage 3 full-seq fp32 tensors at most in the resident
+# family; past this budget Mosaic runs out of VMEM, so dispatch by size.
+_RESIDENT_MAX_BYTES = 6 * 1024 * 1024
+
+
+def _use_resident(s: int, d: int) -> bool:
+    return 3 * s * d * 4 <= _RESIDENT_MAX_BYTES
+
+
+def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k,
+               keep_lse_pad: bool = False):
+    if _use_resident(q.shape[1], q.shape[3]):
+        return _flash_fwd_resident(q, k, v, causal=causal, scale=scale,
+                                   block_q=block_q, block_k=block_k,
+                                   keep_lse_pad=keep_lse_pad)
+    return _flash_fwd_streamed(q, k, v, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k,
+                               keep_lse_pad=keep_lse_pad)
+
+
+def _flash_bwd(res, do, *, causal, scale, block_q, block_k):
+    q = res[0]
+    if _use_resident(q.shape[1], q.shape[3]):
+        return _flash_bwd_resident(res, do, causal=causal, scale=scale,
+                                   block_q=block_q, block_k=block_k)
+    return _flash_bwd_streamed(res, do, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))

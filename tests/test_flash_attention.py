@@ -53,3 +53,29 @@ def test_flash_irregular_shape_falls_back():
                                              scale=None)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_streamed_kernels_match_resident(monkeypatch):
+    """Long-context (streamed) kernel family vs the resident-KV family:
+    same math, different VMEM strategy — outputs and grads must agree."""
+    q, k, v = _make_qkv(jax.random.key(3), s=256)
+
+    def run(use_resident):
+        monkeypatch.setattr(fa, "_use_resident",
+                            lambda s, d: use_resident)
+
+        def loss(q, k, v):
+            return jnp.sum(fa.flash_attention(
+                q, k, v, causal=True, block_q=64, block_k=64) ** 2)
+        out = fa.flash_attention(q, k, v, causal=True, block_q=64,
+                                 block_k=64)
+        grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return out, grads
+
+    o_r, g_r = run(True)
+    o_s, g_s = run(False)
+    np.testing.assert_allclose(np.asarray(o_s), np.asarray(o_r),
+                               rtol=2e-3, atol=2e-3)
+    for a, b in zip(g_s, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-3)
