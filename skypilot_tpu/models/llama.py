@@ -157,6 +157,31 @@ def lora_dense(y: jax.Array, lp: Params, name: str) -> jax.Array:
     return out
 
 
+def qkv_proj(cfg, y: jax.Array, lp: Params, positions: jax.Array):
+    """Projection + RoPE shared by the training forward and the KV-cache
+    decode path (they must never diverge). Returns (q, k, v); v unroped.
+    """
+    b, t = y.shape[0], y.shape[1]
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    q = lora_dense(y, lp, "wq").reshape(b, t, h, hd)
+    kk = lora_dense(y, lp, "wk").reshape(b, t, kvh, hd)
+    vv = lora_dense(y, lp, "wv").reshape(b, t, kvh, hd)
+    return (rope(q, positions, cfg.rope_theta),
+            rope(kk, positions, cfg.rope_theta), vv)
+
+
+def mlp_block(cfg, x: jax.Array, lp: Params,
+              constrain=lambda a, _spec: a) -> jax.Array:
+    """Pre-norm SwiGLU MLP residual block, shared by training and
+    decode."""
+    y = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(y @ lp["w_gate"])
+    up = y @ lp["w_up"]
+    mlp = constrain(gate * up, ("batch", "act_seq", "mlp"))
+    return x + constrain(mlp @ lp["w_down"],
+                         ("batch", "act_seq", "act_embed"))
+
+
 def attention_block(cfg, x: jax.Array, lp: Params, positions: jax.Array,
                     constrain) -> jax.Array:
     """Pre-norm GQA attention residual block, shared by llama and mixtral.
@@ -167,11 +192,7 @@ def attention_block(cfg, x: jax.Array, lp: Params, positions: jax.Array,
     b, s, d = x.shape
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     y = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = lora_dense(y, lp, "wq").reshape(b, s, h, hd)
-    kk = lora_dense(y, lp, "wk").reshape(b, s, kvh, hd)
-    vv = lora_dense(y, lp, "wv").reshape(b, s, kvh, hd)
-    q = rope(q, positions, cfg.rope_theta)
-    kk = rope(kk, positions, cfg.rope_theta)
+    q, kk, vv = qkv_proj(cfg, y, lp, positions)
     q = constrain(q, ("batch", "act_seq", "heads", None))
     kk = constrain(kk, ("batch", "act_seq", "kv_heads", None))
     if cfg.attention_impl == "ring":
@@ -204,13 +225,7 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
            positions: jax.Array, constrain) -> jax.Array:
     lp = layer_params
     x = attention_block(cfg, x, lp, positions, constrain)
-    # MLP block (SwiGLU).
-    y = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(y @ lp["w_gate"])
-    up = y @ lp["w_up"]
-    mlp = constrain(gate * up, ("batch", "act_seq", "mlp"))
-    x = x + constrain(mlp @ lp["w_down"], ("batch", "act_seq", "act_embed"))
-    return x
+    return mlp_block(cfg, x, lp, constrain)
 
 
 def forward(cfg: LlamaConfig, params: Params, tokens: jax.Array,
@@ -233,6 +248,114 @@ def forward(cfg: LlamaConfig, params: Params, tokens: jax.Array,
         layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
     return lm_head(cfg, params, x, constrain)
+
+
+# ----------------------------------------------------------- KV-cache decode
+
+def init_cache(cfg: LlamaConfig, batch: int,
+               max_seq: int) -> Dict[str, jax.Array]:
+    """Per-layer KV cache, stacked on the layer axis like the params
+    (so the decode step scans layers and caches together)."""
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype=cfg.dtype),
+            "v": jnp.zeros(shape, dtype=cfg.dtype)}
+
+
+def forward_with_cache(cfg: LlamaConfig, params: Params,
+                       tokens: jax.Array, cache: Dict[str, jax.Array],
+                       start_pos: jax.Array,
+                       valid_len: Optional[jax.Array] = None,
+                       logits_at: Optional[jax.Array] = None
+                       ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Incremental forward: process a chunk, reading/writing the cache.
+
+    tokens (B, T) are positions [start_pos, start_pos+T); returns
+    (logits (B, T, vocab), updated cache). T == prompt length for
+    prefill, T == 1 for each decode step; per-token cost is O(max_seq),
+    not O(seq^2) — the property a serving endpoint needs (vLLM/JetStream
+    analog; the reference delegates this entirely to vLLM).
+
+    ``valid_len`` (default start_pos + T): cache positions >= valid_len
+    are masked out of attention. Right-padded prefill chunks pass their
+    true length so padding K/V never becomes attendable (padding slots
+    are overwritten by later decode steps before valid_len reaches
+    them). ``logits_at`` (chunk-relative index) computes the lm_head at
+    just that position, returning (B, 1, vocab).
+    """
+    b, t = tokens.shape
+    max_seq = cache["k"].shape[2]
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    if valid_len is None:
+        valid_len = start_pos + t
+    positions = start_pos + jnp.arange(t)[None, :]        # (1, T) bcast
+    positions = jnp.broadcast_to(positions, (b, t))
+    x = params["embed"][tokens]
+
+    kpos = jnp.arange(max_seq)                            # (max_seq,)
+    # Causal over absolute positions, clipped to the valid prefix;
+    # future/garbage cache slots are masked even though they hold data.
+    mask = ((kpos[None, :] <= positions[..., None]) &
+            (kpos[None, None, :] < valid_len))            # (B, T, max_seq)
+
+    def layer_fn(x, scanned):
+        lp, ck, cv = scanned                               # per-layer
+        y = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k_new, v_new = qkv_proj(cfg, y, lp, positions)
+        ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype),
+                                          (0, start_pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype),
+                                          (0, start_pos, 0, 0))
+        # GQA: expand cached KV heads to query heads for the einsums.
+        groups = h // kvh
+        kk = jnp.repeat(ck, groups, axis=2)                # (B,S,H,D)
+        vv = jnp.repeat(cv, groups, axis=2)
+        scores = jnp.einsum("bthd,bshd->bhts",
+                            q.astype(jnp.float32),
+                            kk.astype(jnp.float32)) * (hd ** -0.5)
+        scores = jnp.where(mask[:, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhts,bshd->bthd", probs,
+                          vv.astype(jnp.float32)).astype(x.dtype)
+        attn = attn.reshape(b, t, h * hd)
+        x2 = x + lora_dense(attn, lp, "wo")
+        return mlp_block(cfg, x2, lp), (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache["k"], cache["v"]))
+    if logits_at is not None:
+        # Serving prefill reads exactly one position — skip the
+        # O(T x vocab) head on the padded chunk.
+        x = jax.lax.dynamic_slice_in_dim(x, logits_at, 1, axis=1)
+    logits = lm_head(cfg, params, x, lambda a, _spec: a)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def greedy_decode(cfg: LlamaConfig, params: Params, prompt: jax.Array,
+                  true_len: jax.Array, max_tokens: int,
+                  max_seq: int) -> jax.Array:
+    """Prefill + cached decode: prompt (B, S_pad) -> (B, max_tokens).
+
+    ``true_len`` is the un-padded prompt length (prompt may be
+    right-padded to a bucket so serving compiles stay bounded). One
+    O(S) prefill pass, then max_tokens steps of O(max_seq) each.
+    """
+    b, s_pad = prompt.shape
+    cache = init_cache(cfg, b, max_seq)
+    logits, cache = forward_with_cache(
+        cfg, params, prompt, cache, jnp.int32(0), valid_len=true_len,
+        logits_at=jnp.asarray(true_len - 1, jnp.int32))
+    first = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+
+    def step(carry, i):
+        tok, cache = carry
+        logits, cache = forward_with_cache(
+            cfg, params, tok[:, None], cache, true_len + i)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return (nxt, cache), tok
+
+    (_, _), toks = jax.lax.scan(
+        step, (first, cache), jnp.arange(max_tokens, dtype=jnp.int32))
+    return toks.T                                          # (B, max_tokens)
 
 
 def forward_pipelined(cfg: LlamaConfig, params: Params, tokens: jax.Array,

@@ -45,27 +45,18 @@ def _ceil_to(n: int, b: int) -> int:
 @functools.partial(jax.jit, static_argnums=(0, 4))
 def _greedy_decode(cfg: llama.LlamaConfig, params, buf: jax.Array,
                    start: jax.Array, mt_pad: int) -> jax.Array:
-    """Greedy continuation over a padded buffer.
+    """Greedy continuation over a padded prompt buffer.
 
-    buf: (s_pad + mt_pad,) int32 with the prompt in [0, start); generation
-    writes [start, start + mt_pad). Shapes are bucket sizes and the true
-    prompt length is a dynamic scalar, so all prompts in a bucket share
-    one compile. Recomputes the prefix each step (O(S^2) but simple);
-    serving throughput work (paged KV cache as a Pallas kernel) layers on
-    without changing the HTTP surface.
+    buf: (s_pad,) int32 with the prompt in [0, start). Shapes are bucket
+    sizes and the true prompt length is a dynamic scalar, so all prompts
+    in a bucket share one compile. Decoding is KV-cached
+    (models/llama.greedy_decode): one O(S) prefill, then O(max_seq) per
+    token — the vLLM/JetStream-shaped serving loop, not a quadratic
+    recompute.
     """
-
-    def step(carry, t):
-        buf = carry
-        i = start + t
-        logits = llama.forward(cfg, params, buf[None, :])[0]
-        nxt = jnp.argmax(logits[i - 1]).astype(jnp.int32)
-        buf = buf.at[i].set(nxt)
-        return buf, nxt
-
-    _, toks = jax.lax.scan(step, buf,
-                           jnp.arange(mt_pad, dtype=jnp.int32))
-    return toks
+    max_seq = buf.shape[0] + mt_pad
+    return llama.greedy_decode(cfg, params, buf[None, :], start,
+                               mt_pad, max_seq)[0]
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -107,7 +98,7 @@ class _Handler(BaseHTTPRequestHandler):
             s = len(prompt)
             s_pad = _ceil_to(s, PROMPT_BUCKET)
             mt_pad = _ceil_to(mt, GEN_BUCKET)
-            buf = jnp.zeros((s_pad + mt_pad,), jnp.int32).at[:s].set(
+            buf = jnp.zeros((s_pad,), jnp.int32).at[:s].set(
                 jnp.asarray(prompt, dtype=jnp.int32))
             with ctx["lock"]:
                 toks = _greedy_decode(ctx["cfg"], ctx["params"], buf,
@@ -126,7 +117,7 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
     httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
 
     def warmup():
-        buf = jnp.zeros((PROMPT_BUCKET + GEN_BUCKET,), jnp.int32)
+        buf = jnp.zeros((PROMPT_BUCKET,), jnp.int32)
         _greedy_decode(cfg, params, buf, jnp.int32(8),
                        GEN_BUCKET).block_until_ready()
         ctx["ready"].set()

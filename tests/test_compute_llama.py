@@ -73,3 +73,34 @@ def test_train_step_decreases_loss_sharded():
     # params actually sharded: embed spec ("vocab","embed") -> (tp, fsdp).
     emb_shard = state.params["embed"].sharding
     assert emb_shard.spec == jax.sharding.PartitionSpec("tp", "fsdp")
+
+
+def test_kv_cache_decode_matches_full_forward():
+    """Cached incremental decode (prefill + per-token steps) must produce
+    exactly the greedy continuation that full-recompute forward gives —
+    including with a right-padded prompt bucket."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from skypilot_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    params = llama.init(cfg, jax.random.key(0))
+    b, s, mt = 2, 13, 6
+    prompt = jax.random.randint(jax.random.key(1), (b, s), 1, 128)
+
+    # Reference: recompute the full prefix per token.
+    buf = jnp.zeros((b, s + mt), jnp.int32).at[:, :s].set(prompt)
+    ref = []
+    for i in range(mt):
+        logits = llama.forward(cfg, params, buf[:, :s + i])
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        buf = buf.at[:, s + i].set(nxt)
+        ref.append(nxt)
+    ref = jnp.stack(ref, axis=1)
+
+    # Cached, with the prompt right-padded to a bucket of 16.
+    padded = jnp.zeros((b, 16), jnp.int32).at[:, :s].set(prompt)
+    got = llama.greedy_decode(cfg, params, padded, jnp.int32(s), mt,
+                              max_seq=16 + mt)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
