@@ -305,17 +305,17 @@ def forward_with_cache(cfg: LlamaConfig, params: Params,
                                           (0, start_pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype),
                                           (0, start_pos, 0, 0))
-        # GQA: expand cached KV heads to query heads for the einsums.
+        # GQA grouped attention against the UNEXPANDED cache (the head-
+        # order convention of ops/attention.py): q regrouped per KV head
+        # so no repeat()ed copy of the cache hits HBM on the hot path.
         groups = h // kvh
-        kk = jnp.repeat(ck, groups, axis=2)                # (B,S,H,D)
-        vv = jnp.repeat(cv, groups, axis=2)
-        scores = jnp.einsum("bthd,bshd->bhts",
-                            q.astype(jnp.float32),
-                            kk.astype(jnp.float32)) * (hd ** -0.5)
-        scores = jnp.where(mask[:, None], scores, -1e30)
+        qg = q.reshape(b, t, kvh, groups, hd).astype(jnp.float32)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg,
+                            ck.astype(jnp.float32)) * (hd ** -0.5)
+        scores = jnp.where(mask[:, None, None], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bhts,bshd->bthd", probs,
-                          vv.astype(jnp.float32)).astype(x.dtype)
+        attn = jnp.einsum("bkgts,bskd->btkgd", probs,
+                          cv.astype(jnp.float32)).astype(x.dtype)
         attn = attn.reshape(b, t, h * hd)
         x2 = x + lora_dense(attn, lp, "wo")
         return mlp_block(cfg, x2, lp), (ck, cv)
@@ -340,6 +340,11 @@ def greedy_decode(cfg: LlamaConfig, params: Params, prompt: jax.Array,
     O(S) prefill pass, then max_tokens steps of O(max_seq) each.
     """
     b, s_pad = prompt.shape
+    if s_pad + max_tokens > max_seq:
+        raise ValueError(
+            f"prompt ({s_pad}) + max_tokens ({max_tokens}) exceeds the "
+            f"cache (max_seq={max_seq}); dynamic_update_slice would "
+            f"silently clamp and corrupt the tail.")
     cache = init_cache(cfg, b, max_seq)
     logits, cache = forward_with_cache(
         cfg, params, prompt, cache, jnp.int32(0), valid_len=true_len,

@@ -41,6 +41,26 @@ SP = "sp"
 EP = "ep"
 
 
+def _resolve_axis_sizes(axes: Mapping[str, int], n: int,
+                        what: str = "device count") -> dict:
+    """Resolve one optional -1 axis against `n` and validate the product
+    (shared by the flat and hybrid mesh builders)."""
+    sizes = dict(axes)
+    unknown = [k for k, v in sizes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError(f"At most one axis may be -1, got {unknown}")
+    known = math.prod(v for v in sizes.values() if v != -1)
+    if unknown:
+        if n % known:
+            raise ValueError(
+                f"{what} {n} not divisible by fixed axes {sizes}")
+        sizes[unknown[0]] = n // known
+    if math.prod(sizes.values()) != n:
+        raise ValueError(
+            f"Mesh axes {sizes} do not multiply to {what} {n}")
+    return sizes
+
+
 def make_mesh(axes: Mapping[str, int],
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build a Mesh with the given named axis sizes.
@@ -52,22 +72,60 @@ def make_mesh(axes: Mapping[str, int],
     """
     if devices is None:
         devices = jax.devices()
-    n = len(devices)
-    sizes = dict(axes)
-    unknown = [k for k, v in sizes.items() if v == -1]
-    if len(unknown) > 1:
-        raise ValueError(f"At most one axis may be -1, got {unknown}")
-    known = math.prod(v for v in sizes.values() if v != -1)
-    if unknown:
-        if n % known:
-            raise ValueError(
-                f"Device count {n} not divisible by fixed axes {sizes}")
-        sizes[unknown[0]] = n // known
-    if math.prod(sizes.values()) != n:
-        raise ValueError(
-            f"Mesh axes {sizes} do not multiply to device count {n}")
+    sizes = _resolve_axis_sizes(axes, len(devices))
     dev_array = np.asarray(devices).reshape(tuple(sizes.values()))
     return Mesh(dev_array, tuple(sizes.keys()))
+
+
+def make_multislice_mesh(ici_axes: Mapping[str, int], num_slices: int,
+                         dcn_axis: str = DP,
+                         devices: Optional[Sequence[jax.Device]] = None
+                         ) -> Mesh:
+    """Hybrid DCN x ICI mesh for multi-slice (pod-to-pod) training.
+
+    The leading ``dcn_axis`` spans slices — collectives on it ride the
+    data-center network — while ``ici_axes`` live inside one slice's ICI
+    domain. Standard layout: data parallelism over DCN, fsdp/tp/sp over
+    ICI (the "How to Scale Your Model" recipe; the env contract's
+    MEGASCALE_* variables bring up the DCN transport).
+
+    On real multislice hardware devices carry ``slice_index`` and are
+    grouped by it so the leading axis truly crosses slices; on virtual
+    or single-slice platforms devices are split evenly (same program,
+    simulated topology).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if num_slices < 1 or n % num_slices:
+        raise ValueError(
+            f"{n} devices not divisible into {num_slices} slices")
+    per_slice = n // num_slices
+    sizes = _resolve_axis_sizes(ici_axes, per_slice,
+                                "per-slice device count")
+    if dcn_axis in sizes:
+        raise ValueError(f"dcn axis {dcn_axis!r} also named in ici_axes")
+    # Group by slice: real multislice devices expose slice_index, and
+    # then the claimed num_slices MUST match the physical topology —
+    # a silent mismatch would put the "DCN" axis inside a slice (and an
+    # ICI axis across DCN), inverting the layout with no error.
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if slice_ids != {None} and None not in slice_ids:
+        counts: dict = {}
+        for d in devices:
+            counts[d.slice_index] = counts.get(d.slice_index, 0) + 1
+        if len(counts) != num_slices or set(counts.values()) != {per_slice}:
+            raise ValueError(
+                f"devices span {len(counts)} physical slice(s) "
+                f"{dict(sorted(counts.items()))}, but num_slices="
+                f"{num_slices} x {per_slice} was requested — the DCN "
+                f"axis would not align with slice boundaries.")
+    order = sorted(devices,
+                   key=lambda d: (getattr(d, "slice_index", 0) or 0,
+                                  getattr(d, "id", 0)))
+    dev_array = np.asarray(order).reshape(
+        (num_slices,) + tuple(sizes.values()))
+    return Mesh(dev_array, (dcn_axis,) + tuple(sizes.keys()))
 
 
 @dataclasses.dataclass(frozen=True)

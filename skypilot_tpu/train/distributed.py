@@ -66,6 +66,19 @@ def initialize_from_env(timeout_ms: int = 120_000) -> DistContext:
                        federated=federated)
 
 
+def make_mesh_from_env(ici_axes, dcn_axis: str = "dp"):
+    """Mesh for the launched topology: multi-slice (SKYPILOT_NUM_SLICES
+    > 1) gets a hybrid DCN x ICI mesh with `dcn_axis` crossing slices;
+    single-slice gets a plain ICI mesh. Call after
+    initialize_from_env()."""
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    num_slices = int(os.environ.get(constants.NUM_SLICES, "1"))
+    if num_slices > 1:
+        return mesh_lib.make_multislice_mesh(ici_axes, num_slices,
+                                             dcn_axis=dcn_axis)
+    return mesh_lib.make_mesh(dict(ici_axes))
+
+
 def _client():
     from jax._src import distributed  # coordination-service client
     client = distributed.global_state.client
