@@ -1,0 +1,61 @@
+"""skypilot_tpu: a TPU-native sky-computing framework.
+
+Declare a Task (YAML or Python), optimize placement across TPU types/zones by
+cost, provision slices with automatic failover, gang-execute multi-host jobs
+with a rank/coordinator contract feeding `jax.distributed.initialize`, and
+layer managed spot jobs and autoscaled serving on top.
+
+Mirrors the public surface of the reference framework's `sky/__init__.py`
+(reference: sky/__init__.py:134-188) while keeping the device model
+TPU-native: the schedulable unit is a slice, not a VM.
+
+Compute-stack subpackages (models/, ops/, parallel/, train/) are imported
+lazily so the orchestration CLI stays fast and works on machines without
+accelerators.
+"""
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Dag",
+    "Resources",
+    "Task",
+    "launch",
+    "exec",  # noqa: A001
+    "status",
+    "start",
+    "stop",
+    "down",
+    "autostop",
+    "queue",
+    "cancel",
+    "tail_logs",
+    "optimize",
+    "cost_report",
+    "__version__",
+]
+
+
+def __getattr__(name):
+    # Lazy SDK entrypoints: launch/exec/... without importing the whole
+    # backend stack (or jax) at package import time.
+    if name == "Dag":
+        from skypilot_tpu.dag import Dag
+        return Dag
+    if name == "Resources":
+        from skypilot_tpu.resources import Resources
+        return Resources
+    if name == "Task":
+        from skypilot_tpu.task import Task
+        return Task
+    if name in ("launch", "exec"):
+        from skypilot_tpu import execution
+        return getattr(execution, name)
+    if name in ("status", "start", "stop", "down", "autostop", "queue",
+                "cancel", "tail_logs", "cost_report"):
+        from skypilot_tpu import core
+        return getattr(core, name)
+    if name == "optimize":
+        from skypilot_tpu.optimizer import Optimizer
+        return Optimizer.optimize
+    raise AttributeError(f"module 'skypilot_tpu' has no attribute {name!r}")

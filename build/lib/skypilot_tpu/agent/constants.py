@@ -1,0 +1,47 @@
+"""The env-var contract between the framework and user workloads.
+
+Reference analog: sky/skylet/constants.py:258-261 (SKYPILOT_NODE_RANK /
+NODE_IPS / NUM_NODES / NUM_GPUS_PER_NODE). We keep the same names so
+reference-style recipes port unchanged, and add the TPU-native
+coordinator/slice variables that feed ``jax.distributed.initialize`` over
+ICI/DCN instead of NCCL's MASTER_ADDR.
+"""
+
+# Reference-compatible contract (host granularity).
+NODE_RANK = "SKYPILOT_NODE_RANK"
+NODE_IPS = "SKYPILOT_NODE_IPS"           # newline-separated, rank order
+NUM_NODES = "SKYPILOT_NUM_NODES"          # total hosts across all slices
+TASK_ID = "SKYPILOT_TASK_ID"
+CLUSTER_NAME = "SKYPILOT_CLUSTER_INFO_CLUSTER_NAME"
+NUM_CHIPS_PER_NODE = "SKYPILOT_NUM_TPU_CHIPS_PER_NODE"
+
+# TPU-native additions.
+COORDINATOR_ADDR = "SKYPILOT_COORDINATOR_ADDR"   # head_ip:port for
+                                                 # jax.distributed
+COORDINATOR_PORT = 8476
+NUM_SLICES = "SKYPILOT_NUM_SLICES"
+SLICE_INDEX = "SKYPILOT_SLICE_INDEX"             # which slice this host
+                                                 # belongs to
+# Multi-slice (DCN-spanning) jax runs read MEGASCALE_* from these.
+MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"
+
+# Gang-agent coordination (native host-agent core, agent/native.py):
+# the gang driver runs a coordinator; each host's job wrapper connects,
+# barriers before exec (reference pg.ready() semantics) and heartbeats
+# during the run. For SSH hosts the coordinator is reached through an SSH
+# reverse tunnel bound on this fixed remote port.
+GANG_COORD_ADDR = "STPU_GANG_COORD_ADDR"         # host:port for the wrapper
+GANG_BARRIER_TIMEOUT_SECONDS = 600               # slowest-host allowance
+HEARTBEAT_TIMEOUT_MS = 15_000
+# Exit code recorded for ranks force-cancelled because the gang failed
+# (reference get_or_fail semantics, cloud_vm_ray_backend.py:296-331).
+GANG_FAILED_RC = 137
+
+# On-host layout (under the host's $HOME).
+AGENT_DIR = ".stpu_agent"
+JOBS_DB = f"{AGENT_DIR}/jobs.db"
+LOGS_DIR = "stpu_logs"
+WORKDIR = "stpu_workdir"
+
+# Job queue statuses considered terminal.
+TERMINAL = ("SUCCEEDED", "FAILED", "FAILED_SETUP", "CANCELLED")

@@ -1,0 +1,198 @@
+"""On-host daemon: the skylet analog that runs on every cluster head.
+
+Reference analog: sky/skylet/skylet.py:17-34 (event loop) and
+sky/skylet/events.py (AutostopEvent:90 — idle countdown then
+self-stop/down; JobSchedulerEvent:62 — job-queue pump). The TPU-native
+simplification: gang scheduling is slice-atomic and handled by gang_exec,
+so the daemon's job event reduces to *reconciliation* — detecting gangs
+whose driver died without recording a terminal status.
+
+The daemon is started detached at provision time (local provider:
+spawned by the backend; SSH hosts: provisioner._AGENT_START_CMD) and
+self-terminates when its cluster stops or is torn down. Autostop is
+enforced HERE, on the cluster, with zero client involvement: the client
+writing ``autostop.json`` is the last it has to do — an idle cluster then
+stops itself exactly like the reference's AutostopEvent, even if the
+client machine is gone.
+
+State layout (under the host's $HOME):
+    .stpu_agent/cluster.json   — identity + provider config (provision)
+    .stpu_agent/autostop.json  — {"idle_minutes", "down", "set_at"}
+    .stpu_agent/daemon.pid     — liveness marker
+    .stpu_agent/daemon.log     — event log
+    .stpu_agent/health.json    — TPU topology probe result
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import time
+from typing import Any, Dict, Optional
+
+AGENT_DIR = ".stpu_agent"
+
+
+class Daemon:
+
+    def __init__(self, home: Optional[str] = None,
+                 interval: Optional[float] = None):
+        self.home = pathlib.Path(home or os.path.expanduser("~"))
+        self.agent_dir = self.home / AGENT_DIR
+        self.agent_dir.mkdir(parents=True, exist_ok=True)
+        self.cluster: Dict[str, Any] = self._load_json("cluster.json") or {}
+        self.interval = float(
+            interval if interval is not None
+            else self.cluster.get("daemon_interval", 30.0))
+        # The local provider keeps cluster metadata under the *client's*
+        # STPU_HOME; carry it over so provision.local resolves the same
+        # tree from inside the daemon process.
+        stpu_home = self.cluster.get("stpu_home")
+        if stpu_home:
+            os.environ["STPU_HOME"] = stpu_home
+        self.started_at = time.time()
+
+    # ------------------------------------------------------------ plumbing
+    def _load_json(self, name: str) -> Optional[Dict[str, Any]]:
+        path = self.agent_dir / name
+        try:
+            return json.loads(path.read_text())
+        except (OSError, ValueError):
+            return None
+
+    def log(self, msg: str) -> None:
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+        try:
+            with open(self.agent_dir / "daemon.log", "a") as f:
+                f.write(f"[{stamp}] {msg}\n")
+        except OSError:
+            # After autostop --down the terminate path may have deleted
+            # agent_dir itself (local provider); exit quietly.
+            pass
+
+    # -------------------------------------------------------------- events
+    def reconcile_jobs(self) -> None:
+        """Mark RUNNING jobs whose gang driver died as FAILED (reference:
+        skylet reconciles ray-job state drift, job_lib.update_job_status).
+        """
+        from skypilot_tpu.agent import job_lib
+        for job in job_lib.queue(home=str(self.home), all_jobs=False):
+            status = job_lib.JobStatus(job["status"])
+            pid = job.get("pid")
+            if status != job_lib.JobStatus.RUNNING or not pid:
+                continue
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                self.log(f"job {job['job_id']}: driver pid {pid} gone; "
+                         "marking FAILED")
+                job_lib.set_status(job["job_id"], job_lib.JobStatus.FAILED,
+                                   home=str(self.home))
+            except PermissionError:
+                pass  # pid exists under another uid: alive
+
+    def check_autostop(self) -> bool:
+        """Stop/down the cluster when idle long enough. Returns True when
+        the daemon should exit (cluster no longer running)."""
+        from skypilot_tpu.agent import job_lib
+        cfg = self._load_json("autostop.json")
+        if not cfg:
+            return False
+        idle_minutes = cfg.get("idle_minutes", -1)
+        if idle_minutes is None or idle_minutes < 0:
+            return False
+        if not self.cluster.get("job_db_on_host", False):
+            # The job queue lives elsewhere (client-side exec path for
+            # SSH clusters until the remote job DB lands): idleness is
+            # unknowable here, and guessing would stop a cluster
+            # mid-job. Refuse loudly rather than kill work.
+            self.log("autostop requested but this host does not hold the "
+                     "job DB; skipping (cannot observe idleness)")
+            return False
+        if not job_lib.is_cluster_idle(home=str(self.home)):
+            return False
+        baseline = max(
+            job_lib.last_activity_time(home=str(self.home)),
+            float(cfg.get("set_at", self.started_at)))
+        idle_for = time.time() - baseline
+        if idle_for < idle_minutes * 60:
+            return False
+        down = bool(cfg.get("down"))
+        self.log(f"idle {idle_for:.0f}s >= {idle_minutes}m threshold; "
+                 f"{'terminating' if down else 'stopping'} cluster")
+        # Only exit when the action actually succeeded; a transient API
+        # failure is retried on the next tick instead of silently
+        # disabling autostop forever.
+        return self._self_stop(down)
+
+    def _self_stop(self, down: bool) -> bool:
+        from skypilot_tpu import provision as provision_api
+        name = self.cluster.get("cluster_name")
+        provider = self.cluster.get("provider_name")
+        pconfig = self.cluster.get("provider_config", {})
+        if not name or not provider:
+            self.log("no cluster identity recorded; cannot autostop")
+            return False
+        try:
+            if down:
+                provision_api.terminate_instances(provider, name, pconfig)
+            else:
+                provision_api.stop_instances(provider, name, pconfig)
+            return True
+        except Exception as e:  # noqa: BLE001 — daemon must not die here
+            self.log(f"autostop action failed (will retry): {e!r}")
+            return False
+
+    def cluster_gone(self) -> bool:
+        """True once the provider no longer reports us running — the
+        daemon's cue to exit (covers client-initiated stop/down too)."""
+        from skypilot_tpu import provision as provision_api
+        name = self.cluster.get("cluster_name")
+        provider = self.cluster.get("provider_name")
+        if not name or not provider:
+            return False
+        try:
+            statuses = provision_api.query_instances(
+                provider, name, self.cluster.get("provider_config", {}))
+        except Exception:
+            return False
+        return not statuses or all(
+            s in ("stopped", "terminated") for s in statuses.values())
+
+    # ---------------------------------------------------------------- loop
+    def run(self) -> None:
+        from skypilot_tpu.agent import tpu_health
+        (self.agent_dir / "daemon.pid").write_text(str(os.getpid()))
+        expected = int(self.cluster.get("chips_per_host", 0))
+        report = tpu_health.probe(expected)
+        tpu_health.write_report(report, home=str(self.home))
+        self.log(f"daemon up (pid {os.getpid()}, "
+                 f"interval {self.interval}s, health: {report['detail']})")
+        while True:
+            try:
+                self.reconcile_jobs()
+                if self.check_autostop() or self.cluster_gone():
+                    break
+            except Exception as e:  # noqa: BLE001 — keep the loop alive
+                self.log(f"event error: {e!r}")
+            time.sleep(self.interval)
+        self.log("cluster no longer running; daemon exiting")
+        try:
+            (self.agent_dir / "daemon.pid").unlink()
+        except OSError:
+            pass
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--home", default=None,
+                        help="host $HOME override (local provider)")
+    parser.add_argument("--interval", type=float, default=None,
+                        help="event-loop period in seconds")
+    args = parser.parse_args()
+    Daemon(home=args.home, interval=args.interval).run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,264 @@
+"""Gang executor: run one job command on every host of a cluster, atomically.
+
+The driver-program analog of the reference's generated Ray driver
+(RayCodeGen, sky/backends/cloud_vm_ray_backend.py:211,361-505,525-637):
+where the reference builds a STRICT_SPREAD placement group and
+`run_bash_command_with_log.remote()` per node, the TPU gang is the slice
+itself — this process just fans the command out to every host with the
+rank/env contract and enforces slice-atomic failure:
+
+  * all hosts start together (the provisioner guaranteed co-boot);
+  * the first host to fail cancels all others; their exit is recorded as
+    rc 137 (reference get_or_fail semantics :296-331);
+  * SIGTERM from `job cancel` tears down every host's process.
+
+Runs detached on the head host (local provider: on the client machine,
+which *is* every host). Invoked as:
+    python3 -m skypilot_tpu.agent.gang_exec /path/to/spec.json
+"""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+
+GANG_FAILED_RC = constants.GANG_FAILED_RC
+
+
+def _build_env(spec: Dict, rank: int) -> Dict[str, str]:
+    ips: List[str] = spec["node_ips"]
+    host = spec["hosts"][rank]
+    env = {
+        constants.NODE_RANK: str(rank),
+        constants.NODE_IPS: "\n".join(ips),
+        constants.NUM_NODES: str(len(ips)),
+        constants.TASK_ID: spec["task_id"],
+        constants.CLUSTER_NAME: spec["cluster_name"],
+        constants.NUM_CHIPS_PER_NODE: str(
+            spec.get("chips_per_host", 0)),
+        constants.COORDINATOR_ADDR:
+            f"{ips[0]}:{constants.COORDINATOR_PORT}",
+        constants.NUM_SLICES: str(spec.get("num_slices", 1)),
+        constants.SLICE_INDEX: str(host.get("slice_index", 0)),
+    }
+    if spec.get("num_slices", 1) > 1:
+        env[constants.MEGASCALE_COORDINATOR] = \
+            f"{ips[0]}:{constants.COORDINATOR_PORT + 1}"
+    if host.get("kind") == "local":
+        # Simulated slice hosts have no /dev/accel*; the TPU health gate
+        # (host_wrapper) only makes sense on real TPU VMs.
+        env["STPU_SKIP_HEALTH_PROBE"] = "1"
+    env.update(spec.get("envs", {}))
+    return env
+
+
+class _HostProc:
+    """One host's command, run via the appropriate transport."""
+
+    def __init__(self, host: Dict, rank: int, cmd: str,
+                 env: Dict[str, str], log_path: str,
+                 coord_port: Optional[int] = None):
+        self.rank = rank
+        self.host = host
+        self.returncode: Optional[int] = None
+        log_f = open(log_path, "ab")
+        if host["kind"] == "local":
+            if coord_port is not None:
+                env = dict(env)
+                env[constants.GANG_COORD_ADDR] = \
+                    f"127.0.0.1:{coord_port}"
+                # The wrapper runs with cwd=host_dir; make the package
+                # importable from wherever this driver imported it.
+                import skypilot_tpu
+                pkg_root = os.path.dirname(
+                    os.path.dirname(skypilot_tpu.__file__))
+                existing = env.get("PYTHONPATH") or \
+                    os.environ.get("PYTHONPATH", "")
+                env["PYTHONPATH"] = (
+                    f"{pkg_root}:{existing}" if existing else pkg_root)
+                cmd = (f"{sys.executable} -m "
+                       f"skypilot_tpu.agent.host_wrapper "
+                       f"{shlex.quote(cmd)}")
+            full_env = dict(os.environ)
+            full_env["HOME"] = host["host_dir"]
+            full_env.update(env)
+            self.proc = subprocess.Popen(
+                ["bash", "-c", cmd], stdout=log_f,
+                stderr=subprocess.STDOUT, env=full_env,
+                cwd=host["host_dir"], start_new_session=True)
+        else:  # ssh
+            from skypilot_tpu.utils import command_runner
+            opts = list(command_runner.SSH_COMMON_OPTS)
+            if host.get("proxy_command"):
+                opts += ["-o", f"ProxyCommand={host['proxy_command']}"]
+            if coord_port is not None:
+                # The coordinator lives in this (driver) process; hosts
+                # reach it through an SSH reverse tunnel so NAT between
+                # driver and slice doesn't matter. The remote tunnel port
+                # reuses the coordinator's (OS-assigned, driver-unique)
+                # port number so concurrent gangs don't collide; a bind
+                # failure must kill the ssh (fail fast) rather than
+                # silently cross-wire two gangs.
+                env = dict(env)
+                env[constants.GANG_COORD_ADDR] = \
+                    f"127.0.0.1:{coord_port}"
+                opts += ["-o", "ExitOnForwardFailure=yes",
+                         "-R", f"{coord_port}:127.0.0.1:{coord_port}"]
+                cmd = (f"python3 -m skypilot_tpu.agent.host_wrapper "
+                       f"{shlex.quote(cmd)}")
+            env_prefix = " ".join(
+                f"export {k}={shlex.quote(str(v))};"
+                for k, v in env.items())
+            remote = (f"bash --login -c "
+                      f"{shlex.quote(env_prefix + ' ' + cmd)}")
+            self.proc = subprocess.Popen(
+                ["ssh"] + opts + ["-i", host["ssh_key_path"],
+                                  "-p", str(host.get("ssh_port", 22)),
+                                  f"{host['ssh_user']}@{host['ip']}",
+                                  remote],
+                stdout=log_f, stderr=subprocess.STDOUT,
+                start_new_session=True)
+        self._log_f = log_f
+
+    def wait(self) -> int:
+        self.returncode = self.proc.wait()
+        self._log_f.close()
+        return self.returncode
+
+    def terminate(self) -> None:
+        try:
+            os.killpg(self.proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, OSError):
+            try:
+                self.proc.terminate()
+            except OSError:
+                pass
+
+
+def run_gang(spec: Dict) -> int:
+    """Execute the job across all hosts; returns the job's exit code."""
+    job_id = spec["job_id"]
+    home = spec.get("agent_home")  # head-host home (None = real $HOME)
+    log_dir = pathlib.Path(spec["log_dir"])
+    log_dir.mkdir(parents=True, exist_ok=True)
+
+    job_lib.set_pid(job_id, os.getpid(), home)
+    job_lib.set_status(job_id, job_lib.JobStatus.RUNNING, home)
+
+    # Gang coordinator (native host-agent core): every host's wrapper
+    # barriers here before exec — no host runs until all are up
+    # (reference pg.ready()) — and heartbeats during the run so a hung
+    # host is detected, not just an exited one.
+    coord = None
+    coord_port = None
+    if spec.get("use_gang_agent", True) and len(spec["hosts"]) > 1:
+        from skypilot_tpu.agent import native
+        try:
+            coord = native.Coordinator(
+                len(spec["hosts"]),
+                heartbeat_timeout_ms=constants.HEARTBEAT_TIMEOUT_MS)
+            coord_port = coord.port
+        except OSError:
+            coord = None
+
+    procs: List[_HostProc] = []
+    cancelled = threading.Event()
+
+    def handle_term(signum, frame):
+        del signum, frame
+        cancelled.set()
+        for p in procs:
+            p.terminate()
+    signal.signal(signal.SIGTERM, handle_term)
+
+    for rank, host in enumerate(spec["hosts"]):
+        env = _build_env(spec, rank)
+        procs.append(_HostProc(host, rank, spec["run_cmd"], env,
+                               str(log_dir / f"node-{rank}.log"),
+                               coord_port=coord_port))
+
+    # Wait with gang semantics: first failure cancels the rest.
+    failed_rank: Optional[int] = None
+    lock = threading.Lock()
+    all_done = threading.Event()
+
+    def waiter(p: _HostProc):
+        nonlocal failed_rank
+        rc = p.wait()
+        with lock:
+            if rc != 0 and failed_rank is None and not cancelled.is_set():
+                failed_rank = p.rank
+                for other in procs:
+                    if other is not p and other.returncode is None:
+                        other.terminate()
+
+    def agent_monitor():
+        """Heartbeat-based failure detection: catches hosts that hang or
+        lose connectivity without their ssh process exiting."""
+        nonlocal failed_rank
+        while not all_done.wait(0.5):
+            if coord is None:
+                return
+            dead = coord.failed_rank
+            if dead >= 0 and not cancelled.is_set():
+                with lock:
+                    if failed_rank is None:
+                        failed_rank = dead if dead < len(procs) else 0
+                        for p in procs:
+                            if p.returncode is None:
+                                p.terminate()
+                return
+
+    threads = [threading.Thread(target=waiter, args=(p,), daemon=True)
+               for p in procs]
+    if coord is not None:
+        threads.append(threading.Thread(target=agent_monitor,
+                                        daemon=True))
+    for t in threads:
+        t.start()
+    for t in threads[:len(procs)]:
+        t.join()
+    all_done.set()
+    # Join the monitor BEFORE closing the coordinator: it reads
+    # coord.failed_rank and must never race the native destroy.
+    for t in threads[len(procs):]:
+        t.join()
+    if coord is not None:
+        coord.close()
+
+    if cancelled.is_set():
+        job_lib.set_status(job_id, job_lib.JobStatus.CANCELLED, home)
+        return 1
+    if failed_rank is not None:
+        # Annotate forced-cancel ranks with the gang rc in their logs.
+        for p in procs:
+            if p.rank != failed_rank and p.returncode not in (0, None):
+                with open(log_dir / f"node-{p.rank}.log", "ab") as f:
+                    f.write(
+                        f"\n[gang] cancelled because node {failed_rank} "
+                        f"failed (rc={GANG_FAILED_RC})\n".encode())
+        job_lib.set_status(job_id, job_lib.JobStatus.FAILED, home)
+        return GANG_FAILED_RC
+    job_lib.set_status(job_id, job_lib.JobStatus.SUCCEEDED, home)
+    return 0
+
+
+def main() -> None:
+    spec_path = sys.argv[1]
+    with open(spec_path) as f:
+        spec = json.load(f)
+    rc = run_gang(spec)
+    sys.exit(rc)  # preserves GANG_FAILED_RC=137 for wrappers
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,131 @@
+"""Per-host job wrapper: barrier with the gang, run the command, report.
+
+The host-side half of the native gang agent (agent/native.py). The gang
+driver (gang_exec) wraps every host's command with this module:
+
+    python3 -m skypilot_tpu.agent.host_wrapper <shell command>
+
+Behavior (reference analog — the per-node Ray task body plus the
+placement-group ready wait, sky/backends/cloud_vm_ray_backend.py:296-331,
+361-505):
+  1. connect to the coordinator at $STPU_GANG_COORD_ADDR as
+     $SKYPILOT_NODE_RANK (no coordinator configured → just run);
+  2. barrier generation 0 — no host starts until every host is up;
+  3. run the command under bash, heartbeating in the background;
+  4. exit 137 if the gang failed (another rank died), else the command's
+     exit code.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+from skypilot_tpu.agent import constants
+
+GANG_FAILED_RC = constants.GANG_FAILED_RC
+
+
+def main() -> int:
+    if len(sys.argv) < 2:
+        print("usage: host_wrapper <shell command>", file=sys.stderr)
+        return 2
+    cmd = sys.argv[1]
+    coord_addr = os.environ.get(constants.GANG_COORD_ADDR)
+    rank = int(os.environ.get(constants.NODE_RANK, "0"))
+
+    # Topology/health gate (the nvidia-smi analog): a host with missing
+    # TPU devices fails the gang deterministically BEFORE the barrier
+    # instead of hanging the collective later. Probe result is recorded
+    # for the daemon/debugging.
+    expected_chips = int(
+        os.environ.get(constants.NUM_CHIPS_PER_NODE, "0") or 0)
+    if expected_chips > 0 and \
+            os.environ.get("STPU_SKIP_HEALTH_PROBE") != "1":
+        from skypilot_tpu.agent import tpu_health
+        report = tpu_health.probe(expected_chips)
+        try:
+            tpu_health.write_report(report)
+        except OSError:
+            pass
+        if not report["ok"]:
+            print(f"[wrapper rank {rank}] TPU health check failed: "
+                  f"{report['detail']}", file=sys.stderr, flush=True)
+            if coord_addr:
+                from skypilot_tpu.agent import native
+                host, port = coord_addr.rsplit(":", 1)
+                try:
+                    bad = native.Client(host, int(port), rank,
+                                        timeout_ms=5000)
+                    bad.abort()
+                    bad.close()
+                except OSError:
+                    pass
+            return GANG_FAILED_RC
+
+    client = None
+    if coord_addr:
+        from skypilot_tpu.agent import native
+        host, port = coord_addr.rsplit(":", 1)
+        try:
+            client = native.Client(
+                host, int(port), rank,
+                timeout_ms=constants.GANG_BARRIER_TIMEOUT_SECONDS * 1000)
+        except OSError as e:
+            print(f"[wrapper rank {rank}] coordinator unreachable: {e}",
+                  file=sys.stderr, flush=True)
+            return GANG_FAILED_RC
+        rc = client.barrier(
+            0, timeout_ms=constants.GANG_BARRIER_TIMEOUT_SECONDS * 1000)
+        if rc != 0:
+            print(f"[wrapper rank {rank}] gang barrier failed "
+                  f"(rc={rc})", file=sys.stderr, flush=True)
+            client.close()
+            return GANG_FAILED_RC
+
+    proc = subprocess.Popen(["bash", "-c", cmd],
+                            start_new_session=True)
+
+    def forward(signum, frame):
+        del frame
+        try:
+            os.killpg(proc.pid, signum)
+        except (ProcessLookupError, OSError):
+            pass
+    signal.signal(signal.SIGTERM, forward)
+    signal.signal(signal.SIGINT, forward)
+
+    # If the gang fails while we run, kill our command (rc 137): a host
+    # whose peers died must not keep training on a broken collective.
+    stop = threading.Event()
+
+    def watch_gang():
+        while not stop.wait(0.5):
+            if client is not None and client.failed_rank >= 0:
+                try:
+                    os.killpg(proc.pid, signal.SIGTERM)
+                except (ProcessLookupError, OSError):
+                    pass
+                return
+
+    watcher = None
+    if client is not None:
+        watcher = threading.Thread(target=watch_gang, daemon=True)
+        watcher.start()
+
+    rc = proc.wait()
+    stop.set()
+    if watcher is not None:
+        watcher.join(timeout=2)
+    gang_failed = client is not None and client.failed_rank >= 0
+    if client is not None:
+        client.close()
+    if gang_failed and rc != 0:
+        return GANG_FAILED_RC
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,187 @@
+"""Per-cluster job queue: sqlite on the head host.
+
+Reference analog: sky/skylet/job_lib.py (JobStatus:86, FIFOScheduler:199,
+update_job_status:512, JobLibCodeGen:803). Differences: no codegen strings
+— the same module runs on the head host and is invoked either in-process
+(local provider) or as ``python3 -m skypilot_tpu.agent.job_cli`` over SSH
+(the shipped wheel provides it), and gang execution is handled by
+``gang_exec`` rather than Ray placement groups.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import pathlib
+import signal
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+
+class JobStatus(enum.Enum):
+    INIT = "INIT"
+    PENDING = "PENDING"
+    SETTING_UP = "SETTING_UP"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    FAILED_SETUP = "FAILED_SETUP"
+    CANCELLED = "CANCELLED"
+
+    def is_terminal(self) -> bool:
+        return self in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                        JobStatus.FAILED_SETUP, JobStatus.CANCELLED)
+
+
+def _db_path(home: Optional[str] = None) -> pathlib.Path:
+    root = pathlib.Path(home or os.path.expanduser("~"))
+    p = root / ".stpu_agent" / "jobs.db"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+def _conn(home: Optional[str] = None) -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(home), timeout=10)
+    conn.execute("PRAGMA journal_mode=WAL")
+    conn.execute("""CREATE TABLE IF NOT EXISTS jobs (
+        job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        job_name TEXT,
+        username TEXT,
+        submitted_at REAL,
+        status TEXT,
+        run_timestamp TEXT,
+        start_at REAL,
+        end_at REAL,
+        pid INTEGER,
+        log_dir TEXT)""")
+    conn.commit()
+    return conn
+
+
+def add_job(job_name: str, username: str, run_timestamp: str,
+            log_dir: str, home: Optional[str] = None) -> int:
+    with _conn(home) as conn:
+        cur = conn.execute(
+            "INSERT INTO jobs (job_name, username, submitted_at, status, "
+            "run_timestamp, log_dir) VALUES (?, ?, ?, ?, ?, ?)",
+            (job_name, username, time.time(), JobStatus.INIT.value,
+             run_timestamp, log_dir))
+        return int(cur.lastrowid)
+
+
+def set_status(job_id: int, status: JobStatus,
+               home: Optional[str] = None) -> None:
+    now = time.time()
+    with _conn(home) as conn:
+        if status == JobStatus.RUNNING:
+            conn.execute(
+                "UPDATE jobs SET status=?, start_at=? WHERE job_id=?",
+                (status.value, now, job_id))
+        elif status.is_terminal():
+            conn.execute(
+                "UPDATE jobs SET status=?, end_at=? WHERE job_id=? "
+                "AND end_at IS NULL",
+                (status.value, now, job_id))
+            conn.execute("UPDATE jobs SET status=? WHERE job_id=?",
+                         (status.value, job_id))
+        else:
+            conn.execute("UPDATE jobs SET status=? WHERE job_id=?",
+                         (status.value, job_id))
+
+
+def set_pid(job_id: int, pid: int, home: Optional[str] = None) -> None:
+    with _conn(home) as conn:
+        conn.execute("UPDATE jobs SET pid=? WHERE job_id=?", (pid, job_id))
+
+
+def get_job(job_id: int, home: Optional[str] = None
+            ) -> Optional[Dict[str, Any]]:
+    with _conn(home) as conn:
+        row = conn.execute(
+            "SELECT job_id, job_name, username, submitted_at, status, "
+            "run_timestamp, start_at, end_at, pid, log_dir FROM jobs "
+            "WHERE job_id=?", (job_id,)).fetchone()
+    return _row_to_dict(row) if row else None
+
+
+def get_statuses(job_ids: List[int], home: Optional[str] = None
+                 ) -> Dict[int, Optional[str]]:
+    out: Dict[int, Optional[str]] = {}
+    for jid in job_ids:
+        job = get_job(jid, home)
+        out[jid] = job["status"] if job else None
+    return out
+
+
+def queue(home: Optional[str] = None,
+          all_jobs: bool = True) -> List[Dict[str, Any]]:
+    with _conn(home) as conn:
+        rows = conn.execute(
+            "SELECT job_id, job_name, username, submitted_at, status, "
+            "run_timestamp, start_at, end_at, pid, log_dir FROM jobs "
+            "ORDER BY job_id DESC").fetchall()
+    jobs = [_row_to_dict(r) for r in rows]
+    if not all_jobs:
+        jobs = [j for j in jobs
+                if not JobStatus(j["status"]).is_terminal()]
+    return jobs
+
+
+def cancel_jobs(job_ids: Optional[List[int]] = None,
+                home: Optional[str] = None) -> List[int]:
+    """Cancel running/pending jobs (all non-terminal if job_ids None).
+    Sends SIGTERM to the gang_exec process group; gang_exec fans the
+    cancellation out to every host."""
+    jobs = queue(home)
+    cancelled = []
+    for job in jobs:
+        if job_ids is not None and job["job_id"] not in job_ids:
+            continue
+        status = JobStatus(job["status"])
+        if status.is_terminal():
+            continue
+        pid = job.get("pid")
+        if pid:
+            try:
+                os.killpg(pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError, OSError):
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError, OSError):
+                    pass
+        set_status(job["job_id"], JobStatus.CANCELLED, home)
+        cancelled.append(job["job_id"])
+    return cancelled
+
+
+def is_cluster_idle(home: Optional[str] = None) -> bool:
+    """No non-terminal jobs (reference: job_lib.is_cluster_idle:641)."""
+    return len(queue(home, all_jobs=False)) == 0
+
+
+def last_activity_time(home: Optional[str] = None) -> float:
+    """Latest of: job submission, job end. Used by autostop."""
+    jobs = queue(home)
+    latest = 0.0
+    for job in jobs:
+        for key in ("submitted_at", "end_at"):
+            v = job.get(key)
+            if v:
+                latest = max(latest, float(v))
+    return latest
+
+
+def _row_to_dict(row) -> Dict[str, Any]:
+    (job_id, job_name, username, submitted_at, status, run_timestamp,
+     start_at, end_at, pid, log_dir) = row
+    return {
+        "job_id": job_id, "job_name": job_name, "username": username,
+        "submitted_at": submitted_at, "status": status,
+        "run_timestamp": run_timestamp, "start_at": start_at,
+        "end_at": end_at, "pid": pid, "log_dir": log_dir,
+    }
+
+
+def dump_queue_json(home: Optional[str] = None) -> str:
+    return json.dumps(queue(home))

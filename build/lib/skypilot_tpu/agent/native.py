@@ -1,0 +1,488 @@
+"""Gang coordination core: ctypes bindings over the C++ host agent, with a
+protocol-compatible pure-Python fallback.
+
+The native library (agent/native/hostagent.cc) implements membership,
+rank barrier, heartbeats, and failure broadcast — the coordination slice
+the reference delegates to Ray (placement-group ready + node liveness,
+sky/backends/cloud_vm_ray_backend.py:361-505). It is compiled on first use
+with g++ (cached under ~/.stpu/native/); hosts without a toolchain — or
+with STPU_FORCE_PY_AGENT=1 — use the Python twin, which speaks the same
+wire protocol, so mixed gangs work.
+
+API (both implementations):
+    coord = Coordinator(num_hosts, port=0, heartbeat_timeout_ms=10_000)
+    coord.port; coord.wait_ready(timeout_ms); coord.failed_rank
+    client = Client(host, port, rank, timeout_ms=...)
+    client.barrier(generation, timeout_ms) -> 0 | -1 (timeout) | -2-r
+    client.failed_rank; client.close()
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import socket
+import struct
+import subprocess
+import threading
+import time
+from typing import Dict, Optional
+
+_MAGIC = 0x53545055
+(_REGISTER, _ACK, _BARRIER_REQ, _BARRIER_REL, _HEARTBEAT, _FAIL,
+ _GOODBYE) = 1, 2, 3, 4, 5, 6, 7
+_MSG = struct.Struct("<IIii")
+
+_SRC = pathlib.Path(__file__).parent / "native" / "hostagent.cc"
+
+
+# --------------------------------------------------------------------------
+# Native library build + load
+# --------------------------------------------------------------------------
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _build_lib() -> Optional[pathlib.Path]:
+    from skypilot_tpu.utils import paths
+    out_dir = paths.home() / "native"
+    out_dir.mkdir(parents=True, exist_ok=True)
+    src_mtime = int(_SRC.stat().st_mtime)
+    so_path = out_dir / f"libstpu_agent_{src_mtime}.so"
+    if so_path.exists():
+        return so_path
+    # pid-unique temp: concurrent first-use builds must not interleave
+    # g++ output or clobber each other's os.replace.
+    tmp_path = f"{so_path}.{os.getpid()}.tmp"
+    proc = subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+         "-o", tmp_path, str(_SRC), "-lpthread"],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        return None
+    os.replace(tmp_path, so_path)
+    return so_path
+
+
+def _load_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    with _lib_lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        if os.environ.get("STPU_FORCE_PY_AGENT"):
+            return None
+        so_path = None
+        try:
+            so_path = _build_lib()
+            if so_path is None:
+                return None
+            lib = ctypes.CDLL(str(so_path))
+        except (OSError, subprocess.SubprocessError):
+            # Corrupt/unloadable artifact: fall back to the Python twin
+            # rather than surfacing a spurious gang failure — and remove
+            # the bad cache entry so the next run rebuilds it.
+            if so_path is not None:
+                try:
+                    os.unlink(so_path)
+                except OSError:
+                    pass
+            return None
+        lib.stpu_coord_create.restype = ctypes.c_void_p
+        lib.stpu_coord_create.argtypes = [ctypes.c_int, ctypes.c_int,
+                                          ctypes.c_int]
+        lib.stpu_coord_port.argtypes = [ctypes.c_void_p]
+        lib.stpu_coord_wait_ready.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_int]
+        lib.stpu_coord_registered_count.argtypes = [ctypes.c_void_p]
+        lib.stpu_coord_failed_rank.argtypes = [ctypes.c_void_p]
+        lib.stpu_coord_destroy.argtypes = [ctypes.c_void_p]
+        lib.stpu_client_connect.restype = ctypes.c_void_p
+        lib.stpu_client_connect.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int]
+        lib.stpu_client_barrier.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                            ctypes.c_int]
+        lib.stpu_client_failed_rank.argtypes = [ctypes.c_void_p]
+        lib.stpu_client_abort.argtypes = [ctypes.c_void_p]
+        lib.stpu_client_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load_lib() is not None
+
+
+# --------------------------------------------------------------------------
+# Native wrappers
+# --------------------------------------------------------------------------
+class _NativeCoordinator:
+    def __init__(self, num_hosts: int, port: int = 0,
+                 heartbeat_timeout_ms: int = 10_000):
+        self._lib = _load_lib()
+        self._h = self._lib.stpu_coord_create(port, num_hosts,
+                                              heartbeat_timeout_ms)
+        if not self._h:
+            raise OSError("host-agent coordinator failed to bind")
+        self.port = self._lib.stpu_coord_port(self._h)
+
+    def wait_ready(self, timeout_ms: int) -> int:
+        return self._lib.stpu_coord_wait_ready(self._h, timeout_ms)
+
+    @property
+    def registered_count(self) -> int:
+        return self._lib.stpu_coord_registered_count(self._h)
+
+    @property
+    def failed_rank(self) -> int:
+        return self._lib.stpu_coord_failed_rank(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.stpu_coord_destroy(self._h)
+            self._h = None
+
+
+class _NativeClient:
+    def __init__(self, host: str, port: int, rank: int,
+                 timeout_ms: int = 30_000,
+                 heartbeat_interval_ms: int = 1_000):
+        self._lib = _load_lib()
+        host_ip = socket.gethostbyname(host)
+        self._h = self._lib.stpu_client_connect(
+            host_ip.encode(), port, rank, timeout_ms,
+            heartbeat_interval_ms)
+        if not self._h:
+            raise OSError(
+                f"host-agent client rank {rank} failed to reach "
+                f"{host}:{port}")
+
+    def barrier(self, gen: int, timeout_ms: int) -> int:
+        return self._lib.stpu_client_barrier(self._h, gen, timeout_ms)
+
+    @property
+    def failed_rank(self) -> int:
+        return self._lib.stpu_client_failed_rank(self._h)
+
+    def abort(self) -> None:
+        """Dirty close (no goodbye): simulates host death."""
+        if self._h:
+            self._lib.stpu_client_abort(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.stpu_client_destroy(self._h)
+            self._h = None
+
+
+# --------------------------------------------------------------------------
+# Pure-Python protocol twin
+# --------------------------------------------------------------------------
+def _recv_msg(sock: socket.socket):
+    buf = b""
+    while len(buf) < _MSG.size:
+        chunk = sock.recv(_MSG.size - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    magic, mtype, rank, arg = _MSG.unpack(buf)
+    if magic != _MAGIC:
+        return None
+    return mtype, rank, arg
+
+
+def _send_msg(sock: socket.socket, mtype: int, rank: int,
+              arg: int) -> bool:
+    try:
+        sock.sendall(_MSG.pack(_MAGIC, mtype, rank, arg))
+        return True
+    except OSError:
+        return False
+
+
+class _PyCoordinator:
+    def __init__(self, num_hosts: int, port: int = 0,
+                 heartbeat_timeout_ms: int = 10_000):
+        self.num_hosts = num_hosts
+        self.heartbeat_timeout_ms = heartbeat_timeout_ms
+        self._failed_rank = -1
+        self._stop = False
+        self._cond = threading.Condition()
+        self._conns: Dict[int, socket.socket] = {}
+        self._last_hb: Dict[int, float] = {}
+        self._barrier_waiters: Dict[int, set] = {}
+        self._listen = socket.socket()
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # Loopback only (matches hostagent.cc): the protocol is
+        # unauthenticated; remote hosts come in via SSH reverse tunnel.
+        self._listen.bind(("127.0.0.1", port))
+        self._listen.listen(num_hosts + 8)
+        self.port = self._listen.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        threading.Thread(target=self._monitor_loop, daemon=True).start()
+
+    # -- public ---------------------------------------------------------
+    def wait_ready(self, timeout_ms: int) -> int:
+        deadline = time.time() + timeout_ms / 1000.0
+        with self._cond:
+            while True:
+                if self._failed_rank >= 0:
+                    return -2 - self._failed_rank
+                if len(self._conns) == self.num_hosts:
+                    return 0
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return -1
+                self._cond.wait(remaining)
+
+    @property
+    def registered_count(self) -> int:
+        with self._cond:
+            return len(self._conns)
+
+    @property
+    def failed_rank(self) -> int:
+        return self._failed_rank
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+        with self._cond:
+            conns = list(self._conns.values())
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    # -- internals ------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._listen.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._reader_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _reader_loop(self, conn: socket.socket) -> None:
+        conn.settimeout(10.0)  # bound the registration read
+        try:
+            msg = _recv_msg(conn)
+        except OSError:
+            msg = None
+        if msg is None or msg[0] != _REGISTER:
+            conn.close()
+            return
+        conn.settimeout(None)  # liveness is heartbeat-based from here on
+        rank = msg[1]
+        with self._cond:
+            if rank < 0 or rank >= self.num_hosts or rank in self._conns:
+                conn.close()
+                return
+            self._conns[rank] = conn
+            self._last_hb[rank] = time.time()
+            self._cond.notify_all()
+        _send_msg(conn, _ACK, rank, 0)
+        while not self._stop:
+            try:
+                msg = _recv_msg(conn)
+            except OSError:
+                msg = None
+            if msg is None:
+                if not self._stop:
+                    self._declare_failed(rank)
+                return
+            mtype, _, arg = msg
+            if mtype == _HEARTBEAT:
+                with self._cond:
+                    self._last_hb[rank] = time.time()
+            elif mtype == _BARRIER_REQ:
+                self._on_barrier_req(rank, arg)
+            elif mtype == _GOODBYE:
+                # Clean departure: EOF after this is not a failure.
+                with self._cond:
+                    self._conns.pop(rank, None)
+                    self._last_hb.pop(rank, None)
+                conn.close()
+                return
+
+    def _on_barrier_req(self, rank: int, gen: int) -> None:
+        with self._cond:
+            waiters = self._barrier_waiters.setdefault(gen, set())
+            waiters.add(rank)
+            if len(waiters) == self.num_hosts:
+                for c in self._conns.values():
+                    _send_msg(c, _BARRIER_REL, -1, gen)
+                del self._barrier_waiters[gen]
+
+    def _monitor_loop(self) -> None:
+        while not self._stop:
+            time.sleep(min(self.heartbeat_timeout_ms / 4000.0 + 0.001,
+                           0.5))
+            if self.heartbeat_timeout_ms <= 0:
+                continue
+            dead = -1
+            now = time.time()
+            with self._cond:
+                for rank, last in self._last_hb.items():
+                    if rank in self._conns and \
+                            (now - last) * 1000 > \
+                            self.heartbeat_timeout_ms:
+                        dead = rank
+                        break
+            if dead >= 0:
+                self._declare_failed(dead)
+
+    def _declare_failed(self, rank: int) -> None:
+        with self._cond:
+            if self._failed_rank >= 0:
+                return
+            self._failed_rank = rank
+            for r, c in self._conns.items():
+                if r != rank:
+                    _send_msg(c, _FAIL, rank, 0)
+            self._cond.notify_all()
+
+
+class _PyClient:
+    def __init__(self, host: str, port: int, rank: int,
+                 timeout_ms: int = 30_000,
+                 heartbeat_interval_ms: int = 1_000):
+        self.rank = rank
+        self.heartbeat_interval_ms = heartbeat_interval_ms
+        self._failed_rank = -1
+        self._released = set()
+        self._registered = False
+        self._stop = False
+        self._cond = threading.Condition()
+        deadline = time.time() + timeout_ms / 1000.0
+        last_err: Optional[Exception] = None
+        self._sock = None
+        while time.time() < deadline:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=5)
+                break
+            except OSError as e:
+                last_err = e
+                time.sleep(0.1)
+        if self._sock is None:
+            raise OSError(f"client rank {rank}: cannot reach "
+                          f"{host}:{port}: {last_err}")
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+        if not _send_msg(self._sock, _REGISTER, rank, 0):
+            raise OSError(f"client rank {rank}: register failed")
+        threading.Thread(target=self._reader_loop, daemon=True).start()
+        with self._cond:
+            remaining = deadline - time.time()
+            self._cond.wait_for(lambda: self._registered,
+                                max(remaining, 0.1))
+            if not self._registered:
+                self.close()
+                raise OSError(f"client rank {rank}: no ack")
+        threading.Thread(target=self._heartbeat_loop,
+                         daemon=True).start()
+
+    def barrier(self, gen: int, timeout_ms: int) -> int:
+        if self._sock is None:
+            return -1
+        if not _send_msg(self._sock, _BARRIER_REQ, self.rank, gen):
+            return -1
+        deadline = time.time() + timeout_ms / 1000.0
+        with self._cond:
+            while True:
+                # A released barrier wins over a failure that arrived just
+                # after it: all ranks did reach this generation.
+                if gen in self._released:
+                    return 0
+                if self._failed_rank >= 0:
+                    return -2 - self._failed_rank
+                remaining = deadline - time.time()
+                if remaining <= 0 or self._sock is None:
+                    return -1
+                self._cond.wait(remaining)
+
+    @property
+    def failed_rank(self) -> int:
+        return self._failed_rank
+
+    def abort(self) -> None:
+        """Dirty close (no goodbye): simulates host death."""
+        self._stop = True
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        with self._cond:
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        self._stop = True
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            _send_msg(sock, _GOODBYE, self.rank, 0)
+            try:
+                sock.close()
+            except OSError:
+                pass
+        with self._cond:
+            self._cond.notify_all()
+
+    def _reader_loop(self) -> None:
+        while not self._stop and self._sock is not None:
+            try:
+                msg = _recv_msg(self._sock)
+            except OSError:
+                msg = None
+            with self._cond:
+                if msg is None:
+                    if not self._stop and self._failed_rank < 0:
+                        self._failed_rank = 2**31 - 1  # coord vanished
+                    self._cond.notify_all()
+                    return
+                mtype, rank, arg = msg
+                if mtype == _ACK:
+                    self._registered = True
+                elif mtype == _BARRIER_REL:
+                    self._released.add(arg)
+                elif mtype == _FAIL and self._failed_rank < 0:
+                    self._failed_rank = rank
+                self._cond.notify_all()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop and self._sock is not None:
+            if not _send_msg(self._sock, _HEARTBEAT, self.rank, 0):
+                return
+            time.sleep(self.heartbeat_interval_ms / 1000.0)
+
+
+# --------------------------------------------------------------------------
+# Public factories: native if buildable, Python otherwise.
+# --------------------------------------------------------------------------
+def Coordinator(num_hosts: int, port: int = 0,
+                heartbeat_timeout_ms: int = 10_000):
+    if native_available():
+        return _NativeCoordinator(num_hosts, port, heartbeat_timeout_ms)
+    return _PyCoordinator(num_hosts, port, heartbeat_timeout_ms)
+
+
+def Client(host: str, port: int, rank: int, timeout_ms: int = 30_000,
+           heartbeat_interval_ms: int = 1_000):
+    if native_available():
+        return _NativeClient(host, port, rank, timeout_ms,
+                             heartbeat_interval_ms)
+    return _PyClient(host, port, rank, timeout_ms, heartbeat_interval_ms)

@@ -1,0 +1,60 @@
+"""TPU topology/health probe: the `nvidia-smi` analog for slice hosts.
+
+Reference analog: SURVEY §2.5 row 2 — the reference shells out to
+`nvidia-smi`/Ray resource reporting for GPU health; a TPU host instead
+exposes its chips as ``/dev/accel*`` (PCI DevFS nodes created by the TPU
+driver) and via libtpu. The probe is deliberately cheap and import-free:
+it must run at every gang start (host_wrapper) and daemon boot without
+initializing a JAX backend, because grabbing the TPU runtime would
+conflict with the user workload that is about to own the chips.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import pathlib
+import time
+from typing import Any, Dict, Optional
+
+_ACCEL_GLOBS = ("/dev/accel*", "/dev/vfio/*")
+
+
+def count_local_chips() -> int:
+    """Number of TPU chips visible on this host (0 on non-TPU hosts)."""
+    for pattern in _ACCEL_GLOBS:
+        found = [p for p in glob.glob(pattern)
+                 if os.path.basename(p) != "vfio"]
+        if found:
+            return len(found)
+    return 0
+
+
+def probe(expected_chips: int = 0) -> Dict[str, Any]:
+    """Health verdict for this host.
+
+    ``expected_chips`` comes from the catalog (chips_per_host of the
+    launched slice); 0 means a CPU host (local provider, controllers) and
+    always passes. A TPU host with missing devices fails the gang *before*
+    the barrier, turning a would-be hang into a deterministic rc-137 with
+    a named culprit."""
+    chips = count_local_chips()
+    ok = expected_chips == 0 or chips >= expected_chips
+    return {
+        "ok": ok,
+        "chips_found": chips,
+        "chips_expected": expected_chips,
+        "checked_at": time.time(),
+        "detail": ("healthy" if ok else
+                   f"expected {expected_chips} TPU chips, found {chips} "
+                   f"(driver missing or device held by another process)"),
+    }
+
+
+def write_report(report: Dict[str, Any],
+                 home: Optional[str] = None) -> pathlib.Path:
+    root = pathlib.Path(home or os.path.expanduser("~"))
+    path = root / ".stpu_agent" / "health.json"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(report, indent=2))
+    return path

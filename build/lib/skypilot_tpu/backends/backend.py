@@ -1,0 +1,85 @@
+"""Backend ABC: provision / sync / setup / execute / teardown.
+
+Reference analog: sky/backends/backend.py (ResourceHandle:22, Backend:28 —
+template methods wrapped in timeline events). The single real
+implementation is backends.slice_backend.SliceBackend.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Generic, Optional, TypeVar
+
+from skypilot_tpu.utils import timeline
+
+
+class ResourceHandle:
+    """Opaque pickleable pointer to a launched cluster."""
+
+    def get_cluster_name(self) -> str:
+        raise NotImplementedError
+
+
+_HandleT = TypeVar("_HandleT", bound=ResourceHandle)
+
+
+class Backend(Generic[_HandleT]):
+    NAME = "backend"
+
+    # --- lifecycle -----------------------------------------------------
+    @timeline.event
+    def provision(self, task, to_provision, *, dryrun: bool,
+                  stream_logs: bool, cluster_name: Optional[str] = None,
+                  retry_until_up: bool = False) -> Optional[_HandleT]:
+        return self._provision(task, to_provision, dryrun, stream_logs,
+                               cluster_name, retry_until_up)
+
+    @timeline.event
+    def sync_workdir(self, handle: _HandleT, workdir: str) -> None:
+        self._sync_workdir(handle, workdir)
+
+    @timeline.event
+    def sync_file_mounts(self, handle: _HandleT,
+                         all_file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]]) -> None:
+        self._sync_file_mounts(handle, all_file_mounts, storage_mounts)
+
+    @timeline.event
+    def setup(self, handle: _HandleT, task, detach_setup: bool) -> None:
+        self._setup(handle, task, detach_setup)
+
+    @timeline.event
+    def execute(self, handle: _HandleT, task, detach_run: bool,
+                dryrun: bool = False) -> Optional[int]:
+        """Returns the job id (None for dryrun)."""
+        return self._execute(handle, task, detach_run, dryrun)
+
+    @timeline.event
+    def post_execute(self, handle: _HandleT, down: bool) -> None:
+        self._post_execute(handle, down)
+
+    @timeline.event
+    def teardown(self, handle: _HandleT, terminate: bool,
+                 purge: bool = False) -> None:
+        self._teardown(handle, terminate, purge)
+
+    # --- impl hooks ----------------------------------------------------
+    def _provision(self, task, to_provision, dryrun, stream_logs,
+                   cluster_name, retry_until_up):
+        raise NotImplementedError
+
+    def _sync_workdir(self, handle, workdir):
+        raise NotImplementedError
+
+    def _sync_file_mounts(self, handle, all_file_mounts, storage_mounts):
+        raise NotImplementedError
+
+    def _setup(self, handle, task, detach_setup):
+        raise NotImplementedError
+
+    def _execute(self, handle, task, detach_run, dryrun):
+        raise NotImplementedError
+
+    def _post_execute(self, handle, down):
+        del handle, down
+
+    def _teardown(self, handle, terminate, purge):
+        raise NotImplementedError

@@ -1,0 +1,608 @@
+"""SliceBackend: the execution engine for TPU slice clusters.
+
+Reference analog: sky/backends/cloud_vm_ray_backend.py — but with the Ray
+substrate removed. The mapping:
+
+  RetryingVmProvisioner (:1121)      -> _provision_with_failover below
+  RayCodeGen + placement group (:211) -> agent.gang_exec (slice IS the gang)
+  _exec_code_on_head / ray job submit -> spec.json + detached gang_exec
+  JobLibCodeGen over SSH (:803)       -> agent.job_lib in-process (local) /
+                                         `python3 -m ...job_cli` (ssh)
+  stable_cluster_internal_ips rank    -> ClusterInfo.ordered_instances()
+
+Gang semantics: a slice's hosts provision/fail/cancel atomically; the
+first failed host cancels the gang with rc 137 (gang_exec).
+"""
+from __future__ import annotations
+
+import getpass
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import filelock
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import provision as provision_api
+from skypilot_tpu.agent import constants as agent_constants
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.backends import backend as backend_lib
+from skypilot_tpu.provision.common import ClusterInfo
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.utils import command_runner as runner_lib
+from skypilot_tpu.utils import paths
+
+
+class SliceHandle(backend_lib.ResourceHandle):
+    """Pickled into the state DB; everything needed to reach the cluster."""
+
+    def __init__(self, cluster_name: str, launched_resources: Resources,
+                 num_slices: int, cluster_info: ClusterInfo):
+        self.cluster_name = cluster_name
+        self.launched_resources = launched_resources
+        self.num_slices = num_slices
+        self.cluster_info = cluster_info
+
+    def get_cluster_name(self) -> str:
+        return self.cluster_name
+
+    @property
+    def provider_name(self) -> str:
+        return self.cluster_info.provider_name
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.cluster_info.instances)
+
+    @property
+    def head_home(self) -> Optional[str]:
+        """Local provider: the head host's fake $HOME dir; else None."""
+        head = self.cluster_info.get_head_instance()
+        if head is not None and self.provider_name == "local":
+            return head.tags["host_dir"]
+        return None
+
+    def get_command_runners(self) -> List[runner_lib.CommandRunner]:
+        runners: List[runner_lib.CommandRunner] = []
+        info = self.cluster_info
+        for inst in info.ordered_instances():
+            if info.provider_name == "local":
+                runners.append(runner_lib.LocalCommandRunner(
+                    inst.instance_id, inst.tags["host_dir"]))
+            else:
+                runners.append(runner_lib.SSHCommandRunner(
+                    inst.instance_id,
+                    inst.external_ip or inst.internal_ip,
+                    ssh_user=info.ssh_user,
+                    ssh_key_path=info.ssh_key_path or "~/.ssh/id_rsa",
+                    port=inst.ssh_port,
+                    proxy_command=info.provider_config.get(
+                        "ssh_proxy_command")))
+        return runners
+
+    def __repr__(self) -> str:
+        return (f"SliceHandle({self.cluster_name}: "
+                f"{self.launched_resources} x{self.num_slices}, "
+                f"{self.num_hosts} hosts)")
+
+
+def _cluster_lock(cluster_name: str) -> filelock.FileLock:
+    return filelock.FileLock(
+        str(paths.locks_dir() / f"cluster.{cluster_name}.lock"))
+
+
+class SliceBackend(backend_lib.Backend[SliceHandle]):
+    NAME = "slice"
+
+    # ------------------------------------------------------------ provision
+    def _provision(self, task, to_provision: Optional[Resources], dryrun,
+                   stream_logs, cluster_name, retry_until_up):
+        if cluster_name is None:
+            cluster_name = f"stpu-{getpass.getuser()}"
+        if to_provision is None:
+            to_provision = task.best_resources or task.resources[0]
+        if dryrun:
+            print(f"[dryrun] would provision {cluster_name}: "
+                  f"{to_provision} x{task.num_nodes}")
+            return None
+        with _cluster_lock(cluster_name):
+            record = global_user_state.get_cluster_from_name(cluster_name)
+            if record is not None and record["handle"] is not None:
+                global_user_state.check_owner_identity(record)
+                handle = record["handle"]
+                if record["status"] == ClusterStatus.UP:
+                    self.check_resources_fit_cluster(handle, task)
+                    return handle
+                if record["status"] == ClusterStatus.STOPPED:
+                    return self._restart_cluster(handle)
+            return self._provision_with_failover(
+                task, to_provision, cluster_name, retry_until_up)
+
+    def _provision_with_failover(self, task, to_provision: Resources,
+                                 cluster_name: str,
+                                 retry_until_up: bool) -> SliceHandle:
+        """Zone→region failover with blocklist feedback into the optimizer
+        (reference: provision_with_retries, cloud_vm_ray_backend.py:1900).
+        """
+        blocklist = optimizer_lib.Blocklist()
+        history: List[Exception] = []
+        while True:
+            saved = task.resources
+            try:
+                task.set_resources(to_provision)
+                candidates = optimizer_lib.launchable_candidates(
+                    task, blocklist)
+            finally:
+                task.resources = saved
+            candidates.sort(key=lambda c: c.cost)
+            if not candidates:
+                if retry_until_up:
+                    time.sleep(5)
+                    blocklist = optimizer_lib.Blocklist()
+                    continue
+                raise exceptions.ResourcesUnavailableError(
+                    f"Failed to provision {to_provision} in any zone.",
+                    failover_history=history)
+            for cand in candidates:
+                res = cand.resources
+                try:
+                    return self._provision_once(task, res, cluster_name)
+                except exceptions.ProvisionError as e:
+                    history.append(e)
+                    device = res.accelerator or res.instance_type
+                    if e.blocklist_region:
+                        blocklist = blocklist.add(device,
+                                                  e.blocklist_region)
+                    elif e.blocklist_zone:
+                        blocklist = blocklist.add(device, e.blocklist_zone)
+                    else:
+                        blocklist = blocklist.add(device, res.zone)
+                    # Clean any partial creation before moving on.
+                    try:
+                        provision_api.terminate_instances(
+                            res.provider_name, cluster_name, {})
+                    except Exception:
+                        pass
+            if not retry_until_up:
+                raise exceptions.ResourcesUnavailableError(
+                    f"All zones failed for {to_provision}. "
+                    f"Failover history: "
+                    f"{[str(e) for e in history]}",
+                    failover_history=history)
+
+    def _provision_once(self, task, res: Resources,
+                        cluster_name: str) -> SliceHandle:
+        provider = res.provider_name
+        info = res.slice_info()
+        provider_config: Dict[str, Any] = {
+            "num_slices": task.num_nodes,
+            "region": res.region,
+            "zone": res.zone,
+            "accelerator": res.accelerator,
+            "instance_type": res.instance_type,
+            "runtime_version": res.tpu_runtime_version,
+            "use_spot": res.use_spot,
+            "disk_size": res.disk_size,
+            "hosts_per_slice": info.hosts if info else int(
+                (res.labels or {}).get("hosts_per_slice", 1)),
+            "chips_per_host": info.chips_per_host if info else 0,
+            "labels": res.labels or {},
+        }
+        global_user_state.add_or_update_cluster(
+            cluster_name, handle=None, requested_resources=res,
+            ready=False)
+        provision_api.run_instances(provider, res.region, res.zone,
+                                    cluster_name, provider_config)
+        provision_api.wait_instances(provider, res.region, cluster_name,
+                                     "running")
+        cluster_info = provision_api.get_cluster_info(
+            provider, res.region, cluster_name, provider_config)
+        handle = SliceHandle(cluster_name, res, task.num_nodes,
+                             cluster_info)
+        self._post_provision_setup(handle)
+        global_user_state.add_or_update_cluster(
+            cluster_name, handle=handle, requested_resources=res,
+            ready=True)
+        self._write_ssh_config(handle)
+        return handle
+
+    @staticmethod
+    def _write_ssh_config(handle) -> None:
+        """`ssh <cluster>` convenience entries (reference SSHConfigHelper,
+        backend_utils.py:398); best-effort — an unwritable ~/.ssh must
+        not fail a launch whose cluster is already up and billing."""
+        from skypilot_tpu.utils import ssh_config
+        try:
+            ssh_config.add_cluster(handle)
+        except OSError as e:
+            print(f"warning: could not write ssh config for "
+                  f"{handle.cluster_name}: {e}", file=sys.stderr)
+
+    def _post_provision_setup(self, handle: SliceHandle) -> None:
+        """Wait for SSH + install the agent runtime on real clouds; for
+        local-provider hosts (plain dirs) just record the cluster identity
+        and start the head daemon in-place."""
+        if handle.provider_name == "local":
+            head_home = handle.head_home
+            if head_home is not None:
+                self._write_cluster_identity(handle, head_home)
+                self._start_local_daemon(head_home)
+            return
+        from skypilot_tpu.provision import provisioner
+        provisioner.wait_for_ssh(handle.cluster_info)
+        provisioner.setup_agent_runtime(handle.cluster_info,
+                                        self._cluster_identity(handle))
+
+    def _cluster_identity(self, handle: SliceHandle) -> Dict[str, Any]:
+        """The daemon's view of who it is + how to stop itself
+        (agent/daemon.py cluster.json)."""
+        res = handle.launched_resources
+        sinfo = res.slice_info()
+        identity: Dict[str, Any] = {
+            "cluster_name": handle.cluster_name,
+            "provider_name": handle.provider_name,
+            "provider_config": handle.cluster_info.provider_config,
+            "chips_per_host": sinfo.chips_per_host if sinfo else 0,
+            # Whether the daemon's host holds the job DB (and can thus
+            # observe idleness for autostop). True for the local provider,
+            # whose "head host" home is where gang_exec records jobs.
+            "job_db_on_host": handle.provider_name == "local",
+        }
+        if handle.provider_name == "local":
+            # provision.local resolves cluster metadata under the
+            # client's STPU_HOME; the daemon needs the same root.
+            identity["stpu_home"] = str(paths.home())
+        return identity
+
+    def _write_cluster_identity(self, handle: SliceHandle,
+                                head_home: str) -> None:
+        agent_dir = pathlib.Path(head_home) / ".stpu_agent"
+        agent_dir.mkdir(parents=True, exist_ok=True)
+        (agent_dir / "cluster.json").write_text(
+            json.dumps(self._cluster_identity(handle), indent=2))
+
+    @staticmethod
+    def _start_local_daemon(head_home: str) -> None:
+        """Spawn the head daemon detached, once (skylet analog). Disabled
+        via STPU_DISABLE_DAEMON=1 (hermetic tests that don't exercise
+        autostop)."""
+        if os.environ.get("STPU_DISABLE_DAEMON") == "1":
+            return
+        pid_path = pathlib.Path(head_home) / ".stpu_agent" / "daemon.pid"
+        if pid_path.exists():
+            try:
+                os.kill(int(pid_path.read_text().strip()), 0)
+                return  # already running
+            except (OSError, ValueError):
+                pass
+        cmd = [sys.executable, "-m", "skypilot_tpu.agent.daemon",
+               "--home", head_home]
+        interval = os.environ.get("STPU_DAEMON_INTERVAL")
+        if interval:
+            cmd += ["--interval", interval]
+        subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                         stderr=subprocess.DEVNULL, start_new_session=True)
+
+    @staticmethod
+    def _kill_local_daemon(head_home: Optional[str]) -> None:
+        if head_home is None:
+            return
+        pid_path = pathlib.Path(head_home) / ".stpu_agent" / "daemon.pid"
+        try:
+            os.kill(int(pid_path.read_text().strip()), 15)
+        except (OSError, ValueError):
+            pass
+
+    def _restart_cluster(self, handle: SliceHandle) -> SliceHandle:
+        provider = handle.provider_name
+        res = handle.launched_resources
+        provider_config = {"num_slices": handle.num_slices}
+        provision_api.run_instances(provider, res.region, res.zone,
+                                    handle.cluster_name, provider_config)
+        provision_api.wait_instances(provider, res.region,
+                                     handle.cluster_name, "running")
+        handle.cluster_info = provision_api.get_cluster_info(
+            provider, res.region, handle.cluster_name, provider_config)
+        self._post_provision_setup(handle)
+        # Restarted hosts may have new IPs: refresh the ssh aliases.
+        self._write_ssh_config(handle)
+        # A restart disables any previous autostop (reference `sky start`
+        # semantics): otherwise the restarted daemon reads the stale
+        # autostop.json, sees only old terminal jobs, and stops the
+        # cluster again while the new job is still being submitted.
+        self.set_autostop(handle, -1, down=False)
+        global_user_state.add_or_update_cluster(
+            handle.cluster_name, handle=handle, ready=True)
+        return handle
+
+    def check_resources_fit_cluster(self, handle: SliceHandle,
+                                    task) -> None:
+        for res in task.resources:
+            if res.less_demanding_than(handle.launched_resources):
+                return
+        raise exceptions.ResourcesMismatchError(
+            f"Task requires {task.resources}; cluster "
+            f"{handle.cluster_name} has {handle.launched_resources}")
+
+    # ------------------------------------------------------------ sync/setup
+    def _sync_workdir(self, handle: SliceHandle, workdir: str) -> None:
+        src = os.path.abspath(os.path.expanduser(workdir))
+        if not src.endswith("/"):
+            src += "/"
+        for runner in handle.get_command_runners():
+            runner.rsync(src, f"~/{agent_constants.WORKDIR}/", up=True,
+                         delete=True)
+
+    def _sync_file_mounts(self, handle, all_file_mounts,
+                          storage_mounts) -> None:
+        from skypilot_tpu.data import cloud_stores
+        for dst, src in (all_file_mounts or {}).items():
+            if cloud_stores.is_cloud_store_url(src):
+                cmd = self._download_cmd(src, dst)
+                for runner in handle.get_command_runners():
+                    rc = runner.run(cmd)
+                    runner.check_returncode(rc, cmd,
+                                            f"download {src} failed")
+            else:
+                src_abs = os.path.abspath(os.path.expanduser(src))
+                for runner in handle.get_command_runners():
+                    runner.rsync(src_abs, dst, up=True)
+        for dst, store in (storage_mounts or {}).items():
+            if store.source:
+                # Client-side: create bucket + upload source (reference:
+                # Task.sync_storage_mounts, sky/task.py:951).
+                store.sync()
+            cmd = store.mount_command(dst)
+            for runner in handle.get_command_runners():
+                rc = runner.run(cmd)
+                runner.check_returncode(rc, cmd, f"mount {dst} failed")
+
+    @staticmethod
+    def _download_cmd(src: str, dst: str) -> str:
+        from skypilot_tpu.data import cloud_stores
+        return cloud_stores.get_storage_from_path(
+            src).make_download_command(src, dst)
+
+    def _setup(self, handle: SliceHandle, task, detach_setup) -> None:
+        del detach_setup
+        if task.setup is None:
+            return
+        setup_cmd = (f"cd ~/{agent_constants.WORKDIR} 2>/dev/null; "
+                     + task.setup)
+        import concurrent.futures as cf
+        runners = handle.get_command_runners()
+        log_dir = paths.logs_dir() / handle.cluster_name
+        log_dir.mkdir(parents=True, exist_ok=True)
+
+        def do_setup(idx_runner):
+            idx, runner = idx_runner
+            env = dict(task.envs)
+            env["SKYPILOT_SETUP_NODE_RANK"] = str(idx)
+            return runner.run(setup_cmd, env=env,
+                              log_path=str(log_dir / f"setup-{idx}.log"))
+        with cf.ThreadPoolExecutor(max_workers=min(
+                len(runners), 32)) as pool:
+            rcs = list(pool.map(do_setup, enumerate(runners)))
+        for idx, rc in enumerate(rcs):
+            if rc != 0:
+                raise exceptions.CommandError(
+                    rc, "setup", f"Setup failed on host {idx}; see "
+                    f"{log_dir}/setup-{idx}.log")
+
+    # ------------------------------------------------------------ execute
+    def _execute(self, handle: SliceHandle, task, detach_run,
+                 dryrun=False) -> Optional[int]:
+        if dryrun:
+            print(f"[dryrun] would run on {handle.cluster_name}: "
+                  f"{task.run!r}")
+            return None
+        if task.run is None:
+            return None
+        global_user_state.add_or_update_cluster(
+            handle.cluster_name, handle=handle, ready=True,
+            is_launch=False)
+
+        run_timestamp = time.strftime("%Y-%m-%d-%H-%M-%S")
+        head_home = handle.head_home
+        job_id = job_lib.add_job(
+            task.name or "stpu-job", getpass.getuser(), run_timestamp,
+            log_dir="", home=head_home)
+        log_dir = self._job_log_dir(handle, job_id)
+
+        info = handle.cluster_info
+        instances = info.ordered_instances()
+        res = handle.launched_resources
+        slice_shape = res.slice_info()
+        run_cmd = (f"cd ~/{agent_constants.WORKDIR} 2>/dev/null; "
+                   + task.run)
+
+        hosts = []
+        slice_order = []
+        for inst in instances:
+            if inst.slice_id not in slice_order:
+                slice_order.append(inst.slice_id)
+            slice_index = slice_order.index(inst.slice_id)
+            if handle.provider_name == "local":
+                hosts.append({"kind": "local",
+                              "host_dir": inst.tags["host_dir"],
+                              "slice_index": slice_index})
+            else:
+                hosts.append({
+                    "kind": "ssh",
+                    "ip": inst.external_ip or inst.internal_ip,
+                    "ssh_user": info.ssh_user,
+                    "ssh_key_path": info.ssh_key_path,
+                    "ssh_port": inst.ssh_port,
+                    "proxy_command": info.provider_config.get(
+                        "ssh_proxy_command"),
+                    "slice_index": slice_index,
+                })
+        spec = {
+            "job_id": job_id,
+            "task_id": f"{handle.cluster_name}-{job_id}-{run_timestamp}",
+            "cluster_name": handle.cluster_name,
+            "node_ips": [i.internal_ip for i in instances],
+            "num_slices": handle.num_slices,
+            "hosts_per_slice": slice_shape.hosts if slice_shape else 1,
+            "chips_per_host":
+                slice_shape.chips_per_host if slice_shape else 0,
+            "envs": dict(task.envs),
+            "run_cmd": run_cmd,
+            "log_dir": str(log_dir),
+            "hosts": hosts,
+            "agent_home": head_home,
+        }
+        spec_dir = paths.generated_dir() / handle.cluster_name
+        spec_dir.mkdir(parents=True, exist_ok=True)
+        spec_path = spec_dir / f"job-{job_id}.json"
+        spec_path.write_text(json.dumps(spec, indent=2))
+
+        # The gang driver runs detached so the client can exit; job state
+        # lands in the head's job DB either way.
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "skypilot_tpu.agent.gang_exec",
+             str(spec_path)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True)
+        if not detach_run:
+            self.tail_logs(handle, job_id, follow=True)
+            proc.wait()
+        return job_id
+
+    def _job_log_dir(self, handle: SliceHandle,
+                     job_id: int) -> pathlib.Path:
+        base = (pathlib.Path(handle.head_home)
+                if handle.head_home else paths.logs_dir())
+        return base / agent_constants.LOGS_DIR / f"job-{job_id}"
+
+    # ------------------------------------------------------------ job ops
+    def queue(self, handle: SliceHandle) -> List[Dict[str, Any]]:
+        return job_lib.queue(home=handle.head_home)
+
+    def cancel_jobs(self, handle: SliceHandle,
+                    job_ids: Optional[List[int]] = None) -> List[int]:
+        return job_lib.cancel_jobs(job_ids, home=handle.head_home)
+
+    def job_status(self, handle: SliceHandle,
+                   job_id: int) -> Optional[str]:
+        job = job_lib.get_job(job_id, home=handle.head_home)
+        return job["status"] if job else None
+
+    def tail_logs(self, handle: SliceHandle, job_id: Optional[int],
+                  follow: bool = True, node_rank: int = 0) -> int:
+        if job_id is None:
+            jobs = job_lib.queue(home=handle.head_home)
+            if not jobs:
+                print("No jobs on cluster.")
+                return 1
+            job_id = jobs[0]["job_id"]
+        log_path = self._job_log_dir(handle, job_id) / \
+            f"node-{node_rank}.log"
+        # Wait for the file to appear (job may still be INIT).
+        deadline = time.time() + 30
+        while not log_path.exists():
+            if time.time() > deadline or not follow:
+                print(f"(no logs yet at {log_path})")
+                return 1
+            time.sleep(0.2)
+        with open(log_path, "r", errors="replace") as f:
+            while True:
+                line = f.readline()
+                if line:
+                    print(line, end="", flush=True)
+                    continue
+                job = job_lib.get_job(job_id, home=handle.head_home)
+                done = job is None or job_lib.JobStatus(
+                    job["status"]).is_terminal()
+                if not follow or done:
+                    # Drain anything written between readline and check.
+                    rest = f.read()
+                    if rest:
+                        print(rest, end="", flush=True)
+                    break
+                time.sleep(0.2)
+        job = job_lib.get_job(job_id, home=handle.head_home)
+        if job and job["status"] == job_lib.JobStatus.SUCCEEDED.value:
+            return 0
+        return 1
+
+    # ------------------------------------------------------------ teardown
+    def _teardown(self, handle: SliceHandle, terminate: bool,
+                  purge: bool = False) -> None:
+        with _cluster_lock(handle.cluster_name):
+            if terminate and handle.provider_name == "local":
+                # Kill any live gang before the host dirs vanish, so no
+                # orphan process outlives its (simulated) slice.
+                try:
+                    job_lib.cancel_jobs(None, home=handle.head_home)
+                except Exception:
+                    pass
+                self._kill_local_daemon(handle.head_home)
+            try:
+                if terminate:
+                    provision_api.terminate_instances(
+                        handle.provider_name, handle.cluster_name,
+                        handle.cluster_info.provider_config)
+                else:
+                    res = handle.launched_resources
+                    # Capability check: pods are terminate-only (routed
+                    # through the cloud object, reference
+                    # check_features_are_supported, sky/clouds/cloud.py:524)
+                    from skypilot_tpu import clouds as clouds_lib
+                    clouds_lib.get_cloud(
+                        handle.provider_name).check_features_are_supported(
+                            res, [clouds_lib.CloudImplementationFeatures
+                                  .STOP])
+                    provision_api.stop_instances(
+                        handle.provider_name, handle.cluster_name,
+                        handle.cluster_info.provider_config)
+            except exceptions.NotSupportedError:
+                raise
+            except Exception:
+                if not purge:
+                    raise
+            if terminate:
+                global_user_state.remove_cluster(handle.cluster_name,
+                                                 terminate=True)
+            else:
+                global_user_state.update_cluster_status(
+                    handle.cluster_name, ClusterStatus.STOPPED)
+
+    def set_autostop(self, handle: SliceHandle, idle_minutes: int,
+                     down: bool = False) -> None:
+        """Record autostop client-side AND ship it to the head daemon,
+        which enforces it (reference: AutostopCodeGen over SSH feeding
+        skylet's AutostopEvent, sky/skylet/autostop_lib.py:55)."""
+        if idle_minutes >= 0 and not down:
+            # Autostop-to-STOPPED needs the stop capability (pods are
+            # terminate-only; they must use autostop --down).
+            from skypilot_tpu import clouds as clouds_lib
+            clouds_lib.get_cloud(
+                handle.provider_name).check_features_are_supported(
+                    handle.launched_resources,
+                    [clouds_lib.CloudImplementationFeatures.AUTOSTOP])
+        global_user_state.set_cluster_autostop(
+            handle.cluster_name, idle_minutes, down)
+        cfg = json.dumps({"idle_minutes": idle_minutes, "down": down,
+                          "set_at": time.time()})
+        head_home = handle.head_home
+        if head_home is not None:
+            agent_dir = pathlib.Path(head_home) / ".stpu_agent"
+            agent_dir.mkdir(parents=True, exist_ok=True)
+            (agent_dir / "autostop.json").write_text(cfg)
+            return
+        import shlex
+        runner = handle.get_command_runners()[0]
+        rc = runner.run(
+            "mkdir -p ~/.stpu_agent && "
+            f"printf '%s' {shlex.quote(cfg)} > ~/.stpu_agent/autostop.json")
+        runner.check_returncode(rc, "set_autostop",
+                                f"host {handle.cluster_name}")

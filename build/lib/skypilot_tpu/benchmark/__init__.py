@@ -1,0 +1,6 @@
+"""Benchmark harness: empirically compare TPU candidates on one task.
+
+Reference analog: sky/benchmark/ (benchmark_utils.py:73 launches N
+candidate clusters in parallel, collects sky_callback summaries, reports
+seconds/step, $/step and ETA; benchmark_state.py sqlite).
+"""

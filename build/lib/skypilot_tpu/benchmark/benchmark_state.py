@@ -1,0 +1,96 @@
+"""Benchmark records: sqlite under the client state dir.
+
+Reference analog: sky/benchmark/benchmark_state.py.
+"""
+from __future__ import annotations
+
+import json
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import paths
+
+_COLUMNS = ("benchmark", "cluster_name", "resources_str", "hourly_price",
+            "status", "num_steps", "total_steps", "seconds_per_step",
+            "launched_at")
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(paths.home() / "benchmark.db", timeout=10)
+    conn.execute("PRAGMA journal_mode=WAL")
+    conn.execute("""CREATE TABLE IF NOT EXISTS benchmarks (
+        name TEXT PRIMARY KEY,
+        task_yaml TEXT,
+        created_at REAL)""")
+    conn.execute("""CREATE TABLE IF NOT EXISTS benchmark_results (
+        benchmark TEXT,
+        cluster_name TEXT,
+        resources_str TEXT,
+        hourly_price REAL,
+        status TEXT,
+        num_steps INTEGER,
+        total_steps INTEGER,
+        seconds_per_step REAL,
+        launched_at REAL,
+        PRIMARY KEY (benchmark, cluster_name))""")
+    conn.commit()
+    return conn
+
+
+def add_benchmark(name: str, task_yaml: str) -> bool:
+    with _conn() as conn:
+        try:
+            conn.execute(
+                "INSERT INTO benchmarks VALUES (?, ?, ?)",
+                (name, task_yaml, time.time()))
+            return True
+        except sqlite3.IntegrityError:
+            return False
+
+
+def add_result(benchmark: str, cluster_name: str, resources_str: str,
+               hourly_price: float) -> None:
+    with _conn() as conn:
+        conn.execute(
+            "INSERT OR REPLACE INTO benchmark_results VALUES "
+            "(?, ?, ?, ?, 'RUNNING', NULL, NULL, NULL, ?)",
+            (benchmark, cluster_name, resources_str, hourly_price,
+             time.time()))
+
+
+def update_result(benchmark: str, cluster_name: str, status: str,
+                  num_steps: Optional[int],
+                  seconds_per_step: Optional[float],
+                  total_steps: Optional[int] = None) -> None:
+    with _conn() as conn:
+        conn.execute(
+            "UPDATE benchmark_results SET status=?, num_steps=?, "
+            "seconds_per_step=?, total_steps=COALESCE(?, total_steps) "
+            "WHERE benchmark=? AND cluster_name=?",
+            (status, num_steps, seconds_per_step, total_steps,
+             benchmark, cluster_name))
+
+
+def get_benchmarks() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            "SELECT name, task_yaml, created_at FROM benchmarks"
+        ).fetchall()
+    return [{"name": r[0], "task_yaml": r[1], "created_at": r[2]}
+            for r in rows]
+
+
+def get_results(benchmark: str) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            f"SELECT {', '.join(_COLUMNS)} FROM benchmark_results "
+            "WHERE benchmark=?", (benchmark,)).fetchall()
+    return [dict(zip(_COLUMNS, r)) for r in rows]
+
+
+def delete_benchmark(name: str) -> None:
+    with _conn() as conn:
+        conn.execute("DELETE FROM benchmarks WHERE name=?", (name,))
+        conn.execute("DELETE FROM benchmark_results WHERE benchmark=?",
+                     (name,))

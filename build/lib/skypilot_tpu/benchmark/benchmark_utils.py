@@ -1,0 +1,139 @@
+"""Benchmark orchestration: launch candidates, collect summaries, report.
+
+Reference analog: sky/benchmark/benchmark_utils.py:73 — each candidate
+resource gets its own cluster running the same task with the callback
+env exported; `update` pulls benchmark_summary.json off each cluster and
+derives seconds/step, $/step and cost-to-finish.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import execution
+from skypilot_tpu import global_user_state
+from skypilot_tpu.benchmark import benchmark_state
+from skypilot_tpu.callbacks import ENV_LOG_DIR, SUMMARY_NAME
+from skypilot_tpu.resources import Resources
+
+_REMOTE_LOG_DIR = "~/.stpu_benchmark"
+
+
+def _cluster_name(benchmark: str, idx: int) -> str:
+    return f"stpu-bench-{benchmark}-{idx}"
+
+
+def launch_benchmark(task, candidates: List[Resources],
+                     benchmark: str) -> List[str]:
+    """Launch one cluster per candidate, all running `task` with the
+    callback summary armed. Returns the cluster names."""
+    import copy
+    if not benchmark_state.add_benchmark(
+            benchmark, json.dumps(task.to_yaml_config())):
+        raise ValueError(
+            f"Benchmark {benchmark!r} already exists; "
+            f"`stpu bench delete {benchmark}` first.")
+    names = []
+    try:
+        for i, res in enumerate(candidates):
+            cand_task = copy.deepcopy(task)
+            cand_task.set_resources(res)
+            cand_task.update_envs({ENV_LOG_DIR: _REMOTE_LOG_DIR})
+            name = _cluster_name(benchmark, i)
+            execution.launch(cand_task, cluster_name=name,
+                             detach_run=True, stream_logs=False)
+            benchmark_state.add_result(
+                benchmark, name, str(res),
+                res.hourly_price() * cand_task.num_nodes)
+            names.append(name)
+    except Exception:
+        # Roll back: tear down what already launched and release the
+        # benchmark name, so a failed candidate N doesn't leave earlier
+        # candidates billing behind a name that blocks retry.
+        teardown_benchmark(benchmark)
+        benchmark_state.delete_benchmark(benchmark)
+        raise
+    return names
+
+
+def _fetch_summary(record) -> Optional[Dict[str, Any]]:
+    handle = record["handle"]
+    if handle is None:
+        return None
+    runner = handle.get_command_runners()[0]
+    rc, out, _ = runner.run(
+        f"cat {_REMOTE_LOG_DIR}/{SUMMARY_NAME}", require_outputs=True)
+    if rc != 0:
+        return None
+    try:
+        return json.loads(out.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        return None
+
+
+def update_benchmark(benchmark: str) -> List[Dict[str, Any]]:
+    """Pull summaries from each candidate cluster and refresh results."""
+    for result in benchmark_state.get_results(benchmark):
+        record = global_user_state.get_cluster_from_name(
+            result["cluster_name"])
+        if record is None:
+            benchmark_state.update_result(
+                benchmark, result["cluster_name"], "TERMINATED",
+                result["num_steps"], result["seconds_per_step"])
+            continue
+        summary = _fetch_summary(record)
+        if summary is None:
+            continue
+        sps = summary.get("seconds_per_step")
+        total = summary.get("total_steps")
+        done = (total is not None and
+                summary.get("num_steps", 0) >= total)
+        benchmark_state.update_result(
+            benchmark, result["cluster_name"],
+            "FINISHED" if done else "RUNNING",
+            summary.get("num_steps"), sps, total_steps=total)
+    return report(benchmark)
+
+
+def report(benchmark: str) -> List[Dict[str, Any]]:
+    """Results with derived $/step and cost-to-finish (from the
+    workload's own sky_callback.init(total_steps=...) declaration)."""
+    out = []
+    for r in benchmark_state.get_results(benchmark):
+        row = dict(r)
+        sps = r["seconds_per_step"]
+        if sps is not None:
+            row["dollars_per_step"] = r["hourly_price"] * sps / 3600.0
+            if r.get("total_steps"):
+                row["estimated_total_cost"] = (
+                    row["dollars_per_step"] * r["total_steps"])
+        out.append(row)
+    return out
+
+
+def teardown_benchmark(benchmark: str, terminate: bool = True) -> None:
+    """Tear down all candidate clusters; keep the recorded results."""
+    import sys
+    from skypilot_tpu.backends import slice_backend
+    backend = slice_backend.SliceBackend()
+    for result in benchmark_state.get_results(benchmark):
+        record = global_user_state.get_cluster_from_name(
+            result["cluster_name"])
+        if record is not None and record["handle"] is not None:
+            try:
+                backend.teardown(record["handle"], terminate=terminate,
+                                 purge=True)
+            except Exception as e:  # noqa: BLE001
+                # Keep the cluster record: a transient teardown failure
+                # must stay visible/retryable, never silently orphan a
+                # billed slice.
+                print(f"bench: teardown of "
+                      f"{result['cluster_name']} failed ({e}); "
+                      f"record kept — retry `stpu bench down` or "
+                      f"`stpu down {result['cluster_name']}`.",
+                      file=sys.stderr)
+                continue
+        benchmark_state.update_result(
+            benchmark, result["cluster_name"], "TERMINATED",
+            result["num_steps"], result["seconds_per_step"],
+            total_steps=result.get("total_steps"))

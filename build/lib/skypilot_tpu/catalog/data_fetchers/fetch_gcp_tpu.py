@@ -1,0 +1,203 @@
+"""Generate the static TPU + host-VM catalog CSVs.
+
+Reference analog: sky/clouds/service_catalog/data_fetchers/fetch_gcp.py
+(scrapes the GCP pricing/SKU APIs, including TPU pods). This image has zero
+egress, so the fetcher materializes the catalog from embedded public
+spec/pricing tables instead; re-running it regenerates
+``skypilot_tpu/catalog/data/*.csv`` deterministically. Prices are public
+on-demand/preemptible us-central list prices (USD per chip-hour for TPUs,
+per instance-hour for VMs) and act as the optimizer's cost model — the
+optimizer only needs *relative* correctness to rank choices.
+
+TPU device model (drives all topology math downstream):
+
+  generation  cores/chip  chips/host  naming unit
+  v2          2           4           cores   (tpu-v2-8 = 8 cores, 1 host)
+  v3          2           4           cores
+  v4          2           4           cores
+  v5e         1           8           chips   (tpu-v5e-16 = 16 chips, 2 hosts)
+  v5p         2           4           cores   (tpu-v5p-64 = 32 chips, 8 hosts)
+  v6e         1           4           chips
+
+A *slice* is one ICI domain; its hosts boot together and are the gang.
+"""
+from __future__ import annotations
+
+import csv
+import dataclasses
+import pathlib
+from typing import Dict, List, Tuple
+
+DATA_DIR = pathlib.Path(__file__).resolve().parent.parent / "data"
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuGen:
+    name: str            # catalog accelerator prefix, e.g. "v5p"
+    cores_per_chip: int
+    chips_per_host: int
+    unit: str            # "cores" | "chips" — what the -N suffix counts
+    price_chip_hour: float
+    spot_chip_hour: float
+    sizes: Tuple[int, ...]        # allowed -N suffixes
+    zones: Tuple[str, ...]
+
+
+GENERATIONS: List[TpuGen] = [
+    TpuGen("v2", 2, 4, "cores", 1.125, 0.338,
+           (8, 32, 128, 256, 512),
+           ("us-central1-b", "us-central1-c", "us-central1-f",
+            "europe-west4-a", "asia-east1-c")),
+    TpuGen("v3", 2, 4, "cores", 2.00, 0.60,
+           (8, 32, 64, 128, 256, 512, 1024, 2048),
+           ("us-central1-a", "europe-west4-a")),
+    TpuGen("v4", 2, 4, "cores", 3.22, 0.97,
+           (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
+           ("us-central2-b",)),
+    TpuGen("v5e", 1, 8, "chips", 1.20, 0.60,
+           (1, 4, 8, 16, 32, 64, 128, 256),
+           ("us-central1-a", "us-west4-a", "us-west4-b", "us-east1-c",
+            "us-east5-b", "europe-west4-b", "asia-southeast1-b")),
+    TpuGen("v5p", 2, 4, "cores", 4.20, 1.89,
+           (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 12288),
+           ("us-east5-a", "us-central1-a", "europe-west4-b")),
+    TpuGen("v6e", 1, 4, "chips", 2.70, 1.35,
+           (1, 4, 8, 16, 32, 64, 128, 256),
+           ("us-east5-b", "us-east1-d", "europe-west4-a",
+            "asia-northeast1-b")),
+]
+
+# Host VM types for controllers / CPU tasks (public n2 list prices,
+# us-central1 on-demand / spot, USD per hour).
+CPU_VMS: List[Tuple[str, int, float, float, float]] = [
+    # (instance_type, vcpus, memory_gb, price, spot_price)
+    ("n2-standard-2", 2, 8, 0.0971, 0.0235),
+    ("n2-standard-4", 4, 16, 0.1942, 0.0470),
+    ("n2-standard-8", 8, 32, 0.3885, 0.0940),
+    ("n2-standard-16", 16, 64, 0.7769, 0.1880),
+    ("n2-standard-32", 32, 128, 1.5539, 0.3759),
+    ("n2-highmem-8", 8, 64, 0.5241, 0.1268),
+    ("n2-highmem-16", 16, 128, 1.0481, 0.2536),
+]
+
+CPU_VM_ZONES = [
+    "us-central1-a", "us-central1-b", "us-central1-c", "us-central1-f",
+    "us-central2-b", "us-west4-a", "us-west4-b", "us-east1-c", "us-east1-d",
+    "us-east5-a", "us-east5-b", "europe-west4-a", "europe-west4-b",
+    "asia-east1-c", "asia-southeast1-b", "asia-northeast1-b",
+]
+
+# Regional price multipliers vs us-central1 (coarse public pattern:
+# EU ~+10%, APAC ~+15%). Keyed by region prefix.
+REGION_MULT: Dict[str, float] = {
+    "us-": 1.0,
+    "europe-": 1.10,
+    "asia-": 1.15,
+}
+
+
+def _region(zone: str) -> str:
+    return zone.rsplit("-", 1)[0]
+
+
+def _mult(zone: str) -> float:
+    for prefix, m in REGION_MULT.items():
+        if zone.startswith(prefix):
+            return m
+    return 1.0
+
+
+def _topology(gen: TpuGen, chips: int) -> str:
+    """Approximate physical topology string (2D for v2/v3/v5e/v6e; 3D for
+    v4/v5p). Only used for display + host math cross-checks."""
+    def prime_factors(n: int):
+        fs, p = [], 2
+        while p * p <= n:
+            while n % p == 0:
+                fs.append(p)
+                n //= p
+            p += 1
+        if n > 1:
+            fs.append(n)
+        return fs
+
+    if gen.name in ("v4", "v5p"):
+        # Factor chips into x*y*z as equal as possible: feed prime
+        # factors (largest first) to the smallest dim. Handles
+        # non-power-of-two slices (e.g. 6144 chips -> 16x16x24).
+        dims = [1, 1, 1]
+        for f in sorted(prime_factors(chips), reverse=True):
+            dims.sort()
+            dims[0] *= f
+        dims.sort()
+        return "x".join(str(d) for d in dims)
+    dims = [1, 1]
+    for f in sorted(prime_factors(chips), reverse=True):
+        dims.sort()
+        dims[0] *= f
+    dims.sort()
+    return f"{dims[0]}x{dims[1]}"
+
+
+def build_tpu_rows() -> List[Dict]:
+    rows = []
+    for gen in GENERATIONS:
+        for size in gen.sizes:
+            chips = size // gen.cores_per_chip if gen.unit == "cores" \
+                else size
+            if chips == 0:
+                continue
+            hosts = max(1, (chips + gen.chips_per_host - 1) //
+                        gen.chips_per_host)
+            # Sub-host slices (v5e-1/-4) share one host.
+            acc = f"tpu-{gen.name}-{size}"
+            for zone in gen.zones:
+                m = _mult(zone)
+                rows.append({
+                    "accelerator": acc,
+                    "generation": gen.name,
+                    "chips": chips,
+                    "cores": chips * gen.cores_per_chip,
+                    "hosts": hosts,
+                    "topology": _topology(gen, chips),
+                    "region": _region(zone),
+                    "zone": zone,
+                    "price": round(gen.price_chip_hour * chips * m, 4),
+                    "spot_price": round(gen.spot_chip_hour * chips * m, 4),
+                })
+    return rows
+
+
+def build_vm_rows() -> List[Dict]:
+    rows = []
+    for (itype, vcpus, mem, price, spot) in CPU_VMS:
+        for zone in CPU_VM_ZONES:
+            m = _mult(zone)
+            rows.append({
+                "instance_type": itype,
+                "vcpus": vcpus,
+                "memory_gb": mem,
+                "region": _region(zone),
+                "zone": zone,
+                "price": round(price * m, 4),
+                "spot_price": round(spot * m, 4),
+            })
+    return rows
+
+
+def write_csv(path: pathlib.Path, rows: List[Dict]) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        writer.writeheader()
+        writer.writerows(rows)
+
+
+def main() -> None:
+    write_csv(DATA_DIR / "gcp_tpus.csv", build_tpu_rows())
+    write_csv(DATA_DIR / "gcp_vms.csv", build_vm_rows())
+    print(f"wrote {DATA_DIR}/gcp_tpus.csv and gcp_vms.csv")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Credential probing: which providers can we actually use?
+
+Reference analog: sky/check.py (check:18 — probes each cloud's
+credentials AND its per-capability readiness, persists the enabled set to
+the state DB so the optimizer only plans over reachable clouds). Here a
+"cloud" is a provision provider; each probe returns (ok, reason) and the
+enabled set is persisted via global_user_state.set_enabled_clouds.
+"""
+from __future__ import annotations
+
+from typing import List
+
+
+def check(quiet: bool = False) -> List[str]:
+    """Probe every registered cloud's credentials, persist and return
+    the enabled set (consumed by the optimizer's candidate filter)."""
+    from skypilot_tpu import clouds as clouds_lib
+    from skypilot_tpu import global_user_state
+    enabled = []
+    for name in clouds_lib.registered_names():
+        ok, reason = clouds_lib.get_cloud(name).check_credentials()
+        if ok:
+            enabled.append(name)
+        if not quiet:
+            mark = "✓" if ok else "✗"
+            print(f"  {mark} {name}: {reason}")
+    global_user_state.set_enabled_clouds(enabled)
+    if not quiet:
+        print(f"Enabled providers: {', '.join(enabled) or '(none)'}")
+    return enabled

@@ -1,0 +1,522 @@
+"""CLI: the `stpu` command.
+
+Reference analog: sky/cli.py (click groups for launch/exec/status/stop/
+down/autostop/queue/logs/cancel/check/show-gpus + jobs/serve subcommands,
+sky/cli.py:928,3337,3418). Every command parses args then calls the SDK —
+no business logic lives here.
+"""
+from __future__ import annotations
+
+import sys
+from typing import List, Optional, Tuple
+
+import click
+
+from skypilot_tpu import exceptions
+
+
+def _parse_env(env: Tuple[str, ...]) -> dict:
+    out = {}
+    for item in env:
+        if "=" not in item:
+            raise click.UsageError(f"--env {item!r} must be KEY=VALUE")
+        k, v = item.split("=", 1)
+        out[k] = v
+    return out
+
+
+def _load_task(entrypoint: str, env: Tuple[str, ...], overrides: dict):
+    from skypilot_tpu.task import Task
+    try:
+        task = Task.from_yaml(entrypoint, env_overrides=_parse_env(env))
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    for key, value in overrides.items():
+        if value is None:
+            continue
+        if key == "num_nodes":
+            task.num_nodes = value
+        else:
+            # Apply to every candidate so any_of fallbacks survive.
+            task.set_resources(tuple(
+                r.copy(**{key: value}) for r in task.resources))
+    return task
+
+
+@click.group()
+@click.version_option(message="%(version)s")
+def cli():
+    """stpu: launch, manage, and serve AI workloads on TPU slices."""
+
+
+@cli.command()
+@click.argument("entrypoint", required=True)
+@click.option("--cluster", "-c", default=None, help="Cluster name.")
+@click.option("--env", multiple=True, help="KEY=VALUE env overrides.")
+@click.option("--num-nodes", type=int, default=None,
+              help="Override number of slices.")
+@click.option("--accelerator", "--gpus", "-t", default=None,
+              help="Override slice type, e.g. tpu-v5e-16.")
+@click.option("--use-spot/--no-use-spot", default=None)
+@click.option("--zone", default=None)
+@click.option("--region", default=None)
+@click.option("--cloud", default=None)
+@click.option("--dryrun", is_flag=True)
+@click.option("--down", is_flag=True,
+              help="Tear down the cluster when the job finishes.")
+@click.option("--detach-run", "-d", is_flag=True)
+@click.option("--idle-minutes-to-autostop", "-i", type=int, default=None)
+@click.option("--retry-until-up", is_flag=True)
+@click.option("--no-setup", is_flag=True)
+def launch(entrypoint, cluster, env, num_nodes, accelerator, use_spot,
+           zone, region, cloud, dryrun, down, detach_run,
+           idle_minutes_to_autostop, retry_until_up, no_setup):
+    """Launch a task YAML on a (new or existing) slice cluster."""
+    from skypilot_tpu import execution
+    task = _load_task(entrypoint, env, {
+        "num_nodes": num_nodes, "accelerator": accelerator,
+        "use_spot": use_spot, "zone": zone, "region": region,
+        "cloud": cloud,
+    })
+    try:
+        job_id, handle = execution.launch(
+            task, cluster_name=cluster, dryrun=dryrun, down=down,
+            detach_run=detach_run,
+            idle_minutes_to_autostop=idle_minutes_to_autostop,
+            retry_until_up=retry_until_up, no_setup=no_setup)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    if job_id is not None:
+        click.echo(f"Job submitted: {job_id} "
+                   f"(cluster {handle.cluster_name})")
+
+
+@cli.command(name="exec")
+@click.argument("cluster", required=True)
+@click.argument("entrypoint", required=True)
+@click.option("--env", multiple=True)
+@click.option("--detach-run", "-d", is_flag=True)
+def exec_cmd(cluster, entrypoint, env, detach_run):
+    """Run a task on an existing cluster (skip provision/setup)."""
+    from skypilot_tpu import execution
+    task = _load_task(entrypoint, env, {})
+    try:
+        job_id, _ = execution.exec(task, cluster, detach_run=detach_run)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f"Job submitted: {job_id} (cluster {cluster})")
+
+
+@cli.command()
+@click.option("--refresh", "-r", is_flag=True,
+              help="Reconcile with provider truth.")
+def status(refresh):
+    """List clusters."""
+    from skypilot_tpu import core
+    records = core.status(refresh=refresh)
+    if not records:
+        click.echo("No existing clusters.")
+        return
+    fmt = "{:<20} {:<28} {:<8} {:<10} {:>9}"
+    click.echo(fmt.format("NAME", "RESOURCES", "NODES", "STATUS",
+                          "AUTOSTOP"))
+    for r in records:
+        handle = r["handle"]
+        res = getattr(handle, "launched_resources", None)
+        click.echo(fmt.format(
+            r["name"], repr(res) if res else "-",
+            getattr(handle, "num_slices", "-"),
+            r["status"].value,
+            f"{r['autostop']}m" if r["autostop"] >= 0 else "-"))
+
+
+@cli.command()
+@click.argument("clusters", nargs=-1, required=True)
+def stop(clusters):
+    """Stop cluster(s) (single-host slices only; pods are down-only)."""
+    from skypilot_tpu import core
+    for name in clusters:
+        try:
+            core.stop(name)
+            click.echo(f"Stopped {name}.")
+        except exceptions.SkyTpuError as e:
+            raise click.ClickException(str(e)) from e
+
+
+@cli.command()
+@click.argument("clusters", nargs=-1, required=True)
+def start(clusters):
+    """Restart stopped cluster(s)."""
+    from skypilot_tpu import core
+    for name in clusters:
+        core.start(name)
+        click.echo(f"Started {name}.")
+
+
+@cli.command()
+@click.argument("clusters", nargs=-1, required=True)
+@click.option("--purge", is_flag=True,
+              help="Remove state even if cloud teardown fails.")
+@click.option("--yes", "-y", is_flag=True)
+def down(clusters, purge, yes):
+    """Terminate cluster(s)."""
+    from skypilot_tpu import core
+    if not yes:
+        click.confirm(f"Terminate {', '.join(clusters)}?", abort=True)
+    for name in clusters:
+        core.down(name, purge=purge)
+        click.echo(f"Terminated {name}.")
+
+
+@cli.command()
+@click.argument("cluster", required=True)
+@click.option("--idle-minutes", "-i", type=int, required=True,
+              help="Idle minutes before stopping; -1 cancels.")
+@click.option("--down", "down_after", is_flag=True,
+              help="Terminate instead of stop.")
+def autostop(cluster, idle_minutes, down_after):
+    """Schedule automatic stop/teardown on idleness."""
+    from skypilot_tpu import core
+    core.autostop(cluster, idle_minutes, down_after=down_after)
+    if idle_minutes < 0:
+        click.echo(f"Autostop cancelled for {cluster}.")
+    else:
+        click.echo(f"{cluster}: autostop after {idle_minutes} idle "
+                   f"minutes ({'down' if down_after else 'stop'}).")
+
+
+@cli.command()
+@click.argument("cluster", required=True)
+@click.option("--all-jobs", "-a", is_flag=True, default=False,
+              help="Include finished jobs.")
+def queue(cluster, all_jobs):
+    """Show the cluster's job queue."""
+    from skypilot_tpu import core
+    jobs = core.queue(cluster, all_jobs=all_jobs)
+    fmt = "{:<6} {:<20} {:<12} {:<10}"
+    click.echo(fmt.format("ID", "NAME", "STATUS", "USER"))
+    for j in jobs:
+        click.echo(fmt.format(j["job_id"], j["job_name"] or "-",
+                              j["status"], j["username"] or "-"))
+
+
+@cli.command()
+@click.argument("cluster", required=True)
+@click.argument("job_id", required=False, type=int)
+@click.option("--no-follow", is_flag=True)
+def logs(cluster, job_id, no_follow):
+    """Tail a job's logs (latest job if no id given)."""
+    from skypilot_tpu import core
+    sys.exit(core.tail_logs(cluster, job_id, follow=not no_follow))
+
+
+@cli.command()
+@click.argument("cluster", required=True)
+@click.argument("job_ids", nargs=-1, type=int)
+@click.option("--all", "-a", "all_jobs", is_flag=True)
+def cancel(cluster, job_ids, all_jobs):
+    """Cancel job(s)."""
+    from skypilot_tpu import core
+    done = core.cancel(cluster, list(job_ids) or None, all_jobs=all_jobs)
+    click.echo(f"Cancelled jobs: {done or 'none'}")
+
+
+@cli.command(name="show-tpus")
+@click.argument("name_filter", required=False)
+@click.option("--region", default=None)
+def show_tpus(name_filter, region):
+    """List TPU slice types, zones and prices (analog: sky show-gpus)."""
+    from skypilot_tpu import catalog
+    rows = catalog.list_accelerators(name_filter=name_filter,
+                                     region_filter=region)
+    fmt = "{:<14} {:>6} {:>6} {:<18} {:>12} {:>12}"
+    click.echo(fmt.format("SLICE", "CHIPS", "HOSTS", "ZONE", "$/HR",
+                          "SPOT $/HR"))
+    for r in rows:
+        click.echo(fmt.format(
+            r["accelerator"], r["chips"], r["hosts"], r["zone"],
+            f"{r['price']:.2f}", f"{r['spot_price']:.2f}"))
+
+
+@cli.command()
+def check():
+    """Probe cloud credentials and record enabled clouds."""
+    from skypilot_tpu import check as check_lib
+    enabled = check_lib.check()
+    click.echo(f"Enabled clouds: {', '.join(enabled) or 'none'}")
+
+
+@cli.command(name="cost-report")
+def cost_report():
+    """Accumulated cost per cluster from recorded usage."""
+    from skypilot_tpu import core
+    fmt = "{:<24} {:<10} {:>10} {:>10}"
+    click.echo(fmt.format("NAME", "STATUS", "HOURS", "COST ($)"))
+    for r in core.cost_report():
+        click.echo(fmt.format(
+            r["name"],
+            r["status"].value if r["status"] else "-",
+            f"{r['duration_seconds'] / 3600:.2f}",
+            f"{r['cost']:.2f}"))
+
+
+@cli.group()
+def jobs():
+    """Managed jobs: preemption-recovering task execution."""
+
+
+@jobs.command(name="launch")
+@click.argument("entrypoint", required=True)
+@click.option("--name", "-n", default=None, help="Managed job name.")
+@click.option("--env", multiple=True, help="KEY=VALUE env overrides.")
+@click.option("--detach-run", "-d", is_flag=True)
+def jobs_launch(entrypoint, name, env, detach_run):
+    """Launch a managed job from a task YAML (single task or multi-doc
+    chain pipeline)."""
+    from skypilot_tpu import jobs as jobs_sdk
+    from skypilot_tpu.jobs import core as jobs_core
+    from skypilot_tpu.utils import dag_utils
+    try:
+        dag = dag_utils.load_chain_dag_from_yaml(
+            entrypoint, env_overrides=_parse_env(env))
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    job_id = jobs_sdk.launch(dag, name=name)
+    click.echo(f"Managed job {job_id} submitted.")
+    if not detach_run:
+        sys.exit(jobs_core.tail_logs(job_id, follow=True))
+
+
+@jobs.command(name="queue")
+@click.option("--skip-finished", "-s", is_flag=True)
+def jobs_queue(skip_finished):
+    """List managed jobs."""
+    from skypilot_tpu.jobs import core as jobs_core
+    fmt = "{:<5} {:<20} {:<18} {:>9} {:<24}"
+    click.echo(fmt.format("ID", "NAME", "STATUS", "#RECOVER", "CLUSTER"))
+    for j in jobs_core.queue(skip_finished=skip_finished):
+        click.echo(fmt.format(
+            j["job_id"], (j["job_name"] or "-")[:20], j["status"],
+            j["recovery_count"], j["cluster_name"] or "-"))
+
+
+@jobs.command(name="cancel")
+@click.argument("job_ids", nargs=-1, type=int)
+@click.option("--all", "-a", "all_jobs", is_flag=True)
+def jobs_cancel(job_ids, all_jobs):
+    """Cancel managed job(s)."""
+    from skypilot_tpu.jobs import core as jobs_core
+    done = jobs_core.cancel(list(job_ids) or None, all_jobs=all_jobs)
+    click.echo(f"Cancelling managed jobs: {done or 'none'}")
+
+
+@jobs.command(name="logs")
+@click.argument("job_id", required=False, type=int)
+@click.option("--no-follow", is_flag=True)
+def jobs_logs(job_id, no_follow):
+    """Stream a managed job's task logs."""
+    from skypilot_tpu.jobs import core as jobs_core
+    sys.exit(jobs_core.tail_logs(job_id, follow=not no_follow))
+
+
+@jobs.command(name="dashboard")
+@click.option("--port", default=None, type=int)
+@click.option("--host", default=None)
+def jobs_dashboard(port, host):
+    """Serve an auto-refreshing HTML view of the managed-jobs queue."""
+    from skypilot_tpu.jobs import dashboard
+    dashboard.run(port or dashboard.DEFAULT_PORT,
+                  host or dashboard.DEFAULT_HOST)
+
+
+@cli.group()
+def bench():
+    """Benchmark a task across candidate TPU types ($/step report)."""
+
+
+@bench.command(name="launch")
+@click.argument("entrypoint", required=True)
+@click.option("--benchmark", "-b", required=True, help="Benchmark name.")
+@click.option("--candidate", "-c", "candidates", multiple=True,
+              required=True,
+              help="Accelerator per candidate (repeatable), e.g. "
+                   "-c tpu-v5e-8 -c tpu-v5p-8.")
+@click.option("--env", multiple=True, help="KEY=VALUE env overrides.")
+def bench_launch(entrypoint, benchmark, candidates, env):
+    """Launch one cluster per candidate running ENTRYPOINT with step
+    callbacks armed."""
+    from skypilot_tpu.benchmark import benchmark_utils
+    task = _load_task(entrypoint, env, {})
+    try:
+        res_candidates = [
+            task.resources[0].copy(accelerator=acc, instance_type=None)
+            for acc in candidates]
+        names = benchmark_utils.launch_benchmark(task, res_candidates,
+                                                 benchmark)
+    except (ValueError, exceptions.SkyTpuError) as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f"Benchmark {benchmark}: launched {', '.join(names)}")
+
+
+@bench.command(name="show")
+@click.argument("benchmark", required=True)
+def bench_show(benchmark):
+    """Refresh and show a benchmark's per-candidate results."""
+    from skypilot_tpu.benchmark import benchmark_utils
+    rows = benchmark_utils.update_benchmark(benchmark)
+    if not rows:
+        click.echo(f"No results for benchmark {benchmark!r}.")
+        return
+    fmt = "{:<26} {:<28} {:<10} {:>7} {:>12} {:>12}"
+    click.echo(fmt.format("CLUSTER", "RESOURCES", "STATUS", "STEPS",
+                          "SEC/STEP", "$/STEP"))
+    for r in rows:
+        sps = r.get("seconds_per_step")
+        dps = r.get("dollars_per_step")
+        click.echo(fmt.format(
+            r["cluster_name"], r["resources_str"][:28], r["status"],
+            r["num_steps"] if r["num_steps"] is not None else "-",
+            f"{sps:.3f}" if sps else "-",
+            f"{dps:.6f}" if dps else "-"))
+
+
+@bench.command(name="down")
+@click.argument("benchmark", required=True)
+def bench_down(benchmark):
+    """Tear down a benchmark's candidate clusters (results kept)."""
+    from skypilot_tpu.benchmark import benchmark_utils
+    benchmark_utils.update_benchmark(benchmark)
+    benchmark_utils.teardown_benchmark(benchmark)
+    click.echo(f"Benchmark {benchmark}: clusters torn down.")
+
+
+@bench.command(name="delete")
+@click.argument("benchmark", required=True)
+def bench_delete(benchmark):
+    """Delete a benchmark's records."""
+    from skypilot_tpu.benchmark import benchmark_state
+    benchmark_state.delete_benchmark(benchmark)
+    click.echo(f"Benchmark {benchmark} deleted.")
+
+
+@cli.group()
+def storage():
+    """Storage objects: buckets synced/mounted onto clusters."""
+
+
+@storage.command(name="ls")
+def storage_ls():
+    """List registered storage objects."""
+    from skypilot_tpu import core
+    records = core.storage_ls()
+    if not records:
+        click.echo("No storage objects.")
+        return
+    fmt = "{:<28} {:<8} {:<10} {}"
+    click.echo(fmt.format("NAME", "STORE", "STATUS", "SOURCE"))
+    for r in records:
+        handle = r["handle"] or {}
+        click.echo(fmt.format(r["name"], handle.get("store", "?"),
+                              r["status"] or "?",
+                              handle.get("source") or "-"))
+
+
+@storage.command(name="delete")
+@click.argument("names", nargs=-1, required=True)
+@click.option("--yes", "-y", is_flag=True, help="Skip confirmation.")
+def storage_delete(names, yes):
+    """Delete storage object(s): the bucket AND its registry row."""
+    from skypilot_tpu import core
+    for name in names:
+        if not yes:
+            click.confirm(f"Delete storage {name!r} (bucket contents "
+                          f"included)?", abort=True)
+        try:
+            core.storage_delete(name)
+            click.echo(f"Deleted storage {name}.")
+        except exceptions.SkyTpuError as e:
+            raise click.ClickException(str(e)) from e
+
+
+@storage.command(name="transfer")
+@click.argument("src", required=True)
+@click.argument("dst", required=True)
+def storage_transfer(src, dst):
+    """Transfer SRC bucket to DST bucket (e.g. s3://b1 gcs://b2).
+
+    s3->gcs runs cloud-side via GCP Storage Transfer Service; gcs->s3
+    via gsutil rsync.
+    """
+    from skypilot_tpu.data import data_transfer
+
+    def parse(uri):
+        if "://" not in uri:
+            raise click.ClickException(
+                f"{uri!r}: want store://bucket (gcs://, s3://, local://)")
+        store, bucket = uri.split("://", 1)
+        return store.replace("gs", "gcs") if store == "gs" else store, \
+            bucket.rstrip("/")
+
+    (src_store, src_bucket), (dst_store, dst_bucket) = parse(src), \
+        parse(dst)
+    try:
+        data_transfer.transfer(src_store, src_bucket, dst_store,
+                               dst_bucket)
+    except (exceptions.StorageError,
+            exceptions.NotSupportedError) as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f"Transferred {src} -> {dst}.")
+
+
+@cli.group()
+def serve():
+    """Autoscaled serving: one endpoint, N replicas."""
+
+
+@serve.command(name="up")
+@click.argument("entrypoint", required=True)
+@click.option("--service-name", "-n", default=None)
+@click.option("--env", multiple=True, help="KEY=VALUE env overrides.")
+def serve_up(entrypoint, service_name, env):
+    """Start a service from a task YAML with a `service:` section."""
+    from skypilot_tpu.serve import core as serve_core
+    task = _load_task(entrypoint, env, {})
+    name, endpoint = serve_core.up(task, service_name)
+    click.echo(f"Service {name} starting; endpoint: {endpoint}")
+
+
+@serve.command(name="down")
+@click.argument("service_names", nargs=-1)
+@click.option("--all", "-a", "all_services", is_flag=True)
+def serve_down(service_names, all_services):
+    """Tear down service(s)."""
+    from skypilot_tpu.serve import core as serve_core
+    done = serve_core.down(list(service_names) or None,
+                           all_services=all_services)
+    click.echo(f"Tearing down: {', '.join(done) or 'none'}")
+
+
+@serve.command(name="status")
+@click.argument("service_names", nargs=-1)
+def serve_status(service_names):
+    """Show services and their replicas."""
+    from skypilot_tpu.serve import core as serve_core
+    fmt = "{:<20} {:<16} {:<24} {:<8}"
+    click.echo(fmt.format("SERVICE", "STATUS", "ENDPOINT", "#READY"))
+    # serve_core.status() normalizes statuses to plain strings.
+    for svc in serve_core.status(list(service_names) or None):
+        n_ready = sum(1 for r in svc["replicas"]
+                      if r["status"] == "READY")
+        click.echo(fmt.format(svc["service_name"], svc["status"],
+                              svc["endpoint"], n_ready))
+        for r in svc["replicas"]:
+            click.echo(f"  replica {r['replica_id']:<3} "
+                       f"{r['status']:<14} {r['url'] or '-'}")
+
+
+def main():
+    cli()
+
+
+if __name__ == "__main__":
+    main()

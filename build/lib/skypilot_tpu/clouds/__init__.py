@@ -1,0 +1,33 @@
+"""Cloud registry: name -> capability object.
+
+Reference analog: sky/clouds/cloud_registry.py. The backend, optimizer,
+and `stpu check` resolve providers through here; adding a cloud means
+registering one Cloud subclass (plus its provision module).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds.cloud import (  # noqa: F401 — public API
+    Cloud, CloudImplementationFeatures)
+from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.local import Local
+
+CLOUD_REGISTRY: Dict[str, Cloud] = {
+    GCP.NAME: GCP(),
+    Local.NAME: Local(),
+}
+
+
+def get_cloud(name: str) -> Cloud:
+    try:
+        return CLOUD_REGISTRY[name]
+    except KeyError:
+        raise exceptions.SkyTpuError(
+            f"Unknown cloud {name!r}; registered: "
+            f"{sorted(CLOUD_REGISTRY)}") from None
+
+
+def registered_names() -> List[str]:
+    return sorted(CLOUD_REGISTRY)

@@ -1,0 +1,88 @@
+"""Cloud capability objects: what each provider can and cannot do.
+
+Reference analog: sky/clouds/cloud.py (CloudImplementationFeatures:27,
+Cloud:96, check_features_are_supported:524). The backend and optimizer ask
+a Cloud object — never a provider module — whether an operation is
+possible for a given Resources, so capability rules (TPU pods cannot
+stop, a provider without spot, ports unimplemented) live in exactly one
+place and produce one error shape.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Dict, Iterable, Tuple
+
+from skypilot_tpu import exceptions
+
+
+class CloudImplementationFeatures(enum.Enum):
+    """Operations a cloud may or may not support for given resources.
+
+    Mirrors the reference enum (sky/clouds/cloud.py:27), trimmed to the
+    features this framework exposes.
+    """
+    STOP = "stop"                    # stop (preserve disk) vs terminate
+    AUTOSTOP = "autostop"            # daemon-driven stop when idle
+    MULTI_NODE = "multi_node"        # num_nodes > 1 (multi-slice)
+    SPOT_INSTANCE = "spot_instance"
+    STORAGE_MOUNTING = "storage_mounting"
+    OPEN_PORTS = "open_ports"
+    IMAGE_ID = "image_id"
+
+
+def pod_stop_rules(resources, hint: str
+                   ) -> Dict["CloudImplementationFeatures", str]:
+    """The shared TPU-semantics rule: multi-host pod slices cannot be
+    stopped (and therefore cannot autostop-to-STOPPED); they are
+    terminate-only. Clouds whose multi-host clusters behave like pods
+    merge this into their per-resource table."""
+    sinfo = resources.slice_info() if resources is not None else None
+    if sinfo is None or not sinfo.is_pod:
+        return {}
+    why = (f"multi-host slice {sinfo.accelerator} cannot be stopped, "
+           f"only terminated. {hint}")
+    return {CloudImplementationFeatures.STOP: why,
+            CloudImplementationFeatures.AUTOSTOP: why}
+
+
+class Cloud:
+    """Base capability object; subclasses override the tables/hooks."""
+
+    NAME = "abstract"
+
+    # Features this cloud never supports, with human-readable reasons.
+    _UNSUPPORTED: Dict[CloudImplementationFeatures, str] = {}
+
+    def unsupported_features_for_resources(
+            self, resources) -> Dict[CloudImplementationFeatures, str]:
+        """Per-resource refinement: base table plus rules that depend on
+        the concrete resources (e.g. pod slices cannot stop)."""
+        del resources
+        return dict(self._UNSUPPORTED)
+
+    def check_features_are_supported(
+            self, resources,
+            requested: Iterable[CloudImplementationFeatures]) -> None:
+        """Raise NotSupportedError if any requested feature is
+        unsupported for these resources (reference:
+        check_features_are_supported, sky/clouds/cloud.py:524)."""
+        unsupported = self.unsupported_features_for_resources(resources)
+        bad = {f: unsupported[f] for f in requested if f in unsupported}
+        if bad:
+            reasons = "; ".join(
+                f"{f.value}: {why}" for f, why in bad.items())
+            raise exceptions.NotSupportedError(
+                f"{self.NAME}: requested feature(s) not supported — "
+                f"{reasons}")
+
+    def supports(self, resources,
+                 feature: CloudImplementationFeatures) -> bool:
+        return feature not in self.unsupported_features_for_resources(
+            resources)
+
+    def check_credentials(self) -> Tuple[bool, str]:
+        """(usable, reason) — the `stpu check` probe."""
+        return True, ""
+
+    def __repr__(self) -> str:
+        return self.NAME

@@ -1,0 +1,67 @@
+"""GCP TPU capability object.
+
+Reference analog: sky/clouds/gcp.py:558-610 (TPU-VM host sizing and the
+unstoppable-pod special cases). The TPU-specific rules:
+
+  * multi-host pod slices cannot be stopped, only terminated — the TPU
+    API rejects `stop` on pods (provision/gcp.py stop_instances);
+  * therefore autostop on a pod must use --down;
+  * custom machine images don't apply to TPU VMs (runtime_version is the
+    image knob);
+  * firewall/port management is not implemented yet — declared
+    unsupported rather than silently ignored.
+"""
+from __future__ import annotations
+
+import shutil
+import subprocess
+from typing import Dict, Tuple
+
+from skypilot_tpu.clouds.cloud import (Cloud, CloudImplementationFeatures,
+                                       pod_stop_rules)
+
+
+class GCP(Cloud):
+    NAME = "gcp"
+
+    _UNSUPPORTED = {
+        CloudImplementationFeatures.IMAGE_ID:
+            "TPU VMs take a runtime_version, not a machine image",
+        CloudImplementationFeatures.OPEN_PORTS:
+            "firewall management is not implemented yet; open ports via "
+            "VPC firewall rules out of band",
+    }
+
+    def unsupported_features_for_resources(
+            self, resources) -> Dict[CloudImplementationFeatures, str]:
+        return {**self._UNSUPPORTED,
+                **pod_stop_rules(resources,
+                                 "Use `down` / autostop --down "
+                                 "(TPU API limitation).")}
+
+    def check_credentials(self) -> Tuple[bool, str]:
+        """Usable = gcloud exists + active credentials + a project set.
+
+        The TPU API itself is only reachable with network access; like
+        the reference we treat credential presence as 'enabled' and
+        surface API errors at provision time with failover semantics."""
+        if shutil.which("gcloud") is None:
+            return False, "gcloud CLI not installed"
+        try:
+            proc = subprocess.run(
+                ["gcloud", "auth", "list",
+                 "--filter=status:ACTIVE", "--format=value(account)"],
+                capture_output=True, text=True, timeout=20)
+            if proc.returncode != 0 or not proc.stdout.strip():
+                return False, ("no active gcloud credentials "
+                               "(run `gcloud auth login`)")
+            proc = subprocess.run(
+                ["gcloud", "config", "get-value", "project"],
+                capture_output=True, text=True, timeout=20)
+            project = proc.stdout.strip()
+            if proc.returncode != 0 or not project or project == "(unset)":
+                return False, ("no GCP project configured "
+                               "(run `gcloud config set project ...`)")
+            return True, f"project {project}"
+        except (subprocess.SubprocessError, OSError) as e:
+            return False, f"gcloud probe failed: {e}"

@@ -1,0 +1,29 @@
+"""Local (hermetic) provider capability object.
+
+The simulator mirrors the TPU semantics it stands in for: multi-host
+clusters refuse `stop` exactly like real pod slices, so orchestration
+tests exercise the same refusal path users hit on GCP.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from skypilot_tpu.clouds.cloud import (Cloud, CloudImplementationFeatures,
+                                       pod_stop_rules)
+
+
+class Local(Cloud):
+    NAME = "local"
+
+    _UNSUPPORTED = {
+        CloudImplementationFeatures.IMAGE_ID:
+            "local hosts are directories; no machine images",
+    }
+
+    def unsupported_features_for_resources(
+            self, resources) -> Dict[CloudImplementationFeatures, str]:
+        return {**self._UNSUPPORTED,
+                **pod_stop_rules(resources, "Use `down`.")}
+
+    def check_credentials(self) -> Tuple[bool, str]:
+        return True, "hermetic provider (always available)"

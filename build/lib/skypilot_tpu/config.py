@@ -1,0 +1,68 @@
+"""Layered user config (~/.stpu/config.yaml).
+
+Reference analog: sky/skypilot_config.py (get_nested:102, set_nested:155,
+loaded at import; task-YAML `experimental.config_overrides`). Loaded lazily
+here (first get) so tests can repoint STPU_HOME before first use.
+"""
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Any, Dict, Iterable, Optional
+
+import yaml
+
+from skypilot_tpu.utils import paths
+from skypilot_tpu.utils import schemas
+
+_lock = threading.Lock()
+_config: Optional[Dict[str, Any]] = None
+
+
+def _load() -> Dict[str, Any]:
+    global _config
+    with _lock:
+        if _config is None:
+            path = paths.config_path()
+            if path.exists():
+                with open(path) as f:
+                    loaded = yaml.safe_load(f) or {}
+                schemas.validate_config(loaded)
+                _config = loaded
+            else:
+                _config = {}
+        return _config
+
+
+def reload() -> None:
+    """Drop the cache (used by tests and after `config set`)."""
+    global _config
+    with _lock:
+        _config = None
+
+
+def get_nested(keys: Iterable[str], default: Any = None) -> Any:
+    node: Any = _load()
+    for key in keys:
+        if not isinstance(node, dict) or key not in node:
+            return default
+        node = node[key]
+    return node
+
+
+def set_nested(keys: Iterable[str], value: Any) -> None:
+    """Set + persist a nested key."""
+    config = copy.deepcopy(_load())
+    node = config
+    keys = list(keys)
+    for key in keys[:-1]:
+        node = node.setdefault(key, {})
+    node[keys[-1]] = value
+    schemas.validate_config(config)
+    with open(paths.config_path(), "w") as f:
+        yaml.safe_dump(config, f)
+    reload()
+
+
+def to_dict() -> Dict[str, Any]:
+    return copy.deepcopy(_load())

@@ -1,0 +1,186 @@
+"""Cluster lifecycle + job ops against existing clusters.
+
+Reference analog: sky/core.py (status/start/stop/down/autostop/queue/
+cancel/tail_logs/job_status/cost_report).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision as provision_api
+from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.status_lib import ClusterStatus
+
+
+def _get_handle(cluster_name: str) -> slice_backend.SliceHandle:
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None or record["handle"] is None:
+        raise exceptions.ClusterNotUpError(
+            f"Cluster {cluster_name!r} not found.")
+    global_user_state.check_owner_identity(record)
+    return record["handle"]
+
+
+def _refresh_one(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Reconcile DB status with provider truth (reference:
+    backend_utils._update_cluster_status_no_lock:1777 — ray health vs
+    cloud API; here: agent job DB reachability vs provider query)."""
+    handle = record["handle"]
+    if handle is None:
+        return record
+    try:
+        statuses = provision_api.query_instances(
+            handle.provider_name, handle.cluster_name,
+            handle.cluster_info.provider_config)
+    except Exception:
+        statuses = {}
+    name = record["name"]
+    if not statuses:
+        # Provider has no trace: cluster is gone (e.g. preempted + cleaned).
+        global_user_state.remove_cluster(name, terminate=True)
+        record = dict(record)
+        record["status"] = None
+        return record
+    values = set(statuses.values())
+    if values <= {"running"} and len(statuses) == handle.num_hosts:
+        new_status = ClusterStatus.UP
+    elif values <= {"stopped", "stopping"}:
+        new_status = ClusterStatus.STOPPED
+    else:
+        new_status = ClusterStatus.INIT
+    if new_status != record["status"]:
+        global_user_state.update_cluster_status(name, new_status)
+        record = dict(record)
+        record["status"] = new_status
+    return record
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    records = global_user_state.get_clusters()
+    if cluster_names is not None:
+        records = [r for r in records if r["name"] in cluster_names]
+    if refresh:
+        records = [r for r in (_refresh_one(r) for r in records)
+                   if r["status"] is not None]
+    return records
+
+
+def start(cluster_name: str) -> slice_backend.SliceHandle:
+    handle = _get_handle(cluster_name)
+    backend = slice_backend.SliceBackend()
+    return backend._restart_cluster(handle)  # noqa: SLF001
+
+
+def stop(cluster_name: str) -> None:
+    handle = _get_handle(cluster_name)
+    backend = slice_backend.SliceBackend()
+    backend.teardown(handle, terminate=False)
+
+
+def down(cluster_name: str, purge: bool = False) -> None:
+    handle = _get_handle(cluster_name)
+    backend = slice_backend.SliceBackend()
+    backend.teardown(handle, terminate=True, purge=purge)
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_after: bool = False) -> None:
+    handle = _get_handle(cluster_name)
+    backend = slice_backend.SliceBackend()
+    backend.set_autostop(handle, idle_minutes, down=down_after)
+
+
+def queue(cluster_name: str,
+          all_jobs: bool = True) -> List[Dict[str, Any]]:
+    handle = _get_handle(cluster_name)
+    backend = slice_backend.SliceBackend()
+    jobs = backend.queue(handle)
+    if not all_jobs:
+        from skypilot_tpu.agent import job_lib
+        jobs = [j for j in jobs
+                if not job_lib.JobStatus(j["status"]).is_terminal()]
+    return jobs
+
+
+def cancel(cluster_name: str,
+           job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    handle = _get_handle(cluster_name)
+    backend = slice_backend.SliceBackend()
+    if not all_jobs and not job_ids:
+        raise ValueError("Specify job_ids or all_jobs=True")
+    return backend.cancel_jobs(handle, None if all_jobs else job_ids)
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True) -> int:
+    handle = _get_handle(cluster_name)
+    backend = slice_backend.SliceBackend()
+    return backend.tail_logs(handle, job_id, follow=follow)
+
+
+def job_status(cluster_name: str,
+               job_ids: Optional[List[int]] = None
+               ) -> Dict[int, Optional[str]]:
+    handle = _get_handle(cluster_name)
+    backend = slice_backend.SliceBackend()
+    jobs = backend.queue(handle)
+    if job_ids is None:
+        return {j["job_id"]: j["status"] for j in jobs}
+    by_id = {j["job_id"]: j["status"] for j in jobs}
+    return {jid: by_id.get(jid) for jid in job_ids}
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    """Per-cluster accumulated cost from recorded usage intervals
+    (reference: sky/core.py cost_report + global_user_state:446-503)."""
+    import time as time_lib
+    out = []
+    for record in global_user_state.get_clusters():
+        handle = record["handle"]
+        launched = getattr(handle, "launched_resources", None)
+        duration = 0.0
+        for start_t, end_t in record.get("usage_intervals", []):
+            duration += (end_t or time_lib.time()) - start_t
+        cost = 0.0
+        if launched is not None:
+            try:
+                cost = launched.get_cost(duration) * getattr(
+                    handle, "num_slices", 1)
+            except Exception:
+                cost = 0.0
+        out.append({
+            "name": record["name"], "status": record["status"],
+            "resources": launched, "duration_seconds": duration,
+            "cost": cost,
+        })
+    for hist in global_user_state.get_cluster_history():
+        out.append({
+            "name": hist["name"] + " (terminated)", "status": None,
+            "resources": hist["resources"],
+            "duration_seconds": hist["duration_seconds"],
+            "cost": hist["total_cost"],
+        })
+    return out
+
+
+def storage_ls() -> List[Dict[str, Any]]:
+    """Registered storage objects (reference: sky/core.py storage_ls)."""
+    return global_user_state.get_storage()
+
+
+def storage_delete(name: str) -> None:
+    """Delete a registered bucket + its registry row (reference:
+    sky/core.py storage_delete)."""
+    from skypilot_tpu.data import storage as storage_lib
+    records = {r["name"]: r for r in global_user_state.get_storage()}
+    if name not in records:
+        raise exceptions.SkyTpuError(f"Storage {name!r} not found.")
+    handle = records[name]["handle"] or {}
+    store = storage_lib.Storage(
+        name=name, store=handle.get("store", "gcs"),
+        persistent=handle.get("persistent", True))
+    store.delete()

@@ -1,0 +1,133 @@
+"""Task DAG: dependency graph of Tasks, with chain support.
+
+Reference analog: sky/dag.py (Dag:7 over networkx, is_chain:53,
+thread-local context :71). We keep the same surface (``with Dag() as d``,
+``task1 >> task2``) on a dependency-free adjacency-list core — the
+downstream optimizer only supports chains + general DAGs via topo order.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set
+
+from skypilot_tpu import exceptions
+
+
+class Dag:
+    """A DAG of Tasks. Append with add(); order edges with add_edge() or
+    ``task_a >> task_b`` inside a ``with Dag():`` block."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.tasks: List = []
+        self._edges: Dict[int, Set[int]] = {}   # id(task) -> id(children)
+        self._by_id: Dict[int, object] = {}
+
+    # ------------------------------------------------------------------
+    def add(self, task) -> None:
+        if id(task) not in self._by_id:
+            self.tasks.append(task)
+            self._by_id[id(task)] = task
+            self._edges.setdefault(id(task), set())
+
+    def remove(self, task) -> None:
+        self.tasks.remove(task)
+        self._by_id.pop(id(task))
+        self._edges.pop(id(task), None)
+        for children in self._edges.values():
+            children.discard(id(task))
+
+    def add_edge(self, op1, op2) -> None:
+        if id(op1) not in self._by_id or id(op2) not in self._by_id:
+            raise exceptions.DagError(
+                "Both tasks must be added to the DAG before linking")
+        self._edges[id(op1)].add(id(op2))
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __iter__(self):
+        return iter(self.topo_order())
+
+    # ------------------------------------------------------------------
+    def _in_degree(self) -> Dict[int, int]:
+        deg = {id(t): 0 for t in self.tasks}
+        for children in self._edges.values():
+            for c in children:
+                deg[c] += 1
+        return deg
+
+    def topo_order(self) -> List:
+        deg = self._in_degree()
+        frontier = [t for t in self.tasks if deg[id(t)] == 0]
+        order: List = []
+        while frontier:
+            t = frontier.pop(0)
+            order.append(t)
+            for c in self._edges[id(t)]:
+                deg[c] -= 1
+                if deg[c] == 0:
+                    frontier.append(self._by_id[c])
+        if len(order) != len(self.tasks):
+            raise exceptions.DagError("DAG contains a cycle")
+        return order
+
+    def is_chain(self) -> bool:
+        """True iff tasks form a linear chain (what jobs pipelines use)."""
+        if len(self.tasks) <= 1:
+            return True
+        deg = self._in_degree()
+        roots = [t for t in self.tasks if deg[id(t)] == 0]
+        if len(roots) != 1:
+            return False
+        seen = 0
+        node = id(roots[0])
+        # Bounded walk: a cycle revisits nodes, so > len(tasks) steps
+        # means not-a-chain rather than an infinite loop.
+        while seen <= len(self.tasks):
+            seen += 1
+            children = self._edges[node]
+            if not children:
+                break
+            if len(children) > 1:
+                return False
+            node = next(iter(children))
+        return seen == len(self.tasks)
+
+    def parents(self, task) -> List:
+        return [self._by_id[p] for p, children in self._edges.items()
+                if id(task) in children]
+
+    def children(self, task) -> List:
+        return [self._by_id[c] for c in self._edges[id(task)]]
+
+    # ------------------------------------------------------------------
+    def __enter__(self) -> "Dag":
+        push_dag(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pop_dag()
+
+    def __repr__(self) -> str:
+        names = [getattr(t, "name", None) or "<unnamed>"
+                 for t in self.tasks]
+        return f"Dag({self.name or ''}: {' -> '.join(names)})"
+
+
+_LOCAL = threading.local()
+
+
+def push_dag(dag: Dag) -> None:
+    if not hasattr(_LOCAL, "stack"):
+        _LOCAL.stack = []
+    _LOCAL.stack.append(dag)
+
+
+def pop_dag() -> Dag:
+    return _LOCAL.stack.pop()
+
+
+def get_current_dag() -> Optional[Dag]:
+    stack = getattr(_LOCAL, "stack", None)
+    return stack[-1] if stack else None

@@ -1,0 +1,7 @@
+"""Data: buckets, FUSE mounting, URI downloads.
+
+Reference analog: sky/data/ (SURVEY §2.4).
+"""
+from skypilot_tpu.data.storage import (  # noqa: F401
+    AbstractStore, GcsStore, LocalStore, S3Store, Storage, StorageMode,
+    StoreType)

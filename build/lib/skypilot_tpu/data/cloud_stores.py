@@ -1,0 +1,55 @@
+"""Download-from-URI commands for ``file_mounts`` sources.
+
+Reference analog: sky/cloud_stores.py (CloudStorage ABC — is_directory,
+make_sync_dir_command/make_sync_file_command per scheme). Pure command
+generation; execution happens on cluster hosts.
+"""
+from __future__ import annotations
+
+import shlex
+
+
+class CloudStorage:
+    def make_download_command(self, source: str, dst: str) -> str:
+        raise NotImplementedError
+
+
+class GcsStorage(CloudStorage):
+    def make_download_command(self, source: str, dst: str) -> str:
+        q = shlex.quote
+        return (f"mkdir -p $(dirname {q(dst)}) && "
+                f"gsutil -m cp -r {q(source)} {q(dst)}")
+
+
+class S3Storage(CloudStorage):
+    def make_download_command(self, source: str, dst: str) -> str:
+        q = shlex.quote
+        return (f"mkdir -p $(dirname {q(dst)}) && "
+                f"aws s3 cp --recursive {q(source)} {q(dst)}")
+
+
+class HttpStorage(CloudStorage):
+    def make_download_command(self, source: str, dst: str) -> str:
+        q = shlex.quote
+        return (f"mkdir -p $(dirname {q(dst)}) && "
+                f"curl -fsSL -o {q(dst)} {q(source)}")
+
+
+_REGISTRY = {
+    "gs://": GcsStorage(),
+    "s3://": S3Storage(),
+    "http://": HttpStorage(),
+    "https://": HttpStorage(),
+}
+
+
+def get_storage_from_path(url: str) -> CloudStorage:
+    for prefix, store in _REGISTRY.items():
+        if url.startswith(prefix):
+            return store
+    raise ValueError(f"No storage handler for {url!r}; known schemes: "
+                     f"{sorted(_REGISTRY)}")
+
+
+def is_cloud_store_url(url: str) -> bool:
+    return any(url.startswith(p) for p in _REGISTRY)

@@ -1,0 +1,49 @@
+"""FUSE mount command generation for cluster hosts.
+
+Reference analog: sky/data/mounting_utils.py:24-160 (goofys/gcsfuse/
+blobfuse2/rclone install + mount scripts). GCS-first: TPU VMs mount GCS
+via gcsfuse, exactly the mechanism the reference uses — no new native
+code needed (SURVEY §2.5 FUSE row).
+"""
+from __future__ import annotations
+
+import shlex
+
+GCSFUSE_VERSION = "2.2.0"
+
+_INSTALL_GCSFUSE = (
+    "command -v gcsfuse >/dev/null || ("
+    "ARCH=$(uname -m | grep -q aarch64 && echo arm64 || echo amd64) && "
+    "curl -fsSL -o /tmp/gcsfuse.deb "
+    "https://github.com/GoogleCloudPlatform/gcsfuse/releases/download/"
+    f"v{GCSFUSE_VERSION}/gcsfuse_{GCSFUSE_VERSION}_$ARCH.deb && "
+    "sudo dpkg -i /tmp/gcsfuse.deb)")
+
+_INSTALL_GOOFYS = (
+    "command -v goofys >/dev/null || ("
+    "sudo curl -fsSL -o /usr/local/bin/goofys "
+    "https://github.com/romange/goofys/releases/latest/download/goofys && "
+    "sudo chmod +x /usr/local/bin/goofys)")
+
+
+def get_gcs_mount_command(bucket: str, mount_path: str) -> str:
+    """Install gcsfuse if needed and mount the bucket; idempotent."""
+    q = shlex.quote
+    return (f"{_INSTALL_GCSFUSE} && "
+            f"mkdir -p {q(mount_path)} && "
+            f"(mountpoint -q {q(mount_path)} || "
+            f"gcsfuse --implicit-dirs {q(bucket)} {q(mount_path)})")
+
+
+def get_s3_mount_command(bucket: str, mount_path: str) -> str:
+    q = shlex.quote
+    return (f"{_INSTALL_GOOFYS} && "
+            f"mkdir -p {q(mount_path)} && "
+            f"(mountpoint -q {q(mount_path)} || "
+            f"goofys {q(bucket)} {q(mount_path)})")
+
+
+def get_unmount_command(mount_path: str) -> str:
+    q = shlex.quote
+    return (f"mountpoint -q {q(mount_path)} && "
+            f"fusermount -u {q(mount_path)} || true")

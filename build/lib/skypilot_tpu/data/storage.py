@@ -1,0 +1,271 @@
+"""Storage: buckets synced or FUSE-mounted onto cluster hosts.
+
+Reference analog: sky/data/storage.py (Storage:383, StorageMode COPY/MOUNT
+:191, AbstractStore:196, GcsStore:1496, S3Store:1079). GCS-first (TPU VMs
+live in GCP); S3 is supported as a COPY/MOUNT source via its CLI the same
+way. A hermetic LocalStore (a directory posing as a bucket) makes the whole
+path — upload, COPY fetch, MOUNT — testable without credentials, mirroring
+how the local provider stands in for GCP slices.
+
+All store methods that touch a cluster return *shell command strings*; the
+backend runs them on each host via its command runner (reference pattern:
+mounting_utils.get_mounting_script).
+"""
+from __future__ import annotations
+
+import enum
+import os
+import shlex
+import subprocess
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu.data import mounting_utils
+
+
+class StorageMode(enum.Enum):
+    MOUNT = "MOUNT"
+    COPY = "COPY"
+
+
+class StoreType(enum.Enum):
+    GCS = "gcs"
+    S3 = "s3"
+    LOCAL = "local"
+
+
+class AbstractStore:
+    """One bucket in one object store."""
+
+    def __init__(self, name: str, source: Optional[str] = None):
+        self.name = name
+        self.source = source
+
+    # -- client-side ops ------------------------------------------------
+    def upload(self) -> None:
+        """Sync ``source`` (local path) into the bucket, creating it if
+        needed. Runs on the client."""
+        raise NotImplementedError
+
+    def delete(self) -> None:
+        raise NotImplementedError
+
+    # -- cluster-side command generation --------------------------------
+    def fetch_command(self, dst: str) -> str:
+        """Shell: copy bucket contents into ``dst`` (COPY mode)."""
+        raise NotImplementedError
+
+    def mount_fuse_command(self, dst: str) -> str:
+        """Shell: FUSE-mount the bucket at ``dst`` (MOUNT mode)."""
+        raise NotImplementedError
+
+    def _run(self, cmd: List[str]) -> None:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise exceptions.StorageUploadError(
+                f"{' '.join(cmd)} failed: {proc.stderr.strip()}")
+
+
+class GcsStore(AbstractStore):
+    """GCS via gsutil/gcsfuse (reference: GcsStore:1496 +
+    mounting_utils gcsfuse :60-90)."""
+
+    def upload(self) -> None:
+        if not self._bucket_exists():
+            self._run(["gsutil", "mb", f"gs://{self.name}"])
+        if self.source:
+            src = os.path.abspath(os.path.expanduser(self.source))
+            if os.path.isdir(src):
+                self._run(["gsutil", "-m", "rsync", "-r", src,
+                           f"gs://{self.name}"])
+            else:
+                self._run(["gsutil", "cp", src, f"gs://{self.name}/"])
+
+    def _bucket_exists(self) -> bool:
+        proc = subprocess.run(
+            ["gsutil", "ls", "-b", f"gs://{self.name}"],
+            capture_output=True, text=True)
+        return proc.returncode == 0
+
+    def delete(self) -> None:
+        self._run(["gsutil", "-m", "rm", "-r", f"gs://{self.name}"])
+
+    def fetch_command(self, dst: str) -> str:
+        q = shlex.quote
+        return (f"mkdir -p {q(dst)} && "
+                f"gsutil -m rsync -r gs://{self.name} {q(dst)}")
+
+    def mount_fuse_command(self, dst: str) -> str:
+        return mounting_utils.get_gcs_mount_command(self.name, dst)
+
+
+class S3Store(AbstractStore):
+    """S3 via the aws CLI (reference: S3Store:1079). COPY works anywhere
+    the CLI + credentials exist; MOUNT uses goofys like the reference."""
+
+    def upload(self) -> None:
+        if not self._bucket_exists():
+            self._run(["aws", "s3", "mb", f"s3://{self.name}"])
+        if self.source:
+            src = os.path.abspath(os.path.expanduser(self.source))
+            if os.path.isdir(src):
+                self._run(["aws", "s3", "sync", src, f"s3://{self.name}"])
+            else:
+                self._run(["aws", "s3", "cp", src, f"s3://{self.name}/"])
+
+    def _bucket_exists(self) -> bool:
+        proc = subprocess.run(
+            ["aws", "s3api", "head-bucket", "--bucket", self.name],
+            capture_output=True, text=True)
+        return proc.returncode == 0
+
+    def delete(self) -> None:
+        self._run(["aws", "s3", "rb", f"s3://{self.name}", "--force"])
+
+    def fetch_command(self, dst: str) -> str:
+        q = shlex.quote
+        return (f"mkdir -p {q(dst)} && "
+                f"aws s3 sync s3://{self.name} {q(dst)}")
+
+    def mount_fuse_command(self, dst: str) -> str:
+        return mounting_utils.get_s3_mount_command(self.name, dst)
+
+
+class LocalStore(AbstractStore):
+    """A directory posing as a bucket — hermetic tests' stand-in.
+
+    The "bucket" lives under $STPU_HOME/buckets/<name>; COPY is a cp -r,
+    MOUNT is a symlink (same visibility semantics as a FUSE mount for
+    everything the framework itself does with mounts)."""
+
+    def __init__(self, name: str, source: Optional[str] = None):
+        super().__init__(name, source)
+        from skypilot_tpu.utils import paths
+        self.bucket_dir = paths.home() / "buckets" / name
+
+    def upload(self) -> None:
+        self.bucket_dir.mkdir(parents=True, exist_ok=True)
+        if self.source:
+            # Pure-python sync: the dev image may lack rsync.
+            import shutil
+            src = os.path.abspath(os.path.expanduser(self.source))
+            if os.path.isdir(src):
+                shutil.copytree(src, self.bucket_dir, dirs_exist_ok=True)
+            elif os.path.exists(src):
+                shutil.copy2(src, self.bucket_dir)
+            else:
+                raise exceptions.StorageError(
+                    f"Storage source {src} does not exist.")
+
+    def delete(self) -> None:
+        import shutil
+        shutil.rmtree(self.bucket_dir, ignore_errors=True)
+
+    def fetch_command(self, dst: str) -> str:
+        q = shlex.quote
+        return (f"mkdir -p {q(dst)} && "
+                f"cp -r {q(str(self.bucket_dir))}/. {q(dst)}/")
+
+    def mount_fuse_command(self, dst: str) -> str:
+        # rm -rf first: if dst already exists as a real directory,
+        # `ln -s` would create the link *inside* it at the wrong path.
+        # (On a symlink, rm -rf removes only the link.)
+        q = shlex.quote
+        return (f"mkdir -p $(dirname {q(dst)}) && rm -rf {q(dst)} && "
+                f"ln -s {q(str(self.bucket_dir))} {q(dst)}")
+
+
+_STORE_CLASSES = {
+    StoreType.GCS: GcsStore,
+    StoreType.S3: S3Store,
+    StoreType.LOCAL: LocalStore,
+}
+
+
+class Storage:
+    """User-facing storage object: a named bucket + desired mode.
+
+    YAML shape (reference schema):
+        file_mounts:
+          /data:
+            name: my-bucket
+            source: ./local_dir       # optional
+            store: gcs                # gcs | s3 | local
+            mode: MOUNT               # MOUNT | COPY
+            persistent: true
+    """
+
+    def __init__(self, name: Optional[str] = None,
+                 source: Optional[str] = None,
+                 store: Union[str, StoreType] = StoreType.GCS,
+                 persistent: bool = True,
+                 mode: Union[str, StorageMode] = StorageMode.MOUNT):
+        if source is not None and not isinstance(source, str):
+            # The YAML schema admits list sources for reference parity,
+            # but multi-source buckets aren't implemented yet.
+            raise exceptions.StorageError(
+                f"Storage source must be a single path, got "
+                f"{type(source).__name__}: {source!r}")
+        if name is None:
+            if source is None:
+                raise exceptions.StorageError(
+                    "Storage needs a bucket `name` (or a `source` to "
+                    "derive one from).")
+            name = os.path.basename(
+                os.path.abspath(os.path.expanduser(source))).lower()
+        self.name = name
+        self.source = source
+        self.store_type = (StoreType(store.lower())
+                           if isinstance(store, str) else store)
+        self.persistent = persistent
+        self.mode = (StorageMode(mode.upper())
+                     if isinstance(mode, str) else mode)
+        self.store: AbstractStore = _STORE_CLASSES[self.store_type](
+            name, source)
+
+    # ------------------------------------------------------------------
+    def sync(self) -> None:
+        """Create the bucket and upload `source` (client side); records
+        the storage object in the state DB."""
+        self.store.upload()
+        global_user_state.add_or_update_storage(
+            self.name, {"store": self.store_type.value,
+                        "source": self.source,
+                        "persistent": self.persistent}, "READY")
+
+    def delete(self) -> None:
+        self.store.delete()
+        global_user_state.remove_storage(self.name)
+
+    def mount_command(self, dst: str) -> str:
+        """The shell command a host runs to make this storage visible at
+        ``dst`` (dispatches on mode)."""
+        if self.mode == StorageMode.COPY:
+            return self.store.fetch_command(dst)
+        return self.store.mount_fuse_command(dst)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> "Storage":
+        return cls(
+            name=config.get("name"),
+            source=config.get("source"),
+            store=config.get("store", "gcs"),
+            persistent=config.get("persistent", True),
+            mode=config.get("mode", "MOUNT"),
+        )
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"name": self.name,
+                               "store": self.store_type.value,
+                               "mode": self.mode.value}
+        if self.source is not None:
+            out["source"] = self.source
+        if not self.persistent:
+            out["persistent"] = False
+        return out
+
+    def __repr__(self) -> str:
+        return (f"Storage({self.name}, {self.store_type.value}, "
+                f"{self.mode.value})")

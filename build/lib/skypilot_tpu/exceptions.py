@@ -1,0 +1,133 @@
+"""Framework exceptions.
+
+Reference analog: sky/exceptions.py (ResourcesUnavailableError with
+failover_history, CommandError, JobExitCode, ...). Kept minimal and
+TPU-shaped: provisioning failures carry the failover history so the
+optimizer/provisioner loop can re-plan, exactly like the reference's
+retrying provisioner (sky/backends/cloud_vm_ray_backend.py:1900-2048).
+"""
+from __future__ import annotations
+
+import enum
+from typing import List, Optional
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class ResourcesUnavailableError(SkyTpuError):
+    """No feasible/launchable resources (possibly after failover).
+
+    ``no_failover`` mirrors the reference's semantics: when True the caller
+    must not retry elsewhere (e.g. user pinned a zone).
+    """
+
+    def __init__(self, message: str, no_failover: bool = False,
+                 failover_history: Optional[List[Exception]] = None):
+        super().__init__(message)
+        self.no_failover = no_failover
+        self.failover_history: List[Exception] = failover_history or []
+
+    def with_failover_history(
+            self, history: List[Exception]) -> "ResourcesUnavailableError":
+        self.failover_history = history
+        return self
+
+
+class ResourcesMismatchError(SkyTpuError):
+    """Requested resources do not match the existing cluster."""
+
+
+class ProvisionError(SkyTpuError):
+    """A concrete provisioning attempt failed.
+
+    ``retryable_in_zone``: transient, same zone may be retried.
+    ``blocklist_zone`` / ``blocklist_region``: scope to skip on failover
+    (stockout → zone; quota → region, mirroring the reference's per-error
+    blocklist parsing, sky/backends/cloud_vm_ray_backend.py:997-1051).
+    """
+
+    def __init__(self, message: str, *, retryable_in_zone: bool = False,
+                 blocklist_zone: Optional[str] = None,
+                 blocklist_region: Optional[str] = None):
+        super().__init__(message)
+        self.retryable_in_zone = retryable_in_zone
+        self.blocklist_zone = blocklist_zone
+        self.blocklist_region = blocklist_region
+
+
+class ClusterNotUpError(SkyTpuError):
+    """Operation requires an UP cluster."""
+
+    def __init__(self, message: str, cluster_status=None):
+        super().__init__(message)
+        self.cluster_status = cluster_status
+
+
+class ClusterOwnerIdentityMismatchError(SkyTpuError):
+    """Cluster was created under a different cloud identity."""
+
+
+class CommandError(SkyTpuError):
+    """A remote command failed."""
+
+    def __init__(self, returncode: int, command: str, error_msg: str = "",
+                 detailed_reason: str = ""):
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        super().__init__(
+            f"Command failed with return code {returncode}: {command}\n"
+            f"{error_msg}")
+
+
+class NotSupportedError(SkyTpuError):
+    """Feature not supported by the target cloud / resource."""
+
+
+class NoCloudAccessError(SkyTpuError):
+    """No cloud credentials found / enabled."""
+
+
+class StorageError(SkyTpuError):
+    """Bucket create/sync/mount failure."""
+
+
+class StorageBucketCreateError(StorageError):
+    pass
+
+
+class StorageBucketGetError(StorageError):
+    pass
+
+
+class StorageUploadError(StorageError):
+    pass
+
+
+class ServeUserTerminatedError(SkyTpuError):
+    pass
+
+
+class InvalidTaskError(SkyTpuError, ValueError):
+    """Task/YAML validation error."""
+
+
+class DagError(SkyTpuError, ValueError):
+    """DAG structure error (cycles, non-chain where chain required)."""
+
+
+class JobExitCode(enum.IntEnum):
+    """Exit codes surfaced by job execution (reference: sky/exceptions.py).
+
+    137 = gang failure: one host died, the rest were force-cancelled
+    (reference get_or_fail semantics, cloud_vm_ray_backend.py:296-331).
+    """
+    SUCCEEDED = 0
+    FAILED = 1
+    NOT_FINISHED = 101
+    NOT_FOUND = 102
+    CANCELLED = 103
+    GANG_FAILED = 137

@@ -1,0 +1,176 @@
+"""Launch/exec pipeline: optimize → provision → sync → setup → exec.
+
+Reference analog: sky/execution.py (Stage:31, _execute:95, launch:347,
+exec:480). Stages and semantics match; the backend is SliceBackend.
+"""
+from __future__ import annotations
+
+import enum
+from typing import List, Optional, Tuple, Union
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import usage_lib
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = enum.auto()
+    PROVISION = enum.auto()
+    SYNC_WORKDIR = enum.auto()
+    SYNC_FILE_MOUNTS = enum.auto()
+    SETUP = enum.auto()
+    PRE_EXEC = enum.auto()
+    EXEC = enum.auto()
+    DOWN = enum.auto()
+
+
+def _to_dag(entrypoint: Union[Task, dag_lib.Dag]) -> dag_lib.Dag:
+    if isinstance(entrypoint, dag_lib.Dag):
+        return entrypoint
+    d = dag_lib.Dag()
+    d.add(entrypoint)
+    return d
+
+
+def _execute(
+    entrypoint: Union[Task, dag_lib.Dag],
+    *,
+    dryrun: bool = False,
+    down: bool = False,
+    stream_logs: bool = True,
+    cluster_name: Optional[str] = None,
+    detach_setup: bool = False,
+    detach_run: bool = False,
+    idle_minutes_to_autostop: Optional[int] = None,
+    retry_until_up: bool = False,
+    stages: Optional[List[Stage]] = None,
+    no_setup: bool = False,
+) -> Tuple[Optional[int], Optional[slice_backend.SliceHandle]]:
+    dag = _to_dag(entrypoint)
+    if len(dag.tasks) != 1:
+        raise exceptions.NotSupportedError(
+            "launch/exec take a single task; multi-task pipelines go "
+            "through `jobs.launch` (managed pipelines).")
+    task = dag.tasks[0]
+    backend = slice_backend.SliceBackend()
+    stages = stages or list(Stage)
+
+    if Stage.OPTIMIZE in stages and (task.best_resources is None):
+        # Only optimize when the placement isn't pinned to an existing
+        # cluster's resources.
+        optimizer_lib.Optimizer.optimize(dag, quiet=not stream_logs)
+
+    if idle_minutes_to_autostop is not None and not down:
+        # Pre-flight the autostop capability BEFORE provisioning: a pod
+        # slice cannot autostop-to-STOPPED, and finding that out after a
+        # multi-host slice came up would leave it running with no
+        # autostop — the exact idle-burn the flag exists to prevent.
+        from skypilot_tpu import clouds as clouds_lib
+        planned = task.best_resources or task.resources[0]
+        clouds_lib.get_cloud(
+            planned.provider_name).check_features_are_supported(
+                planned,
+                [clouds_lib.CloudImplementationFeatures.AUTOSTOP])
+
+    handle = None
+    if Stage.PROVISION in stages:
+        handle = backend.provision(
+            task, task.best_resources, dryrun=dryrun,
+            stream_logs=stream_logs, cluster_name=cluster_name,
+            retry_until_up=retry_until_up)
+    elif cluster_name is not None:
+        record = global_user_state.get_cluster_from_name(cluster_name)
+        handle = record["handle"] if record else None
+    if dryrun or handle is None:
+        return None, None
+
+    if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
+        backend.sync_workdir(handle, task.workdir)
+    if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
+                                             task.storage_mounts):
+        backend.sync_file_mounts(handle, task.file_mounts,
+                                 task.storage_mounts)
+    if Stage.SETUP in stages and not no_setup:
+        backend.setup(handle, task, detach_setup=detach_setup)
+    if Stage.PRE_EXEC in stages and idle_minutes_to_autostop is not None:
+        backend.set_autostop(handle, idle_minutes_to_autostop, down=down)
+
+    job_id = None
+    if Stage.EXEC in stages:
+        try:
+            global_user_state.add_or_update_cluster(
+                handle.cluster_name, handle=handle, ready=True,
+                is_launch=False)
+            job_id = backend.execute(handle, task, detach_run=detach_run)
+        finally:
+            backend.post_execute(handle, down=down)
+
+    if Stage.DOWN in stages and down and idle_minutes_to_autostop is None:
+        backend.teardown(handle, terminate=True)
+    return job_id, handle
+
+
+@usage_lib.entrypoint
+def launch(
+    task: Union[Task, dag_lib.Dag],
+    cluster_name: Optional[str] = None,
+    *,
+    dryrun: bool = False,
+    down: bool = False,
+    stream_logs: bool = True,
+    detach_setup: bool = False,
+    detach_run: bool = False,
+    idle_minutes_to_autostop: Optional[int] = None,
+    retry_until_up: bool = False,
+    no_setup: bool = False,
+) -> Tuple[Optional[int], Optional[slice_backend.SliceHandle]]:
+    """Provision (or reuse) a cluster and run the task on it.
+
+    Returns (job_id, handle).
+    """
+    return _execute(
+        task, dryrun=dryrun, down=down, stream_logs=stream_logs,
+        cluster_name=cluster_name, detach_setup=detach_setup,
+        detach_run=detach_run,
+        idle_minutes_to_autostop=idle_minutes_to_autostop,
+        retry_until_up=retry_until_up, no_setup=no_setup)
+
+
+@usage_lib.entrypoint
+def exec(  # noqa: A001  (mirrors the reference name sky.exec)
+    task: Union[Task, dag_lib.Dag],
+    cluster_name: str,
+    *,
+    dryrun: bool = False,
+    down: bool = False,
+    stream_logs: bool = True,
+    detach_run: bool = False,
+) -> Tuple[Optional[int], Optional[slice_backend.SliceHandle]]:
+    """Run a task on an existing, UP cluster: skips provision/setup
+    (reference: sky/execution.py:480 — workdir sync + exec only)."""
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None or record["handle"] is None:
+        raise exceptions.ClusterNotUpError(
+            f"Cluster {cluster_name!r} does not exist; `launch` first.")
+    if record["status"] != ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f"Cluster {cluster_name!r} is {record['status'].value}, "
+            f"not UP.", cluster_status=record["status"])
+    # exec runs code on the cluster — it must be identity-guarded like
+    # every other operation on an existing cluster.
+    global_user_state.check_owner_identity(record)
+    dag = _to_dag(task)
+    the_task = dag.tasks[0]
+    handle = record["handle"]
+    backend = slice_backend.SliceBackend()
+    backend.check_resources_fit_cluster(handle, the_task)
+    the_task.best_resources = handle.launched_resources
+    return _execute(
+        dag, dryrun=dryrun, down=down, stream_logs=stream_logs,
+        cluster_name=cluster_name, detach_run=detach_run,
+        stages=[Stage.SYNC_WORKDIR, Stage.EXEC, Stage.DOWN])

@@ -1,0 +1,306 @@
+"""Client-side persistent state: clusters, usage intervals, storage registry.
+
+Reference analog: sky/global_user_state.py (sqlite ~/.sky/state.db,
+create_table:34, add_or_update_cluster:139, get_clusters:602, cluster
+history for cost reports :446-503). Same sqlite+WAL discipline; pickled
+handles; one row per cluster.
+"""
+from __future__ import annotations
+
+import json
+import pickle
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.utils import paths
+
+_lock = threading.Lock()
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(paths.state_db_path(), timeout=10)
+    conn.execute("PRAGMA journal_mode=WAL")
+    _create_tables(conn)
+    return conn
+
+
+def _create_tables(conn: sqlite3.Connection) -> None:
+    conn.execute("""CREATE TABLE IF NOT EXISTS clusters (
+        name TEXT PRIMARY KEY,
+        launched_at INTEGER,
+        handle BLOB,
+        last_use TEXT,
+        status TEXT,
+        autostop INTEGER DEFAULT -1,
+        to_down INTEGER DEFAULT 0,
+        usage_intervals BLOB,
+        requested_resources BLOB,
+        owner TEXT)""")
+    # Migration for pre-owner DBs.
+    try:
+        conn.execute("ALTER TABLE clusters ADD COLUMN owner TEXT")
+    except sqlite3.OperationalError:
+        pass
+    conn.execute("""CREATE TABLE IF NOT EXISTS cluster_history (
+        cluster_hash TEXT,
+        name TEXT,
+        launched_at INTEGER,
+        duration_seconds REAL,
+        resources BLOB,
+        num_nodes INTEGER,
+        total_cost REAL)""")
+    conn.execute("""CREATE TABLE IF NOT EXISTS enabled_clouds (
+        name TEXT PRIMARY KEY)""")
+    conn.execute("""CREATE TABLE IF NOT EXISTS storage (
+        name TEXT PRIMARY KEY,
+        launched_at INTEGER,
+        handle BLOB,
+        last_use TEXT,
+        status TEXT)""")
+    conn.commit()
+
+
+# ------------------------------------------------------------------ clusters
+
+def add_or_update_cluster(cluster_name: str, handle: Any,
+                          requested_resources: Optional[Any] = None,
+                          ready: bool = False,
+                          is_launch: bool = True) -> None:
+    """Insert/refresh a cluster row. On launch, opens a usage interval
+    (start, None) used later for cost reporting."""
+    status = ClusterStatus.UP if ready else ClusterStatus.INIT
+    now = int(time.time())
+    with _lock, _conn() as conn:
+        row = conn.execute(
+            "SELECT usage_intervals, launched_at FROM clusters "
+            "WHERE name=?", (cluster_name,)).fetchone()
+        intervals: List[Tuple[int, Optional[int]]] = []
+        launched_at = now
+        if row is not None:
+            intervals = pickle.loads(row[0]) if row[0] else []
+            launched_at = row[1] or now
+        if is_launch and (not intervals or intervals[-1][1] is not None):
+            intervals.append((now, None))
+        from skypilot_tpu.utils import usage_lib
+        # Ownership is claimed exactly once, at row creation; restarts
+        # and status updates must never let a different identity adopt
+        # an existing (possibly legacy NULL-owner) row.
+        owner = usage_lib.user_identity() if row is None else None
+        conn.execute(
+            """INSERT INTO clusters
+               (name, launched_at, handle, last_use, status, autostop,
+                to_down, usage_intervals, requested_resources, owner)
+               VALUES (?, ?, ?, ?, ?,
+                       COALESCE((SELECT autostop FROM clusters
+                                 WHERE name=?), -1),
+                       COALESCE((SELECT to_down FROM clusters
+                                 WHERE name=?), 0), ?, ?, ?)
+               ON CONFLICT(name) DO UPDATE SET
+                 handle=excluded.handle, last_use=excluded.last_use,
+                 status=excluded.status,
+                 usage_intervals=excluded.usage_intervals,
+                 requested_resources=COALESCE(
+                     excluded.requested_resources,
+                     clusters.requested_resources),
+                 owner=COALESCE(clusters.owner, excluded.owner)""",
+            (cluster_name, launched_at, pickle.dumps(handle),
+             json.dumps({"ts": now}), status.value, cluster_name,
+             cluster_name, pickle.dumps(intervals),
+             pickle.dumps(requested_resources)
+             if requested_resources is not None else None,
+             owner))
+
+
+def update_cluster_status(cluster_name: str,
+                          status: ClusterStatus) -> None:
+    now = int(time.time())
+    with _lock, _conn() as conn:
+        if status != ClusterStatus.UP:
+            # Close the open usage interval.
+            row = conn.execute(
+                "SELECT usage_intervals FROM clusters WHERE name=?",
+                (cluster_name,)).fetchone()
+            if row is not None:
+                intervals = pickle.loads(row[0]) if row[0] else []
+                if intervals and intervals[-1][1] is None:
+                    intervals[-1] = (intervals[-1][0], now)
+                conn.execute(
+                    "UPDATE clusters SET usage_intervals=? WHERE name=?",
+                    (pickle.dumps(intervals), cluster_name))
+        conn.execute("UPDATE clusters SET status=? WHERE name=?",
+                     (status.value, cluster_name))
+
+
+def remove_cluster(cluster_name: str, terminate: bool) -> None:
+    """On stop: keep row, mark STOPPED. On terminate: archive to history
+    and delete."""
+    now = int(time.time())
+    with _lock, _conn() as conn:
+        row = conn.execute(
+            "SELECT launched_at, handle, usage_intervals, "
+            "requested_resources FROM clusters WHERE name=?",
+            (cluster_name,)).fetchone()
+        if row is None:
+            return
+        if not terminate:
+            conn.execute(
+                "UPDATE clusters SET status=?, handle=handle WHERE name=?",
+                (ClusterStatus.STOPPED.value, cluster_name))
+            return
+        launched_at, handle_blob, intervals_blob, res_blob = row
+        intervals = pickle.loads(intervals_blob) if intervals_blob else []
+        if intervals and intervals[-1][1] is None:
+            intervals[-1] = (intervals[-1][0], now)
+        duration = sum((end - start) for start, end in intervals
+                       if end is not None)
+        cost = 0.0
+        handle = pickle.loads(handle_blob) if handle_blob else None
+        launched = getattr(handle, "launched_resources", None)
+        if launched is not None:
+            try:
+                cost = launched.get_cost(duration) * getattr(
+                    handle, "num_slices", 1)
+            except Exception:
+                cost = 0.0
+        conn.execute(
+            """INSERT INTO cluster_history
+               (cluster_hash, name, launched_at, duration_seconds,
+                resources, num_nodes, total_cost)
+               VALUES (?, ?, ?, ?, ?, ?, ?)""",
+            (f"{cluster_name}-{launched_at}", cluster_name, launched_at,
+             duration, pickle.dumps(launched),
+             getattr(handle, "num_slices", 1), cost))
+        conn.execute("DELETE FROM clusters WHERE name=?", (cluster_name,))
+    # All terminate paths (backend teardown, status reconciler, jobs
+    # recovery, serve) funnel through here — drop the `ssh <cluster>`
+    # alias so a recycled IP can't be reached via a stale Host block.
+    from skypilot_tpu.utils import ssh_config
+    ssh_config.remove_cluster(cluster_name)
+
+
+def get_cluster_from_name(
+        cluster_name: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute(
+            "SELECT name, launched_at, handle, last_use, status, autostop, "
+            "to_down, usage_intervals, owner FROM clusters WHERE name=?",
+            (cluster_name,)).fetchone()
+    return _row_to_record(row) if row else None
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            "SELECT name, launched_at, handle, last_use, status, autostop, "
+            "to_down, usage_intervals, owner FROM clusters "
+            "ORDER BY launched_at DESC").fetchall()
+    return [_row_to_record(r) for r in rows]
+
+
+def check_owner_identity(record: Dict[str, Any]) -> None:
+    """Refuse to operate on a cluster created by a different user
+    identity (reference: check_owner_identity,
+    sky/backends/backend_utils.py:1536). Override with
+    STPU_SKIP_IDENTITY_CHECK=1 (intentional handover)."""
+    import os
+    if os.environ.get("STPU_SKIP_IDENTITY_CHECK") == "1":
+        return
+    owner = record.get("owner")
+    if owner is None:
+        return  # record predates owner tracking
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.utils import usage_lib
+    me = usage_lib.user_identity()
+    if owner != me:
+        raise exceptions.ClusterOwnerIdentityMismatchError(
+            f"Cluster {record['name']!r} was created by identity "
+            f"{owner!r}; current identity is {me!r}. Set "
+            f"STPU_SKIP_IDENTITY_CHECK=1 to override.")
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    (name, launched_at, handle, last_use, status, autostop, to_down,
+     intervals, owner) = row
+    return {
+        "name": name,
+        "launched_at": launched_at,
+        "handle": pickle.loads(handle) if handle else None,
+        "last_use": last_use,
+        "status": ClusterStatus(status),
+        "autostop": autostop,
+        "to_down": bool(to_down),
+        "usage_intervals": pickle.loads(intervals) if intervals else [],
+        "owner": owner,
+    }
+
+
+def set_cluster_autostop(cluster_name: str, idle_minutes: int,
+                         to_down: bool) -> None:
+    with _lock, _conn() as conn:
+        conn.execute(
+            "UPDATE clusters SET autostop=?, to_down=? WHERE name=?",
+            (idle_minutes, int(to_down), cluster_name))
+
+
+def get_cluster_history() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            "SELECT cluster_hash, name, launched_at, duration_seconds, "
+            "resources, num_nodes, total_cost FROM cluster_history "
+            "ORDER BY launched_at DESC").fetchall()
+    return [{
+        "cluster_hash": r[0], "name": r[1], "launched_at": r[2],
+        "duration_seconds": r[3],
+        "resources": pickle.loads(r[4]) if r[4] else None,
+        "num_nodes": r[5], "total_cost": r[6],
+    } for r in rows]
+
+
+# ------------------------------------------------------------------ clouds
+
+def set_enabled_clouds(clouds: List[str]) -> None:
+    with _lock, _conn() as conn:
+        conn.execute("DELETE FROM enabled_clouds")
+        conn.executemany("INSERT INTO enabled_clouds VALUES (?)",
+                         [(c,) for c in clouds])
+
+
+def get_enabled_clouds() -> List[str]:
+    with _conn() as conn:
+        rows = conn.execute("SELECT name FROM enabled_clouds").fetchall()
+    return [r[0] for r in rows]
+
+
+# ------------------------------------------------------------------ storage
+
+def add_or_update_storage(name: str, handle: Any, status: str) -> None:
+    now = int(time.time())
+    with _lock, _conn() as conn:
+        conn.execute(
+            """INSERT INTO storage (name, launched_at, handle, last_use,
+                                    status)
+               VALUES (?, ?, ?, ?, ?)
+               ON CONFLICT(name) DO UPDATE SET handle=excluded.handle,
+                 last_use=excluded.last_use, status=excluded.status""",
+            (name, now, pickle.dumps(handle), json.dumps({"ts": now}),
+             status))
+
+
+def remove_storage(name: str) -> None:
+    with _lock, _conn() as conn:
+        conn.execute("DELETE FROM storage WHERE name=?", (name,))
+
+
+def get_storage() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            "SELECT name, launched_at, handle, last_use, status "
+            "FROM storage").fetchall()
+    return [{
+        "name": r[0], "launched_at": r[1],
+        "handle": pickle.loads(r[2]) if r[2] else None,
+        "last_use": r[3], "status": r[4],
+    } for r in rows]

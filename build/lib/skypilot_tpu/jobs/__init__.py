@@ -1,0 +1,14 @@
+"""Managed jobs: launch a task under a controller that watches it and
+recovers from (spot TPU) preemptions.
+
+Reference analog: sky/jobs/ (SURVEY §2.3, §3.2).
+"""
+from skypilot_tpu.jobs.state import ManagedJobStatus  # noqa: F401
+
+
+def __getattr__(name):
+    if name in ("launch", "queue", "cancel", "tail_logs", "wait"):
+        from skypilot_tpu.jobs import core
+        return getattr(core, name)
+    raise AttributeError(f"module 'skypilot_tpu.jobs' has no attribute "
+                         f"{name!r}")

@@ -1,0 +1,226 @@
+"""Managed-jobs controller: launch, watch, recover.
+
+Reference analog: sky/jobs/controller.py (JobsController:46, monitor loop
+_run_one_task:103 — poll the on-cluster job status; distinguish user
+failure from preemption by asking the *cloud* for instance health
+:250-325, because a preempted spot TPU can't report its own death).
+
+Deployment difference: the reference runs this on a launched controller VM;
+here it runs as a detached local process per managed job (the client is the
+controller host). The control flow is identical, so moving it onto a
+controller VM is a transport change, not a logic change.
+
+Runnable:  python -m skypilot_tpu.jobs.controller --job-id N dag.yaml
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision as provision_api
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.utils import dag_utils
+
+# Poll gap between on-cluster job status checks (reference:
+# JOB_STATUS_CHECK_GAP_SECONDS). Overridable for hermetic tests.
+def _poll_seconds() -> float:
+    return float(os.environ.get("STPU_JOBS_POLL_SECONDS", "15"))
+
+
+class _Cancelled(Exception):
+    pass
+
+
+class JobsController:
+    def __init__(self, job_id: int, dag_yaml_path: str):
+        self.job_id = job_id
+        self.dag = dag_utils.load_chain_dag_from_yaml(dag_yaml_path)
+        self.backend = slice_backend.SliceBackend()
+        self._cancel_requested = False
+
+    # ------------------------------------------------------------------
+    def run(self) -> None:
+        jobs_state.set_controller_pid(self.job_id, os.getpid())
+        installed = []
+        if threading.current_thread() is threading.main_thread():
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                installed.append(
+                    (sig, signal.signal(sig, self._handle_cancel_signal)))
+        try:
+            self._run()
+        finally:
+            for sig, old in installed:
+                signal.signal(sig, old)
+
+    def _run(self) -> None:
+        try:
+            for task_index, task in enumerate(self.dag.topo_order()):
+                jobs_state.set_task_index(self.job_id, task_index)
+                self._run_one_task(task_index, task)
+            jobs_state.set_status(self.job_id, ManagedJobStatus.SUCCEEDED)
+        except _Cancelled:
+            jobs_state.set_status(self.job_id, ManagedJobStatus.CANCELLED)
+        except exceptions.ResourcesUnavailableError as e:
+            jobs_state.set_status(self.job_id,
+                                  ManagedJobStatus.FAILED_NO_RESOURCE,
+                                  failure_reason=str(e))
+        except _UserFailure as e:
+            jobs_state.set_status(self.job_id, e.status,
+                                  failure_reason=str(e))
+        except Exception as e:  # noqa: BLE001 — controller crash
+            jobs_state.set_status(self.job_id,
+                                  ManagedJobStatus.FAILED_CONTROLLER,
+                                  failure_reason=repr(e))
+            raise
+
+    def _handle_cancel_signal(self, signum, frame) -> None:
+        del signum, frame
+        self._cancel_requested = True
+
+    def _check_cancelled(self) -> None:
+        # Signal path (SIGTERM from `jobs cancel`) OR DB path: a cancel
+        # issued before our pid was recorded leaves status=CANCELLING with
+        # no signal delivered — honor it here.
+        if not self._cancel_requested:
+            if jobs_state.get_status(self.job_id) == \
+                    ManagedJobStatus.CANCELLING:
+                self._cancel_requested = True
+        if self._cancel_requested:
+            jobs_state.set_status(self.job_id,
+                                  ManagedJobStatus.CANCELLING)
+            raise _Cancelled()
+
+    # ------------------------------------------------------------------
+    def _cluster_name(self, task_index: int) -> str:
+        job = jobs_state.get_job(self.job_id)
+        base = (job["job_name"] or "job").replace("_", "-")[:20]
+        return f"stpu-jobs-{base}-{self.job_id}-{task_index}"
+
+    def _run_one_task(self, task_index: int, task) -> None:
+        cluster_name = self._cluster_name(task_index)
+        jobs_state.set_cluster_name(self.job_id, cluster_name)
+        strategy = recovery_strategy.StrategyExecutor.make(
+            cluster_name, task, retry_gap_seconds=min(
+                _poll_seconds(), recovery_strategy.RETRY_INIT_GAP_SECONDS))
+        try:
+            jobs_state.set_status(self.job_id, ManagedJobStatus.STARTING)
+            cluster_job_id = strategy.launch()
+            jobs_state.set_status(self.job_id, ManagedJobStatus.RUNNING)
+            self._watch(strategy, cluster_name, cluster_job_id)
+        finally:
+            # Task done (or cancelled/failed/launch half-succeeded): the
+            # task cluster must not outlive its managed job (reference:
+            # controller.py cleanup).
+            self._teardown_cluster(cluster_name)
+
+    def _watch(self, strategy, cluster_name: str,
+               cluster_job_id: Optional[int]) -> None:
+        """Poll until SUCCEEDED; recover on preemption; raise on failure."""
+        missing_count = 0
+        while True:
+            self._check_cancelled()
+            time.sleep(_poll_seconds())
+            self._check_cancelled()
+            status = self._job_status(cluster_name, cluster_job_id)
+            healthy = self._cluster_healthy(cluster_name)
+            if status == job_lib.JobStatus.SUCCEEDED:
+                return
+            if status == job_lib.JobStatus.CANCELLED:
+                raise _Cancelled()
+            if status in (job_lib.JobStatus.FAILED,
+                          job_lib.JobStatus.FAILED_SETUP):
+                # Distinguish true user failure from a preemption that
+                # killed the gang: ask the provider for instance health
+                # (reference: controller.py:250-325).
+                if healthy:
+                    raise _UserFailure(
+                        ManagedJobStatus.FAILED
+                        if status == job_lib.JobStatus.FAILED
+                        else ManagedJobStatus.FAILED_SETUP,
+                        f"Task failed on cluster ({status.value}); see "
+                        f"`stpu logs {cluster_name}`.")
+            elif status is not None and healthy:
+                missing_count = 0
+                continue  # INIT/PENDING/SETTING_UP/RUNNING, all hosts up.
+            elif status is None and healthy:
+                # Transient job-DB read hiccup on a live cluster: retry a
+                # few times before declaring the job lost.
+                missing_count += 1
+                if missing_count < recovery_strategy.MAX_JOB_CHECKING_RETRY:
+                    continue
+            jobs_state.set_recovering(self.job_id)
+            cluster_job_id = strategy.recover()
+            jobs_state.set_status(self.job_id, ManagedJobStatus.RUNNING)
+            missing_count = 0
+
+    # ------------------------------------------------------------------
+    def _job_status(self, cluster_name: str, cluster_job_id: Optional[int]
+                    ) -> Optional[job_lib.JobStatus]:
+        record = global_user_state.get_cluster_from_name(cluster_name)
+        if record is None or record["handle"] is None:
+            return None
+        if cluster_job_id is None:
+            return None
+        try:
+            value = self.backend.job_status(record["handle"],
+                                            cluster_job_id)
+        except Exception:  # noqa: BLE001 — unreachable head host
+            return None
+        return job_lib.JobStatus(value) if value else None
+
+    def _cluster_healthy(self, cluster_name: str) -> bool:
+        """All hosts still 'running' per the provider (cloud truth)."""
+        record = global_user_state.get_cluster_from_name(cluster_name)
+        if record is None or record["handle"] is None:
+            return False
+        handle = record["handle"]
+        try:
+            statuses = provision_api.query_instances(
+                handle.provider_name, handle.cluster_name,
+                handle.cluster_info.provider_config)
+        except Exception:  # noqa: BLE001
+            return False
+        return (len(statuses) == handle.num_hosts and
+                set(statuses.values()) == {"running"})
+
+    def _teardown_cluster(self, cluster_name: str) -> None:
+        record = global_user_state.get_cluster_from_name(cluster_name)
+        if record is None or record["handle"] is None:
+            return
+        try:
+            self.backend.teardown(record["handle"], terminate=True,
+                                  purge=True)
+        except Exception:  # noqa: BLE001 — already gone
+            global_user_state.remove_cluster(cluster_name, terminate=True)
+
+
+class _UserFailure(Exception):
+    def __init__(self, status: ManagedJobStatus, msg: str):
+        super().__init__(msg)
+        self.status = status
+
+
+def run_controller(job_id: int, dag_yaml_path: str) -> None:
+    JobsController(job_id, dag_yaml_path).run()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--job-id", type=int, required=True)
+    parser.add_argument("dag_yaml")
+    args = parser.parse_args()
+    run_controller(args.job_id, args.dag_yaml)
+
+
+if __name__ == "__main__":
+    main()

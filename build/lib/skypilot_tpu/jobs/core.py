@@ -1,0 +1,324 @@
+"""Managed jobs SDK: launch/queue/cancel/tail_logs.
+
+Reference analog: sky/jobs/core.py (launch:30 wraps the user DAG into a
+controller task launched on the jobs-controller cluster; queue/cancel/
+tail_logs reach the controller via codegen over SSH). Same architecture
+here: by default (`controller mode: cluster`) the job's controller process
+runs **on the stpu-jobs-controller cluster** — the client can exit and
+preemption recovery keeps running — and the client SDK proxies state reads
+through the controller head. `mode: local` keeps the controller as a
+client-local process (controller-logic unit tests, debugging).
+
+This module doubles as the controller-side RPC surface:
+
+    python -m skypilot_tpu.jobs.core submit --dag-yaml P --name N
+    python -m skypilot_tpu.jobs.core queue [--skip-finished]
+    python -m skypilot_tpu.jobs.core cancel (--ids 1,2 | --all)
+    python -m skypilot_tpu.jobs.core status --job-id N
+
+each printing one JSON document (the remote-RPC convention; reference:
+ManagedJobCodeGen, sky/jobs/utils.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import controller_utils
+from skypilot_tpu.utils import dag_utils
+from skypilot_tpu.utils import paths
+
+_JOBS = controller_utils.Controllers.JOBS
+
+
+def launch(entrypoint: Union[Task, dag_lib.Dag],
+           name: Optional[str] = None,
+           detach: bool = True,
+           controller: Optional[str] = None) -> int:
+    """Start a managed job; returns its managed-job id.
+
+    controller='cluster' (default, via config jobs.controller.mode) runs
+    the job's controller process on the self-hosted controller cluster;
+    'local' keeps it on the client. ``detach=False`` with 'local' runs the
+    controller inline (blocking) — hermetic tests and debugging.
+    """
+    dag = dag_utils.convert_entrypoint_to_dag(entrypoint)
+    if not dag.is_chain():
+        raise exceptions.NotSupportedError(
+            "Managed jobs support single tasks or chain pipelines only.")
+    dag.name = name or dag.name or dag.tasks[0].name or "unnamed"
+
+    mode = controller or controller_utils.controller_mode(_JOBS)
+    if mode == "local" or not detach:
+        return _launch_local(dag, detach)
+
+    # Self-hosted path: ship the DAG to the controller cluster and submit
+    # there; the controller process outlives this client.
+    handle = controller_utils.ensure_controller_up(_JOBS)
+    stamp = f"{dag.name}-{int(time.time()*1000)}-{os.getpid()}"
+    inbox = f"~/.stpu/jobs_inbox/{stamp}.yaml"
+    local_yaml = paths.generated_dir() / "managed_jobs" / f"{stamp}.yaml"
+    local_yaml.parent.mkdir(parents=True, exist_ok=True)
+    dag_utils.dump_chain_dag_to_yaml(dag, str(local_yaml))
+    runner = handle.get_command_runners()[0]
+    runner.run("mkdir -p ~/.stpu/jobs_inbox")
+    runner.rsync(str(local_yaml), inbox, up=True)
+    out = controller_utils.run_on_controller(
+        handle, controller_utils.module_command(
+            "skypilot_tpu.jobs.core", "submit", "--dag-yaml", inbox,
+            "--name", dag.name))
+    return int(out["job_id"])
+
+
+def _launch_local(dag: dag_lib.Dag, detach: bool) -> int:
+    """Register + spawn the controller process on *this* host. Runs on the
+    client in 'local' mode and on the controller head in 'cluster' mode
+    (invoked there by the `submit` RPC)."""
+    resources_str = ", ".join(
+        str(res) for task in dag.tasks for res in task.resources)
+    jobs_dir = paths.generated_dir() / "managed_jobs"
+    jobs_dir.mkdir(parents=True, exist_ok=True)
+    job_id = jobs_state.add_job(dag.name, "", resources_str,
+                                num_tasks=len(dag.tasks))
+    dag_yaml_path = str(jobs_dir / f"job-{job_id}.yaml")
+    dag_utils.dump_chain_dag_to_yaml(dag, dag_yaml_path)
+    jobs_state.set_dag_yaml_path(job_id, dag_yaml_path)
+    jobs_state.set_status(job_id, ManagedJobStatus.SUBMITTED)
+
+    if detach:
+        log_dir = paths.logs_dir() / "managed_jobs"
+        log_dir.mkdir(parents=True, exist_ok=True)
+        with open(log_dir / f"controller-{job_id}.log", "ab") as log_f:
+            subprocess.Popen(
+                [sys.executable, "-m", "skypilot_tpu.jobs.controller",
+                 "--job-id", str(job_id), dag_yaml_path],
+                stdout=log_f, stderr=subprocess.STDOUT,
+                start_new_session=True, env=dict(os.environ))
+    else:
+        from skypilot_tpu.jobs import controller
+        controller.run_controller(job_id, dag_yaml_path)
+    return job_id
+
+
+# ---------------------------------------------------------------- queries
+def _proxy() -> Optional[Any]:
+    """Controller-cluster handle when jobs state is self-hosted."""
+    return controller_utils.controller_handle(_JOBS)
+
+
+def queue(skip_finished: bool = False) -> List[Dict[str, Any]]:
+    """List managed jobs (reference: sky jobs queue)."""
+    handle = _proxy()
+    if handle is None:
+        return jobs_state.queue(skip_finished=skip_finished)
+    args = ["queue"] + (["--skip-finished"] if skip_finished else [])
+    return controller_utils.run_on_controller(
+        handle, controller_utils.module_command(
+            "skypilot_tpu.jobs.core", *args))
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    handle = _proxy()
+    if handle is None:
+        return jobs_state.get_job(job_id)
+    out = controller_utils.run_on_controller(
+        handle, controller_utils.module_command(
+            "skypilot_tpu.jobs.core", "status", "--job-id", str(job_id)))
+    return out or None
+
+
+def get_status(job_id: int) -> Optional[ManagedJobStatus]:
+    job = get_job(job_id)
+    return ManagedJobStatus(job["status"]) if job else None
+
+
+def cancel(job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    """Cancel managed jobs: signal their controllers; each controller
+    cancels its cluster job and tears the cluster down. A job whose
+    controller died is finalized (incl. orphaned-cluster teardown)."""
+    if not job_ids and not all_jobs:
+        raise exceptions.SkyTpuError(
+            "Specify managed job ids to cancel, or all_jobs=True "
+            "(`stpu jobs cancel --all`).")
+    handle = _proxy()
+    if handle is None:
+        return _cancel_local(job_ids, all_jobs)
+    args = ["cancel"]
+    args += ["--all"] if all_jobs else ["--ids", ",".join(
+        str(i) for i in (job_ids or []))]
+    out = controller_utils.run_on_controller(
+        handle, controller_utils.module_command(
+            "skypilot_tpu.jobs.core", *args))
+    return list(out["cancelled"])
+
+
+def _cancel_local(job_ids: Optional[List[int]],
+                  all_jobs: bool) -> List[int]:
+    """Cancel on this host (controller pids are local here)."""
+    jobs = jobs_state.queue(skip_finished=True)
+    if not all_jobs:
+        jobs = [j for j in jobs if j["job_id"] in (job_ids or [])]
+    cancelled = []
+    for job in jobs:
+        pid = job.get("controller_pid")
+        # CANCELLING is observed by the controller at its next poll even
+        # if it never received our signal (e.g. pid not yet recorded).
+        # Conditional: a controller that just reached a terminal status
+        # must keep it — and such a job needs no cancelling at all.
+        if not jobs_state.set_cancelling(job["job_id"]):
+            continue
+        if pid:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                _finalize_dead_controller(job)
+        elif time.time() - (job.get("submitted_at") or 0) > 60:
+            # No pid a minute after submission: the controller died on
+            # startup and will never observe CANCELLING — finalize here.
+            _finalize_dead_controller(job)
+        cancelled.append(job["job_id"])
+    return cancelled
+
+
+def _finalize_dead_controller(job: Dict[str, Any]) -> None:
+    """The controller died without cleaning up: tear down its orphaned
+    task cluster and mark the job CANCELLED."""
+    cluster_name = job.get("cluster_name")
+    if cluster_name:
+        record = global_user_state.get_cluster_from_name(cluster_name)
+        if record is not None and record["handle"] is not None:
+            backend = slice_backend.SliceBackend()
+            try:
+                backend.teardown(record["handle"], terminate=True,
+                                 purge=True)
+            except Exception:  # noqa: BLE001 — already gone
+                global_user_state.remove_cluster(cluster_name,
+                                                 terminate=True)
+    # Conditional: the controller may have exited normally between our
+    # queue() snapshot and the kill — a just-reached SUCCEEDED/FAILED
+    # must not be overwritten with CANCELLED.
+    jobs_state.finalize_status(job["job_id"], ManagedJobStatus.CANCELLED)
+
+
+def tail_logs(job_id: Optional[int] = None, follow: bool = True) -> int:
+    """Stream the task logs of a managed job via its current cluster."""
+    handle = _proxy()
+    if handle is not None:
+        args = ["tail"]
+        if job_id is not None:
+            args += ["--job-id", str(job_id)]
+        if not follow:
+            args += ["--no-follow"]
+        rc = controller_utils.run_on_controller(
+            handle, controller_utils.module_command(
+                "skypilot_tpu.jobs.core", *args), stream=True)
+        return int(rc)
+    return _tail_logs_local(job_id, follow)
+
+
+def _tail_logs_local(job_id: Optional[int], follow: bool) -> int:
+    if job_id is None:
+        jobs = jobs_state.queue()
+        if not jobs:
+            print("No managed jobs.")
+            return 1
+        job_id = jobs[0]["job_id"]
+    job = jobs_state.get_job(job_id)
+    if job is None:
+        raise exceptions.SkyTpuError(f"Managed job {job_id} not found.")
+    deadline = time.time() + 30
+    while True:
+        job = jobs_state.get_job(job_id)
+        cluster_name = job.get("cluster_name")
+        if cluster_name:
+            record = global_user_state.get_cluster_from_name(cluster_name)
+            if record is not None and record["handle"] is not None:
+                backend = slice_backend.SliceBackend()
+                return backend.tail_logs(record["handle"], None,
+                                         follow=follow)
+        if (ManagedJobStatus(job["status"]).is_terminal() or
+                time.time() > deadline or not follow):
+            print(f"Managed job {job_id} is {job['status']}; "
+                  f"no live cluster to stream from.")
+            return 0 if job["status"] == "SUCCEEDED" else 1
+        time.sleep(0.5)
+
+
+def wait(job_id: int, timeout: float = 300.0) -> ManagedJobStatus:
+    """Block until the managed job reaches a terminal state."""
+    deadline = time.time() + timeout
+    # Proxied polls spawn a controller-side interpreter per call; use a
+    # gentler interval than the local sqlite path.
+    interval = 0.3 if _proxy() is None else 1.5
+    status = None
+    while time.time() < deadline:
+        status = get_status(job_id)
+        if status is not None and status.is_terminal():
+            return status
+        time.sleep(interval)
+    raise TimeoutError(
+        f"Managed job {job_id} not terminal after {timeout}s "
+        f"(status={status})")
+
+
+# ------------------------------------------------------- controller-side RPC
+def main() -> None:
+    parser = argparse.ArgumentParser(prog="skypilot_tpu.jobs.core")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("submit")
+    p.add_argument("--dag-yaml", required=True)
+    p.add_argument("--name", required=True)
+
+    p = sub.add_parser("queue")
+    p.add_argument("--skip-finished", action="store_true")
+
+    p = sub.add_parser("cancel")
+    p.add_argument("--ids", default=None)
+    p.add_argument("--all", action="store_true", dest="all_jobs")
+
+    p = sub.add_parser("status")
+    p.add_argument("--job-id", type=int, required=True)
+
+    p = sub.add_parser("tail")
+    p.add_argument("--job-id", type=int, default=None)
+    p.add_argument("--no-follow", action="store_true")
+
+    args = parser.parse_args()
+    if args.cmd == "submit":
+        dag = dag_utils.load_chain_dag_from_yaml(
+            os.path.expanduser(args.dag_yaml))
+        dag.name = args.name
+        job_id = _launch_local(dag, detach=True)
+        print(json.dumps({"job_id": job_id}))
+    elif args.cmd == "queue":
+        print(json.dumps(jobs_state.queue(
+            skip_finished=args.skip_finished)))
+    elif args.cmd == "cancel":
+        ids = ([int(i) for i in args.ids.split(",") if i]
+               if args.ids else None)
+        print(json.dumps(
+            {"cancelled": _cancel_local(ids, args.all_jobs)}))
+    elif args.cmd == "status":
+        print(json.dumps(jobs_state.get_job(args.job_id)))
+    elif args.cmd == "tail":
+        raise SystemExit(_tail_logs_local(args.job_id,
+                                          follow=not args.no_follow))
+
+
+if __name__ == "__main__":
+    main()

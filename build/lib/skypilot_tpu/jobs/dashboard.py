@@ -1,0 +1,114 @@
+"""Managed-jobs dashboard: one-file HTTP view of the jobs queue.
+
+Reference analog: sky/jobs/dashboard/ (a flask app on the controller
+serving an auto-refreshing jobs table). Stdlib-only here; reads through
+jobs.core.queue(), which transparently proxies to the self-hosted
+controller cluster when one exists.
+
+    stpu jobs dashboard --port 8265
+"""
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_PAGE = """<!doctype html>
+<html><head><title>stpu managed jobs</title>
+<meta http-equiv="refresh" content="10">
+<style>
+ body {{ font-family: monospace; margin: 2em; }}
+ table {{ border-collapse: collapse; }}
+ td, th {{ border: 1px solid #999; padding: 4px 10px; text-align: left; }}
+ th {{ background: #eee; }}
+ .SUCCEEDED {{ color: #080; }} .RUNNING {{ color: #06c; }}
+ .FAILED, .FAILED_SETUP, .FAILED_NO_RESOURCE, .FAILED_CONTROLLER
+   {{ color: #c00; }}
+ .RECOVERING, .CANCELLING {{ color: #c60; }}
+</style></head>
+<body><h2>Managed jobs</h2><p>{now}</p>
+<table><tr><th>ID</th><th>Name</th><th>Status</th><th>Recoveries</th>
+<th>Cluster</th><th>Submitted</th><th>Failure</th></tr>
+{rows}
+</table></body></html>"""
+
+
+def _render(jobs) -> str:
+    rows = []
+    for j in jobs:
+        submitted = time.strftime(
+            "%Y-%m-%d %H:%M:%S",
+            time.localtime(j.get("submitted_at") or 0))
+        rows.append(
+            "<tr><td>{}</td><td>{}</td>"
+            "<td class=\"{}\">{}</td><td>{}</td><td>{}</td>"
+            "<td>{}</td><td>{}</td></tr>".format(
+                j["job_id"], html.escape(str(j.get("job_name") or "-")),
+                html.escape(str(j["status"])),
+                html.escape(str(j["status"])),
+                j.get("recovery_count") or 0,
+                html.escape(str(j.get("cluster_name") or "-")),
+                submitted,
+                html.escape(str(j.get("failure_reason") or ""))))
+    return _PAGE.format(now=time.strftime("%Y-%m-%d %H:%M:%S"),
+                        rows="\n".join(rows))
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        from skypilot_tpu.jobs import core as jobs_core
+        try:
+            jobs = jobs_core.queue()
+        except Exception as e:  # noqa: BLE001 — render, don't crash
+            jobs, err = [], str(e)
+        else:
+            err = None
+        if self.path.startswith("/api"):
+            body = json.dumps({"jobs": jobs, "error": err}).encode()
+            ctype = "application/json"
+        else:
+            page = _render(jobs)
+            if err:
+                page = page.replace("<table>",
+                                    f"<p style='color:#c00'>"
+                                    f"{html.escape(err)}</p><table>")
+            body, ctype = page.encode(), "text/html"
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+DEFAULT_PORT = 8265
+DEFAULT_HOST = "127.0.0.1"
+
+
+def serve(port: int, host: str = DEFAULT_HOST) -> ThreadingHTTPServer:
+    return ThreadingHTTPServer((host, port), _Handler)
+
+
+def run(port: int = DEFAULT_PORT, host: str = DEFAULT_HOST) -> None:
+    """Print the URL and serve until interrupted (shared by the CLI and
+    `python -m` entrypoints)."""
+    httpd = serve(port, host)
+    print(f"Jobs dashboard: http://{host}:{port} (ctrl-c to stop)",
+          flush=True)
+    httpd.serve_forever()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=DEFAULT_PORT)
+    parser.add_argument("--host", default=DEFAULT_HOST)
+    args = parser.parse_args()
+    run(args.port, args.host)
+
+
+if __name__ == "__main__":
+    main()

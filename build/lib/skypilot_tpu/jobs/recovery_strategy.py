@@ -1,0 +1,160 @@
+"""Preemption-recovery strategies for managed jobs.
+
+Reference analog: sky/jobs/recovery_strategy.py (StrategyExecutor:62 with
+__init_subclass__ registry :85, FAILOVER:372, EAGER_NEXT_REGION:458 — the
+default). A strategy owns the task's cluster: it launches it, and after a
+preemption relaunches it — either retrying the same placement first
+(FAILOVER) or immediately re-optimizing to the next cheapest placement
+(EAGER_NEXT_REGION). TPU note: spot-TPU preemption is only visible via the
+cloud API (reference jobs/controller.py:236-262), so recovery always starts
+by force-terminating whatever half-dead slice remains.
+"""
+from __future__ import annotations
+
+import time
+import traceback
+from typing import Dict, Optional, Type
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import global_user_state
+from skypilot_tpu.backends import slice_backend
+
+RECOVERY_REGISTRY: Dict[str, Type["StrategyExecutor"]] = {}
+
+DEFAULT_RECOVERY_STRATEGY = "EAGER_NEXT_REGION"
+MAX_JOB_CHECKING_RETRY = 10
+RETRY_INIT_GAP_SECONDS = 60
+
+
+class StrategyExecutor:
+    """Launch/recover the cluster running one managed task."""
+
+    NAME = "STRATEGY_BASE"
+
+    def __init__(self, cluster_name: str, task, max_restarts_on_errors: int,
+                 retry_gap_seconds: Optional[float] = None):
+        self.cluster_name = cluster_name
+        self.task = task
+        self.max_restarts_on_errors = max_restarts_on_errors
+        self.restart_count = 0
+        self.retry_gap_seconds = (RETRY_INIT_GAP_SECONDS
+                                  if retry_gap_seconds is None
+                                  else retry_gap_seconds)
+        self.backend = slice_backend.SliceBackend()
+
+    def __init_subclass__(cls, name: Optional[str] = None, **kwargs):
+        super().__init_subclass__(**kwargs)
+        if name is not None:
+            cls.NAME = name
+            RECOVERY_REGISTRY[name] = cls
+
+    @classmethod
+    def make(cls, cluster_name: str, task,
+             retry_gap_seconds: Optional[float] = None
+             ) -> "StrategyExecutor":
+        name = None
+        for res in task.resources:
+            name = res.spot_recovery or res.job_recovery or name
+        name = (name or DEFAULT_RECOVERY_STRATEGY).upper()
+        if name not in RECOVERY_REGISTRY:
+            raise exceptions.NotSupportedError(
+                f"Unknown recovery strategy {name!r}; available: "
+                f"{sorted(RECOVERY_REGISTRY)}")
+        return RECOVERY_REGISTRY[name](cluster_name, task,
+                                       max_restarts_on_errors=0,
+                                       retry_gap_seconds=retry_gap_seconds)
+
+    # ------------------------------------------------------------------
+    def launch(self) -> Optional[int]:
+        """Initial launch. Returns the on-cluster job id."""
+        return self._launch(raise_on_failure=True)
+
+    def recover(self) -> Optional[int]:
+        """Relaunch after a preemption/failure. Subclasses decide where."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def _cleanup_cluster(self) -> None:
+        """Force-terminate the (possibly half-dead) task cluster."""
+        record = global_user_state.get_cluster_from_name(self.cluster_name)
+        if record is None or record["handle"] is None:
+            global_user_state.remove_cluster(self.cluster_name,
+                                             terminate=True)
+            return
+        try:
+            self.backend.teardown(record["handle"], terminate=True,
+                                  purge=True)
+        except Exception:  # cluster may already be gone
+            global_user_state.remove_cluster(self.cluster_name,
+                                             terminate=True)
+
+    def _launch(self, raise_on_failure: bool = True,
+                max_retry: int = 3) -> Optional[int]:
+        """Launch with retries; returns on-cluster job id or None."""
+        backoff = self.retry_gap_seconds
+        for attempt in range(max_retry):
+            try:
+                job_id, handle = execution.launch(
+                    self.task, cluster_name=self.cluster_name,
+                    detach_run=True, stream_logs=False)
+                assert handle is not None
+                return job_id
+            except exceptions.ResourcesUnavailableError as e:
+                if raise_on_failure and attempt == max_retry - 1:
+                    raise exceptions.ResourcesUnavailableError(
+                        f"Failed to launch cluster after {max_retry} "
+                        f"attempts: {e}",
+                        failover_history=e.failover_history) from e
+            except Exception:  # noqa: BLE001 — surfaced via controller log
+                if raise_on_failure and attempt == max_retry - 1:
+                    raise
+                traceback.print_exc()
+            time.sleep(backoff)
+        return None
+
+
+class FailoverStrategyExecutor(StrategyExecutor, name="FAILOVER"):
+    """Retry the previous placement first; widen only when that fails.
+
+    Reference: recovery_strategy.py:372 — keeps data/ckpt locality by
+    preferring the same region before re-optimizing.
+    """
+
+    def recover(self) -> Optional[int]:
+        self._cleanup_cluster()
+        # 1. Same placement (zone pinned from the last launch). The
+        #    original resource set (incl. any_of alternatives) is restored
+        #    afterwards, whatever happens.
+        prev = self.task.best_resources
+        original = self.task.resources
+        if prev is not None:
+            try:
+                self.task.set_resources(prev)
+                job_id = self._launch(raise_on_failure=False, max_retry=1)
+                if job_id is not None:
+                    return job_id
+            except Exception:  # noqa: BLE001
+                pass
+            finally:
+                self.task.resources = original
+        # 2. Anywhere the user allowed: drop the pin and re-optimize.
+        self._relax_placement()
+        return self._launch(raise_on_failure=True)
+
+    def _relax_placement(self) -> None:
+        self.task.best_resources = None
+
+
+class EagerNextRegionStrategyExecutor(FailoverStrategyExecutor,
+                                      name="EAGER_NEXT_REGION"):
+    """Immediately re-optimize to the next cheapest placement (default).
+
+    Reference: recovery_strategy.py:458 — a preempted zone's spot capacity
+    is likely still bad, so don't waste the retry on it.
+    """
+
+    def recover(self) -> Optional[int]:
+        self._cleanup_cluster()
+        self._relax_placement()
+        return self._launch(raise_on_failure=True)

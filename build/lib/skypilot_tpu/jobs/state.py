@@ -1,0 +1,216 @@
+"""Managed-job state: sqlite table of jobs owned by the jobs controller.
+
+Reference analog: sky/jobs/state.py (ManagedJobStatus, spot table on the
+controller; 613 LoC). Here the controller runs as a detached local process,
+so the DB lives under the client's state dir (``paths.home()``).
+"""
+from __future__ import annotations
+
+import enum
+import pathlib
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import paths
+
+
+class ManagedJobStatus(enum.Enum):
+    """Lifecycle of a managed job (reference: sky/jobs/state.py).
+
+    PENDING → SUBMITTED → STARTING → RUNNING ⇄ RECOVERING → SUCCEEDED
+    with FAILED / FAILED_SETUP / FAILED_NO_RESOURCE / FAILED_CONTROLLER /
+    CANCELLING → CANCELLED as terminal branches.
+    """
+    PENDING = "PENDING"
+    SUBMITTED = "SUBMITTED"
+    STARTING = "STARTING"
+    RUNNING = "RUNNING"
+    RECOVERING = "RECOVERING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    FAILED_SETUP = "FAILED_SETUP"
+    FAILED_NO_RESOURCE = "FAILED_NO_RESOURCE"
+    FAILED_CONTROLLER = "FAILED_CONTROLLER"
+    CANCELLING = "CANCELLING"
+    CANCELLED = "CANCELLED"
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    def is_failed(self) -> bool:
+        return self in (ManagedJobStatus.FAILED,
+                        ManagedJobStatus.FAILED_SETUP,
+                        ManagedJobStatus.FAILED_NO_RESOURCE,
+                        ManagedJobStatus.FAILED_CONTROLLER)
+
+
+_TERMINAL = {
+    ManagedJobStatus.SUCCEEDED, ManagedJobStatus.FAILED,
+    ManagedJobStatus.FAILED_SETUP, ManagedJobStatus.FAILED_NO_RESOURCE,
+    ManagedJobStatus.FAILED_CONTROLLER, ManagedJobStatus.CANCELLED,
+}
+
+
+def _db_path() -> pathlib.Path:
+    p = paths.home() / "managed_jobs.db"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn.execute("PRAGMA journal_mode=WAL")
+    conn.execute("""CREATE TABLE IF NOT EXISTS managed_jobs (
+        job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        job_name TEXT,
+        dag_yaml_path TEXT,
+        resources_str TEXT,
+        cluster_name TEXT,
+        status TEXT,
+        submitted_at REAL,
+        start_at REAL,
+        end_at REAL,
+        last_recovered_at REAL,
+        recovery_count INTEGER DEFAULT 0,
+        task_index INTEGER DEFAULT 0,
+        num_tasks INTEGER DEFAULT 1,
+        controller_pid INTEGER,
+        failure_reason TEXT)""")
+    conn.commit()
+    return conn
+
+
+_COLUMNS = ("job_id", "job_name", "dag_yaml_path", "resources_str",
+            "cluster_name", "status", "submitted_at", "start_at", "end_at",
+            "last_recovered_at", "recovery_count", "task_index",
+            "num_tasks", "controller_pid", "failure_reason")
+
+
+def add_job(job_name: str, dag_yaml_path: str, resources_str: str,
+            num_tasks: int) -> int:
+    with _conn() as conn:
+        cur = conn.execute(
+            "INSERT INTO managed_jobs (job_name, dag_yaml_path, "
+            "resources_str, status, submitted_at, num_tasks) "
+            "VALUES (?, ?, ?, ?, ?, ?)",
+            (job_name, dag_yaml_path, resources_str,
+             ManagedJobStatus.PENDING.value, time.time(), num_tasks))
+        return int(cur.lastrowid)
+
+
+def set_status(job_id: int, status: ManagedJobStatus,
+               failure_reason: Optional[str] = None) -> None:
+    now = time.time()
+    with _conn() as conn:
+        if status == ManagedJobStatus.RUNNING:
+            conn.execute(
+                "UPDATE managed_jobs SET status=?, start_at="
+                "COALESCE(start_at, ?) WHERE job_id=?",
+                (status.value, now, job_id))
+        elif status.is_terminal():
+            conn.execute(
+                "UPDATE managed_jobs SET status=?, end_at=?, "
+                "failure_reason=COALESCE(?, failure_reason) "
+                "WHERE job_id=?",
+                (status.value, now, failure_reason, job_id))
+        else:
+            conn.execute(
+                "UPDATE managed_jobs SET status=? WHERE job_id=?",
+                (status.value, job_id))
+
+
+def set_cancelling(job_id: int) -> bool:
+    """Move a job to CANCELLING unless it already reached a terminal
+    status (the controller may finish between the caller's queue()
+    snapshot and this write). Returns True iff the row was updated."""
+    with _conn() as conn:
+        cur = conn.execute(
+            "UPDATE managed_jobs SET status=? "
+            "WHERE job_id=? AND status NOT IN (%s)" %
+            ",".join("?" * len(_TERMINAL)),
+            (ManagedJobStatus.CANCELLING.value, job_id,
+             *[s.value for s in _TERMINAL]))
+        return cur.rowcount > 0
+
+
+def finalize_status(job_id: int, status: ManagedJobStatus,
+                    failure_reason: Optional[str] = None) -> bool:
+    """Set a terminal status only if the job is not already terminal.
+
+    Used when finalizing a dead controller: if the controller exited
+    normally between the caller's queue() snapshot and the signal (job
+    just reached SUCCEEDED/FAILED), that terminal status must win.
+    Returns True iff the row was updated.
+    """
+    assert status.is_terminal(), status
+    with _conn() as conn:
+        cur = conn.execute(
+            "UPDATE managed_jobs SET status=?, end_at=?, "
+            "failure_reason=COALESCE(?, failure_reason) "
+            "WHERE job_id=? AND status NOT IN (%s)" %
+            ",".join("?" * len(_TERMINAL)),
+            (status.value, time.time(), failure_reason, job_id,
+             *[s.value for s in _TERMINAL]))
+        return cur.rowcount > 0
+
+
+def set_recovering(job_id: int) -> None:
+    with _conn() as conn:
+        conn.execute(
+            "UPDATE managed_jobs SET status=?, recovery_count="
+            "recovery_count+1, last_recovered_at=? WHERE job_id=?",
+            (ManagedJobStatus.RECOVERING.value, time.time(), job_id))
+
+
+def set_dag_yaml_path(job_id: int, dag_yaml_path: str) -> None:
+    with _conn() as conn:
+        conn.execute(
+            "UPDATE managed_jobs SET dag_yaml_path=? WHERE job_id=?",
+            (dag_yaml_path, job_id))
+
+
+def set_cluster_name(job_id: int, cluster_name: str) -> None:
+    with _conn() as conn:
+        conn.execute(
+            "UPDATE managed_jobs SET cluster_name=? WHERE job_id=?",
+            (cluster_name, job_id))
+
+
+def set_controller_pid(job_id: int, pid: int) -> None:
+    with _conn() as conn:
+        conn.execute(
+            "UPDATE managed_jobs SET controller_pid=? WHERE job_id=?",
+            (pid, job_id))
+
+
+def set_task_index(job_id: int, task_index: int) -> None:
+    with _conn() as conn:
+        conn.execute(
+            "UPDATE managed_jobs SET task_index=? WHERE job_id=?",
+            (task_index, job_id))
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute(
+            f"SELECT {', '.join(_COLUMNS)} FROM managed_jobs "
+            "WHERE job_id=?", (job_id,)).fetchone()
+    return dict(zip(_COLUMNS, row)) if row else None
+
+
+def get_status(job_id: int) -> Optional[ManagedJobStatus]:
+    job = get_job(job_id)
+    return ManagedJobStatus(job["status"]) if job else None
+
+
+def queue(skip_finished: bool = False) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            f"SELECT {', '.join(_COLUMNS)} FROM managed_jobs "
+            "ORDER BY job_id DESC").fetchall()
+    jobs = [dict(zip(_COLUMNS, r)) for r in rows]
+    if skip_finished:
+        jobs = [j for j in jobs
+                if not ManagedJobStatus(j["status"]).is_terminal()]
+    return jobs

@@ -1,0 +1,673 @@
+"""Blockwise (flash) attention as Pallas TPU kernels, fwd and bwd.
+
+Forward: online-softmax with the KV loop as a *grid dimension* — each
+step stages one (block_k, d) tile into VMEM and carries (m, l, acc) in
+VMEM scratch, so the working set is O(block) regardless of sequence
+length (64k+ sequences compile; an in-kernel full-K load would blow VMEM
+past ~8k). Logits never touch HBM.
+
+Backward: two kernels from the saved (q, k, v, o, lse) — a dq kernel
+gridded (batch, head, q_block, kv_block) and a dk/dv kernel gridded
+(batch, kv_block, head, q_block), the flash-attention-2 split so each
+output block has a single writer. delta = rowsum(do*o) is recomputed
+in-kernel. Causal runs skip fully-masked block pairs via predicated
+compute on the grid.
+
+GQA (n_heads % n_kv_heads == 0): the dk/dv kernel orders the grid so one
+KV head's query-head group and all q blocks are consecutive steps; the
+group-sum accumulates in VMEM scratch and writes (B, KVH, S, D) once —
+no per-query-head gradient reaches HBM.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+
+LSE_PAD = 8    # trailing tile dim for the lse output (tiling constraint)
+_STAT = 128    # lane width for the (m, l) scratch carries
+
+
+def _causal_mask(s, q_start, k_start):
+    bq, bk = s.shape
+    qpos = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = k_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(qpos >= kpos, s, _NEG_INF)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale: float, causal: bool):
+    # Blocks: q/o (bq, d); k/v (bk, d); lse (bq, LSE_PAD).
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+    bq, d = q_ref.shape
+    bk = k_ref.shape[0]
+    q_start = qi * bq
+    k_start = ki * bk
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Causal: a KV block right of the Q block's last row contributes
+    # nothing — skip its compute (the fetch already happened).
+    run = (k_start <= q_start + bq - 1) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, q_start, k_start)
+        m_prev = m_scr[...][:, 0:1]
+        l_prev = l_scr[...][:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[...][:, 0:1], 1e-30)
+        o_ref[...] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[...] = jnp.broadcast_to(
+            m_scr[...][:, 0:1] + jnp.log(l), lse_ref.shape)
+
+
+def _flash_fwd_streamed(q: jax.Array, k: jax.Array, v: jax.Array, *,
+               causal: bool, scale: float,
+               block_q: int, block_k: int,
+               keep_lse_pad: bool = False
+               ) -> Tuple[jax.Array, jax.Array]:
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    groups = h // kvh
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    grid = (b, h, s // block_q, s // block_k)
+
+    # Kernel operates in (B, H, S, D) layout so the last two dims of every
+    # block are MXU/VPU-tileable (S and D); XLA fuses the transposes into
+    # the surrounding projections.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // groups, ki, 0)),
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // groups, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q, LSE_PAD),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, LSE_PAD), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _STAT), jnp.float32),
+            pltpu.VMEM((block_q, _STAT), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=jax.default_backend() == "cpu",
+    )(qt, kt, vt)
+    # keep_lse_pad: the (B,H,S,LSE_PAD) layout feeds the bwd kernels
+    # directly (already lane-tileable); [..., 0] is the logical value.
+    return out.transpose(0, 2, 1, 3), (lse if keep_lse_pad
+                                       else lse[..., 0])
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+               dq_scr, delta_scr, *, scale: float, causal: bool):
+    # Blocks: q/o/do/dq (bq, d); k/v (bk, d); lse (bq, LSE_PAD).
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+    bq, d = q_ref.shape
+    bk = k_ref.shape[0]
+    q_start = qi * bq
+    k_start = ki * bk
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+        # delta depends only on the q block — compute once, not per
+        # KV step (nk can be 256+ on the long-context path).
+        do = do_ref[...].astype(jnp.float32)
+        o = o_ref[...].astype(jnp.float32)
+        delta_scr[...] = jnp.broadcast_to(
+            jnp.sum(do * o, axis=-1, keepdims=True), delta_scr.shape)
+
+    run = (k_start <= q_start + bq - 1) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...][:, 0:1]
+        delta = delta_scr[...][:, 0:1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, q_start, k_start)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[...] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                causal: bool, groups: int):
+    # Grid (batch, kv_block, head, q_block): for one KV-head group the
+    # `groups * nq` innermost steps hit the same (bi, hi//groups, ki)
+    # output block; dk/dv accumulate in scratch (the GQA group-sum) and
+    # write once at the group's final step.
+    ki, hi, qi = pl.program_id(1), pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+    bq, d = q_ref.shape
+    bk = k_ref.shape[0]
+    q_start = qi * bq
+    k_start = ki * bk
+
+    first = jnp.logical_and(hi % groups == 0, qi == 0)
+
+    @pl.when(first)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    run = (q_start + bq - 1 >= k_start) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        o = o_ref[...].astype(jnp.float32)
+        lse = lse_ref[...][:, 0:1]
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, q_start, k_start)
+        p = jnp.exp(s - lse)
+        # dv += p^T @ do ; dk += ds^T @ (q*scale)
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    last = jnp.logical_and(hi % groups == groups - 1, qi == nq - 1)
+
+    @pl.when(last)
+    def _finish():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_streamed(res, do, *, causal: bool, scale: float,
+               block_q: int, block_k: int):
+    q, k, v, o, lse_pad = res
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    groups = h // kvh
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    nq, nk = s // block_q, s // block_k
+    interpret = jax.default_backend() == "cpu"
+
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    ot = o.transpose(0, 2, 1, 3)
+    dot_ = do.transpose(0, 2, 1, 3)
+
+    qspec = pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    kvspec = pl.BlockSpec((None, None, block_k, d),
+                          lambda bi, hi, qi, ki: (bi, hi // groups, ki, 0))
+    lse_q = pl.BlockSpec((None, None, block_q, LSE_PAD),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+
+    dqt = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal),
+        grid=(b, h, nq, nk),
+        in_specs=[qspec, kvspec, kvspec, qspec, qspec, lse_q],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, _STAT), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, ot, dot_, lse_pad)
+
+    # Grid (batch, kv_block, head, q_block): head × q_block innermost so
+    # one KV head's whole group accumulates into the resident output.
+    q_h = pl.BlockSpec((None, None, block_q, d),
+                       lambda bi, ki, hi, qi: (bi, hi, qi, 0))
+    kv_h = pl.BlockSpec((None, None, block_k, d),
+                        lambda bi, ki, hi, qi: (bi, hi // groups, ki, 0))
+    lse_h = pl.BlockSpec((None, None, block_q, LSE_PAD),
+                         lambda bi, ki, hi, qi: (bi, hi, qi, 0))
+    dkt, dvt = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          groups=groups),
+        grid=(b, nk, h, nq),
+        in_specs=[q_h, kv_h, kv_h, q_h, q_h, lse_h],
+        out_specs=[kv_h, kv_h],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kvh, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, kvh, s, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, ot, dot_, lse_pad)
+
+    dq = dqt.transpose(0, 2, 1, 3)
+    dk = dkt.transpose(0, 2, 1, 3)
+    dv = dvt.transpose(0, 2, 1, 3)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+
+# --------------------------------------------------------------------------
+# Resident-KV kernel family: K/V (fwd, dq) and Q/O/dO (dkv) are staged into
+# VMEM once per head and reused across the in-kernel block loop — fastest
+# for short/medium sequences, but the full-sequence staging caps length.
+# The streamed family above keeps O(block) VMEM and scales to 64k+.
+# --------------------------------------------------------------------------
+
+def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                scale: float, block_k: int, causal: bool, seq_len: int):
+    # Refs are rank-reduced by the None dims in the BlockSpecs:
+    # q_ref/o_ref: (block_q, d); k_ref/v_ref: (seq_len, d);
+    # lse_ref: (block_q, LSE_PAD)
+    qi = pl.program_id(2)
+    q = q_ref[...].astype(jnp.float32) * scale  # (bq, D)
+    bq, d = q.shape
+    q_start = qi * bq
+
+    if causal:
+        # Only KV blocks at or before the end of this Q block contribute.
+        n_blocks = lax.div(q_start + bq + block_k - 1, block_k)
+    else:
+        n_blocks = seq_len // block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = q_start + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            kpos = j * block_k + lax.broadcasted_iota(jnp.int32,
+                                                      (bq, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq, 1), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((bq, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((bq, d), dtype=jnp.float32)
+    m, l, acc = lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (acc / l).astype(o_ref.dtype)
+    # lse block is (block_q, LSE_PAD): broadcast across the pad dim, which
+    # exists only to satisfy the (8,128)-ish tiling constraint on outputs.
+    lse_ref[...] = jnp.broadcast_to(m + jnp.log(l),
+                                    (bq, lse_ref.shape[-1]))
+
+
+def _flash_fwd_resident(q: jax.Array, k: jax.Array, v: jax.Array, *,
+               causal: bool, scale: float,
+               block_q: int, block_k: int,
+               keep_lse_pad: bool = False
+               ) -> Tuple[jax.Array, jax.Array]:
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    groups = h // kvh
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    grid = (b, h, s // block_q)
+
+    # Kernel operates in (B, H, S, D) layout so the last two dims of every
+    # block are MXU/VPU-tileable (S and D); XLA fuses the transposes into
+    # the surrounding projections.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel_resident, scale=scale, block_k=block_k,
+                          causal=causal, seq_len=s),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, s, d),
+                         lambda bi, hi, qi: (bi, hi // groups, 0, 0)),
+            pl.BlockSpec((None, None, s, d),
+                         lambda bi, hi, qi: (bi, hi // groups, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q, LSE_PAD),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, LSE_PAD), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=jax.default_backend() == "cpu",
+    )(qt, kt, vt)
+    # keep_lse_pad: the (B,H,S,LSE_PAD) layout feeds the bwd kernels
+    # directly (already lane-tileable); [..., 0] is the logical value.
+    return out.transpose(0, 2, 1, 3), (lse if keep_lse_pad
+                                       else lse[..., 0])
+
+
+def _dq_kernel_resident(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
+               scale: float, block_k: int, causal: bool, seq_len: int):
+    # q/o/do/dq_ref: (block_q, d); k/v_ref: (seq_len, d);
+    # lse_ref: (block_q, LSE_PAD)
+    qi = pl.program_id(2)
+    q = q_ref[...].astype(jnp.float32) * scale
+    do = do_ref[...].astype(jnp.float32)
+    o = o_ref[...].astype(jnp.float32)
+    lse = lse_ref[...][:, 0:1]                       # (bq, 1)
+    delta = jnp.sum(do * o, axis=-1, keepdims=True)  # (bq, 1)
+    bq, d = q.shape
+    q_start = qi * bq
+    if causal:
+        n_blocks = lax.div(q_start + bq + block_k - 1, block_k)
+    else:
+        n_blocks = seq_len // block_k
+
+    def body(j, dq):
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = q_start + lax.broadcasted_iota(jnp.int32, (bq, block_k),
+                                                  0)
+            kpos = j * block_k + lax.broadcasted_iota(jnp.int32,
+                                                      (bq, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((bq, d), dtype=jnp.float32)
+    dq = lax.fori_loop(0, n_blocks, body, dq0)
+    dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel_resident(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                dk_ref, dv_ref, *, scale: float, block_q: int,
+                causal: bool, seq_len: int, groups: int):
+    # k/v/dk/dv_ref: (block_k, d); q/o/do_ref: (seq_len, d);
+    # lse_ref: (seq_len, LSE_PAD). Grid is (batch, kv_block, head) with
+    # head fastest, so the `groups` query heads of one KV head hit the
+    # same (bi, hi // groups, ki) output block on consecutive steps and
+    # the GQA group-sum happens by accumulating into the resident block
+    # — no per-query-head (B,H,S,D) gradient ever reaches HBM.
+    ki = pl.program_id(1)
+    hi = pl.program_id(2)
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    bk, d = k.shape
+    k_start = ki * bk
+    nq = seq_len // block_q
+    i0 = lax.div(k_start, block_q) if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32) * scale
+        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        o = o_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(i * block_q, block_q), :][:, 0:1]
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            kpos = k_start + lax.broadcasted_iota(jnp.int32,
+                                                  (block_q, bk), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        # dv += p^T @ do ; dk += ds^T @ (q*scale)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros((bk, d), dtype=jnp.float32)
+    dk, dv = lax.fori_loop(i0, nq, body, (z, z))
+
+    first_in_group = hi % groups == 0
+
+    @pl.when(first_in_group)
+    def _():
+        dk_ref[...] = dk
+        dv_ref[...] = dv
+
+    @pl.when(jnp.logical_not(first_in_group))
+    def _():
+        dk_ref[...] += dk
+        dv_ref[...] += dv
+
+
+def _flash_bwd_resident(res, do, *, causal: bool, scale: float,
+               block_q: int, block_k: int):
+    q, k, v, o, lse_pad = res
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    groups = h // kvh
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    interpret = jax.default_backend() == "cpu"
+
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    ot = o.transpose(0, 2, 1, 3)
+    dot_ = do.transpose(0, 2, 1, 3)
+
+    qspec = pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, i: (bi, hi, i, 0))
+    kv_full = pl.BlockSpec((None, None, s, d),
+                           lambda bi, hi, i: (bi, hi // groups, 0, 0))
+    lse_q = pl.BlockSpec((None, None, block_q, LSE_PAD),
+                         lambda bi, hi, i: (bi, hi, i, 0))
+
+    dqt = pl.pallas_call(
+        functools.partial(_dq_kernel_resident, scale=scale, block_k=block_k,
+                          causal=causal, seq_len=s),
+        grid=(b, h, s // block_q),
+        in_specs=[qspec, kv_full, kv_full, qspec, qspec, lse_q],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, ot, dot_, lse_pad)
+
+    # Grid (batch, kv_block, head), head fastest: the group's heads
+    # accumulate into the same resident (B,KVH,S,D) output block.
+    kvspec = pl.BlockSpec((None, None, block_k, d),
+                          lambda bi, i, hi: (bi, hi // groups, i, 0))
+    fullq_h = pl.BlockSpec((None, None, s, d),
+                           lambda bi, i, hi: (bi, hi, 0, 0))
+    lse_h = pl.BlockSpec((None, None, s, LSE_PAD),
+                         lambda bi, i, hi: (bi, hi, 0, 0))
+    dkt, dvt = pl.pallas_call(
+        functools.partial(_dkv_kernel_resident, scale=scale, block_q=block_q,
+                          causal=causal, seq_len=s, groups=groups),
+        grid=(b, s // block_k, h),
+        in_specs=[fullq_h, kvspec, kvspec, fullq_h, fullq_h, lse_h],
+        out_specs=[kvspec, kvspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kvh, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, kvh, s, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, ot, dot_, lse_pad)
+
+    dq = dqt.transpose(0, 2, 1, 3)
+    dk = dkt.transpose(0, 2, 1, 3)
+    dv = dvt.transpose(0, 2, 1, 3)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+
+
+# Streamed kernels stage 3 full-seq fp32 tensors at most in the resident
+# family; past this budget Mosaic runs out of VMEM, so dispatch by size.
+_RESIDENT_MAX_BYTES = 6 * 1024 * 1024
+
+
+def _use_resident(s: int, d: int) -> bool:
+    return 3 * s * d * 4 <= _RESIDENT_MAX_BYTES
+
+
+def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k,
+               keep_lse_pad: bool = False):
+    if _use_resident(q.shape[1], q.shape[3]):
+        return _flash_fwd_resident(q, k, v, causal=causal, scale=scale,
+                                   block_q=block_q, block_k=block_k,
+                                   keep_lse_pad=keep_lse_pad)
+    return _flash_fwd_streamed(q, k, v, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k,
+                               keep_lse_pad=keep_lse_pad)
+
+
+def _flash_bwd(res, do, *, causal, scale, block_q, block_k):
+    q = res[0]
+    if _use_resident(q.shape[1], q.shape[3]):
+        return _flash_bwd_resident(res, do, causal=causal, scale=scale,
+                                   block_q=block_q, block_k=block_k)
+    return _flash_bwd_streamed(res, do, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, causal=causal, scale=scale,
+                        block_q=block_q, block_k=block_k)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
+    out, lse_pad = _flash_fwd(q, k, v, causal=causal, scale=scale,
+                              block_q=block_q, block_k=block_k,
+                              keep_lse_pad=True)
+    return out, (q, k, v, out, lse_pad)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
+    return _flash_bwd(res, do, causal=causal, scale=scale,
+                      block_q=block_q, block_k=block_k)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+    """Flash attention. q: (B,S,H,D); k,v: (B,S,KVH,D)."""
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if (k.shape[1] != s or s % block_q or s % block_k or h % k.shape[2] or
+            block_q % 8 or block_k % 8 or d % 8):
+        # Irregular/misaligned shapes: fall back to the XLA reference path
+        # (Mosaic requires 8-sublane-aligned blocks).
+        from skypilot_tpu.ops import attention as attention_ops
+        return attention_ops._reference_attention(q, k, v, causal=causal,
+                                                  scale=scale)
+    return _flash(q, k, v, causal, scale, block_q, block_k)

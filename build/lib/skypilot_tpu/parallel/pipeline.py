@@ -1,0 +1,150 @@
+"""GPipe pipeline parallelism over the `pp` mesh axis.
+
+TPU-native pipelining: the layer stack is split into `pp` stages whose
+params live on different devices (leading stage axis sharded over `pp`);
+microbatches flow stage-to-stage via `lax.ppermute` in a GPipe schedule of
+M + P - 1 ticks. Only `pp` is manual (`jax.shard_map(axis_names={'pp'})`) —
+dp/fsdp/tp inside a stage stay automatic, so pipeline composes with the
+rest of the rule table.
+
+Reference analog: none in-framework — the reference reaches PP only by
+handing DeepSpeed a hostfile (SURVEY.md §2.6). Here it is a first-class
+transform.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+PyTree = Any
+
+
+def _gpipe_local(stage_params, x_mb, extras_mb, *, stage_fn,
+                 axis_name: str, num_stages: int, num_microbatches: int):
+    """shard_map body. stage_params: this stage's params (leading stage
+    axis already consumed). x_mb: (M, mb, ...) microbatched activations,
+    replicated w.r.t. pp. Returns (M, mb, ...) outputs of the final stage.
+    """
+    # Local shard of the stage-stacked params has leading size 1: squeeze.
+    stage_params = jax.tree.map(lambda a: a[0], stage_params)
+    s = lax.axis_index(axis_name)
+    m_total = num_microbatches
+    is_first = s == 0
+    is_last = s == num_stages - 1
+    send_perm = [(i, i + 1) for i in range(num_stages - 1)]
+
+    def tick(carry, t):
+        recv, outputs = carry
+        m = t - s  # microbatch this stage works on at tick t
+        active = jnp.logical_and(m >= 0, m < m_total)
+        m_c = jnp.clip(m, 0, m_total - 1)
+        x_own = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, m_c, keepdims=False),
+            x_mb)
+        ex = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, m_c, keepdims=False),
+            extras_mb)
+        x_in = jax.tree.map(
+            lambda own, r: jnp.where(is_first, own, r), x_own, recv)
+        y = stage_fn(stage_params, x_in, ex)
+        # Last stage stores its result; inactive ticks write to a clipped
+        # slot but are masked out.
+        write = jnp.logical_and(is_last, active)
+        outputs = jax.tree.map(
+            lambda buf, val: lax.dynamic_update_index_in_dim(
+                buf,
+                jnp.where(write, val,
+                          lax.dynamic_index_in_dim(buf, m_c,
+                                                   keepdims=False)),
+                m_c, 0),
+            outputs, y)
+        recv_next = jax.tree.map(
+            lambda a: lax.ppermute(a, axis_name, send_perm), y)
+        return (recv_next, outputs), None
+
+    recv0 = jax.tree.map(lambda a: jnp.zeros_like(a[0]), x_mb)
+    out0 = jax.tree.map(jnp.zeros_like, x_mb)
+    (_, outputs), _ = lax.scan(tick, (recv0, out0),
+                               jnp.arange(m_total + num_stages - 1))
+    # Broadcast final-stage outputs to every pp rank. psum in f32: XLA
+    # miscompiles ("Invalid binary instruction opcode copy") on bf16 psum
+    # over a manual axis when auto axes are present (jaxlib 0.9 CPU).
+    def bcast(a):
+        masked = jnp.where(is_last, a, jnp.zeros_like(a))
+        return lax.psum(masked.astype(jnp.float32),
+                        axis_name).astype(a.dtype)
+    outputs = jax.tree.map(bcast, outputs)
+    return outputs
+
+
+def gpipe(stage_fn: Callable[[PyTree, PyTree, PyTree], PyTree],
+          stage_params: PyTree,
+          x: PyTree,
+          extras: Optional[PyTree] = None, *,
+          mesh,
+          pp_axis: str = mesh_lib.PP,
+          num_microbatches: int) -> PyTree:
+    """Run a stage-stacked computation as a GPipe pipeline.
+
+    Args:
+      stage_fn: (local_stage_params, x_mb, extras_mb) -> y_mb with y_mb the
+        same shape/dtype as x_mb (residual-stream contract).
+      stage_params: pytree whose leaves have a leading `num_stages` axis,
+        sharded over `pp_axis`.
+      x: activations pytree, leaves (M, mb, ...) — microbatched on dim 0.
+      extras: per-microbatch side inputs (positions, masks), leaves
+        (M, ...); passed to stage_fn but never permuted between stages.
+      num_microbatches: M. Pipeline bubble fraction is (P-1)/(M+P-1).
+    """
+    if extras is None:
+        extras = jax.tree.map(lambda a: jnp.zeros((a.shape[0],)), x)
+
+    if pp_axis not in mesh.axis_names or mesh.shape[pp_axis] == 1:
+        # No pipeline axis: plain sequential application of all stages.
+        def apply_all(x_mb, ex):
+            n = jax.tree.leaves(stage_params)[0].shape[0]
+            def body(c, i):
+                lp = jax.tree.map(lambda a: a[i], stage_params)
+                return stage_fn(lp, c, ex), None
+            out, _ = lax.scan(body, x_mb, jnp.arange(n))
+            return out
+        return jax.vmap(apply_all)(x, extras)
+
+    num_stages = mesh.shape[pp_axis]
+
+    # CPU-backend workaround: jaxlib 0.9 miscompiles psum of bf16 over a
+    # manual axis when auto axes are present ("Invalid binary instruction
+    # opcode copy"). shard_map's transpose inserts exactly such psums for
+    # the pp-replicated activation boundaries, so on CPU the boundary
+    # arrays travel in f32 and stages cast back to the compute dtype.
+    f32_boundary = jax.default_backend() == "cpu"
+    x_dtypes = jax.tree.map(lambda a: a.dtype, x)
+    inner_stage_fn = stage_fn
+    if f32_boundary:
+        def inner_stage_fn(lp, x_in, ex):  # noqa: F811
+            x_in = jax.tree.map(lambda a, dt: a.astype(dt), x_in, x_dtypes)
+            y = stage_fn(lp, x_in, ex)
+            return jax.tree.map(lambda a: a.astype(jnp.float32), y)
+        x = jax.tree.map(lambda a: a.astype(jnp.float32), x)
+
+    inner = jax.shard_map(
+        functools.partial(_gpipe_local, stage_fn=inner_stage_fn,
+                          axis_name=pp_axis, num_stages=num_stages,
+                          num_microbatches=num_microbatches),
+        mesh=mesh,
+        in_specs=(P(pp_axis), P(), P()),
+        out_specs=P(),
+        axis_names={pp_axis},
+        check_vma=False,
+    )
+    out = inner(stage_params, x, extras)
+    if f32_boundary:
+        out = jax.tree.map(lambda a, dt: a.astype(dt), out, x_dtypes)
+    return out

@@ -1,0 +1,127 @@
+"""Ring attention: context parallelism over the `sp` mesh axis.
+
+Long-context capability the reference framework lacks entirely (SURVEY.md
+§2.6: no sequence/context parallelism anywhere in the reference). Native
+here: the sequence axis of q/k/v is sharded over `sp`; each device computes
+blockwise attention of its local queries against the KV chunk it currently
+holds, accumulates with online softmax, and passes KV around the ring with
+`lax.ppermute` — collectives ride the ICI torus, overlap comes from XLA
+scheduling the permute against the chunk matmuls.
+
+Only the `sp` axis is manual (`jax.shard_map(..., axis_names={'sp'})`);
+dp/fsdp/tp stay automatic, so the same rule table governs the rest of the
+model around this op.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+_NEG_INF = -1e30
+
+
+def _chunk_update(q, kc, vc, qpos, kpos, m, l, acc, *, causal, scale):
+    """One online-softmax update of local queries against one KV chunk.
+
+    q: (B, Sl, H, D); kc/vc: (B, Sl, KVH, D) fp32; m/l: (B, H, Sl, 1);
+    acc: (B, H, Sl, D).
+    """
+    b, sl, h, d = q.shape
+    kvh = kc.shape[2]
+    groups = h // kvh
+    # Grouped-query form: keep K/V at KVH heads and fold the group axis
+    # into the einsum instead of materializing repeated K/V (which would
+    # multiply the hot loop's working set by `groups` at long context).
+    qg = q.reshape(b, sl, kvh, groups, d)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, kc) * scale
+    s = s.reshape(b, h, sl, kc.shape[1])  # head = kv_head*groups + g
+    if causal:
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    # Guard fully-masked rows: exp(-inf - (-inf)) -> use stable max.
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pg = p.reshape(b, kvh, groups, sl, kc.shape[1])
+    av = jnp.einsum("bkgqs,bskd->bkgqd", pg, vc).reshape(b, h, sl, d)
+    acc_new = acc * alpha + av
+    return m_new, l_new, acc_new
+
+
+def _ring_local(q, k, v, *, axis_name: str, causal: bool,
+                scale: float, axis_size: int):
+    idx = lax.axis_index(axis_name)
+    b, sl, h, d = q.shape
+    qf = q.astype(jnp.float32)
+    qpos = idx * sl + jnp.arange(sl)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def body(carry, step):
+        m, l, acc, kc, vc = carry
+        chunk_idx = (idx - step) % axis_size
+        kpos = chunk_idx * sl + jnp.arange(sl)
+        m, l, acc = _chunk_update(qf, kc.astype(jnp.float32),
+                                  vc.astype(jnp.float32), qpos, kpos,
+                                  m, l, acc, causal=causal, scale=scale)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (m, l, acc, kc, vc), None
+
+    m0 = jnp.full((b, h, sl, 1), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, sl, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((b, h, sl, d), dtype=jnp.float32)
+    body = jax.checkpoint(body, prevent_cse=False)
+    (m, l, acc, _, _), _ = lax.scan(body, (m0, l0, acc0, k, v),
+                                    jnp.arange(axis_size))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # (B, Sl, H, D)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   mesh, sp_axis: str = mesh_lib.SP,
+                   causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Context-parallel causal attention.
+
+    q: (B, S, H, D); k/v: (B, S, KVH, D), S sharded over `sp_axis`.
+    Falls back to single-chunk local attention when the mesh has no sp axis.
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    if sp_axis not in mesh.axis_names or mesh.shape[sp_axis] == 1:
+        from skypilot_tpu.ops import attention as attention_ops
+        return attention_ops.attention(q, k, v, causal=causal, scale=scale)
+    axis_size = mesh.shape[sp_axis]
+    spec = P(None, sp_axis, None, None)
+    inner = jax.shard_map(
+        functools.partial(_ring_local, axis_name=sp_axis, causal=causal,
+                          scale=scale, axis_size=axis_size),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={sp_axis},
+        check_vma=False,
+    )
+    return inner(q, k, v)
+
+
+def ring_attention_from_context(q: jax.Array, k: jax.Array,
+                                v: jax.Array) -> jax.Array:
+    """Model-side entrypoint: resolve the mesh from the ambient context
+    installed by the trainer (`mesh_lib.use_mesh`)."""
+    pair = mesh_lib.current_mesh_rules()
+    if pair is None:
+        raise RuntimeError(
+            "attention_impl='ring' requires an ambient mesh: wrap the "
+            "forward call in `with mesh_lib.use_mesh(mesh, rules): ...` "
+            "(make_train_step does this automatically).")
+    mesh, _ = pair
+    return ring_attention(q, k, v, mesh=mesh)

@@ -1,0 +1,83 @@
+"""Stateless per-cloud provisioning SPI, dispatched by module name.
+
+Reference analog: sky/provision/__init__.py (_route_to_cloud_impl:30; ops
+query/run/wait/stop/terminate/get_cluster_info). Providers implement plain
+functions in ``skypilot_tpu.provision.<provider>``:
+
+    run_instances(region, zone, cluster_name, config) -> ProvisionRecord
+    wait_instances(region, cluster_name, state) -> None
+    query_instances(cluster_name, provider_config) -> Dict[id, status_str]
+    get_cluster_info(region, cluster_name, provider_config) -> ClusterInfo
+    stop_instances(cluster_name, provider_config) -> None
+    terminate_instances(cluster_name, provider_config) -> None
+
+Providers: ``gcp`` (TPU VMs via the TPU REST API), ``local`` (subprocess
+hosts for hermetic multi-host testing — the analog of the reference's
+Kind-backed `sky local up` path, sky/cli.py:5054).
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+from typing import Any
+
+from skypilot_tpu.provision.common import (  # noqa: F401
+    ClusterInfo, InstanceInfo, ProvisionRecord)
+
+
+@functools.lru_cache(maxsize=None)
+def _provider_module(provider_name: str):
+    try:
+        return importlib.import_module(
+            f"skypilot_tpu.provision.{provider_name}")
+    except ModuleNotFoundError as e:
+        from skypilot_tpu import exceptions
+        raise exceptions.NoCloudAccessError(
+            f"No provisioner for provider {provider_name!r} "
+            f"(module skypilot_tpu.provision.{provider_name} not "
+            f"found).") from e
+
+
+def _route(provider_name: str, func_name: str, *args, **kwargs) -> Any:
+    module = _provider_module(provider_name)
+    fn = getattr(module, func_name, None)
+    if fn is None:
+        raise NotImplementedError(
+            f"Provider {provider_name!r} does not implement {func_name}")
+    return fn(*args, **kwargs)
+
+
+def run_instances(provider_name: str, region, zone, cluster_name: str,
+                  config: dict) -> ProvisionRecord:
+    return _route(provider_name, "run_instances", region, zone,
+                  cluster_name, config)
+
+
+def wait_instances(provider_name: str, region, cluster_name: str,
+                   state: str) -> None:
+    return _route(provider_name, "wait_instances", region, cluster_name,
+                  state)
+
+
+def query_instances(provider_name: str, cluster_name: str,
+                    provider_config: dict) -> dict:
+    return _route(provider_name, "query_instances", cluster_name,
+                  provider_config)
+
+
+def get_cluster_info(provider_name: str, region, cluster_name: str,
+                     provider_config: dict) -> ClusterInfo:
+    return _route(provider_name, "get_cluster_info", region, cluster_name,
+                  provider_config)
+
+
+def stop_instances(provider_name: str, cluster_name: str,
+                   provider_config: dict) -> None:
+    return _route(provider_name, "stop_instances", cluster_name,
+                  provider_config)
+
+
+def terminate_instances(provider_name: str, cluster_name: str,
+                        provider_config: dict) -> None:
+    return _route(provider_name, "terminate_instances", cluster_name,
+                  provider_config)

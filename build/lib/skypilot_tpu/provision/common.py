@@ -1,0 +1,73 @@
+"""Shared provisioner dataclasses.
+
+Reference analog: sky/provision/common.py (ProvisionRecord, ClusterInfo,
+InstanceInfo). One TPU-native addition: an *instance* here is a slice host
+(TPU VM worker), and a cluster groups hosts by slice — slice_id is the
+gang boundary for atomic failure handling.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    instance_id: str
+    internal_ip: str
+    external_ip: Optional[str]
+    slice_id: str                  # which slice this host belongs to
+    host_index: int                # index within the slice (rank source)
+    ssh_port: int = 22
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Everything the backend needs to reach a provisioned cluster."""
+    cluster_name: str
+    provider_name: str             # provision module name ("gcp", "local")
+    region: Optional[str]
+    zone: Optional[str]
+    instances: Dict[str, InstanceInfo] = dataclasses.field(
+        default_factory=dict)
+    head_instance_id: Optional[str] = None
+    ssh_user: str = "root"
+    ssh_key_path: Optional[str] = None
+    provider_config: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+
+    def get_head_instance(self) -> Optional[InstanceInfo]:
+        if self.head_instance_id is None:
+            return None
+        return self.instances.get(self.head_instance_id)
+
+    def ordered_instances(self) -> List[InstanceInfo]:
+        """Deterministic rank order: sort by (slice_id, host_index) with
+        the head's slice first — the analog of the reference's
+        sorted-internal-IP rank assignment
+        (sky/backends/cloud_vm_ray_backend.py:497-505)."""
+        head = self.get_head_instance()
+        head_slice = head.slice_id if head else ""
+
+        def key(inst: InstanceInfo):
+            return (inst.slice_id != head_slice, inst.slice_id,
+                    inst.host_index)
+        return sorted(self.instances.values(), key=key)
+
+    def internal_ips(self) -> List[str]:
+        return [i.internal_ip for i in self.ordered_instances()]
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    """Result of run_instances: what got created/resumed."""
+    provider_name: str
+    region: Optional[str]
+    zone: Optional[str]
+    cluster_name: str
+    head_instance_id: Optional[str]
+    created_instance_ids: List[str] = dataclasses.field(
+        default_factory=list)
+    resumed_instance_ids: List[str] = dataclasses.field(
+        default_factory=list)

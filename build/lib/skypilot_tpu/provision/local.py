@@ -1,0 +1,134 @@
+"""Local provider: fake multi-host clusters as directories + subprocesses.
+
+The hermetic analog of a TPU pod slice: each "host" is a directory under
+``$STPU_HOME/local_clusters/<cluster>/`` with its own $HOME, and commands
+run as local subprocesses. This gives real end-to-end coverage of
+provision → rsync → setup → gang exec → logs → autostop → teardown with
+zero cloud credentials — the role Kind plays for the reference
+(`sky local up`, sky/cli.py:5054) and the multi-host test harness
+SURVEY.md §4 calls for.
+
+Failure injection: config["fail_zones"] lists zones whose provisioning
+raises (stockout simulation) so failover paths are testable.
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+import shutil
+from typing import Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
+                                           ProvisionRecord)
+from skypilot_tpu.utils import paths
+
+PROVIDER_NAME = "local"
+
+
+def _cluster_dir(cluster_name: str) -> pathlib.Path:
+    return paths.home() / "local_clusters" / cluster_name
+
+
+def _meta_path(cluster_name: str) -> pathlib.Path:
+    return _cluster_dir(cluster_name) / "metadata.json"
+
+
+def run_instances(region: Optional[str], zone: Optional[str],
+                  cluster_name: str, config: dict) -> ProvisionRecord:
+    if zone and zone in config.get("fail_zones", ()):
+        raise exceptions.ProvisionError(
+            f"local: simulated stockout in zone {zone}",
+            blocklist_zone=zone)
+    num_slices = int(config.get("num_slices", 1))
+    hosts_per_slice = int(config.get("hosts_per_slice", 1))
+    cdir = _cluster_dir(cluster_name)
+    created = []
+    instances = {}
+    for s in range(num_slices):
+        for h in range(hosts_per_slice):
+            iid = f"{cluster_name}-s{s}-h{h}"
+            host_dir = cdir / iid
+            host_dir.mkdir(parents=True, exist_ok=True)
+            created.append(iid)
+            instances[iid] = {
+                "instance_id": iid, "slice_id": f"slice-{s}",
+                "host_index": h, "host_dir": str(host_dir),
+                "status": "running",
+            }
+    meta = {
+        "cluster_name": cluster_name, "region": region, "zone": zone,
+        "num_slices": num_slices, "hosts_per_slice": hosts_per_slice,
+        "instances": instances,
+        "head_instance_id": f"{cluster_name}-s0-h0",
+    }
+    _meta_path(cluster_name).write_text(json.dumps(meta, indent=2))
+    return ProvisionRecord(
+        provider_name=PROVIDER_NAME, region=region, zone=zone,
+        cluster_name=cluster_name,
+        head_instance_id=meta["head_instance_id"],
+        created_instance_ids=created)
+
+
+def wait_instances(region, cluster_name: str, state: str) -> None:
+    del region, state  # local instances are synchronous
+
+
+def query_instances(cluster_name: str,
+                    provider_config: dict) -> Dict[str, str]:
+    del provider_config
+    meta_path = _meta_path(cluster_name)
+    if not meta_path.exists():
+        return {}
+    meta = json.loads(meta_path.read_text())
+    return {iid: info["status"]
+            for iid, info in meta["instances"].items()}
+
+
+def get_cluster_info(region, cluster_name: str,
+                     provider_config: dict) -> ClusterInfo:
+    meta = json.loads(_meta_path(cluster_name).read_text())
+    instances = {}
+    for iid, info in meta["instances"].items():
+        instances[iid] = InstanceInfo(
+            instance_id=iid, internal_ip="127.0.0.1", external_ip=None,
+            slice_id=info["slice_id"], host_index=info["host_index"],
+            tags={"host_dir": info["host_dir"]})
+    return ClusterInfo(
+        cluster_name=cluster_name, provider_name=PROVIDER_NAME,
+        region=meta.get("region"), zone=meta.get("zone"),
+        instances=instances,
+        head_instance_id=meta["head_instance_id"],
+        provider_config=provider_config or {})
+
+
+def simulate_preemption(cluster_name: str) -> None:
+    """Test hook: mark all instances preempted, the way a spot TPU slice
+    dies — the provider's status flips but nothing on-host announces it
+    (reference: spot preemption only visible via cloud API,
+    sky/jobs/controller.py:236-262)."""
+    meta_path = _meta_path(cluster_name)
+    if not meta_path.exists():
+        return
+    meta = json.loads(meta_path.read_text())
+    for info in meta["instances"].values():
+        info["status"] = "preempted"
+    meta_path.write_text(json.dumps(meta, indent=2))
+
+
+def stop_instances(cluster_name: str, provider_config: dict) -> None:
+    del provider_config
+    meta_path = _meta_path(cluster_name)
+    if not meta_path.exists():
+        return
+    meta = json.loads(meta_path.read_text())
+    for info in meta["instances"].values():
+        info["status"] = "stopped"
+    meta_path.write_text(json.dumps(meta, indent=2))
+
+
+def terminate_instances(cluster_name: str, provider_config: dict) -> None:
+    del provider_config
+    cdir = _cluster_dir(cluster_name)
+    if cdir.exists():
+        shutil.rmtree(cdir)

@@ -1,0 +1,14 @@
+"""Recipe tree: runnable training/serving entrypoints for the example YAMLs.
+
+Reference analog: the reference ships its workloads as YAML `run:` sections
+shelling out to external trainers (torchtune, vLLM, llm.c — e.g.
+llm/llama-3_1-finetuning/lora.yaml, examples/torch_ddp_benchmark/). Here the
+recipes are native JAX modules (`python -m skypilot_tpu.recipes.<name>`)
+that consume the framework's env contract (SKYPILOT_NODE_RANK /
+SKYPILOT_COORDINATOR_ADDR → jax.distributed) and its compute stack
+(models/, parallel/, train/).
+
+All recipes run on synthetic, deterministically generated data so they are
+hermetic: no dataset downloads, identical behavior on CPU (tests, local
+provider) and TPU (real runs). Flags select real-scale configs.
+"""

@@ -1,0 +1,285 @@
+"""Resource requirements: a TPU slice (or CPU VM) in a zone, at a price.
+
+Reference analog: sky/resources.py (Resources:30, _set_accelerators:527,
+_validate_and_set_region_zone:600, get_cost:982, less_demanding_than:1078,
+from_yaml_config:1277). The TPU-native difference: the schedulable unit is a
+*slice* — ``accelerator='tpu-v5p-64'`` implies the host VMs (8 hosts × 4
+chips), their gang membership, and the ICI domain. There is no separate
+"instance_type + accelerator count" pair for TPU resources; for CPU-only
+tasks (controllers, data prep) ``instance_type`` picks a plain VM.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+
+# Default TPU VM software version per generation (public runtime names).
+_DEFAULT_RUNTIME = {
+    "v2": "tpu-ubuntu2204-base",
+    "v3": "tpu-ubuntu2204-base",
+    "v4": "tpu-ubuntu2204-base",
+    "v5e": "v2-alpha-tpuv5-lite",
+    "v5p": "v2-alpha-tpuv5",
+    "v6e": "v2-alpha-tpuv6e",
+}
+
+_DEFAULT_DISK_SIZE_GB = 256
+
+
+@dataclasses.dataclass(frozen=True)
+class Resources:
+    """Immutable resource spec. ``copy()`` derives variants.
+
+    Exactly one of (accelerator, instance_type, cpus/memory floors) drives
+    sizing:
+      * ``accelerator``: a TPU slice name (``tpu-v5e-16``).
+      * ``instance_type``: an explicit CPU VM type.
+      * ``cpus``/``memory``: floors; the cheapest VM meeting them is chosen
+        at optimization time (reference: Resources(cpus='4+')).
+
+    ``cloud``: provisioning provider. None means the default real cloud
+    ("gcp"); "local" targets the hermetic subprocess provider (no catalog,
+    price 0) used by tests and `stpu local` workflows.
+    """
+    accelerator: Optional[str] = None
+    cloud: Optional[str] = None
+    instance_type: Optional[str] = None
+    cpus: Optional[Union[int, str]] = None      # 4 or "4+"
+    memory: Optional[Union[float, str]] = None  # GB, 16 or "16+"
+    region: Optional[str] = None
+    zone: Optional[str] = None
+    use_spot: bool = False
+    spot_recovery: Optional[str] = None         # e.g. "EAGER_NEXT_REGION"
+    disk_size: int = _DEFAULT_DISK_SIZE_GB
+    image_id: Optional[str] = None
+    runtime_version: Optional[str] = None       # TPU software version
+    ports: tuple = ()
+    labels: Optional[Dict[str, str]] = None
+    autostop: Optional[int] = None              # idle minutes; -1 = down
+    job_recovery: Optional[str] = None
+
+    # ------------------------------------------------------------------
+    def __post_init__(self):
+        if self.cloud is not None:
+            from skypilot_tpu import clouds as clouds_lib
+            if self.cloud not in clouds_lib.CLOUD_REGISTRY:
+                raise exceptions.InvalidTaskError(
+                    f"Unknown cloud {self.cloud!r}; supported: "
+                    f"{', '.join(clouds_lib.registered_names())}")
+        if self.cloud == "local":
+            return  # no catalog validation for the hermetic provider
+        if self.accelerator is not None:
+            # Normalize user spellings (V5E-8, tpu_v5e_8, v5litepod-8)
+            # to the canonical catalog name, validating against it.
+            from skypilot_tpu.utils import accelerator_registry
+            object.__setattr__(
+                self, "accelerator",
+                accelerator_registry.canonicalize_accelerator_name(
+                    self.accelerator))
+            if self.instance_type is not None:
+                raise exceptions.InvalidTaskError(
+                    "accelerator and instance_type are mutually exclusive "
+                    "for TPU resources: the slice implies its host VMs.")
+        if self.zone is not None and self.region is not None:
+            if not self.zone.startswith(self.region):
+                raise exceptions.InvalidTaskError(
+                    f"zone {self.zone!r} is not in region {self.region!r}")
+        if self.zone is not None and self.region is None:
+            object.__setattr__(self, "region", self.zone.rsplit("-", 1)[0])
+        self._validate_catalog_placement()
+
+    def _validate_catalog_placement(self):
+        if self.accelerator is not None:
+            zones = catalog.tpu_zones(self.accelerator, region=self.region)
+            if self.region is not None and not zones:
+                raise exceptions.InvalidTaskError(
+                    f"{self.accelerator} is not offered in region "
+                    f"{self.region}; offered in "
+                    f"{catalog.tpu_regions(self.accelerator)}")
+            if self.zone is not None and self.zone not in \
+                    catalog.tpu_zones(self.accelerator):
+                raise exceptions.InvalidTaskError(
+                    f"{self.accelerator} is not offered in zone "
+                    f"{self.zone}; offered in "
+                    f"{catalog.tpu_zones(self.accelerator)}")
+        elif self.instance_type is not None:
+            catalog.vm_info(self.instance_type)
+            if self.zone is not None and self.zone not in \
+                    catalog.vm_zones(self.instance_type):
+                raise exceptions.InvalidTaskError(
+                    f"{self.instance_type} not offered in zone {self.zone}")
+
+    # ------------------------------------------------------------------
+    @property
+    def is_tpu(self) -> bool:
+        return self.accelerator is not None
+
+    def slice_info(self) -> Optional[catalog.SliceInfo]:
+        if self.accelerator is None:
+            return None
+        return catalog.slice_info(self.accelerator)
+
+    @property
+    def num_hosts(self) -> int:
+        """Hosts per node-unit: slice hosts for TPU, 1 for a VM."""
+        info = self.slice_info()
+        return info.hosts if info else 1
+
+    @property
+    def tpu_runtime_version(self) -> Optional[str]:
+        if not self.is_tpu:
+            return None
+        if self.runtime_version:
+            return self.runtime_version
+        return _DEFAULT_RUNTIME[self.slice_info().generation]
+
+    @property
+    def provider_name(self) -> str:
+        return self.cloud or "gcp"
+
+    @property
+    def is_launchable(self) -> bool:
+        """Concrete enough to hand to the provisioner: needs a zone and a
+        concrete device/VM (local provider needs neither)."""
+        if self.cloud == "local":
+            return True
+        return (self.zone is not None and
+                (self.accelerator is not None or
+                 self.instance_type is not None))
+
+    def need_cleanup_after_preemption(self) -> bool:
+        """Spot TPU slices are not auto-deleted on preemption — the managed
+        jobs controller must terminate the husk (reference:
+        sky/resources.py:595, sky/clouds/gcp.py:881)."""
+        return self.is_tpu and self.use_spot
+
+    # ------------------------------------------------------------------
+    def hourly_price(self) -> float:
+        """Price of this (concrete) resource per hour."""
+        if self.cloud == "local":
+            return 0.0
+        if self.accelerator is not None:
+            return catalog.tpu_price(self.accelerator, zone=self.zone,
+                                     region=self.region,
+                                     use_spot=self.use_spot)
+        itype = self.instance_type
+        if itype is None:
+            itype = catalog.default_vm_for(*self._cpu_mem_floor())
+        return catalog.vm_price(itype, zone=self.zone, region=self.region,
+                                use_spot=self.use_spot)
+
+    def get_cost(self, seconds: float) -> float:
+        return self.hourly_price() * seconds / 3600.0
+
+    def _cpu_mem_floor(self):
+        def floor(v, default):
+            if v is None:
+                return default
+            if isinstance(v, str):
+                return float(v.rstrip("+"))
+            return float(v)
+        return floor(self.cpus, 0), floor(self.memory, 0)
+
+    # ------------------------------------------------------------------
+    def copy(self, **override) -> "Resources":
+        return dataclasses.replace(self, **override)
+
+    def less_demanding_than(self, other: "Resources") -> bool:
+        """True if an ``other``-shaped cluster can serve this request
+        (reference: sky/resources.py:1078; used by `exec` reuse checks)."""
+        if self.accelerator is not None:
+            if other.accelerator != self.accelerator:
+                return False
+        if self.instance_type is not None and \
+                other.instance_type != self.instance_type:
+            return False
+        cpus, mem = self._cpu_mem_floor()
+        if other.instance_type is not None and (cpus or mem):
+            info = catalog.vm_info(other.instance_type)
+            if info["vcpus"] < cpus or info["memory_gb"] < mem:
+                return False
+        if self.use_spot != other.use_spot:
+            return False
+        for field in ("region", "zone"):
+            mine = getattr(self, field)
+            if mine is not None and getattr(other, field) != mine:
+                return False
+        return True
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_yaml_config(cls, config: Optional[Dict[str, Any]]
+                         ) -> "Resources":
+        config = dict(config or {})
+        known = {
+            "accelerator", "accelerators", "instance_type", "cpus",
+            "memory", "region", "zone", "use_spot", "spot_recovery",
+            "disk_size", "image_id", "runtime_version", "ports", "labels",
+            "autostop", "job_recovery", "any_of", "cloud",
+        }
+        unknown = set(config) - known
+        if unknown:
+            raise exceptions.InvalidTaskError(
+                f"Unknown resources fields: {sorted(unknown)}")
+        acc_plural = config.pop("accelerators", None)
+        acc_singular = config.pop("accelerator", None)
+        if acc_plural is not None and acc_singular is not None:
+            raise exceptions.InvalidTaskError(
+                "Specify either 'accelerators' or 'accelerator', not both.")
+        acc = acc_plural if acc_plural is not None else acc_singular
+        if isinstance(acc, dict):
+            if len(acc) != 1:
+                raise exceptions.InvalidTaskError(
+                    f"Exactly one accelerator entry expected, got {acc}")
+            (acc, count), = acc.items()
+            if count != 1:
+                raise exceptions.InvalidTaskError(
+                    f"TPU slices have count 1 (the size is in the name); "
+                    f"got {acc}: {count}. Want more chips? Pick a bigger "
+                    f"slice (e.g. tpu-v5e-32) or more num_nodes (slices).")
+        ports = config.pop("ports", ()) or ()
+        if isinstance(ports, (int, str)):
+            ports = (ports,)
+        config.pop("any_of", None)  # handled by Task.set_resources
+        return cls(accelerator=acc, ports=tuple(str(p) for p in ports),
+                   **config)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.accelerator is not None:
+            out["accelerators"] = self.accelerator
+        for field in ("cloud", "instance_type", "cpus", "memory", "region",
+                      "zone", "spot_recovery", "image_id",
+                      "runtime_version", "labels", "autostop",
+                      "job_recovery"):
+            val = getattr(self, field)
+            if val is not None:
+                out[field] = val
+        if self.use_spot:
+            out["use_spot"] = True
+        if self.disk_size != _DEFAULT_DISK_SIZE_GB:
+            out["disk_size"] = self.disk_size
+        if self.ports:
+            out["ports"] = list(self.ports)
+        return out
+
+    def __repr__(self) -> str:
+        parts: List[str] = []
+        if self.accelerator:
+            info = self.slice_info()
+            parts.append(f"{self.accelerator}"
+                         f"[{info.chips}chips/{info.hosts}hosts]")
+        if self.instance_type:
+            parts.append(self.instance_type)
+        if self.cpus:
+            parts.append(f"cpus={self.cpus}")
+        if self.use_spot:
+            parts.append("[spot]")
+        if self.zone:
+            parts.append(self.zone)
+        elif self.region:
+            parts.append(self.region)
+        return f"Resources({', '.join(parts) or 'cheapest'})"

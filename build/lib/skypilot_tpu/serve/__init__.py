@@ -1,0 +1,18 @@
+"""SkyServe-style serving: one endpoint → N autoscaled, readiness-probed,
+preemption-aware replicas.
+
+Reference analog: sky/serve/ (SURVEY §2.3, §3.3).
+"""
+from skypilot_tpu.serve.serve_state import (  # noqa: F401
+    ReplicaStatus, ServiceStatus)
+
+
+def __getattr__(name):
+    if name in ("up", "down", "status", "wait_ready"):
+        from skypilot_tpu.serve import core
+        return getattr(core, name)
+    if name == "SkyServiceSpec":
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        return SkyServiceSpec
+    raise AttributeError(f"module 'skypilot_tpu.serve' has no attribute "
+                         f"{name!r}")

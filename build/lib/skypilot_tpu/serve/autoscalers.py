@@ -1,0 +1,102 @@
+"""Autoscalers: request rate → target replica count.
+
+Reference analog: sky/serve/autoscalers.py (Autoscaler:57,
+RequestRateAutoscaler:141 — QPS over a sliding window divided by
+target_qps_per_replica, with upscale/downscale delay hysteresis).
+Pure logic, no I/O — unit-testable with synthetic timestamps
+(reference test: tests/test_serve_autoscaler.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import List, Optional
+
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+
+@dataclasses.dataclass
+class AutoscalerDecision:
+    target_num_replicas: int
+
+
+class Autoscaler:
+    """Base: fixed replica count."""
+
+    def __init__(self, spec: SkyServiceSpec):
+        self.spec = spec
+        self.target_num_replicas = spec.min_replicas
+
+    def collect_request_information(
+            self, request_timestamps: List[float]) -> None:
+        del request_timestamps
+
+    def evaluate_scaling(self,
+                         now: Optional[float] = None) -> AutoscalerDecision:
+        del now
+        return AutoscalerDecision(self.target_num_replicas)
+
+    @classmethod
+    def from_spec(cls, spec: SkyServiceSpec) -> "Autoscaler":
+        if spec.autoscaling_enabled:
+            return RequestRateAutoscaler(spec)
+        return cls(spec)
+
+
+class RequestRateAutoscaler(Autoscaler):
+    """qps/window → ceil(qps / target_qps_per_replica), with hysteresis:
+    a higher target must persist for upscale_delay_seconds before scaling
+    up (resp. downscale_delay_seconds down) so bursts don't thrash."""
+
+    def __init__(self, spec: SkyServiceSpec):
+        super().__init__(spec)
+        self.request_timestamps: List[float] = []
+        self._upscale_candidate_since: Optional[float] = None
+        self._downscale_candidate_since: Optional[float] = None
+
+    def collect_request_information(
+            self, request_timestamps: List[float]) -> None:
+        self.request_timestamps.extend(request_timestamps)
+
+    def _trim_window(self, now: float) -> None:
+        cutoff = now - self.spec.qps_window_seconds
+        self.request_timestamps = [
+            t for t in self.request_timestamps if t >= cutoff]
+
+    def _raw_target(self, now: float) -> int:
+        self._trim_window(now)
+        qps = len(self.request_timestamps) / self.spec.qps_window_seconds
+        target = math.ceil(qps / self.spec.target_qps_per_replica)
+        lo = self.spec.min_replicas
+        # No max_replicas = no growth budget: autoscaling can only shed
+        # load back down to min_replicas, never launch unboundedly.
+        hi = self.spec.max_replicas if self.spec.max_replicas is not None \
+            else lo
+        return max(lo, min(hi, target))
+
+    def evaluate_scaling(self,
+                         now: Optional[float] = None) -> AutoscalerDecision:
+        now = time.time() if now is None else now
+        raw = self._raw_target(now)
+        current = self.target_num_replicas
+        if raw > current:
+            self._downscale_candidate_since = None
+            if self._upscale_candidate_since is None:
+                self._upscale_candidate_since = now
+            if (now - self._upscale_candidate_since >=
+                    self.spec.upscale_delay_seconds):
+                self.target_num_replicas = raw
+                self._upscale_candidate_since = None
+        elif raw < current:
+            self._upscale_candidate_since = None
+            if self._downscale_candidate_since is None:
+                self._downscale_candidate_since = now
+            if (now - self._downscale_candidate_since >=
+                    self.spec.downscale_delay_seconds):
+                self.target_num_replicas = raw
+                self._downscale_candidate_since = None
+        else:
+            self._upscale_candidate_since = None
+            self._downscale_candidate_since = None
+        return AutoscalerDecision(self.target_num_replicas)

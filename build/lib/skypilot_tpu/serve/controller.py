@@ -1,0 +1,101 @@
+"""SkyServe controller: reconcile replicas toward the autoscaler target.
+
+Reference analog: sky/serve/controller.py (SkyServeController:34 — FastAPI
+app with the autoscaler loop _run_autoscaler:55). Here the controller and
+the load balancer share one process (serve/service.py forks nothing); each
+tick: probe replicas → feed LB request timestamps to the autoscaler →
+reconcile count → publish ready URLs to the LB policy → persist state.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.load_balancer import RequestRecorder
+from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
+from skypilot_tpu.serve.replica_managers import SkyPilotReplicaManager
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+
+
+def _tick_seconds() -> float:
+    return float(os.environ.get("STPU_SERVE_TICK_SECONDS", "10"))
+
+
+class SkyServeController:
+    def __init__(self, service_name: str, spec, task,
+                 policy: LoadBalancingPolicy,
+                 recorder: RequestRecorder):
+        self.service_name = service_name
+        self.spec = spec
+        self.replica_manager = SkyPilotReplicaManager(service_name, spec,
+                                                      task)
+        self.autoscaler = autoscalers.Autoscaler.from_spec(spec)
+        self.policy = policy
+        self.recorder = recorder
+        self._stop = False
+        self._was_ready = False
+
+    def stop(self) -> None:
+        self._stop = True
+
+    # ------------------------------------------------------------------
+    def run(self) -> None:
+        serve_state.set_service_controller_pid(self.service_name,
+                                               os.getpid())
+        serve_state.set_service_status(self.service_name,
+                                       ServiceStatus.REPLICA_INIT)
+        try:
+            while not self._stop:
+                self._tick()
+                deadline = time.time() + _tick_seconds()
+                while time.time() < deadline and not self._stop:
+                    time.sleep(0.05)
+        finally:
+            self._shutdown()
+
+    # A broken task fails this many replicas in a row (with no READY in
+    # between) before the controller declares the service FAILED and stops
+    # launching replacements.
+    MAX_CONSECUTIVE_REPLICA_FAILURES = 3
+
+    def _tick(self) -> None:
+        rm = self.replica_manager
+        rm.probe_all()
+        self.autoscaler.collect_request_information(self.recorder.drain())
+        target = self.autoscaler.evaluate_scaling().target_num_replicas
+        given_up = (rm.consecutive_failure_count >=
+                    self.MAX_CONSECUTIVE_REPLICA_FAILURES)
+        alive = rm.alive_count()
+        if alive < target and not given_up:
+            rm.scale_up(target - alive)
+        elif alive > target:
+            for rid in rm.scale_down_candidates()[:alive - target]:
+                rm.scale_down(rid)
+        ready = rm.ready_urls()
+        self.policy.set_ready_replicas(ready)
+        self._publish_status(ready, given_up)
+
+    def _publish_status(self, ready, given_up: bool) -> None:
+        if ready:
+            self._was_ready = True
+            status = ServiceStatus.READY
+        elif given_up:
+            status = ServiceStatus.FAILED
+        elif self._was_ready:
+            status = ServiceStatus.NO_REPLICA
+        else:
+            statuses = self.replica_manager.status_snapshot()
+            all_failed = statuses and all(
+                s == ReplicaStatus.FAILED for s in statuses)
+            status = (ServiceStatus.FAILED if all_failed
+                      else ServiceStatus.REPLICA_INIT)
+        serve_state.set_service_status(self.service_name, status)
+
+    def _shutdown(self) -> None:
+        serve_state.set_service_status(self.service_name,
+                                       ServiceStatus.SHUTTING_DOWN)
+        self.replica_manager.shutdown_all()
+        serve_state.remove_service(self.service_name)

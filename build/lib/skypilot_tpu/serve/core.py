@@ -1,0 +1,275 @@
+"""Serve SDK: up/down/status.
+
+Reference analog: sky/serve/core.py (up:94 fills
+sky-serve-controller.yaml.j2 and launches the controller cluster; down/
+status reach it via codegen). Same architecture here: by default
+(`serve.controller.mode: cluster`) the service's controller + load
+balancer run **on the stpu-serve-controller cluster** and the client SDK
+proxies through its head; `mode: local` keeps them as client-local
+processes (unit tests, debugging).
+
+Controller-side RPC surface (one JSON document per call):
+
+    python -m skypilot_tpu.serve.core submit --task-yaml P --service-name N
+    python -m skypilot_tpu.serve.core dump [--names a,b]
+    python -m skypilot_tpu.serve.core teardown (--names a,b | --all)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import controller_utils
+from skypilot_tpu.utils import paths
+
+_SERVE = controller_utils.Controllers.SERVE
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _proxy() -> Optional[Any]:
+    return controller_utils.controller_handle(_SERVE)
+
+
+def _endpoint_host(handle) -> str:
+    """The address clients use to reach the LB on the controller head."""
+    head = handle.cluster_info.get_head_instance()
+    return head.external_ip or head.internal_ip or "127.0.0.1"
+
+
+def up(task: Task, service_name: Optional[str] = None,
+       controller: Optional[str] = None) -> Tuple[str, str]:
+    """Start a service; returns (service_name, endpoint URL)."""
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            "Task YAML needs a `service:` section for `serve up`.")
+    service_name = service_name or task.name or "service"
+
+    mode = controller or controller_utils.controller_mode(_SERVE)
+    if mode == "local":
+        return _up_local(task, service_name)
+
+    handle = controller_utils.ensure_controller_up(_SERVE)
+    serve_dir = paths.generated_dir() / "serve"
+    serve_dir.mkdir(parents=True, exist_ok=True)
+    local_yaml = serve_dir / f"{service_name}.yaml"
+    task.to_yaml(str(local_yaml))
+    inbox = f"~/.stpu/serve_inbox/{service_name}.yaml"
+    runner = handle.get_command_runners()[0]
+    runner.run("mkdir -p ~/.stpu/serve_inbox")
+    runner.rsync(str(local_yaml), inbox, up=True)
+    out = controller_utils.run_on_controller(
+        handle, controller_utils.module_command(
+            "skypilot_tpu.serve.core", "submit", "--task-yaml", inbox,
+            "--service-name", service_name))
+    if "error" in out:
+        raise exceptions.SkyTpuError(out["error"])
+    endpoint = f"http://{_endpoint_host(handle)}:{out['lb_port']}"
+    return service_name, endpoint
+
+
+def _up_local(task: Task, service_name: str) -> Tuple[str, str]:
+    """Register + spawn the service (controller+LB) on *this* host. Runs
+    on the client in 'local' mode, on the controller head via `submit`."""
+    lb_port = _free_port()
+
+    serve_dir = paths.generated_dir() / "serve"
+    serve_dir.mkdir(parents=True, exist_ok=True)
+    task_yaml_path = str(serve_dir / f"{service_name}.yaml")
+    task.to_yaml(task_yaml_path)
+
+    ok = serve_state.add_service(
+        service_name, json.dumps(task.service.to_yaml_config()),
+        task_yaml_path, lb_port)
+    if not ok:
+        raise exceptions.SkyTpuError(
+            f"Service {service_name!r} already exists; "
+            f"`stpu serve down {service_name}` first.")
+
+    log_dir = paths.logs_dir() / "serve"
+    log_dir.mkdir(parents=True, exist_ok=True)
+    with open(log_dir / f"{service_name}.log", "ab") as log_f:
+        subprocess.Popen(
+            [sys.executable, "-m", "skypilot_tpu.serve.service",
+             "--service-name", service_name,
+             "--task-yaml", task_yaml_path,
+             "--lb-port", str(lb_port)],
+            stdout=log_f, stderr=subprocess.STDOUT,
+            start_new_session=True, env=dict(os.environ))
+    return service_name, f"http://127.0.0.1:{lb_port}"
+
+
+def down(service_names: Optional[List[str]] = None,
+         all_services: bool = False, timeout: float = 60.0) -> List[str]:
+    """Tear down service(s): signal the controller and wait for it to
+    clean up its replicas; finalize orphans if the controller is dead."""
+    if not service_names and not all_services:
+        raise exceptions.SkyTpuError(
+            "Specify service names or all_services=True.")
+    handle = _proxy()
+    if handle is None:
+        return _down_local(service_names, all_services, timeout)
+    args = ["teardown", "--timeout", str(timeout)]
+    args += ["--all"] if all_services else [
+        "--names", ",".join(service_names or [])]
+    out = controller_utils.run_on_controller(
+        handle, controller_utils.module_command(
+            "skypilot_tpu.serve.core", *args))
+    return list(out["down"])
+
+
+def _down_local(service_names: Optional[List[str]], all_services: bool,
+                timeout: float) -> List[str]:
+    services = serve_state.get_services()
+    if not all_services:
+        services = [s for s in services
+                    if s["service_name"] in (service_names or [])]
+    done = []
+    for svc in services:
+        name = svc["service_name"]
+        pid = svc.get("controller_pid")
+        alive = False
+        if pid:
+            try:
+                os.kill(pid, signal.SIGTERM)
+                alive = True
+            except (ProcessLookupError, PermissionError):
+                pass
+        if alive:
+            deadline = time.time() + timeout
+            while (serve_state.get_service(name) is not None and
+                   time.time() < deadline):
+                time.sleep(0.2)
+        if serve_state.get_service(name) is not None:
+            _finalize_dead_service(name)
+        done.append(name)
+    return done
+
+
+def _finalize_dead_service(service_name: str) -> None:
+    backend = slice_backend.SliceBackend()
+    for rep in serve_state.get_replicas(service_name):
+        record = global_user_state.get_cluster_from_name(
+            rep["cluster_name"])
+        if record is not None and record["handle"] is not None:
+            try:
+                backend.teardown(record["handle"], terminate=True,
+                                 purge=True)
+            except Exception:  # noqa: BLE001
+                global_user_state.remove_cluster(rep["cluster_name"],
+                                                 terminate=True)
+    serve_state.remove_service(service_name)
+
+
+def status(service_names: Optional[List[str]] = None
+           ) -> List[Dict[str, Any]]:
+    """Service records with replicas; statuses normalized to plain strings
+    (identical shape in local and cluster mode)."""
+    handle = _proxy()
+    if handle is None:
+        return _status_local(service_names, "127.0.0.1")
+    args = ["dump"]
+    if service_names is not None:
+        args += ["--names", ",".join(service_names)]
+    services = controller_utils.run_on_controller(
+        handle, controller_utils.module_command(
+            "skypilot_tpu.serve.core", *args))
+    host = _endpoint_host(handle)
+    for svc in services:
+        svc["endpoint"] = f"http://{host}:{svc['lb_port']}"
+    return services
+
+
+def _status_local(service_names: Optional[List[str]],
+                  host: str) -> List[Dict[str, Any]]:
+    services = serve_state.get_services()
+    if service_names is not None:
+        services = [s for s in services
+                    if s["service_name"] in service_names]
+    for svc in services:
+        svc["replicas"] = serve_state.get_replicas(svc["service_name"])
+        svc["endpoint"] = f"http://{host}:{svc['lb_port']}"
+        svc["status"] = getattr(svc["status"], "value", svc["status"])
+        for rep in svc["replicas"]:
+            rep["status"] = getattr(rep["status"], "value", rep["status"])
+    return services
+
+
+def wait_ready(service_name: str, timeout: float = 120.0) -> str:
+    """Block until the service is READY; returns the endpoint URL."""
+    deadline = time.time() + timeout
+    # Proxied polls spawn a controller-side interpreter per call; use a
+    # gentler interval than the local sqlite path.
+    interval = 0.3 if _proxy() is None else 1.5
+    svc = None
+    while time.time() < deadline:
+        matches = status([service_name])
+        svc = matches[0] if matches else None
+        if svc is not None:
+            if svc["status"] == ServiceStatus.READY.value:
+                return svc["endpoint"]
+            if svc["status"] == ServiceStatus.FAILED.value:
+                raise exceptions.SkyTpuError(
+                    f"Service {service_name} FAILED; see controller log.")
+        time.sleep(interval)
+    raise TimeoutError(
+        f"Service {service_name} not READY after {timeout}s "
+        f"(status={svc['status'] if svc else 'missing'})")
+
+
+# ------------------------------------------------------- controller-side RPC
+def main() -> None:
+    parser = argparse.ArgumentParser(prog="skypilot_tpu.serve.core")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("submit")
+    p.add_argument("--task-yaml", required=True)
+    p.add_argument("--service-name", required=True)
+
+    p = sub.add_parser("dump")
+    p.add_argument("--names", default=None)
+
+    p = sub.add_parser("teardown")
+    p.add_argument("--names", default=None)
+    p.add_argument("--all", action="store_true", dest="all_services")
+    p.add_argument("--timeout", type=float, default=60.0)
+
+    args = parser.parse_args()
+    if args.cmd == "submit":
+        task = Task.from_yaml(os.path.expanduser(args.task_yaml))
+        try:
+            name, endpoint = _up_local(task, args.service_name)
+        except exceptions.SkyTpuError as e:
+            print(json.dumps({"error": str(e)}))
+            return
+        lb_port = int(endpoint.rsplit(":", 1)[1])
+        print(json.dumps({"service_name": name, "lb_port": lb_port}))
+    elif args.cmd == "dump":
+        names = args.names.split(",") if args.names else None
+        # _status_local normalizes enum statuses to strings.
+        print(json.dumps(_status_local(names, "127.0.0.1")))
+    elif args.cmd == "teardown":
+        names = args.names.split(",") if args.names else None
+        done = _down_local(names, args.all_services, args.timeout)
+        print(json.dumps({"down": done}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""Load balancer: HTTP reverse proxy over ready replicas.
+
+Reference analog: sky/serve/load_balancer.py (FastAPI + httpx proxy,
+RoundRobin select, request-rate reporting to the controller). Stdlib
+implementation: ThreadingHTTPServer + urllib forwarding; the controller
+runs in the same process, so replica sync and QPS reporting are shared
+memory instead of the reference's periodic HTTP sync.
+"""
+from __future__ import annotations
+
+import http.server
+import socketserver
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, List, Optional
+
+from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
+
+_HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding",
+                "te", "trailer", "upgrade", "proxy-authorization",
+                "proxy-authenticate", "host", "content-length"}
+
+
+class RequestRecorder:
+    """Thread-safe sink of request timestamps, drained by the autoscaler
+    each controller tick (reference: LB reports qps to controller)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._timestamps: List[float] = []
+
+    def record(self) -> None:
+        with self._lock:
+            self._timestamps.append(time.time())
+
+    def drain(self) -> List[float]:
+        with self._lock:
+            out, self._timestamps = self._timestamps, []
+            return out
+
+
+class _ProxyHandler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    policy: LoadBalancingPolicy = None  # set by make_handler
+    recorder: RequestRecorder = None
+
+    def log_message(self, fmt, *args):  # quiet
+        del fmt, args
+
+    def _proxy(self, method: str) -> None:
+        self.recorder.record()
+        target = self.policy.select_replica()
+        if target is None:
+            self.send_response(503)
+            body = b"No ready replicas.\n"
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        url = target.rstrip("/") + self.path
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else None
+        headers = {k: v for k, v in self.headers.items()
+                   if k.lower() not in _HOP_HEADERS}
+        req = urllib.request.Request(url, data=body, headers=headers,
+                                     method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                payload = resp.read()
+                self.send_response(resp.status)
+                for k, v in resp.getheaders():
+                    if k.lower() not in _HOP_HEADERS:
+                        self.send_header(k, v)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            self.send_response(e.code)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        except (urllib.error.URLError, ConnectionError, OSError,
+                TimeoutError):
+            self.send_response(502)
+            payload = b"Replica unreachable.\n"
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    def do_GET(self):
+        self._proxy("GET")
+
+    def do_POST(self):
+        self._proxy("POST")
+
+    def do_PUT(self):
+        self._proxy("PUT")
+
+    def do_DELETE(self):
+        self._proxy("DELETE")
+
+
+class _ThreadingHTTPServer(socketserver.ThreadingMixIn,
+                           http.server.HTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def run_load_balancer(port: int, policy: LoadBalancingPolicy,
+                      recorder: RequestRecorder,
+                      ready_event: Optional[threading.Event] = None
+                      ) -> _ThreadingHTTPServer:
+    """Start the LB server on a daemon thread; returns the server (call
+    .shutdown() to stop)."""
+    handler = type("Handler", (_ProxyHandler,),
+                   {"policy": policy, "recorder": recorder})
+    server = _ThreadingHTTPServer(("0.0.0.0", port), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    if ready_event is not None:
+        ready_event.set()
+    return server

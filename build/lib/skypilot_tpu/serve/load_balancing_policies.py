@@ -1,0 +1,37 @@
+"""Load-balancing policies.
+
+Reference analog: sky/serve/load_balancing_policies.py
+(LoadBalancingPolicy:22, RoundRobinPolicy:47).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import List, Optional
+
+
+class LoadBalancingPolicy:
+    def set_ready_replicas(self, urls: List[str]) -> None:
+        raise NotImplementedError
+
+    def select_replica(self) -> Optional[str]:
+        raise NotImplementedError
+
+
+class RoundRobinPolicy(LoadBalancingPolicy):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._urls: List[str] = []
+        self._cycle = itertools.cycle([])
+
+    def set_ready_replicas(self, urls: List[str]) -> None:
+        with self._lock:
+            if urls != self._urls:
+                self._urls = list(urls)
+                self._cycle = itertools.cycle(self._urls)
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self._urls:
+                return None
+            return next(self._cycle)

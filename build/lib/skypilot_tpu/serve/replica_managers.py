@@ -1,0 +1,300 @@
+"""Replica manager: launch/probe/terminate replica clusters.
+
+Reference analog: sky/serve/replica_managers.py (SkyPilotReplicaManager:606
+— _launch_replica:641 via recursive sky.launch, readiness probe:487,
+_probe_all_replicas:1021, _handle_preemption:777). Each replica is a full
+cluster launched through the same execution stack users call; preemption is
+detected by provider health query exactly like managed jobs.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision as provision_api
+from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+PROBE_TIMEOUT_SECONDS = 4
+# Probe failures tolerated after a replica has been READY before it is
+# declared NOT_READY / checked for preemption.
+_MAX_CONSECUTIVE_FAILURES = 3
+
+# Env var handed to every replica so its server knows which port to bind.
+REPLICA_PORT_ENV = "SKYPILOT_SERVE_REPLICA_PORT"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ReplicaInfo:
+    def __init__(self, replica_id: int, cluster_name: str, port: int):
+        self.replica_id = replica_id
+        self.cluster_name = cluster_name
+        self.port = port
+        self.status = ReplicaStatus.PENDING
+        self.url: Optional[str] = None
+        self.launched_at = time.time()
+        self.first_ready_at: Optional[float] = None
+        self.consecutive_failures = 0
+        # In-flight _launch_replica thread; _terminate_replica joins it so
+        # teardown never races a half-finished execution.launch.
+        self.launch_thread: Optional[threading.Thread] = None
+
+
+class SkyPilotReplicaManager:
+    def __init__(self, service_name: str, spec: SkyServiceSpec, task):
+        self.service_name = service_name
+        self.spec = spec
+        self.task = task
+        self.replicas: Dict[int, ReplicaInfo] = {}
+        self._lock = threading.RLock()
+        self._next_replica_id = 1
+        # Consecutive replica failures with no READY success in between;
+        # the controller gives up (service FAILED) past a threshold so a
+        # deterministically-broken task can't launch clusters forever.
+        self.consecutive_failure_count = 0
+        self._threads: List[threading.Thread] = []
+        self.backend = slice_backend.SliceBackend()
+
+    # ------------------------------------------------------------ scaling
+    def scale_up(self, n: int = 1) -> None:
+        for _ in range(n):
+            with self._lock:
+                replica_id = self._next_replica_id
+                self._next_replica_id += 1
+                cluster_name = f"{self.service_name}-replica-{replica_id}"
+                if self._is_local():
+                    port = _free_port()
+                elif self.task.resources and next(
+                        iter(self.task.resources)).ports:
+                    port = int(next(iter(self.task.resources)).ports[0])
+                else:
+                    port = 8080
+                info = ReplicaInfo(replica_id, cluster_name, port)
+                self.replicas[replica_id] = info
+            self._persist(info)
+            t = threading.Thread(target=self._launch_replica,
+                                 args=(info,), daemon=True)
+            info.launch_thread = t
+            t.start()
+            self._threads.append(t)
+
+    def scale_down(self, replica_id: int, sync: bool = False,
+                   keep_record: bool = False) -> None:
+        """Terminate a replica's cluster. ``keep_record`` leaves its row
+        (with its terminal status) in serve state for debuggability."""
+        with self._lock:
+            info = self.replicas.get(replica_id)
+            if info is None:
+                return
+            terminal = info.status in (ReplicaStatus.FAILED,
+                                       ReplicaStatus.PREEMPTED)
+            if not (keep_record and terminal):
+                info.status = ReplicaStatus.SHUTTING_DOWN
+        self._persist(info)
+        t = threading.Thread(target=self._terminate_replica,
+                             args=(info, keep_record), daemon=True)
+        t.start()
+        self._threads.append(t)
+        if sync:
+            t.join()
+
+    def shutdown_all(self) -> None:
+        with self._lock:
+            ids = [rid for rid, info in self.replicas.items()
+                   if info.status != ReplicaStatus.SHUTTING_DOWN]
+        for rid in ids:
+            self.scale_down(rid)
+        for t in list(self._threads):
+            t.join(timeout=60)
+
+    # ------------------------------------------------------------ launch
+    def _is_local(self) -> bool:
+        res = next(iter(self.task.resources))
+        return res.provider_name == "local"
+
+    def _launch_replica(self, info: ReplicaInfo) -> None:
+        info.status = ReplicaStatus.PROVISIONING
+        self._persist(info)
+        import copy as copy_lib
+        task = copy_lib.deepcopy(self.task)
+        task.service = None
+        task.update_envs({REPLICA_PORT_ENV: str(info.port)})
+        try:
+            _, handle = execution.launch(
+                task, cluster_name=info.cluster_name, detach_run=True,
+                stream_logs=False)
+        except Exception as e:  # noqa: BLE001 — incl. ResourcesUnavailable
+            print(f"[replica {info.replica_id}] launch failed: {e}")
+            info.status = ReplicaStatus.FAILED
+            self.consecutive_failure_count += 1
+            self._persist(info)
+            # Clean whatever half-provisioned cluster remains.
+            self.scale_down(info.replica_id, keep_record=True)
+            return
+        head = handle.cluster_info.get_head_instance()
+        host = "127.0.0.1" if self._is_local() else (
+            head.external_ip or head.internal_ip)
+        info.url = f"http://{host}:{info.port}"
+        info.launched_at = time.time()
+        if info.status != ReplicaStatus.SHUTTING_DOWN:
+            info.status = ReplicaStatus.STARTING
+        self._persist(info)
+
+    def _terminate_replica(self, info: ReplicaInfo,
+                           keep_record: bool = False) -> None:
+        # Never tear down under a replica whose launch is still in flight:
+        # execution.launch would finish re-creating the cluster after our
+        # teardown and leak it (the replica is popped below, so nothing
+        # would track it). SHUTTING_DOWN is already set, so waiting is
+        # safe and the launch epilogue won't flip the status back.
+        lt = info.launch_thread
+        if lt is not None and lt is not threading.current_thread():
+            lt.join()
+        record = global_user_state.get_cluster_from_name(info.cluster_name)
+        if record is not None and record["handle"] is not None:
+            try:
+                self.backend.teardown(record["handle"], terminate=True,
+                                      purge=True)
+            except Exception:  # noqa: BLE001
+                global_user_state.remove_cluster(info.cluster_name,
+                                                 terminate=True)
+        with self._lock:
+            self.replicas.pop(info.replica_id, None)
+        if not keep_record:
+            serve_state.remove_replica(self.service_name, info.replica_id)
+
+    # ------------------------------------------------------------ probing
+    def probe_all(self) -> None:
+        """Reference: _probe_all_replicas:1021 — parallel readiness probes
+        + preemption detection for probe-dead replicas."""
+        with self._lock:
+            candidates = [info for info in self.replicas.values()
+                          if info.status in (ReplicaStatus.STARTING,
+                                             ReplicaStatus.READY,
+                                             ReplicaStatus.NOT_READY)]
+        threads = [threading.Thread(target=self._probe_one, args=(i,),
+                                    daemon=True) for i in candidates]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=PROBE_TIMEOUT_SECONDS + 2)
+
+    def _probe_one(self, info: ReplicaInfo) -> None:
+        ok = self._http_probe(info.url)
+        if ok:
+            info.consecutive_failures = 0
+            self.consecutive_failure_count = 0
+            if info.first_ready_at is None:
+                info.first_ready_at = time.time()
+            if info.status != ReplicaStatus.SHUTTING_DOWN:
+                info.status = ReplicaStatus.READY
+            self._persist(info)
+            return
+        # Not answering. Within the initial grace window this is normal.
+        if (info.first_ready_at is None and
+                time.time() - info.launched_at <
+                self.spec.initial_delay_seconds):
+            return
+        info.consecutive_failures += 1
+        if info.consecutive_failures < _MAX_CONSECUTIVE_FAILURES:
+            if info.status == ReplicaStatus.READY:
+                info.status = ReplicaStatus.NOT_READY
+                self._persist(info)
+            return
+        # Persistent failure: preempted (provider unhealthy) or dead.
+        if self._cluster_healthy(info.cluster_name):
+            # Server dead on a healthy cluster = user-code failure. Tear
+            # the cluster down (no leak) but keep the FAILED row visible.
+            info.status = ReplicaStatus.FAILED
+            self.consecutive_failure_count += 1
+            self._persist(info)
+            self.scale_down(info.replica_id, keep_record=True)
+        else:
+            info.status = ReplicaStatus.PREEMPTED
+            self._persist(info)
+            # Reference _handle_preemption:777: clean the husk; the
+            # controller's reconcile loop launches a replacement.
+            self.scale_down(info.replica_id)
+
+    def _http_probe(self, url: Optional[str]) -> bool:
+        if url is None:
+            return False
+        full = url.rstrip("/") + self.spec.readiness_path
+        try:
+            if self.spec.readiness_post_data is not None:
+                data = json.dumps(self.spec.readiness_post_data).encode()
+                req = urllib.request.Request(
+                    full, data=data,
+                    headers={"Content-Type": "application/json"})
+            else:
+                req = urllib.request.Request(full)
+            with urllib.request.urlopen(
+                    req, timeout=PROBE_TIMEOUT_SECONDS) as resp:
+                return 200 <= resp.status < 300
+        except (urllib.error.URLError, ConnectionError, OSError,
+                TimeoutError):
+            return False
+
+    def _cluster_healthy(self, cluster_name: str) -> bool:
+        record = global_user_state.get_cluster_from_name(cluster_name)
+        if record is None or record["handle"] is None:
+            return False
+        handle = record["handle"]
+        try:
+            statuses = provision_api.query_instances(
+                handle.provider_name, handle.cluster_name,
+                handle.cluster_info.provider_config)
+        except Exception:  # noqa: BLE001
+            return False
+        return (len(statuses) == handle.num_hosts and
+                set(statuses.values()) == {"running"})
+
+    # ------------------------------------------------------------ queries
+    def ready_urls(self) -> List[str]:
+        with self._lock:
+            return [info.url for info in self.replicas.values()
+                    if info.status == ReplicaStatus.READY and info.url]
+
+    def alive_count(self) -> int:
+        with self._lock:
+            return sum(1 for info in self.replicas.values()
+                       if info.status.is_alive())
+
+    def status_snapshot(self) -> List[ReplicaStatus]:
+        with self._lock:
+            return [info.status for info in self.replicas.values()]
+
+    def scale_down_candidates(self) -> List[int]:
+        """Prefer killing not-yet-ready replicas, then newest first."""
+        with self._lock:
+            alive = [info for info in self.replicas.values()
+                     if info.status.is_alive()]
+        alive.sort(key=lambda i: (i.status == ReplicaStatus.READY,
+                                  -i.replica_id))
+        return [i.replica_id for i in alive]
+
+    def _persist(self, info: ReplicaInfo) -> None:
+        # Membership check + upsert under one lock hold (RLock): a
+        # straggler probe racing _terminate_replica's pop/remove must not
+        # re-insert the deleted row after the check passes.
+        with self._lock:
+            if info.replica_id not in self.replicas:
+                return
+            serve_state.upsert_replica(self.service_name, info.replica_id,
+                                       info.cluster_name, info.status,
+                                       info.url)

@@ -1,0 +1,173 @@
+"""Serve state: sqlite tables for services and their replicas.
+
+Reference analog: sky/serve/serve_state.py. The controller process writes;
+the client SDK (`serve.status`) reads.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import pathlib
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import paths
+
+
+class ServiceStatus(enum.Enum):
+    CONTROLLER_INIT = "CONTROLLER_INIT"
+    REPLICA_INIT = "REPLICA_INIT"   # no ready replica yet
+    READY = "READY"
+    NO_REPLICA = "NO_REPLICA"       # was ready; all replicas gone
+    SHUTTING_DOWN = "SHUTTING_DOWN"
+    FAILED = "FAILED"
+
+    def is_terminal(self) -> bool:
+        return self == ServiceStatus.FAILED
+
+
+class ReplicaStatus(enum.Enum):
+    PENDING = "PENDING"
+    PROVISIONING = "PROVISIONING"
+    STARTING = "STARTING"           # provisioned, not yet probe-ready
+    READY = "READY"
+    NOT_READY = "NOT_READY"         # probe failing, within grace
+    SHUTTING_DOWN = "SHUTTING_DOWN"
+    PREEMPTED = "PREEMPTED"
+    FAILED = "FAILED"
+
+    def is_alive(self) -> bool:
+        return self in (ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
+                        ReplicaStatus.STARTING, ReplicaStatus.READY,
+                        ReplicaStatus.NOT_READY)
+
+
+def _db_path() -> pathlib.Path:
+    p = paths.home() / "serve.db"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=10)
+    conn.execute("PRAGMA journal_mode=WAL")
+    conn.execute("""CREATE TABLE IF NOT EXISTS services (
+        service_name TEXT PRIMARY KEY,
+        status TEXT,
+        spec_json TEXT,
+        task_yaml_path TEXT,
+        lb_port INTEGER,
+        controller_pid INTEGER,
+        created_at REAL)""")
+    conn.execute("""CREATE TABLE IF NOT EXISTS replicas (
+        service_name TEXT,
+        replica_id INTEGER,
+        cluster_name TEXT,
+        status TEXT,
+        url TEXT,
+        launched_at REAL,
+        PRIMARY KEY (service_name, replica_id))""")
+    conn.commit()
+    return conn
+
+
+# ------------------------------------------------------------------ services
+def add_service(service_name: str, spec_json: str, task_yaml_path: str,
+                lb_port: int) -> bool:
+    """False if a live service with this name already exists."""
+    with _conn() as conn:
+        row = conn.execute(
+            "SELECT status FROM services WHERE service_name=?",
+            (service_name,)).fetchone()
+        if row is not None:
+            return False
+        conn.execute(
+            "INSERT INTO services (service_name, status, spec_json, "
+            "task_yaml_path, lb_port, created_at) VALUES (?, ?, ?, ?, ?, ?)",
+            (service_name, ServiceStatus.CONTROLLER_INIT.value, spec_json,
+             task_yaml_path, lb_port, time.time()))
+        return True
+
+
+def set_service_status(service_name: str, status: ServiceStatus) -> None:
+    with _conn() as conn:
+        conn.execute("UPDATE services SET status=? WHERE service_name=?",
+                     (status.value, service_name))
+
+
+def set_service_controller_pid(service_name: str, pid: int) -> None:
+    with _conn() as conn:
+        conn.execute(
+            "UPDATE services SET controller_pid=? WHERE service_name=?",
+            (pid, service_name))
+
+
+def get_service(service_name: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute(
+            "SELECT service_name, status, spec_json, task_yaml_path, "
+            "lb_port, controller_pid, created_at FROM services "
+            "WHERE service_name=?", (service_name,)).fetchone()
+    if row is None:
+        return None
+    return _service_row(row)
+
+
+def get_services() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            "SELECT service_name, status, spec_json, task_yaml_path, "
+            "lb_port, controller_pid, created_at FROM services").fetchall()
+    return [_service_row(r) for r in rows]
+
+
+def remove_service(service_name: str) -> None:
+    with _conn() as conn:
+        conn.execute("DELETE FROM services WHERE service_name=?",
+                     (service_name,))
+        conn.execute("DELETE FROM replicas WHERE service_name=?",
+                     (service_name,))
+
+
+def _service_row(row) -> Dict[str, Any]:
+    (name, status, spec_json, task_yaml_path, lb_port, pid,
+     created_at) = row
+    return {
+        "service_name": name, "status": ServiceStatus(status),
+        "spec": json.loads(spec_json) if spec_json else {},
+        "task_yaml_path": task_yaml_path, "lb_port": lb_port,
+        "controller_pid": pid, "created_at": created_at,
+    }
+
+
+# ------------------------------------------------------------------ replicas
+def upsert_replica(service_name: str, replica_id: int, cluster_name: str,
+                   status: ReplicaStatus, url: Optional[str]) -> None:
+    with _conn() as conn:
+        conn.execute(
+            "INSERT INTO replicas (service_name, replica_id, cluster_name,"
+            " status, url, launched_at) VALUES (?, ?, ?, ?, ?, ?) "
+            "ON CONFLICT(service_name, replica_id) DO UPDATE SET "
+            "status=excluded.status, url=excluded.url, "
+            "cluster_name=excluded.cluster_name",
+            (service_name, replica_id, cluster_name, status.value, url,
+             time.time()))
+
+
+def remove_replica(service_name: str, replica_id: int) -> None:
+    with _conn() as conn:
+        conn.execute(
+            "DELETE FROM replicas WHERE service_name=? AND replica_id=?",
+            (service_name, replica_id))
+
+
+def get_replicas(service_name: str) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            "SELECT replica_id, cluster_name, status, url, launched_at "
+            "FROM replicas WHERE service_name=? ORDER BY replica_id",
+            (service_name,)).fetchall()
+    return [{"replica_id": r[0], "cluster_name": r[1],
+             "status": ReplicaStatus(r[2]), "url": r[3],
+             "launched_at": r[4]} for r in rows]

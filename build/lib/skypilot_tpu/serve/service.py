@@ -1,0 +1,53 @@
+"""Serve service entrypoint: controller + load balancer in one process.
+
+Reference analog: sky/serve/service.py:131 (_start forks the controller and
+the load balancer as separate processes on the controller VM). Here both
+run in one process — LB on a daemon thread, controller on the main thread —
+started detached by `serve.core.up`:
+
+    python -m skypilot_tpu.serve.service --service-name NAME \
+        --task-yaml path.yaml --lb-port 8000
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+
+from skypilot_tpu.serve import load_balancer
+from skypilot_tpu.serve import load_balancing_policies
+from skypilot_tpu.serve.controller import SkyServeController
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+from skypilot_tpu.task import Task
+
+
+def run_service(service_name: str, task_yaml: str, lb_port: int) -> None:
+    task = Task.from_yaml(task_yaml)
+    spec = task.service or SkyServiceSpec()
+    policy = load_balancing_policies.RoundRobinPolicy()
+    recorder = load_balancer.RequestRecorder()
+    controller = SkyServeController(service_name, spec, task, policy,
+                                    recorder)
+    server = load_balancer.run_load_balancer(lb_port, policy, recorder)
+
+    def handle_term(signum, frame):
+        del signum, frame
+        controller.stop()
+    signal.signal(signal.SIGTERM, handle_term)
+    signal.signal(signal.SIGINT, handle_term)
+    try:
+        controller.run()
+    finally:
+        server.shutdown()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--service-name", required=True)
+    parser.add_argument("--task-yaml", required=True)
+    parser.add_argument("--lb-port", type=int, required=True)
+    args = parser.parse_args()
+    run_service(args.service_name, args.task_yaml, args.lb_port)
+
+
+if __name__ == "__main__":
+    main()

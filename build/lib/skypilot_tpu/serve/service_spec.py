@@ -1,0 +1,102 @@
+"""Service section of a task YAML → SkyServiceSpec.
+
+Reference analog: sky/serve/service_spec.py (SkyServiceSpec built from the
+``service:`` YAML section; readiness probe + static replicas or an
+autoscaling replica_policy).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import schemas
+
+DEFAULT_INITIAL_DELAY_SECONDS = 1200
+DEFAULT_QPS_WINDOW_SECONDS = 60
+DEFAULT_UPSCALE_DELAY_SECONDS = 300
+DEFAULT_DOWNSCALE_DELAY_SECONDS = 1200
+
+
+@dataclasses.dataclass(frozen=True)
+class SkyServiceSpec:
+    readiness_path: str = "/"
+    initial_delay_seconds: int = DEFAULT_INITIAL_DELAY_SECONDS
+    readiness_post_data: Optional[Any] = None
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None      # None = fixed at min_replicas
+    target_qps_per_replica: Optional[float] = None
+    qps_window_seconds: int = DEFAULT_QPS_WINDOW_SECONDS
+    upscale_delay_seconds: int = DEFAULT_UPSCALE_DELAY_SECONDS
+    downscale_delay_seconds: int = DEFAULT_DOWNSCALE_DELAY_SECONDS
+    base_ondemand_fallback_replicas: int = 0
+
+    @property
+    def autoscaling_enabled(self) -> bool:
+        return self.target_qps_per_replica is not None
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> "SkyServiceSpec":
+        schemas.validate_service(config)
+        probe = config["readiness_probe"]
+        if isinstance(probe, str):
+            path, delay, post = probe, DEFAULT_INITIAL_DELAY_SECONDS, None
+        else:
+            path = probe.get("path", "/")
+            delay = probe.get("initial_delay_seconds",
+                              DEFAULT_INITIAL_DELAY_SECONDS)
+            post = probe.get("post_data")
+            if isinstance(post, str):
+                post = json.loads(post)
+        policy = config.get("replica_policy")
+        static = config.get("replicas")
+        if policy is not None and static is not None:
+            raise exceptions.InvalidTaskError(
+                "Specify either service.replicas or "
+                "service.replica_policy, not both.")
+        kwargs: Dict[str, Any] = dict(
+            readiness_path=path, initial_delay_seconds=delay,
+            readiness_post_data=post)
+        if policy is not None:
+            kwargs.update(
+                min_replicas=policy.get("min_replicas", 1),
+                max_replicas=policy.get("max_replicas"),
+                target_qps_per_replica=policy.get(
+                    "target_qps_per_replica"),
+                qps_window_seconds=policy.get(
+                    "qps_window_seconds", DEFAULT_QPS_WINDOW_SECONDS),
+                upscale_delay_seconds=policy.get(
+                    "upscale_delay_seconds", DEFAULT_UPSCALE_DELAY_SECONDS),
+                downscale_delay_seconds=policy.get(
+                    "downscale_delay_seconds",
+                    DEFAULT_DOWNSCALE_DELAY_SECONDS),
+                base_ondemand_fallback_replicas=policy.get(
+                    "base_ondemand_fallback_replicas", 0),
+            )
+        elif static is not None:
+            kwargs.update(min_replicas=static)
+        return cls(**kwargs)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        probe: Dict[str, Any] = {"path": self.readiness_path,
+                                 "initial_delay_seconds":
+                                     self.initial_delay_seconds}
+        if self.readiness_post_data is not None:
+            probe["post_data"] = self.readiness_post_data
+        out: Dict[str, Any] = {"readiness_probe": probe}
+        if self.autoscaling_enabled or self.max_replicas is not None:
+            policy: Dict[str, Any] = {"min_replicas": self.min_replicas}
+            if self.max_replicas is not None:
+                policy["max_replicas"] = self.max_replicas
+            if self.target_qps_per_replica is not None:
+                policy["target_qps_per_replica"] = \
+                    self.target_qps_per_replica
+            policy["qps_window_seconds"] = self.qps_window_seconds
+            policy["upscale_delay_seconds"] = self.upscale_delay_seconds
+            policy["downscale_delay_seconds"] = \
+                self.downscale_delay_seconds
+            out["replica_policy"] = policy
+        else:
+            out["replicas"] = self.min_replicas
+        return out

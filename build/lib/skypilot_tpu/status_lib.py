@@ -1,0 +1,28 @@
+"""Shared status enums (reference: sky/status_lib.py:8)."""
+from __future__ import annotations
+
+import enum
+
+
+class ClusterStatus(enum.Enum):
+    """Slice-cluster lifecycle.
+
+    INIT: provisioning started or runtime in unknown/partial state.
+    UP: all hosts up, agent healthy.
+    STOPPED: hosts stopped (TPU slices can only stop if single-host;
+             pods are terminate-only, like the reference notes for TPU VMs,
+             sky/provision/gcp/instance_utils.py:1317-1620).
+    """
+    INIT = "INIT"
+    UP = "UP"
+    STOPPED = "STOPPED"
+
+    def colored_str(self) -> str:
+        color = {"INIT": "yellow", "UP": "green",
+                 "STOPPED": "cyan"}[self.value]
+        return f"[{color}]{self.value}[/{color}]"
+
+
+class StatusVersion(enum.Enum):
+    """Handle compatibility marker for pickled handles in the state DB."""
+    V1 = 1

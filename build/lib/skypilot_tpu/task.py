@@ -1,0 +1,222 @@
+"""Task: the declarative unit of work.
+
+Reference analog: sky/task.py (Task:171, from_yaml_config:347,
+set_resources:629, set_file_mounts:707, __rshift__:1159). Same surface —
+name/setup/run/num_nodes/envs/workdir/file_mounts/resources/service — with
+one TPU-native semantic shift: ``num_nodes`` counts *slices* (each slice's
+host fan-out is implicit in the accelerator, e.g. tpu-v5p-64 = 8 hosts that
+always gang together).
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple, Union
+
+import yaml
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.utils import schemas
+
+_VALID_NAME_RE = re.compile(r"^[a-zA-Z0-9]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$")
+
+CommandOrGen = Union[str, Callable[[int, List[str]], Optional[str]], None]
+
+
+class Task:
+    """A coarse-grained unit: setup + run on num_nodes slices."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: CommandOrGen = None,
+        envs: Optional[Dict[str, str]] = None,
+        workdir: Optional[str] = None,
+        num_nodes: int = 1,
+    ):
+        self.name = name
+        if name is not None and not _VALID_NAME_RE.match(name):
+            raise exceptions.InvalidTaskError(
+                f"Invalid task name {name!r}")
+        self.setup = setup
+        self.run = run
+        self.envs: Dict[str, str] = {
+            k: str(v) for k, v in (envs or {}).items()}
+        self.workdir = workdir
+        if num_nodes < 1:
+            raise exceptions.InvalidTaskError(
+                f"num_nodes must be >= 1, got {num_nodes}")
+        self.num_nodes = num_nodes
+        self.file_mounts: Dict[str, str] = {}
+        self.storage_mounts: Dict[str, Any] = {}  # path -> data.Storage
+        self.resources: Tuple[Resources, ...] = (Resources(),)
+        self.service: Optional[Any] = None        # serve.SkyServiceSpec
+        self.best_resources: Optional[Resources] = None
+        self.estimated_runtime_seconds: Optional[float] = None
+
+        # Auto-register with an ambient `with Dag():` block.
+        current = dag_lib.get_current_dag()
+        if current is not None:
+            current.add(self)
+
+    # ------------------------------------------------------------------
+    def set_resources(
+        self, resources: Union[Resources, Set[Resources],
+                               List[Resources], Tuple[Resources, ...]]
+    ) -> "Task":
+        if isinstance(resources, Resources):
+            resources = (resources,)
+        self.resources = tuple(resources)
+        if not self.resources:
+            raise exceptions.InvalidTaskError("Empty resources set")
+        return self
+
+    def set_file_mounts(self, mounts: Optional[Dict[str, str]]) -> "Task":
+        if mounts is None:
+            self.file_mounts = {}
+            return self
+        for dst, src in mounts.items():
+            if not isinstance(src, str):
+                raise exceptions.InvalidTaskError(
+                    f"file_mounts[{dst!r}] must be a path/URI string; use "
+                    f"set_storage_mounts for storage objects")
+        self.file_mounts = dict(mounts)
+        return self
+
+    def set_storage_mounts(self, mounts: Optional[Dict[str, Any]]) -> "Task":
+        self.storage_mounts = dict(mounts or {})
+        return self
+
+    def update_envs(self, envs: Dict[str, str]) -> "Task":
+        self.envs.update({k: str(v) for k, v in envs.items()})
+        return self
+
+    def set_time_estimator(
+            self, fn: Callable[[Resources], float]) -> "Task":
+        self._time_estimator = fn
+        return self
+
+    def estimate_runtime(self, resources: Resources) -> float:
+        fn = getattr(self, "_time_estimator", None)
+        if fn is None:
+            # Default 1 hour, matching the reference's assumption when no
+            # estimator is given (sky/optimizer.py:255-263).
+            return 3600.0
+        return float(fn(resources))
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any],
+                         env_overrides: Optional[Dict[str, str]] = None
+                         ) -> "Task":
+        schemas.validate_task(config)
+        envs = dict(config.get("envs") or {})
+        if env_overrides:
+            envs.update(env_overrides)
+        missing = [k for k, v in envs.items() if v is None]
+        if missing:
+            raise exceptions.InvalidTaskError(
+                f"Environment variable(s) {missing} need values; pass "
+                f"--env {missing[0]}=... or set a default in the YAML.")
+        task = cls(
+            name=config.get("name"),
+            setup=config.get("setup"),
+            run=config.get("run"),
+            envs=envs,
+            workdir=config.get("workdir"),
+            num_nodes=config.get("num_nodes", 1),
+        )
+
+        res_config = dict(config.get("resources") or {})
+        any_of = res_config.pop("any_of", None)
+        if any_of:
+            candidates = []
+            for override in any_of:
+                merged = {**res_config, **override}
+                candidates.append(Resources.from_yaml_config(merged))
+            task.set_resources(tuple(candidates))
+        else:
+            task.set_resources(Resources.from_yaml_config(res_config))
+
+        file_mounts: Dict[str, str] = {}
+        storage_specs: Dict[str, Dict] = {}
+        for dst, src in (config.get("file_mounts") or {}).items():
+            if isinstance(src, str):
+                file_mounts[dst] = src
+            else:
+                storage_specs[dst] = src
+        task.set_file_mounts(file_mounts)
+        if storage_specs:
+            from skypilot_tpu.data import storage as storage_lib
+            task.set_storage_mounts({
+                dst: storage_lib.Storage.from_yaml_config(spec)
+                for dst, spec in storage_specs.items()})
+
+        if config.get("service"):
+            from skypilot_tpu.serve import service_spec
+            task.service = service_spec.SkyServiceSpec.from_yaml_config(
+                config["service"])
+        return task
+
+    @classmethod
+    def from_yaml(cls, path: str,
+                  env_overrides: Optional[Dict[str, str]] = None) -> "Task":
+        with open(os.path.expanduser(path)) as f:
+            config = yaml.safe_load(f)
+        if not isinstance(config, dict):
+            raise exceptions.InvalidTaskError(
+                f"{path} does not contain a YAML mapping")
+        return cls.from_yaml_config(config, env_overrides)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.name:
+            out["name"] = self.name
+        if self.workdir:
+            out["workdir"] = self.workdir
+        if self.num_nodes != 1:
+            out["num_nodes"] = self.num_nodes
+        if len(self.resources) == 1:
+            res = self.resources[0].to_yaml_config()
+        else:
+            res = {"any_of": [r.to_yaml_config() for r in self.resources]}
+        if res:
+            out["resources"] = res
+        if self.envs:
+            out["envs"] = dict(self.envs)
+        mounts: Dict[str, Any] = dict(self.file_mounts)
+        for dst, store in self.storage_mounts.items():
+            mounts[dst] = store.to_yaml_config()
+        if mounts:
+            out["file_mounts"] = mounts
+        if self.setup:
+            out["setup"] = self.setup
+        if self.run is not None and isinstance(self.run, str):
+            out["run"] = self.run
+        if self.service is not None:
+            out["service"] = self.service.to_yaml_config()
+        return out
+
+    def to_yaml(self, path: str) -> None:
+        with open(os.path.expanduser(path), "w") as f:
+            yaml.safe_dump(self.to_yaml_config(), f, sort_keys=False)
+
+    # ------------------------------------------------------------------
+    def __rshift__(self, other: "Task") -> "Task":
+        current = dag_lib.get_current_dag()
+        if current is None:
+            raise exceptions.DagError(
+                "task_a >> task_b requires an active `with Dag():` block")
+        current.add_edge(self, other)
+        return other
+
+    def __repr__(self) -> str:
+        res = self.best_resources or (
+            self.resources[0] if len(self.resources) == 1
+            else f"{len(self.resources)} candidates")
+        n = f", num_nodes={self.num_nodes}" if self.num_nodes != 1 else ""
+        return f"Task({self.name or '<unnamed>'}: {res}{n})"

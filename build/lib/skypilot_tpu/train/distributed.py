@@ -1,0 +1,128 @@
+"""Multi-host bootstrap from the framework env contract.
+
+This is the TPU-native replacement for the reference's
+``torch.distributed.launch --master_addr=$MASTER_ADDR`` wiring (reference:
+examples/resnet_distributed_torch.yaml:20-26): recipes call
+``initialize_from_env()`` which reads the SKYPILOT_* variables the gang
+executor exports (agent/constants.py) and hands them to
+``jax.distributed.initialize`` — coordinator = head host, process_id = node
+rank. On a real TPU slice this federates every host's chips into one
+``jax.devices()`` view and all collectives ride ICI/DCN.
+
+On platforms whose XLA backend does not federate across processes (the CPU
+local provider used by the hermetic e2e tests), the coordination service
+still connects — barriers and the key-value store span processes — so this
+module also provides a small KV-based mean-allreduce used by recipes as the
+gradient-sync fallback. It is a *real* synchronous data-parallel step (all
+ranks exchange and average), just not an XLA collective.
+"""
+from __future__ import annotations
+
+import base64
+import dataclasses
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from skypilot_tpu.agent import constants
+
+
+@dataclasses.dataclass(frozen=True)
+class DistContext:
+    rank: int
+    num_nodes: int
+    coordinator: Optional[str]
+    initialized: bool      # jax.distributed.initialize was called
+    federated: bool        # jax.device_count() spans processes
+
+    @property
+    def is_multiprocess(self) -> bool:
+        return self.num_nodes > 1
+
+
+def initialize_from_env(timeout_ms: int = 120_000) -> DistContext:
+    """Read the env contract and bring up jax.distributed.
+
+    Single-node runs (or runs outside the framework) return an
+    uninitialized context and everything proceeds single-process.
+    """
+    rank = int(os.environ.get(constants.NODE_RANK, "0"))
+    num_nodes = int(os.environ.get(constants.NUM_NODES, "1"))
+    coordinator = os.environ.get(constants.COORDINATOR_ADDR)
+    if num_nodes <= 1 or not coordinator:
+        return DistContext(rank=rank, num_nodes=num_nodes,
+                           coordinator=coordinator, initialized=False,
+                           federated=False)
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_nodes,
+        process_id=rank,
+        initialization_timeout=max(1, timeout_ms // 1000))
+    federated = jax.device_count() > jax.local_device_count()
+    return DistContext(rank=rank, num_nodes=num_nodes,
+                       coordinator=coordinator, initialized=True,
+                       federated=federated)
+
+
+def make_mesh_from_env(ici_axes, dcn_axis: str = "dp"):
+    """Mesh for the launched topology: multi-slice (SKYPILOT_NUM_SLICES
+    > 1) gets a hybrid DCN x ICI mesh with `dcn_axis` crossing slices;
+    single-slice gets a plain ICI mesh. Call after
+    initialize_from_env()."""
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    num_slices = int(os.environ.get(constants.NUM_SLICES, "1"))
+    if num_slices > 1:
+        return mesh_lib.make_multislice_mesh(ici_axes, num_slices,
+                                             dcn_axis=dcn_axis)
+    return mesh_lib.make_mesh(dict(ici_axes))
+
+
+def _client():
+    from jax._src import distributed  # coordination-service client
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError("jax.distributed is not initialized")
+    return client
+
+
+def barrier(name: str, timeout_ms: int = 120_000) -> None:
+    """Cross-process barrier through the coordination service."""
+    _client().wait_at_barrier(name, timeout_ms)
+
+
+def kv_put(key: str, value: str) -> None:
+    _client().key_value_set(key, value, allow_overwrite=True)
+
+
+def kv_get(key: str, timeout_ms: int = 120_000) -> str:
+    return _client().blocking_key_value_get(key, timeout_ms)
+
+
+def kv_allreduce_mean(tree: Any, ctx: DistContext, tag: str,
+                      timeout_ms: int = 120_000) -> Any:
+    """Mean-allreduce a small pytree of arrays across processes via the
+    coordination KV store. Gradient-sync fallback for non-federated
+    platforms; O(bytes * num_nodes) through the coordinator, so only for
+    test-scale models — real TPU runs never hit this path (psum over ICI).
+    """
+    if not ctx.is_multiprocess:
+        return tree
+    leaves, treedef = jax.tree.flatten(tree)
+    flat = np.concatenate(
+        [np.asarray(x, dtype=np.float32).ravel() for x in leaves])
+    kv_put(f"ar/{tag}/{ctx.rank}",
+           base64.b64encode(flat.tobytes()).decode())
+    acc = np.zeros_like(flat)
+    for r in range(ctx.num_nodes):
+        buf = base64.b64decode(kv_get(f"ar/{tag}/{r}", timeout_ms))
+        acc += np.frombuffer(buf, dtype=np.float32)
+    acc /= ctx.num_nodes
+    out, off = [], 0
+    for x in leaves:
+        n = int(np.prod(np.shape(x)) or 1)
+        out.append(np.asarray(acc[off:off + n]).reshape(np.shape(x))
+                   .astype(np.asarray(x).dtype))
+        off += n
+    return jax.tree.unflatten(treedef, out)

@@ -1,0 +1,60 @@
+"""Canonical accelerator names.
+
+Reference analog: sky/utils/accelerator_registry.py
+(canonicalize_accelerator_name:75 — case/alias fixup against the catalog;
+is_schedulable_non_gpu_accelerator:67 — the "TPU is not a GPU" switch).
+Users write `V5E-8`, `v5e-8`, `tpu_v5e_8`, `TPU-v5litepod-8`; the
+framework plans over exactly one spelling: ``tpu-<gen>-<chips>``.
+"""
+from __future__ import annotations
+
+import difflib
+from typing import List, Optional
+
+from skypilot_tpu import exceptions
+
+_ALIASES = {
+    "v5litepod": "v5e",
+    "v5lite": "v5e",
+    "v6litepod": "v6e",
+}
+
+
+def _known_types() -> List[str]:
+    from skypilot_tpu import catalog
+    return sorted({row["accelerator"]
+                   for row in catalog.list_accelerators()})
+
+
+def canonicalize_accelerator_name(name: str) -> str:
+    """Normalize an accelerator spelling to its catalog form.
+
+    Raises InvalidTaskError with a did-you-mean suggestion for unknown
+    names; returns non-TPU names (future GPU support) untouched only if
+    the catalog knows them — today everything must resolve to a TPU.
+    """
+    raw = name
+    name = name.strip().lower().replace("_", "-")
+    if not name.startswith("tpu-"):
+        name = f"tpu-{name}"
+    parts = name.split("-")
+    # tpu-<gen>[-<chips>]; map marketing aliases onto catalog gens.
+    if len(parts) >= 2 and parts[1] in _ALIASES:
+        parts[1] = _ALIASES[parts[1]]
+        name = "-".join(parts)
+    from skypilot_tpu import catalog
+    try:
+        catalog.slice_info(name)  # full validation against the catalog
+        return name
+    except ValueError:
+        pass
+    suggestion = difflib.get_close_matches(name, _known_types(), n=1)
+    hint = f" Did you mean {suggestion[0]!r}?" if suggestion else ""
+    raise exceptions.InvalidTaskError(
+        f"Unknown accelerator {raw!r}.{hint}")
+
+
+def is_schedulable_non_gpu_accelerator(name: Optional[str]) -> bool:
+    """True for accelerators the gang scheduler treats as whole slices
+    rather than per-device GPUs (reference: the `tpu-` prefix switch)."""
+    return bool(name) and name.lower().startswith("tpu-")

@@ -1,0 +1,161 @@
+"""Shared plumbing for self-hosted jobs/serve controllers.
+
+Reference analog: sky/utils/controller_utils.py (Controllers:88 enum with
+name detection, get_controller_resources:384) plus the deployment pattern
+of sky/jobs/core.py:30 / templates/jobs-controller.yaml.j2: the control
+plane runs **on a launched controller cluster**, not on the client — a
+closed client laptop must not kill spot recovery.
+
+The client's SDK calls here resolve to three primitives:
+  * ensure_controller_up(kind)   — launch/reuse the controller cluster
+  * controller_handle(kind)      — passive lookup (None if absent)
+  * run_on_controller(...)       — execute a framework command on the
+    controller head with the controller's own isolated state dir
+    (STPU_HOME=$HOME/.stpu), returning parsed JSON.
+
+On the hermetic local provider the controller head is a directory +
+subprocess; on SSH providers the same commands run over the wheel-installed
+package. Controller resources come from config
+``{jobs,serve}.controller.resources`` (default: the local provider).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import shlex
+import sys
+from typing import Any, Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.status_lib import ClusterStatus
+
+
+class Controllers(enum.Enum):
+    JOBS = ("jobs", "stpu-jobs-controller")
+    SERVE = ("serve", "stpu-serve-controller")
+
+    @property
+    def config_key(self) -> str:
+        return self.value[0]
+
+    @property
+    def cluster_name(self) -> str:
+        return self.value[1]
+
+
+def controller_mode(kind: Controllers) -> str:
+    """'cluster' (self-hosted, default) or 'local' (controller processes on
+    the client — debugging and controller-logic unit tests)."""
+    return config_lib.get_nested(
+        (kind.config_key, "controller", "mode"), "cluster")
+
+
+def controller_resources(kind: Controllers) -> Resources:
+    spec = config_lib.get_nested(
+        (kind.config_key, "controller", "resources"), None)
+    if spec:
+        return Resources.from_yaml_config(dict(spec))
+    return Resources(cloud="local")
+
+
+def controller_handle(kind: Controllers) -> Optional[Any]:
+    """The controller cluster's handle if self-hosting is in effect and
+    the cluster is UP, else None. Never launches anything.
+
+    In 'local' mode this returns None even when a controller cluster
+    exists (e.g. left over from earlier cluster-mode use), so local-mode
+    jobs/services stay visible and cancellable on the client."""
+    if controller_mode(kind) == "local":
+        return None
+    record = global_user_state.get_cluster_from_name(kind.cluster_name)
+    if record is None or record["handle"] is None:
+        return None
+    if record["status"] != ClusterStatus.UP:
+        return None
+    return record["handle"]
+
+
+def ensure_controller_up(kind: Controllers) -> Any:
+    """Launch (or reuse/restart) the controller cluster; returns handle.
+
+    Reference: jobs-controller.yaml.j2 filled and launched by
+    sky/jobs/core.py:30. The init task is trivial — the cluster exists to
+    host controller processes submitted per managed job / service.
+    """
+    from skypilot_tpu import execution
+    from skypilot_tpu.task import Task
+
+    handle = controller_handle(kind)
+    if handle is not None:
+        return handle
+    task = Task(f"{kind.config_key}-controller-init", run="true")
+    task.set_resources(controller_resources(kind))
+    _, handle = execution.launch(task, cluster_name=kind.cluster_name,
+                                 detach_run=True, stream_logs=False)
+    return handle
+
+
+def _repo_root() -> str:
+    import skypilot_tpu
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(skypilot_tpu.__file__)))
+
+
+def _controller_python(handle) -> str:
+    """Interpreter for controller-side commands: the client's own
+    interpreter on the local provider (same machine), the wheel-installed
+    environment's python3 on SSH hosts (the client's sys.executable path
+    does not exist there)."""
+    if getattr(handle, "provider_name", None) == "local":
+        return sys.executable
+    return "python3"
+
+
+def controller_command(handle, argv: list) -> str:
+    """Wrap a framework command for execution on a controller host: state
+    isolated under the host's own $HOME, package importable (PYTHONPATH
+    covers the local provider; SSH hosts have the wheel installed)."""
+    inner = " ".join(shlex.quote(a) for a in argv)
+    return (f'export STPU_HOME="$HOME/.stpu"; '
+            f'export PYTHONPATH={shlex.quote(_repo_root())}:"$PYTHONPATH"; '
+            f"{inner}")
+
+
+def run_on_controller(handle, module_argv: list, *,
+                      parse_json: bool = True,
+                      stream: bool = False) -> Any:
+    """Run `python -m <module> ...` on the controller head.
+
+    `module_argv` is [module, *args] (see module_command). With
+    parse_json, the command's stdout must be a JSON document (the
+    framework's remote-RPC convention — reference: codegen strings over
+    SSH, sky/skylet/job_lib.py:803)."""
+    runner = handle.get_command_runners()[0]
+    argv = [_controller_python(handle), "-m", *module_argv]
+    cmd = controller_command(handle, argv)
+    if stream:
+        return runner.run(cmd, stream_logs=True)
+    rc, out, err = runner.run(cmd, require_outputs=True)
+    if rc != 0:
+        raise exceptions.CommandError(
+            rc, f"controller command {module_argv}", f"{out}\n{err}")
+    if not parse_json:
+        return out
+    try:
+        # Tolerate stray warnings above the payload: parse the last line.
+        payload = out.strip().splitlines()[-1]
+        return json.loads(payload)
+    except (json.JSONDecodeError, IndexError) as e:
+        raise exceptions.SkyTpuError(
+            f"Controller returned non-JSON output: {out!r} "
+            f"(stderr: {err!r})") from e
+
+
+def module_command(module: str, *args: str) -> list:
+    """[module, *args] for run_on_controller (interpreter resolved
+    per-provider there)."""
+    return [module, *args]

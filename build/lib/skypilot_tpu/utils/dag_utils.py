@@ -1,0 +1,70 @@
+"""Dag ↔ YAML: multi-document YAML for chain DAGs.
+
+Reference analog: sky/utils/dag_utils.py (load_chain_dag_from_yaml /
+dump_chain_dag_to_yaml). Format: first document is ``{name: <dag name>}``,
+each following document is one task's YAML config, in chain order.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Union
+
+import yaml
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu.task import Task
+
+
+def convert_entrypoint_to_dag(
+        entrypoint: Union[Task, dag_lib.Dag]) -> dag_lib.Dag:
+    if isinstance(entrypoint, dag_lib.Dag):
+        return entrypoint
+    dag = dag_lib.Dag(name=entrypoint.name)
+    dag.add(entrypoint)
+    return dag
+
+
+def dump_chain_dag_to_yaml_str(dag: dag_lib.Dag) -> str:
+    if not dag.is_chain():
+        raise exceptions.DagError(
+            "Only chain DAGs can be serialized for managed jobs.")
+    docs: List[Dict] = [{"name": dag.name}]
+    for task in dag.topo_order():
+        docs.append(task.to_yaml_config())
+    return yaml.safe_dump_all(docs, default_flow_style=False,
+                              sort_keys=False)
+
+
+def dump_chain_dag_to_yaml(dag: dag_lib.Dag, path: str) -> None:
+    with open(os.path.expanduser(path), "w") as f:
+        f.write(dump_chain_dag_to_yaml_str(dag))
+
+
+def load_chain_dag_from_yaml(
+        path: str,
+        env_overrides: Optional[Dict[str, str]] = None) -> dag_lib.Dag:
+    with open(os.path.expanduser(path)) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d is not None]
+    if not docs:
+        raise exceptions.InvalidTaskError(f"{path} is empty")
+    for doc in docs:
+        if not isinstance(doc, dict):
+            raise exceptions.InvalidTaskError(
+                f"{path}: every YAML document must be a mapping, "
+                f"got {type(doc).__name__}")
+    dag_name = None
+    if set(docs[0].keys()) <= {"name"}:
+        dag_name = docs[0].get("name")
+        docs = docs[1:]
+    if not docs:  # a bare `name:` document is a single empty task
+        docs = [{}]
+    dag = dag_lib.Dag(name=dag_name)
+    prev = None
+    for config in docs:
+        task = Task.from_yaml_config(config or {}, env_overrides)
+        dag.add(task)
+        if prev is not None:
+            dag.add_edge(prev, task)
+        prev = task
+    return dag

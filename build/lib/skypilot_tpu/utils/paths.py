@@ -1,0 +1,55 @@
+"""Client-side state layout under ~/.stpu (overridable via STPU_HOME).
+
+Reference analog: ~/.sky/{state.db,config.yaml,generated/,wheels/} --
+sky/global_user_state.py:30, sky/backends/backend_utils.py:751.
+"""
+import functools
+import os
+import pathlib
+
+
+@functools.lru_cache(maxsize=None)
+def _home() -> pathlib.Path:
+    root = pathlib.Path(os.environ.get("STPU_HOME", "~/.stpu")).expanduser()
+    root.mkdir(parents=True, exist_ok=True)
+    return root
+
+
+def reset_for_tests() -> None:
+    _home.cache_clear()
+
+
+def home() -> pathlib.Path:
+    return _home()
+
+
+def state_db_path() -> pathlib.Path:
+    return _home() / "state.db"
+
+
+def config_path() -> pathlib.Path:
+    return _home() / "config.yaml"
+
+
+def generated_dir() -> pathlib.Path:
+    d = _home() / "generated"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def logs_dir() -> pathlib.Path:
+    d = _home() / "logs"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def locks_dir() -> pathlib.Path:
+    d = _home() / "locks"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def benchmark_dir() -> pathlib.Path:
+    d = _home() / "benchmarks"
+    d.mkdir(parents=True, exist_ok=True)
+    return d

@@ -1,0 +1,189 @@
+"""JSON-schema validation for task YAML / service spec / user config.
+
+Reference analog: sky/utils/schemas.py (905 LoC of hand-built jsonschema
+dicts validated on every Task.from_yaml_config). Kept to the fields this
+framework implements; validation errors surface the YAML path.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jsonschema
+
+from skypilot_tpu import exceptions
+
+_RESOURCES_SCHEMA = {
+    "type": "object",
+    "additionalProperties": False,
+    "properties": {
+        "cloud": {"type": "string"},
+        "accelerator": {"type": "string"},
+        "accelerators": {
+            "anyOf": [{"type": "string"},
+                      {"type": "object",
+                       "additionalProperties": {"type": "integer"}}],
+        },
+        "instance_type": {"type": "string"},
+        "cpus": {"anyOf": [{"type": "integer"}, {"type": "string"}]},
+        "memory": {"anyOf": [{"type": "number"}, {"type": "string"}]},
+        "region": {"type": "string"},
+        "zone": {"type": "string"},
+        "use_spot": {"type": "boolean"},
+        "spot_recovery": {"type": "string"},
+        "job_recovery": {"type": "string"},
+        "disk_size": {"type": "integer"},
+        "image_id": {"type": "string"},
+        "runtime_version": {"type": "string"},
+        "autostop": {"anyOf": [{"type": "integer"}, {"type": "boolean"}]},
+        "ports": {
+            "anyOf": [{"type": "integer"}, {"type": "string"},
+                      {"type": "array",
+                       "items": {"anyOf": [{"type": "integer"},
+                                           {"type": "string"}]}}],
+        },
+        "labels": {"type": "object",
+                   "additionalProperties": {"type": "string"}},
+        "any_of": {"type": "array", "items": {"type": "object"}},
+    },
+}
+
+_STORAGE_SCHEMA = {
+    "type": "object",
+    "additionalProperties": False,
+    "properties": {
+        "name": {"type": "string"},
+        "source": {"anyOf": [{"type": "string"},
+                             {"type": "array",
+                              "items": {"type": "string"}}]},
+        "store": {"type": "string", "enum": ["gcs", "s3", "local"]},
+        "persistent": {"type": "boolean"},
+        "mode": {"type": "string", "enum": ["MOUNT", "COPY"]},
+    },
+}
+
+_SERVICE_SCHEMA = {
+    "type": "object",
+    "additionalProperties": False,
+    "required": ["readiness_probe"],
+    "properties": {
+        "readiness_probe": {
+            "anyOf": [
+                {"type": "string"},
+                {"type": "object",
+                 "additionalProperties": False,
+                 "properties": {
+                     "path": {"type": "string"},
+                     "initial_delay_seconds": {"type": "integer"},
+                     "post_data": {"type": ["object", "string"]},
+                 }},
+            ],
+        },
+        "replicas": {"type": "integer"},
+        "replica_policy": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {
+                "min_replicas": {"type": "integer"},
+                "max_replicas": {"type": "integer"},
+                "target_qps_per_replica": {"type": "number"},
+                "qps_window_seconds": {"type": "integer"},
+                "upscale_delay_seconds": {"type": "integer"},
+                "downscale_delay_seconds": {"type": "integer"},
+                "base_ondemand_fallback_replicas": {"type": "integer"},
+            },
+        },
+    },
+}
+
+TASK_SCHEMA = {
+    "type": "object",
+    "additionalProperties": False,
+    "properties": {
+        "name": {"type": "string"},
+        "workdir": {"type": "string"},
+        "num_nodes": {"type": "integer", "minimum": 1},
+        "setup": {"type": "string"},
+        "run": {"type": "string"},
+        "envs": {"type": "object",
+                 "additionalProperties": {
+                     "anyOf": [{"type": "string"}, {"type": "number"},
+                               {"type": "null"}]}},
+        "file_mounts": {
+            "type": "object",
+            "additionalProperties": {
+                "anyOf": [{"type": "string"}, _STORAGE_SCHEMA],
+            },
+        },
+        "resources": _RESOURCES_SCHEMA,
+        "service": _SERVICE_SCHEMA,
+    },
+}
+
+CONFIG_SCHEMA = {
+    "type": "object",
+    "additionalProperties": False,
+    "properties": {
+        "gcp": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {
+                "project_id": {"type": "string"},
+                "vpc_name": {"type": "string"},
+                "use_internal_ips": {"type": "boolean"},
+                "ssh_proxy_command": {"type": "string"},
+            },
+        },
+        "jobs": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {
+                "controller": {
+                    "type": "object",
+                    "properties": {
+                        "resources": _RESOURCES_SCHEMA,
+                        "mode": {"enum": ["cluster", "local"]},
+                    },
+                },
+            },
+        },
+        "serve": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {
+                "controller": {
+                    "type": "object",
+                    "properties": {
+                        "resources": _RESOURCES_SCHEMA,
+                        "mode": {"enum": ["cluster", "local"]},
+                    },
+                },
+            },
+        },
+    },
+}
+
+
+def _validate(config: Dict[str, Any], schema: Dict[str, Any],
+              what: str) -> None:
+    try:
+        jsonschema.validate(config, schema)
+    except jsonschema.ValidationError as e:
+        path = ".".join(str(p) for p in e.absolute_path) or "<root>"
+        raise exceptions.InvalidTaskError(
+            f"Invalid {what} at {path!r}: {e.message}") from e
+
+
+def validate_task(config: Dict[str, Any]) -> None:
+    _validate(config, TASK_SCHEMA, "task YAML")
+
+
+def validate_resources(config: Dict[str, Any]) -> None:
+    _validate(config, _RESOURCES_SCHEMA, "resources")
+
+
+def validate_service(config: Dict[str, Any]) -> None:
+    _validate(config, _SERVICE_SCHEMA, "service spec")
+
+
+def validate_config(config: Dict[str, Any]) -> None:
+    _validate(config, CONFIG_SCHEMA, "config")

@@ -1,0 +1,100 @@
+"""SSH-config management: `ssh <cluster>` just works after a launch.
+
+Reference analog: sky/backends/backend_utils.py SSHConfigHelper:398 — per-
+cluster Host blocks written under a framework dir, pulled into the user's
+~/.ssh/config via one managed Include line. Host aliases: `<cluster>` is
+the head host, `<cluster>-<rank>` each worker.
+
+Only SSH-reachable clusters get entries (the local provider's hosts are
+directories, not sshd's).
+"""
+from __future__ import annotations
+
+import os
+import pathlib
+import re
+from typing import Optional
+
+_INCLUDE_MARK = "# Added by skypilot_tpu (stpu)"
+
+
+def _ssh_dir() -> pathlib.Path:
+    from skypilot_tpu.utils import paths
+    d = paths.home() / "ssh"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _user_ssh_config() -> pathlib.Path:
+    return pathlib.Path(
+        os.environ.get("STPU_SSH_CONFIG", "~/.ssh/config")).expanduser()
+
+
+def _ensure_include() -> None:
+    """Prepend `Include <stpu ssh dir>/*` to the user ssh config once.
+    Must be at the top: OpenSSH only allows Include before the first
+    Host/Match block to apply globally."""
+    cfg = _user_ssh_config()
+    include = f"Include {_ssh_dir()}/*"
+    if cfg.exists():
+        content = cfg.read_text()
+        if include in content:
+            return
+        new = f"{_INCLUDE_MARK}\n{include}\n\n{content}"
+    else:
+        cfg.parent.mkdir(parents=True, exist_ok=True)
+        new = f"{_INCLUDE_MARK}\n{include}\n"
+    cfg.write_text(new)
+    cfg.chmod(0o600)
+
+
+def add_cluster(handle) -> None:
+    """Write Host blocks for every SSH-reachable host of the cluster."""
+    info = handle.cluster_info
+    instances = info.ordered_instances()
+    blocks = []
+    for rank, inst in enumerate(instances):
+        ip = inst.external_ip or inst.internal_ip
+        if not ip or ip == "127.0.0.1":
+            continue  # local-provider pseudo-host
+        alias = (handle.cluster_name if rank == 0
+                 else f"{handle.cluster_name}-{rank}")
+        lines = [
+            f"Host {alias}",
+            f"  HostName {ip}",
+            f"  User {getattr(info, 'ssh_user', None) or 'root'}",
+            f"  IdentityFile "
+            f"{getattr(info, 'ssh_key_path', None) or '~/.ssh/id_rsa'}",
+            "  IdentitiesOnly yes",
+            "  StrictHostKeyChecking no",
+            "  UserKnownHostsFile /dev/null",
+            "  LogLevel ERROR",
+        ]
+        port = getattr(inst, "ssh_port", None)
+        if port and port != 22:
+            lines.append(f"  Port {port}")
+        proxy = (info.provider_config or {}).get("ssh_proxy_command")
+        if proxy:
+            lines.append(f"  ProxyCommand {proxy}")
+        blocks.append("\n".join(lines))
+    if not blocks:
+        return
+    (_ssh_dir() / _safe(handle.cluster_name)).write_text(
+        "\n\n".join(blocks) + "\n")
+    _ensure_include()
+
+
+def remove_cluster(cluster_name: str) -> None:
+    try:
+        (_ssh_dir() / _safe(cluster_name)).unlink()
+    except FileNotFoundError:
+        pass
+
+
+def _safe(name: str) -> str:
+    return re.sub(r"[^A-Za-z0-9._-]", "_", name)
+
+
+def cluster_config_path(cluster_name: str) -> Optional[pathlib.Path]:
+    p = _ssh_dir() / _safe(cluster_name)
+    return p if p.exists() else None

@@ -1,0 +1,83 @@
+"""Chrome trace-event profiling of client-side operations.
+
+Reference analog: sky/utils/timeline.py (Event:21, @timeline.event
+decorator :73, dump-at-exit gated on env). Enable by setting
+``STPU_TIMELINE_FILE`` to an output path; open the result in
+chrome://tracing or Perfetto.
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_events: List[Dict[str, Any]] = []
+_lock = threading.Lock()
+_registered = False
+
+
+def _enabled() -> Optional[str]:
+    return os.environ.get("STPU_TIMELINE_FILE")
+
+
+class Event:
+    """Records a complete (ph=X) trace event around a with-block."""
+
+    def __init__(self, name: str, message: Optional[str] = None):
+        self._name = name
+        self._message = message
+        self._start = 0.0
+
+    def __enter__(self) -> "Event":
+        self._start = time.time()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if _enabled() is None:
+            return
+        event = {
+            "name": self._name,
+            "cat": "stpu",
+            "ph": "X",
+            "ts": self._start * 1e6,
+            "dur": (time.time() - self._start) * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+        }
+        if self._message:
+            event["args"] = {"message": self._message}
+        global _registered
+        with _lock:
+            _events.append(event)
+            if not _registered:
+                atexit.register(save)
+                _registered = True
+
+
+def event(fn: Callable = None, *, name: Optional[str] = None) -> Callable:
+    """Decorator recording fn's wall time as a trace event."""
+    def decorator(func):
+        event_name = name or f"{func.__module__}.{func.__qualname__}"
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            with Event(event_name):
+                return func(*args, **kwargs)
+        return wrapper
+    if fn is not None:
+        return decorator(fn)
+    return decorator
+
+
+def save() -> None:
+    path = _enabled()
+    if path is None:
+        return
+    with _lock:
+        payload = {"traceEvents": list(_events)}
+    with open(os.path.expanduser(path), "w") as f:
+        json.dump(payload, f)

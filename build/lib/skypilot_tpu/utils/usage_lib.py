@@ -1,0 +1,85 @@
+"""Usage telemetry: local, append-only entrypoint records.
+
+Reference analog: sky/usage/usage_lib.py (UsageMessageToReport schema,
+the `entrypoint` decorator on every SDK call, yaml redaction, opt-out
+env). Difference by design: the reference fire-and-forgets to a hosted
+Loki; this framework records to a local JSONL
+(``~/.stpu/usage/usage.jsonl``) and never phones home — an operator who
+wants central collection tails that file. Opt out entirely with
+``STPU_DISABLE_USAGE_COLLECTION=1``.
+"""
+from __future__ import annotations
+
+import functools
+import getpass
+import hashlib
+import json
+import os
+import time
+import uuid
+from typing import Any, Callable
+
+DISABLE_ENV = "STPU_DISABLE_USAGE_COLLECTION"
+
+_run_id = uuid.uuid4().hex[:12]
+
+
+def _enabled() -> bool:
+    return os.environ.get(DISABLE_ENV, "0") != "1"
+
+
+def _user_hash() -> str:
+    try:
+        user = getpass.getuser()
+    except (KeyError, OSError):
+        # No passwd entry / no USER env (bare-UID containers).
+        user = f"uid-{os.getuid()}"
+    return hashlib.md5(user.encode()).hexdigest()[:8]
+
+
+def user_identity() -> str:
+    """Stable identity for cluster ownership checks (reference:
+    check_owner_identity, sky/backends/backend_utils.py:1536)."""
+    return _user_hash()
+
+
+def _record(payload: dict) -> None:
+    from skypilot_tpu.utils import paths
+    usage_dir = paths.home() / "usage"
+    usage_dir.mkdir(parents=True, exist_ok=True)
+    with open(usage_dir / "usage.jsonl", "a") as f:
+        f.write(json.dumps(payload) + "\n")
+
+
+def entrypoint(fn: Callable) -> Callable:
+    """Record one line per SDK entrypoint call: name, duration, outcome.
+    Arguments are NOT recorded (no YAML/env contents — stricter than the
+    reference's redaction, same spirit)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args: Any, **kwargs: Any) -> Any:
+        if not _enabled():
+            return fn(*args, **kwargs)
+        t0 = time.time()
+        outcome, exc_type = "ok", None
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as e:
+            outcome = "error"
+            exc_type = type(e).__name__
+            raise
+        finally:
+            try:
+                _record({
+                    "ts": t0,
+                    "run_id": _run_id,
+                    "user": _user_hash(),
+                    "entrypoint": fn.__qualname__,
+                    "duration_seconds": round(time.time() - t0, 3),
+                    "outcome": outcome,
+                    "exception": exc_type,
+                })
+            except OSError:
+                pass  # usage recording must never break the call
+
+    return wrapper

@@ -84,6 +84,15 @@ def test_serve_llm_endpoints():
                             ).encode())
         out = json.loads(urllib.request.urlopen(req, timeout=120).read())
         assert len(out["tokens"]) == 4
+        # Sampling path: valid token ids, seeded deterministically.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"prompt": [1, 2, 3], "max_tokens": 4,
+                             "temperature": 0.8, "seed": 7}).encode())
+        out1 = json.loads(urllib.request.urlopen(req, timeout=120).read())
+        out2 = json.loads(urllib.request.urlopen(req, timeout=120).read())
+        assert out1["tokens"] == out2["tokens"]
+        assert all(0 <= t < cfg.vocab_size for t in out1["tokens"])
         # Bad request -> 400, not a crash.
         req = urllib.request.Request(
             f"http://127.0.0.1:{port}/generate", data=b'{"nope": 1}')
