@@ -485,6 +485,22 @@ def serve_up(entrypoint, service_name, env):
     click.echo(f"Service {name} starting; endpoint: {endpoint}")
 
 
+@serve.command(name="update")
+@click.argument("service_name", required=True)
+@click.argument("entrypoint", required=True)
+@click.option("--env", multiple=True, help="KEY=VALUE env overrides.")
+def serve_update(service_name, entrypoint, env):
+    """Roll a running service to a new task YAML revision (no downtime:
+    new replicas come READY before old ones are drained)."""
+    from skypilot_tpu.serve import core as serve_core
+    task = _load_task(entrypoint, env, {})
+    try:
+        version = serve_core.update(task, service_name)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f"Service {service_name} rolling to version {version}.")
+
+
 @serve.command(name="down")
 @click.argument("service_names", nargs=-1)
 @click.option("--all", "-a", "all_services", is_flag=True)

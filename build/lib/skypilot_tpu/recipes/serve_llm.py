@@ -101,7 +101,9 @@ class _Handler(BaseHTTPRequestHandler):
             temperature = round(
                 max(0.0, min(float(req.get("temperature", 0.0)), 2.0)),
                 1)
-            seed = int(req.get("seed", 0))
+            # Mask to uint32 range: any int is a valid seed, and an
+            # out-of-range value must not escape the 400 contract.
+            seed = int(req.get("seed", 0)) & 0xFFFFFFFF
             ctx = self.server_ctx
             s = len(prompt)
             s_pad = _ceil_to(s, PROMPT_BUCKET)

@@ -37,6 +37,10 @@ class SkyServeController:
         self.recorder = recorder
         self._stop = False
         self._was_ready = False
+        self.version = 1
+        # Outdated replicas pulled from the LB last tick; terminated next
+        # tick so in-flight requests drain before the server dies.
+        self._draining: set = set()
 
     def stop(self) -> None:
         self._stop = True
@@ -61,20 +65,65 @@ class SkyServeController:
     # launching replacements.
     MAX_CONSECUTIVE_REPLICA_FAILURES = 3
 
+    def _check_update(self) -> None:
+        """Adopt a new revision registered by `stpu serve update`
+        (reference: update_version, sky/serve/replica_managers.py:1167).
+        New replicas launch from the new task; old ones are drained by
+        the rollover logic in _tick once replacements are READY."""
+        row = serve_state.get_service(self.service_name)
+        if row is None or row.get("version", 1) <= self.version:
+            return
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        from skypilot_tpu.task import Task
+        try:
+            task = Task.from_yaml(row["task_yaml_path"])
+            spec = (task.service or
+                    SkyServiceSpec.from_yaml_config(
+                        {"readiness_probe": "/",
+                         **row.get("spec", {})}))
+        except Exception as e:  # noqa: BLE001 — bad update must not
+            # Record the failure where `serve status` surfaces it; keep
+            # serving the running revision and don't retry the broken
+            # one every tick.
+            serve_state.set_update_error(
+                self.service_name,
+                f"revision v{row['version']} failed to load: {e!r}; "
+                f"still serving v{self.version}")
+            self.version = row["version"]
+            return
+        serve_state.set_update_error(self.service_name, None)
+        self.version = row["version"]
+        self.replica_manager.apply_update(self.version, spec, task)
+        self.spec = spec
+        self.autoscaler = autoscalers.Autoscaler.from_spec(spec)
+
     def _tick(self) -> None:
         rm = self.replica_manager
+        self._check_update()
         rm.probe_all()
         self.autoscaler.collect_request_information(self.recorder.drain())
         target = self.autoscaler.evaluate_scaling().target_num_replicas
         given_up = (rm.consecutive_failure_count >=
                     self.MAX_CONSECUTIVE_REPLICA_FAILURES)
-        alive = rm.alive_count()
-        if alive < target and not given_up:
-            rm.scale_up(target - alive)
-        elif alive > target:
-            for rid in rm.scale_down_candidates()[:alive - target]:
+        # Rolling update: bring CURRENT-version capacity to target (old
+        # replicas keep serving as surge), then roll outdated replicas
+        # out in two phases — pulled from the LB one tick, terminated the
+        # next — so availability never dips and in-flight requests drain.
+        alive_current = rm.alive_current_count()
+        if alive_current < target and not given_up:
+            rm.scale_up(target - alive_current)
+        elif alive_current > target:
+            for rid in rm.scale_down_candidates()[
+                    :alive_current - target]:
                 rm.scale_down(rid)
-        ready = rm.ready_urls()
+        outdated = set(rm.outdated_alive_ids())
+        if rm.ready_current_count() >= target:
+            for rid in outdated & self._draining:
+                rm.scale_down(rid)
+            self._draining = outdated
+        else:
+            self._draining = set()
+        ready = rm.ready_urls(exclude_ids=self._draining)
         self.policy.set_ready_replicas(ready)
         self._publish_status(ready, given_up)
 

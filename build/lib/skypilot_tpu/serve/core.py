@@ -116,6 +116,63 @@ def _up_local(task: Task, service_name: str) -> Tuple[str, str]:
     return service_name, f"http://127.0.0.1:{lb_port}"
 
 
+def update(task: Task, service_name: str,
+           controller: Optional[str] = None) -> int:
+    """Register a new revision of a running service; the controller
+    rolls replicas over to it with no availability dip (reference:
+    sky serve update / update_version:1167). Returns the new version."""
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            "Task YAML needs a `service:` section for `serve update`.")
+    mode = controller or controller_utils.controller_mode(_SERVE)
+    if mode == "local":
+        return _update_local(task, service_name)
+    handle = _proxy()
+    if handle is None:
+        raise exceptions.SkyTpuError(
+            f"No serve controller cluster; is {service_name!r} up?")
+    serve_dir = paths.generated_dir() / "serve"
+    serve_dir.mkdir(parents=True, exist_ok=True)
+    stamp = f"{service_name}-update-{int(time.time()*1000)}"
+    local_yaml = serve_dir / f"{stamp}.yaml"
+    task.to_yaml(str(local_yaml))
+    inbox = f"~/.stpu/serve_inbox/{stamp}.yaml"
+    runner = handle.get_command_runners()[0]
+    runner.run("mkdir -p ~/.stpu/serve_inbox")
+    runner.rsync(str(local_yaml), inbox, up=True)
+    out = controller_utils.run_on_controller(
+        handle, controller_utils.module_command(
+            "skypilot_tpu.serve.core", "update", "--task-yaml", inbox,
+            "--service-name", service_name))
+    if "error" in out:
+        raise exceptions.SkyTpuError(out["error"])
+    return int(out["version"])
+
+
+def _update_local(task: Task, service_name: str) -> int:
+    """Register the new revision on *this* host (controller-side)."""
+    row = serve_state.get_service(service_name)
+    if row is None:
+        raise exceptions.SkyTpuError(
+            f"Service {service_name!r} not found.")
+    serve_dir = paths.generated_dir() / "serve"
+    serve_dir.mkdir(parents=True, exist_ok=True)
+    # A fresh uniquely-named file per revision: the controller re-reads
+    # task_yaml_path on version bump, so never rewrite a file it may be
+    # reading (and concurrent updates must not collide).
+    new_yaml = serve_dir / (
+        f"{service_name}-update-{int(time.time()*1000)}-"
+        f"{os.getpid()}.yaml")
+    task.to_yaml(str(new_yaml))
+    version = serve_state.bump_service_version(
+        service_name, json.dumps(task.service.to_yaml_config()),
+        str(new_yaml))
+    if version is None:
+        raise exceptions.SkyTpuError(
+            f"Service {service_name!r} disappeared during update.")
+    return version
+
+
 def down(service_names: Optional[List[str]] = None,
          all_services: bool = False, timeout: float = 60.0) -> List[str]:
     """Tear down service(s): signal the controller and wait for it to
@@ -243,6 +300,10 @@ def main() -> None:
     p.add_argument("--task-yaml", required=True)
     p.add_argument("--service-name", required=True)
 
+    p = sub.add_parser("update")
+    p.add_argument("--task-yaml", required=True)
+    p.add_argument("--service-name", required=True)
+
     p = sub.add_parser("dump")
     p.add_argument("--names", default=None)
 
@@ -261,6 +322,14 @@ def main() -> None:
             return
         lb_port = int(endpoint.rsplit(":", 1)[1])
         print(json.dumps({"service_name": name, "lb_port": lb_port}))
+    elif args.cmd == "update":
+        task = Task.from_yaml(os.path.expanduser(args.task_yaml))
+        try:
+            version = _update_local(task, args.service_name)
+        except exceptions.SkyTpuError as e:
+            print(json.dumps({"error": str(e)}))
+            return
+        print(json.dumps({"version": version}))
     elif args.cmd == "dump":
         names = args.names.split(",") if args.names else None
         # _status_local normalizes enum statuses to strings.

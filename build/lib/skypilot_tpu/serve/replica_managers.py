@@ -41,10 +41,16 @@ def _free_port() -> int:
 
 
 class ReplicaInfo:
-    def __init__(self, replica_id: int, cluster_name: str, port: int):
+    def __init__(self, replica_id: int, cluster_name: str, port: int,
+                 version: int = 1,
+                 spec: Optional[SkyServiceSpec] = None):
         self.replica_id = replica_id
         self.cluster_name = cluster_name
         self.port = port
+        self.version = version
+        # The spec THIS replica was launched under: a rolling update must
+        # keep probing old replicas with their own readiness contract.
+        self.spec = spec
         self.status = ReplicaStatus.PENDING
         self.url: Optional[str] = None
         self.launched_at = time.time()
@@ -60,6 +66,7 @@ class SkyPilotReplicaManager:
         self.service_name = service_name
         self.spec = spec
         self.task = task
+        self.version = 1
         self.replicas: Dict[int, ReplicaInfo] = {}
         self._lock = threading.RLock()
         self._next_replica_id = 1
@@ -84,7 +91,8 @@ class SkyPilotReplicaManager:
                     port = int(next(iter(self.task.resources)).ports[0])
                 else:
                     port = 8080
-                info = ReplicaInfo(replica_id, cluster_name, port)
+                info = ReplicaInfo(replica_id, cluster_name, port,
+                                   version=self.version, spec=self.spec)
                 self.replicas[replica_id] = info
             self._persist(info)
             t = threading.Thread(target=self._launch_replica,
@@ -195,7 +203,8 @@ class SkyPilotReplicaManager:
             t.join(timeout=PROBE_TIMEOUT_SECONDS + 2)
 
     def _probe_one(self, info: ReplicaInfo) -> None:
-        ok = self._http_probe(info.url)
+        spec = info.spec or self.spec
+        ok = self._http_probe(info.url, spec)
         if ok:
             info.consecutive_failures = 0
             self.consecutive_failure_count = 0
@@ -208,7 +217,7 @@ class SkyPilotReplicaManager:
         # Not answering. Within the initial grace window this is normal.
         if (info.first_ready_at is None and
                 time.time() - info.launched_at <
-                self.spec.initial_delay_seconds):
+                spec.initial_delay_seconds):
             return
         info.consecutive_failures += 1
         if info.consecutive_failures < _MAX_CONSECUTIVE_FAILURES:
@@ -231,13 +240,15 @@ class SkyPilotReplicaManager:
             # controller's reconcile loop launches a replacement.
             self.scale_down(info.replica_id)
 
-    def _http_probe(self, url: Optional[str]) -> bool:
+    def _http_probe(self, url: Optional[str],
+                    spec: Optional[SkyServiceSpec] = None) -> bool:
+        spec = spec or self.spec
         if url is None:
             return False
-        full = url.rstrip("/") + self.spec.readiness_path
+        full = url.rstrip("/") + spec.readiness_path
         try:
-            if self.spec.readiness_post_data is not None:
-                data = json.dumps(self.spec.readiness_post_data).encode()
+            if spec.readiness_post_data is not None:
+                data = json.dumps(spec.readiness_post_data).encode()
                 req = urllib.request.Request(
                     full, data=data,
                     headers={"Content-Type": "application/json"})
@@ -265,28 +276,58 @@ class SkyPilotReplicaManager:
                 set(statuses.values()) == {"running"})
 
     # ------------------------------------------------------------ queries
-    def ready_urls(self) -> List[str]:
+    def ready_urls(self, exclude_ids=()) -> List[str]:
         with self._lock:
             return [info.url for info in self.replicas.values()
-                    if info.status == ReplicaStatus.READY and info.url]
-
-    def alive_count(self) -> int:
-        with self._lock:
-            return sum(1 for info in self.replicas.values()
-                       if info.status.is_alive())
+                    if info.status == ReplicaStatus.READY and info.url
+                    and info.replica_id not in exclude_ids]
 
     def status_snapshot(self) -> List[ReplicaStatus]:
         with self._lock:
             return [info.status for info in self.replicas.values()]
 
     def scale_down_candidates(self) -> List[int]:
-        """Prefer killing not-yet-ready replicas, then newest first."""
+        """Surplus trim for the autoscaler: CURRENT-version replicas
+        only (outdated ones are the rollover's job — killing a READY old
+        replica because new capacity over-provisioned would dip
+        availability mid-update). Prefer not-yet-ready, then newest."""
         with self._lock:
             alive = [info for info in self.replicas.values()
-                     if info.status.is_alive()]
+                     if info.status.is_alive()
+                     and info.version >= self.version]
         alive.sort(key=lambda i: (i.status == ReplicaStatus.READY,
                                   -i.replica_id))
         return [i.replica_id for i in alive]
+
+    # ------------------------------------------------------------ updates
+    def apply_update(self, version: int, spec: SkyServiceSpec,
+                     task) -> None:
+        """Adopt a new revision: replicas launched from now on carry it;
+        the controller's rollover logic drains the old ones."""
+        with self._lock:
+            self.version = version
+            self.spec = spec
+            self.task = task
+
+    def alive_current_count(self) -> int:
+        with self._lock:
+            return sum(1 for info in self.replicas.values()
+                       if info.status.is_alive()
+                       and info.version >= self.version)
+
+    def ready_current_count(self) -> int:
+        with self._lock:
+            return sum(1 for info in self.replicas.values()
+                       if info.status == ReplicaStatus.READY
+                       and info.version >= self.version)
+
+    def outdated_alive_ids(self) -> List[int]:
+        with self._lock:
+            out = [info for info in self.replicas.values()
+                   if info.status.is_alive()
+                   and info.version < self.version]
+        out.sort(key=lambda i: i.replica_id)
+        return [i.replica_id for i in out]
 
     def _persist(self, info: ReplicaInfo) -> None:
         # Membership check + upsert under one lock hold (RLock): a
@@ -297,4 +338,4 @@ class SkyPilotReplicaManager:
                 return
             serve_state.upsert_replica(self.service_name, info.replica_id,
                                        info.cluster_name, info.status,
-                                       info.url)
+                                       info.url, version=info.version)

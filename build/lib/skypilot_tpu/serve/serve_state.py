@@ -59,7 +59,9 @@ def _conn() -> sqlite3.Connection:
         task_yaml_path TEXT,
         lb_port INTEGER,
         controller_pid INTEGER,
-        created_at REAL)""")
+        created_at REAL,
+        version INTEGER DEFAULT 1,
+        update_error TEXT)""")
     conn.execute("""CREATE TABLE IF NOT EXISTS replicas (
         service_name TEXT,
         replica_id INTEGER,
@@ -67,9 +69,31 @@ def _conn() -> sqlite3.Connection:
         status TEXT,
         url TEXT,
         launched_at REAL,
+        version INTEGER DEFAULT 1,
         PRIMARY KEY (service_name, replica_id))""")
+    _migrate(conn)
     conn.commit()
     return conn
+
+
+_migrated_paths: set = set()
+
+
+def _migrate(conn: sqlite3.Connection) -> None:
+    """Add columns to pre-existing DBs; once per (process, db path) so
+    the per-call cost is a set lookup, not swallowed ALTER failures."""
+    path = str(_db_path())
+    if path in _migrated_paths:
+        return
+    for table, col, decl in (
+            ("services", "version", "INTEGER DEFAULT 1"),
+            ("services", "update_error", "TEXT"),
+            ("replicas", "version", "INTEGER DEFAULT 1")):
+        cols = {r[1] for r in conn.execute(
+            f"PRAGMA table_info({table})").fetchall()}
+        if col not in cols:
+            conn.execute(f"ALTER TABLE {table} ADD COLUMN {col} {decl}")
+    _migrated_paths.add(path)
 
 
 # ------------------------------------------------------------------ services
@@ -90,6 +114,32 @@ def add_service(service_name: str, spec_json: str, task_yaml_path: str,
         return True
 
 
+def bump_service_version(service_name: str, spec_json: str,
+                         task_yaml_path: str) -> Optional[int]:
+    """Register a new task/spec revision; the controller observes the
+    version change and rolls replicas over to it (reference:
+    update_version, sky/serve/replica_managers.py:1167). Returns the new
+    version, or None if the service does not exist."""
+    with _conn() as conn:
+        cur = conn.execute(
+            "UPDATE services SET version=version+1, spec_json=?, "
+            "task_yaml_path=? WHERE service_name=?",
+            (spec_json, task_yaml_path, service_name))
+        if cur.rowcount == 0:
+            return None
+        row = conn.execute(
+            "SELECT version FROM services WHERE service_name=?",
+            (service_name,)).fetchone()
+        return int(row[0])
+
+
+def set_update_error(service_name: str, error: Optional[str]) -> None:
+    with _conn() as conn:
+        conn.execute(
+            "UPDATE services SET update_error=? WHERE service_name=?",
+            (error, service_name))
+
+
 def set_service_status(service_name: str, status: ServiceStatus) -> None:
     with _conn() as conn:
         conn.execute("UPDATE services SET status=? WHERE service_name=?",
@@ -107,8 +157,9 @@ def get_service(service_name: str) -> Optional[Dict[str, Any]]:
     with _conn() as conn:
         row = conn.execute(
             "SELECT service_name, status, spec_json, task_yaml_path, "
-            "lb_port, controller_pid, created_at FROM services "
-            "WHERE service_name=?", (service_name,)).fetchone()
+            "lb_port, controller_pid, created_at, version, update_error "
+            "FROM services WHERE service_name=?",
+            (service_name,)).fetchone()
     if row is None:
         return None
     return _service_row(row)
@@ -118,7 +169,8 @@ def get_services() -> List[Dict[str, Any]]:
     with _conn() as conn:
         rows = conn.execute(
             "SELECT service_name, status, spec_json, task_yaml_path, "
-            "lb_port, controller_pid, created_at FROM services").fetchall()
+            "lb_port, controller_pid, created_at, version, update_error "
+            "FROM services").fetchall()
     return [_service_row(r) for r in rows]
 
 
@@ -132,27 +184,31 @@ def remove_service(service_name: str) -> None:
 
 def _service_row(row) -> Dict[str, Any]:
     (name, status, spec_json, task_yaml_path, lb_port, pid,
-     created_at) = row
+     created_at, version, update_error) = row
     return {
         "service_name": name, "status": ServiceStatus(status),
         "spec": json.loads(spec_json) if spec_json else {},
         "task_yaml_path": task_yaml_path, "lb_port": lb_port,
         "controller_pid": pid, "created_at": created_at,
+        "version": version, "update_error": update_error,
     }
 
 
 # ------------------------------------------------------------------ replicas
 def upsert_replica(service_name: str, replica_id: int, cluster_name: str,
-                   status: ReplicaStatus, url: Optional[str]) -> None:
+                   status: ReplicaStatus, url: Optional[str],
+                   version: int = 1) -> None:
     with _conn() as conn:
         conn.execute(
             "INSERT INTO replicas (service_name, replica_id, cluster_name,"
-            " status, url, launched_at) VALUES (?, ?, ?, ?, ?, ?) "
+            " status, url, launched_at, version) "
+            "VALUES (?, ?, ?, ?, ?, ?, ?) "
             "ON CONFLICT(service_name, replica_id) DO UPDATE SET "
             "status=excluded.status, url=excluded.url, "
-            "cluster_name=excluded.cluster_name",
+            "cluster_name=excluded.cluster_name, "
+            "version=excluded.version",
             (service_name, replica_id, cluster_name, status.value, url,
-             time.time()))
+             time.time(), version))
 
 
 def remove_replica(service_name: str, replica_id: int) -> None:
@@ -165,9 +221,9 @@ def remove_replica(service_name: str, replica_id: int) -> None:
 def get_replicas(service_name: str) -> List[Dict[str, Any]]:
     with _conn() as conn:
         rows = conn.execute(
-            "SELECT replica_id, cluster_name, status, url, launched_at "
-            "FROM replicas WHERE service_name=? ORDER BY replica_id",
-            (service_name,)).fetchall()
+            "SELECT replica_id, cluster_name, status, url, launched_at, "
+            "version FROM replicas WHERE service_name=? ORDER BY "
+            "replica_id", (service_name,)).fetchall()
     return [{"replica_id": r[0], "cluster_name": r[1],
              "status": ReplicaStatus(r[2]), "url": r[3],
-             "launched_at": r[4]} for r in rows]
+             "launched_at": r[4], "version": r[5]} for r in rows]

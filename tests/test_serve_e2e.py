@@ -140,3 +140,54 @@ def test_service_spec_yaml_roundtrip():
         {"readiness_probe": "/", "replicas": 3})
     assert simple.min_replicas == 3
     assert not simple.autoscaling_enabled
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_serve_rolling_update():
+    """`serve update` rolls replicas to a new task revision: new-version
+    replicas come READY before outdated ones are drained, and the
+    service keeps answering throughout."""
+    def versioned_task(body):
+        task = Task("roll-svc", run=(
+            f'cd $(mktemp -d) && echo "{body}" > index.html && '
+            'exec python3 -m http.server $SKYPILOT_SERVE_REPLICA_PORT'))
+        task.set_resources(Resources(cloud="local"))
+        task.service = SkyServiceSpec(readiness_path="/",
+                                      initial_delay_seconds=60,
+                                      min_replicas=2)
+        return task
+
+    name, endpoint = serve_core.up(versioned_task("body-v1"), "svc-roll",
+                                   controller="local")
+    try:
+        serve_core.wait_ready(name, timeout=90)
+        _, body = _get(endpoint + "/")
+        assert "body-v1" in body
+
+        version = serve_core.update(versioned_task("body-v2"), name,
+                                    controller="local")
+        assert version == 2
+
+        # Roll completes: all replicas on v2, old ones gone, service
+        # kept answering every poll along the way.
+        deadline = time.time() + 120
+        rolled = False
+        while time.time() < deadline:
+            status, body = _get(endpoint + "/")  # never a dropped req
+            assert status == 200
+            reps = serve_state.get_replicas(name)
+            ready = [r for r in reps
+                     if r["status"] == ReplicaStatus.READY]
+            if (len(ready) == 2 and
+                    all(r["version"] == 2 for r in ready) and
+                    all(r["version"] == 2 for r in reps)):
+                rolled = True
+                break
+            time.sleep(0.3)
+        assert rolled, f"rollout incomplete: {serve_state.get_replicas(name)}"
+
+        # Traffic now comes from v2 bodies only.
+        bodies = {_get(endpoint + "/")[1].strip() for _ in range(4)}
+        assert bodies == {"body-v2"}, bodies
+    finally:
+        serve_core.down([name], timeout=90)
