@@ -54,15 +54,25 @@ class LlamaConfig:
                            n_heads=8, n_kv_heads=4, mlp_dim=256,
                            max_seq_len=512)
 
-    def flops_per_token(self) -> float:
-        """Approximate fwd+bwd FLOPs per token (6 * params for matmuls +
-        attention term); used for MFU accounting."""
+    def flops_per_token(self, seq_len: Optional[int] = None) -> float:
+        """Approximate fwd+bwd FLOPs per token for MFU accounting.
+
+        Without ``seq_len``: the conservative 6N parameter-matmul count
+        (PaLM's "model FLOPs" convention; understates real work). With
+        ``seq_len``: adds the causal attention score/value matmuls
+        (~6 * L * S * d per token), the attention-inclusive figure.
+        """
         p_layer = (self.dim * (self.n_heads + 2 * self.n_kv_heads) *
                    self.head_dim + self.n_heads * self.head_dim * self.dim +
                    3 * self.dim * self.mlp_dim)
         p = self.n_layers * p_layer + self.vocab_size * self.dim * (
             1 if self.tie_embeddings else 2)
-        return 6.0 * p
+        flops = 6.0 * p
+        if seq_len is not None:
+            # QK^T + PV: 4*S*d fwd per layer, halved by causal masking,
+            # tripled for fwd+bwd.
+            flops += 6.0 * self.n_layers * seq_len * self.dim
+        return flops
 
     def num_params(self) -> int:
         p_layer = (self.dim * (self.n_heads + 2 * self.n_kv_heads) *

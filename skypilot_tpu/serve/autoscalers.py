@@ -43,6 +43,18 @@ class Autoscaler:
             return RequestRateAutoscaler(spec)
         return cls(spec)
 
+    def adopt_state(self, old: "Autoscaler") -> None:
+        """Carry scaling state across a rolling update: the new revision
+        must not reset the target to min_replicas under live load (that
+        would mass-terminate healthy replicas, bypassing hysteresis)."""
+        lo, hi = self.spec.min_replicas, (self.spec.max_replicas or
+                                          self.spec.min_replicas)
+        self.target_num_replicas = max(lo, min(old.target_num_replicas,
+                                               hi))
+        if isinstance(old, RequestRateAutoscaler) and isinstance(
+                self, RequestRateAutoscaler):
+            self.request_timestamps = list(old.request_timestamps)
+
 
 class RequestRateAutoscaler(Autoscaler):
     """qps/window → ceil(qps / target_qps_per_replica), with hysteresis:

@@ -95,7 +95,9 @@ class SkyServeController:
         self.version = row["version"]
         self.replica_manager.apply_update(self.version, spec, task)
         self.spec = spec
-        self.autoscaler = autoscalers.Autoscaler.from_spec(spec)
+        new_autoscaler = autoscalers.Autoscaler.from_spec(spec)
+        new_autoscaler.adopt_state(self.autoscaler)
+        self.autoscaler = new_autoscaler
 
     def _tick(self) -> None:
         rm = self.replica_manager

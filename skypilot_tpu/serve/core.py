@@ -170,6 +170,15 @@ def _update_local(task: Task, service_name: str) -> int:
     if version is None:
         raise exceptions.SkyTpuError(
             f"Service {service_name!r} disappeared during update.")
+    # Prune superseded revision files, keeping the new one and the one
+    # the controller may still be mid-read on (the pre-bump current).
+    keep = {str(new_yaml), row["task_yaml_path"]}
+    for old in serve_dir.glob(f"{service_name}-update-*.yaml"):
+        if str(old) not in keep:
+            try:
+                old.unlink()
+            except OSError:
+                pass
     return version
 
 
@@ -323,12 +332,18 @@ def main() -> None:
         lb_port = int(endpoint.rsplit(":", 1)[1])
         print(json.dumps({"service_name": name, "lb_port": lb_port}))
     elif args.cmd == "update":
-        task = Task.from_yaml(os.path.expanduser(args.task_yaml))
+        inbox_path = os.path.expanduser(args.task_yaml)
+        task = Task.from_yaml(inbox_path)
         try:
             version = _update_local(task, args.service_name)
         except exceptions.SkyTpuError as e:
             print(json.dumps({"error": str(e)}))
             return
+        # The inbox copy was re-dumped into the serve dir; drop it.
+        try:
+            os.unlink(inbox_path)
+        except OSError:
+            pass
         print(json.dumps({"version": version}))
     elif args.cmd == "dump":
         names = args.names.split(",") if args.names else None

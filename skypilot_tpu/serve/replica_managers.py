@@ -303,11 +303,15 @@ class SkyPilotReplicaManager:
     def apply_update(self, version: int, spec: SkyServiceSpec,
                      task) -> None:
         """Adopt a new revision: replicas launched from now on carry it;
-        the controller's rollover logic drains the old ones."""
+        the controller's rollover logic drains the old ones. The
+        consecutive-failure counter resets — an update is the documented
+        recovery action for a service whose old task was broken, so the
+        new revision must get a fresh chance to launch."""
         with self._lock:
             self.version = version
             self.spec = spec
             self.task = task
+            self.consecutive_failure_count = 0
 
     def alive_current_count(self) -> int:
         with self._lock:

@@ -45,14 +45,23 @@ def build_wheel() -> pathlib.Path:
         if out_dir.exists():
             shutil.rmtree(out_dir)
         out_dir.mkdir(parents=True)
-        # --no-build-isolation: build with the environment's setuptools;
-        # isolated builds try to download build deps, which fails on
-        # zero-egress hosts (and wastes a network round trip elsewhere).
-        proc = subprocess.run(
-            [sys.executable, "-m", "pip", "wheel", "--no-deps",
-             "--no-build-isolation",
-             "--wheel-dir", str(out_dir), str(_REPO_ROOT)],
-            capture_output=True, text=True)
+        # Build from a temp copy so setuptools' build/ and egg-info
+        # droppings never land in the working repo. --no-build-isolation:
+        # isolated builds try to download setuptools, which fails on
+        # zero-egress hosts.
+        import tempfile
+        with tempfile.TemporaryDirectory(prefix="stpu-wheel-") as td:
+            src = pathlib.Path(td) / "src"
+            shutil.copytree(
+                _REPO_ROOT, src,
+                ignore=shutil.ignore_patterns(
+                    ".git", "build", "*.egg-info", "__pycache__",
+                    ".pytest_cache", "tests"))
+            proc = subprocess.run(
+                [sys.executable, "-m", "pip", "wheel", "--no-deps",
+                 "--no-build-isolation",
+                 "--wheel-dir", str(out_dir), str(src)],
+                capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(
                 f"wheel build failed:\n{proc.stderr[-2000:]}")
