@@ -4,6 +4,8 @@ The hermetic multi-host harness SURVEY.md §4 calls for: each "host" is a
 directory + subprocess, so gang execution, the env contract, job state,
 logs, and teardown are exercised for real — no cloud, no TPU.
 """
+import json
+import pathlib
 import time
 
 import pytest
@@ -190,3 +192,42 @@ def test_tpu_pod_cannot_stop():
     backend = slice_backend.SliceBackend()
     with pytest.raises(exceptions.NotSupportedError, match="terminate"):
         backend.teardown(handle, terminate=False)
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_stop_start_cycle_resets_autostop():
+    """stop -> start: the cluster comes back UP, runs jobs again, and a
+    previous autostop setting is cleared in the DB and in the on-host
+    autostop.json (reference `sky start` semantics). Enforcement by the
+    daemon itself is covered in test_daemon_autostop.py; the daemon is
+    disabled under this suite's fixture."""
+    task = Task("cycle", run="echo first-run")
+    task.set_resources(_local_res())
+    job_id, handle = execution.launch(task, cluster_name="t-cycle",
+                                      detach_run=True, stream_logs=False,
+                                      idle_minutes_to_autostop=1)
+    assert _wait_job(handle, job_id) == "SUCCEEDED"
+    record = global_user_state.get_cluster_from_name("t-cycle")
+    assert record["autostop"] == 1
+
+    core.stop("t-cycle")
+    assert global_user_state.get_cluster_from_name(
+        "t-cycle")["status"] == ClusterStatus.STOPPED
+
+    handle = core.start("t-cycle")
+    record = global_user_state.get_cluster_from_name("t-cycle")
+    assert record["status"] == ClusterStatus.UP
+    # Autostop disabled by the restart, in the DB and on the host.
+    assert record["autostop"] == -1
+    cfg = json.loads(
+        (pathlib.Path(handle.head_home) / ".stpu_agent" /
+         "autostop.json").read_text())
+    assert cfg["idle_minutes"] == -1
+
+    # The restarted cluster executes jobs again.
+    task2 = Task("after-restart", run="echo second-run")
+    task2.set_resources(_local_res())
+    job_id2, _ = execution.exec(task2, "t-cycle", detach_run=True,
+                                stream_logs=False)
+    assert _wait_job(handle, job_id2) == "SUCCEEDED"
+    core.down("t-cycle")
