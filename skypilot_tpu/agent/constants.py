@@ -37,6 +37,11 @@ HEARTBEAT_TIMEOUT_MS = 15_000
 # (reference get_or_fail semantics, cloud_vm_ray_backend.py:296-331).
 GANG_FAILED_RC = 137
 
+# Cluster-internal SSH key (on the head, installed by the provisioner):
+# lets the head-resident gang driver reach workers over the slice's
+# internal network with no client involvement.
+INTERNAL_KEY_PATH = "~/.ssh/stpu_internal_key"
+
 # On-host layout (under the host's $HOME).
 AGENT_DIR = ".stpu_agent"
 JOBS_DB = f"{AGENT_DIR}/jobs.db"

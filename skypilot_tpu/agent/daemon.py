@@ -102,14 +102,6 @@ class Daemon:
         idle_minutes = cfg.get("idle_minutes", -1)
         if idle_minutes is None or idle_minutes < 0:
             return False
-        if not self.cluster.get("job_db_on_host", False):
-            # The job queue lives elsewhere (client-side exec path for
-            # SSH clusters until the remote job DB lands): idleness is
-            # unknowable here, and guessing would stop a cluster
-            # mid-job. Refuse loudly rather than kill work.
-            self.log("autostop requested but this host does not hold the "
-                     "job DB; skipping (cannot observe idleness)")
-            return False
         if not job_lib.is_cluster_idle(home=str(self.home)):
             return False
         baseline = max(

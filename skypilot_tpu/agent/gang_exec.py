@@ -71,7 +71,22 @@ class _HostProc:
         self.host = host
         self.returncode: Optional[int] = None
         log_f = open(log_path, "ab")
-        if host["kind"] == "local":
+        if host["kind"] == "exec":
+            # The driver runs ON this host (head-resident submission):
+            # its own rank is a plain subprocess, no SSH-to-self.
+            if coord_port is not None:
+                env = dict(env)
+                env[constants.GANG_COORD_ADDR] = f"127.0.0.1:{coord_port}"
+                cmd = (f"{sys.executable} -m "
+                       f"skypilot_tpu.agent.host_wrapper "
+                       f"{shlex.quote(cmd)}")
+            full_env = dict(os.environ)
+            full_env.update(env)
+            self.proc = subprocess.Popen(
+                ["bash", "--login", "-c", cmd], stdout=log_f,
+                stderr=subprocess.STDOUT, env=full_env,
+                cwd=os.path.expanduser("~"), start_new_session=True)
+        elif host["kind"] == "local":
             if coord_port is not None:
                 env = dict(env)
                 env[constants.GANG_COORD_ADDR] = \
@@ -121,7 +136,9 @@ class _HostProc:
             remote = (f"bash --login -c "
                       f"{shlex.quote(env_prefix + ' ' + cmd)}")
             self.proc = subprocess.Popen(
-                ["ssh"] + opts + ["-i", host["ssh_key_path"],
+                ["ssh"] + opts + ["-i",
+                                  os.path.expanduser(
+                                      host["ssh_key_path"]),
                                   "-p", str(host.get("ssh_port", 22)),
                                   f"{host['ssh_user']}@{host['ip']}",
                                   remote],
@@ -253,10 +270,20 @@ def run_gang(spec: Dict) -> int:
 
 
 def main() -> None:
-    spec_path = sys.argv[1]
+    argv = [a for a in sys.argv[1:] if a != "--delete-spec"]
+    delete_spec = "--delete-spec" in sys.argv[1:]
+    spec_path = argv[0]
     with open(spec_path) as f:
         spec = json.load(f)
     rc = run_gang(spec)
+    if delete_spec:
+        # One-shot submission-staged spec (job_cli.submit passes the
+        # flag): deleted only AFTER the gang ran, so a driver that dies
+        # mid-job leaves the spec on disk for debugging/resubmission.
+        try:
+            os.unlink(spec_path)
+        except OSError:
+            pass
     sys.exit(rc)  # preserves GANG_FAILED_RC=137 for wrappers
 
 

@@ -1,0 +1,166 @@
+"""Head-side job RPC: ``python3 -m skypilot_tpu.agent.job_cli <cmd> ...``.
+
+Reference analog: sky/skylet/job_lib.py JobLibCodeGen:803 — the reference
+ships ``python3 -u -c <codegen>`` strings over SSH to mutate the head's
+job DB and submit drivers. Here the shipped wheel provides a real CLI
+instead of codegen strings; the client (SliceBackend) invokes it through
+a CommandRunner, so the SAME seam serves real SSH heads and the hermetic
+local provider's directory-hosts.
+
+Everything head-resident: the job DB (``~/.stpu_agent/jobs.db``), the job
+logs (``~/stpu_logs/job-<id>/``), and the detached gang driver
+(``gang_exec``) all live on the head host — the client can exit the
+moment ``submit`` returns and the job still runs, is queryable, and
+counts toward the daemon's idleness clock (autostop).
+
+RPC framing: results are printed as one line ``STPU_RPC:{json}`` so the
+client can pick it out of login-shell noise (motd, profile chatter).
+``tail`` is the exception: it streams raw log lines and encodes the job's
+final status in its exit code.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+from typing import Any, List, Optional
+
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+
+RPC_PREFIX = "STPU_RPC:"
+
+
+def _reply(payload: Any) -> None:
+    print(f"{RPC_PREFIX}{json.dumps(payload)}", flush=True)
+
+
+def parse_reply(stdout: str) -> Any:
+    """Client-side: extract the last RPC payload from mixed stdout."""
+    result = None
+    for line in stdout.splitlines():
+        if line.startswith(RPC_PREFIX):
+            result = json.loads(line[len(RPC_PREFIX):])
+    if result is None:
+        raise ValueError(f"no {RPC_PREFIX} line in job_cli output:\n"
+                         f"{stdout[-2000:]}")
+    return result
+
+
+def submit(spec_path: str) -> None:
+    """Register the job and launch its gang driver, detached.
+
+    The client ships a spec WITHOUT job_id/log_dir; those are assigned
+    here, on the head, so the job exists in the head DB before the
+    client hears back — a dead client can never orphan a running job.
+    """
+    path = pathlib.Path(spec_path).expanduser()
+    spec = json.loads(path.read_text())
+    job_id = job_lib.add_job(
+        spec.get("job_name") or "stpu-job",
+        spec.get("username") or os.environ.get("USER", "unknown"),
+        spec.get("run_timestamp") or time.strftime("%Y-%m-%d-%H-%M-%S"),
+        log_dir="")
+    log_dir = (pathlib.Path(os.path.expanduser("~"))
+               / constants.LOGS_DIR / f"job-{job_id}")
+    job_lib.set_log_dir(job_id, str(log_dir))
+    spec["job_id"] = job_id
+    spec["log_dir"] = str(log_dir)
+    spec["task_id"] = (f"{spec.get('cluster_name', 'cluster')}-{job_id}-"
+                       f"{spec.get('run_timestamp', '')}")
+    spec["agent_home"] = None  # gang_exec runs here: real $HOME
+    path.write_text(json.dumps(spec, indent=2))
+    subprocess.Popen(
+        [sys.executable, "-m", "skypilot_tpu.agent.gang_exec",
+         str(path), "--delete-spec"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True)
+    _reply({"job_id": job_id, "log_dir": str(log_dir)})
+
+
+def tail(job_id: Optional[int], follow: bool, node_rank: int) -> int:
+    """Stream a job's log to stdout; exit 0 iff the job SUCCEEDED."""
+    if job_id is None:
+        jobs = job_lib.queue()
+        if not jobs:
+            print("No jobs on cluster.")
+            return 1
+        job_id = jobs[0]["job_id"]
+    job = job_lib.get_job(job_id)
+    if job is None:
+        print(f"Job {job_id} not found.")
+        return 1
+    log_path = (pathlib.Path(os.path.expanduser("~")) / constants.LOGS_DIR
+                / f"job-{job_id}" / f"node-{node_rank}.log")
+    deadline = time.time() + 30
+    while not log_path.exists():
+        if time.time() > deadline or not follow:
+            print(f"(no logs yet at {log_path})")
+            return 1
+        time.sleep(0.2)
+    with open(log_path, "r", errors="replace") as f:
+        while True:
+            line = f.readline()
+            if line:
+                print(line, end="", flush=True)
+                continue
+            job = job_lib.get_job(job_id)
+            done = job is None or job_lib.JobStatus(
+                job["status"]).is_terminal()
+            if not follow or done:
+                rest = f.read()
+                if rest:
+                    print(rest, end="", flush=True)
+                break
+            time.sleep(0.2)
+    job = job_lib.get_job(job_id)
+    if job and job["status"] == job_lib.JobStatus.SUCCEEDED.value:
+        return 0
+    return 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="job_cli", description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("submit")
+    p.add_argument("spec_path")
+
+    sub.add_parser("queue")
+
+    p = sub.add_parser("cancel")
+    p.add_argument("--jobs", default="",
+                   help="comma-separated job ids; empty = all live jobs")
+
+    p = sub.add_parser("status")
+    p.add_argument("job_id", type=int)
+
+    p = sub.add_parser("tail")
+    p.add_argument("job_id", type=int, nargs="?", default=None)
+    p.add_argument("--no-follow", action="store_true")
+    p.add_argument("--node-rank", type=int, default=0)
+
+    args = parser.parse_args(argv)
+    if args.cmd == "submit":
+        submit(args.spec_path)
+    elif args.cmd == "queue":
+        _reply(job_lib.queue())
+    elif args.cmd == "cancel":
+        ids = ([int(x) for x in args.jobs.split(",") if x]
+               if args.jobs else None)
+        _reply(job_lib.cancel_jobs(ids))
+    elif args.cmd == "status":
+        job = job_lib.get_job(args.job_id)
+        _reply({"status": job["status"] if job else None})
+    elif args.cmd == "tail":
+        return tail(args.job_id, follow=not args.no_follow,
+                    node_rank=args.node_rank)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

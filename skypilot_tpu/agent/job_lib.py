@@ -95,6 +95,13 @@ def set_pid(job_id: int, pid: int, home: Optional[str] = None) -> None:
         conn.execute("UPDATE jobs SET pid=? WHERE job_id=?", (pid, job_id))
 
 
+def set_log_dir(job_id: int, log_dir: str,
+                home: Optional[str] = None) -> None:
+    with _conn(home) as conn:
+        conn.execute("UPDATE jobs SET log_dir=? WHERE job_id=?",
+                     (log_dir, job_id))
+
+
 def get_job(job_id: int, home: Optional[str] = None
             ) -> Optional[Dict[str, Any]]:
     with _conn(home) as conn:

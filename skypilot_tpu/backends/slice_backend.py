@@ -5,10 +5,17 @@ substrate removed. The mapping:
 
   RetryingVmProvisioner (:1121)      -> _provision_with_failover below
   RayCodeGen + placement group (:211) -> agent.gang_exec (slice IS the gang)
-  _exec_code_on_head / ray job submit -> spec.json + detached gang_exec
-  JobLibCodeGen over SSH (:803)       -> agent.job_lib in-process (local) /
-                                         `python3 -m ...job_cli` (ssh)
+  _exec_code_on_head / ray job submit -> spec rsync'd to head +
+                                         `job_cli submit` spawns the gang
+                                         driver DETACHED on the head
+  JobLibCodeGen over SSH (:803)       -> `python3 -m ...job_cli` RPC via
+                                         the head's CommandRunner (same
+                                         seam for SSH and local hosts)
   stable_cluster_internal_ips rank    -> ClusterInfo.ordered_instances()
+
+The job DB, job logs, and gang driver are all HEAD-RESIDENT: a client
+that exits right after submit leaves a fully tracked job behind, and the
+on-host daemon can observe idleness for autostop on every provider.
 
 Gang semantics: a slice's hosts provision/fail/cancel atomically; the
 first failed host cancels the gang with rc 137 (gang_exec).
@@ -251,10 +258,6 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
             "provider_name": handle.provider_name,
             "provider_config": handle.cluster_info.provider_config,
             "chips_per_host": sinfo.chips_per_host if sinfo else 0,
-            # Whether the daemon's host holds the job DB (and can thus
-            # observe idleness for autostop). True for the local provider,
-            # whose "head host" home is where gang_exec records jobs.
-            "job_db_on_host": handle.provider_name == "local",
         }
         if handle.provider_name == "local":
             # provision.local resolves cluster metadata under the
@@ -398,6 +401,65 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
                     f"{log_dir}/setup-{idx}.log")
 
     # ------------------------------------------------------------ execute
+    def _build_job_spec(self, handle: SliceHandle, task,
+                        run_timestamp: str) -> Dict[str, Any]:
+        """The gang spec as the HEAD host will execute it.
+
+        job_id/log_dir/task_id are intentionally absent: they are
+        assigned by job_cli.submit on the head, where the job DB lives
+        (reference: _add_job via JobLibCodeGen over SSH,
+        sky/backends/cloud_vm_ray_backend.py:3310).
+
+        Host transports are head-relative: the head runs its own rank as
+        a plain subprocess (kind "exec") and reaches workers over the
+        slice's INTERNAL network with the cluster-internal key the
+        provisioner installed — never back through the client.
+        """
+        info = handle.cluster_info
+        instances = info.ordered_instances()
+        res = handle.launched_resources
+        slice_shape = res.slice_info()
+        run_cmd = (f"cd ~/{agent_constants.WORKDIR} 2>/dev/null; "
+                   + task.run)
+
+        hosts = []
+        slice_order = []
+        for rank, inst in enumerate(instances):
+            if inst.slice_id not in slice_order:
+                slice_order.append(inst.slice_id)
+            slice_index = slice_order.index(inst.slice_id)
+            if handle.provider_name == "local":
+                hosts.append({"kind": "local",
+                              "host_dir": inst.tags["host_dir"],
+                              "slice_index": slice_index})
+            elif rank == 0:
+                hosts.append({"kind": "exec",
+                              "slice_index": slice_index})
+            else:
+                hosts.append({
+                    "kind": "ssh",
+                    "ip": inst.internal_ip,
+                    "ssh_user": info.ssh_user,
+                    "ssh_key_path": agent_constants.INTERNAL_KEY_PATH,
+                    "ssh_port": inst.ssh_port,
+                    "proxy_command": None,
+                    "slice_index": slice_index,
+                })
+        return {
+            "job_name": task.name or "stpu-job",
+            "username": getpass.getuser(),
+            "run_timestamp": run_timestamp,
+            "cluster_name": handle.cluster_name,
+            "node_ips": [i.internal_ip for i in instances],
+            "num_slices": handle.num_slices,
+            "hosts_per_slice": slice_shape.hosts if slice_shape else 1,
+            "chips_per_host":
+                slice_shape.chips_per_host if slice_shape else 0,
+            "envs": dict(task.envs),
+            "run_cmd": run_cmd,
+            "hosts": hosts,
+        }
+
     def _execute(self, handle: SliceHandle, task, detach_run,
                  dryrun=False) -> Optional[int]:
         if dryrun:
@@ -411,128 +473,86 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
             is_launch=False)
 
         run_timestamp = time.strftime("%Y-%m-%d-%H-%M-%S")
-        head_home = handle.head_home
-        job_id = job_lib.add_job(
-            task.name or "stpu-job", getpass.getuser(), run_timestamp,
-            log_dir="", home=head_home)
-        log_dir = self._job_log_dir(handle, job_id)
+        spec = self._build_job_spec(handle, task, run_timestamp)
 
-        info = handle.cluster_info
-        instances = info.ordered_instances()
-        res = handle.launched_resources
-        slice_shape = res.slice_info()
-        run_cmd = (f"cd ~/{agent_constants.WORKDIR} 2>/dev/null; "
-                   + task.run)
-
-        hosts = []
-        slice_order = []
-        for inst in instances:
-            if inst.slice_id not in slice_order:
-                slice_order.append(inst.slice_id)
-            slice_index = slice_order.index(inst.slice_id)
-            if handle.provider_name == "local":
-                hosts.append({"kind": "local",
-                              "host_dir": inst.tags["host_dir"],
-                              "slice_index": slice_index})
-            else:
-                hosts.append({
-                    "kind": "ssh",
-                    "ip": inst.external_ip or inst.internal_ip,
-                    "ssh_user": info.ssh_user,
-                    "ssh_key_path": info.ssh_key_path,
-                    "ssh_port": inst.ssh_port,
-                    "proxy_command": info.provider_config.get(
-                        "ssh_proxy_command"),
-                    "slice_index": slice_index,
-                })
-        spec = {
-            "job_id": job_id,
-            "task_id": f"{handle.cluster_name}-{job_id}-{run_timestamp}",
-            "cluster_name": handle.cluster_name,
-            "node_ips": [i.internal_ip for i in instances],
-            "num_slices": handle.num_slices,
-            "hosts_per_slice": slice_shape.hosts if slice_shape else 1,
-            "chips_per_host":
-                slice_shape.chips_per_host if slice_shape else 0,
-            "envs": dict(task.envs),
-            "run_cmd": run_cmd,
-            "log_dir": str(log_dir),
-            "hosts": hosts,
-            "agent_home": head_home,
-        }
+        # Ship the spec to the head and submit there: job DB mutation +
+        # gang-driver spawn happen ON the cluster, so the job survives
+        # this client exiting one line from now.
         spec_dir = paths.generated_dir() / handle.cluster_name
         spec_dir.mkdir(parents=True, exist_ok=True)
-        spec_path = spec_dir / f"job-{job_id}.json"
-        spec_path.write_text(json.dumps(spec, indent=2))
-
-        # The gang driver runs detached so the client can exit; job state
-        # lands in the head's job DB either way.
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "skypilot_tpu.agent.gang_exec",
-             str(spec_path)],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            start_new_session=True)
+        # uuid-named: two submits in the same second (e.g. from the jobs
+        # controller) must not overwrite each other's staged spec while a
+        # detached gang driver still reads it.
+        import uuid
+        local_spec = spec_dir / f"job-{uuid.uuid4().hex[:12]}.json"
+        local_spec.write_text(json.dumps(spec, indent=2))
+        runner = self._head_runner(handle)
+        remote_spec = f"~/.stpu_agent/specs/{local_spec.name}"
+        rc = runner.run("mkdir -p ~/.stpu_agent/specs")
+        runner.check_returncode(rc, "mkdir specs",
+                                handle.cluster_name)
+        runner.rsync(str(local_spec), "~/.stpu_agent/specs/", up=True)
+        local_spec.unlink(missing_ok=True)  # staged copy; head owns it now
+        reply = self._job_rpc(handle, ["submit", remote_spec],
+                              runner=runner)
+        job_id = int(reply["job_id"])
         if not detach_run:
             self.tail_logs(handle, job_id, follow=True)
-            proc.wait()
         return job_id
 
-    def _job_log_dir(self, handle: SliceHandle,
-                     job_id: int) -> pathlib.Path:
-        base = (pathlib.Path(handle.head_home)
-                if handle.head_home else paths.logs_dir())
-        return base / agent_constants.LOGS_DIR / f"job-{job_id}"
-
     # ------------------------------------------------------------ job ops
+    def _head_runner(self, handle: SliceHandle) -> runner_lib.CommandRunner:
+        return handle.get_command_runners()[0]
+
+    @staticmethod
+    def _job_cli_cmd(runner: runner_lib.CommandRunner,
+                     args: List[str]) -> str:
+        import shlex
+        return (f"{runner.remote_python} -m skypilot_tpu.agent.job_cli "
+                + " ".join(shlex.quote(a) for a in args))
+
+    def _job_rpc(self, handle: SliceHandle, args: List[str],
+                 runner: Optional[runner_lib.CommandRunner] = None) -> Any:
+        """Run job_cli on the head, parse its RPC reply (the head-DB
+        seam; reference: codegen-over-SSH, sky/skylet/job_lib.py:803)."""
+        from skypilot_tpu.agent import job_cli
+        if runner is None:
+            runner = self._head_runner(handle)
+        cmd = self._job_cli_cmd(runner, args)
+        rc, out, err = runner.run(cmd, require_outputs=True)
+        runner.check_returncode(
+            rc, cmd, f"job_cli failed on {handle.cluster_name} head: "
+            f"{err[-2000:] if err else out[-2000:]}")
+        return job_cli.parse_reply(out)
+
     def queue(self, handle: SliceHandle) -> List[Dict[str, Any]]:
-        return job_lib.queue(home=handle.head_home)
+        return self._job_rpc(handle, ["queue"])
 
     def cancel_jobs(self, handle: SliceHandle,
                     job_ids: Optional[List[int]] = None) -> List[int]:
-        return job_lib.cancel_jobs(job_ids, home=handle.head_home)
+        if job_ids is not None and not job_ids:
+            return []  # explicit empty list cancels nothing (None = all)
+        args = ["cancel"]
+        if job_ids is not None:
+            args += ["--jobs", ",".join(str(j) for j in job_ids)]
+        return self._job_rpc(handle, args)
 
     def job_status(self, handle: SliceHandle,
                    job_id: int) -> Optional[str]:
-        job = job_lib.get_job(job_id, home=handle.head_home)
-        return job["status"] if job else None
+        return self._job_rpc(handle, ["status", str(job_id)])["status"]
 
     def tail_logs(self, handle: SliceHandle, job_id: Optional[int],
                   follow: bool = True, node_rank: int = 0) -> int:
-        if job_id is None:
-            jobs = job_lib.queue(home=handle.head_home)
-            if not jobs:
-                print("No jobs on cluster.")
-                return 1
-            job_id = jobs[0]["job_id"]
-        log_path = self._job_log_dir(handle, job_id) / \
-            f"node-{node_rank}.log"
-        # Wait for the file to appear (job may still be INIT).
-        deadline = time.time() + 30
-        while not log_path.exists():
-            if time.time() > deadline or not follow:
-                print(f"(no logs yet at {log_path})")
-                return 1
-            time.sleep(0.2)
-        with open(log_path, "r", errors="replace") as f:
-            while True:
-                line = f.readline()
-                if line:
-                    print(line, end="", flush=True)
-                    continue
-                job = job_lib.get_job(job_id, home=handle.head_home)
-                done = job is None or job_lib.JobStatus(
-                    job["status"]).is_terminal()
-                if not follow or done:
-                    # Drain anything written between readline and check.
-                    rest = f.read()
-                    if rest:
-                        print(rest, end="", flush=True)
-                    break
-                time.sleep(0.2)
-        job = job_lib.get_job(job_id, home=handle.head_home)
-        if job and job["status"] == job_lib.JobStatus.SUCCEEDED.value:
-            return 0
-        return 1
+        """Stream job logs from the head; rc 0 iff the job SUCCEEDED."""
+        runner = self._head_runner(handle)
+        args = ["tail"]
+        if job_id is not None:
+            args.append(str(job_id))
+        if not follow:
+            args.append("--no-follow")
+        args += ["--node-rank", str(node_rank)]
+        return runner.run(self._job_cli_cmd(runner, args),
+                          stream_logs=True)
 
     # ------------------------------------------------------------ teardown
     def _teardown(self, handle: SliceHandle, terminate: bool,

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 import json
+import os
 import subprocess
 import time
 from typing import List, Optional
@@ -71,17 +72,57 @@ def wait_for_ssh(info: ClusterInfo,
             retryable_in_zone=True)
 
 
+def _internal_keypair(cluster_name: str):
+    """Cluster-internal SSH keypair (generated once per cluster,
+    client-side): the private half goes to the head, the public half
+    into every host's authorized_keys — so the head-resident gang
+    driver reaches workers over the slice's internal network with the
+    client long gone. Returns (private_key_path, pubkey_line)."""
+    from skypilot_tpu.utils import paths
+    key_dir = paths.generated_dir() / cluster_name
+    key_dir.mkdir(parents=True, exist_ok=True)
+    priv = key_dir / "internal_key"
+    if not priv.exists():
+        # Pure-python keygen (the client image need not ship ssh-keygen).
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric import ed25519
+        key = ed25519.Ed25519PrivateKey.generate()
+        priv_bytes = key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.OpenSSH,
+            serialization.NoEncryption())
+        pub_bytes = key.public_key().public_bytes(
+            serialization.Encoding.OpenSSH,
+            serialization.PublicFormat.OpenSSH)
+        # .pub first, then the private key ATOMICALLY (tmp + rename):
+        # the gate above is priv.exists(), so no crash point may leave
+        # an existing-but-incomplete private key it would trust forever.
+        priv.with_suffix(".pub").write_text(
+            f"{pub_bytes.decode()} stpu-internal-{cluster_name}\n")
+        tmp = priv.with_suffix(".tmp")
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        try:
+            os.write(fd, priv_bytes)
+        finally:
+            os.close(fd)
+        os.replace(tmp, priv)
+    pub = priv.with_suffix(".pub").read_text().strip()
+    return priv, pub
+
+
 def setup_agent_runtime(info: ClusterInfo,
                         cluster_identity: Optional[dict] = None) -> None:
-    """Ship the framework wheel, record the cluster identity, and start
-    the head daemon — all hosts in parallel (reference:
-    instance_setup.setup_runtime_on_cluster:173 +
-    start_skylet_on_head_node:407). ``cluster_identity`` is the daemon's
-    cluster.json (who am I + provider config for self-stop)."""
+    """Ship the framework wheel, record the cluster identity, install
+    the cluster-internal keypair, and start the head daemon — all hosts
+    in parallel (reference: instance_setup.setup_runtime_on_cluster:173
+    + start_skylet_on_head_node:407). ``cluster_identity`` is the
+    daemon's cluster.json (who am I + provider config for self-stop)."""
     import shlex
 
+    from skypilot_tpu.agent import constants as agent_constants
     from skypilot_tpu.utils import wheel_utils
     wheel_path = wheel_utils.build_wheel()
+    priv_key, pub_key = _internal_keypair(info.cluster_name)
     instances = info.ordered_instances()
     identity_json = json.dumps(cluster_identity or {
         "cluster_name": info.cluster_name,
@@ -92,13 +133,22 @@ def setup_agent_runtime(info: ClusterInfo,
     def bring_up(inst):
         runner = _ssh_runner(info, inst)
         runner.rsync(str(wheel_path), "~/.stpu_wheels/", up=True)
+        is_head = inst.instance_id == info.head_instance_id
         cmd = (f"{_RUNTIME_INSTALL_CMD} && "
-               "mkdir -p ~/.stpu_agent && "
+               "mkdir -p ~/.stpu_agent ~/.ssh && chmod 700 ~/.ssh && "
+               f"{{ grep -qxF {shlex.quote(pub_key)} "
+               "~/.ssh/authorized_keys 2>/dev/null || "
+               f"printf '%s\\n' {shlex.quote(pub_key)} "
+               ">> ~/.ssh/authorized_keys; } && "
+               "chmod 600 ~/.ssh/authorized_keys && "
                f"printf '%s' {shlex.quote(identity_json)} "
                "> ~/.stpu_agent/cluster.json")
-        # Only the head runs the daemon (job DB + autostop live there).
-        if inst.instance_id == info.head_instance_id:
-            cmd += " && " + _AGENT_START_CMD
+        if is_head:
+            runner.run("mkdir -p ~/.ssh && chmod 700 ~/.ssh")
+            runner.rsync(str(priv_key),
+                         agent_constants.INTERNAL_KEY_PATH, up=True)
+            cmd += (f" && chmod 600 {agent_constants.INTERNAL_KEY_PATH}"
+                    " && " + _AGENT_START_CMD)
         rc = runner.run(cmd)
         runner.check_returncode(rc, "agent bring-up",
                                 f"host {inst.instance_id}")

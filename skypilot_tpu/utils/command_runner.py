@@ -15,6 +15,7 @@ import os
 import pathlib
 import shlex
 import subprocess
+import sys
 import tempfile
 from typing import Dict, List, Optional, Tuple, Union
 
@@ -57,6 +58,11 @@ def _run_with_log(cmd: List[str], *, log_path: Optional[str],
 
 class CommandRunner:
     """Abstract: run a shell command on a host / rsync files to it."""
+
+    # Interpreter that has the framework wheel importable on the host.
+    # SSH hosts pip-install the shipped wheel into the system python3;
+    # local directory-hosts reuse this process's interpreter.
+    remote_python = "python3"
 
     def __init__(self, node_id: str, internal_ip: str):
         self.node_id = node_id
@@ -151,6 +157,8 @@ class LocalCommandRunner(CommandRunner):
     semantics (per-host file trees, per-host logs) hold on one machine.
     """
 
+    remote_python = sys.executable
+
     def __init__(self, node_id: str, host_dir: str):
         super().__init__(node_id, "127.0.0.1")
         self.host_dir = pathlib.Path(host_dir)
@@ -162,6 +170,14 @@ class LocalCommandRunner(CommandRunner):
             cmd = " ".join(shlex.quote(c) for c in cmd)
         full_env = dict(os.environ)
         full_env["HOME"] = str(self.host_dir)
+        # Simulate the wheel install real hosts get: make the framework
+        # importable from the fake host's cwd (the host root dir).
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        existing = full_env.get("PYTHONPATH", "")
+        if pkg_root not in existing.split(":"):
+            full_env["PYTHONPATH"] = (f"{pkg_root}:{existing}"
+                                      if existing else pkg_root)
         if env:
             full_env.update({k: str(v) for k, v in env.items()})
         argv = ["bash", "-c", cmd]

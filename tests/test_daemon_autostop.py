@@ -51,10 +51,11 @@ def test_autostop_stops_idle_cluster_without_client(live_daemon):
     job_id, handle = execution.launch(
         task, cluster_name="t-auto", detach_run=True, stream_logs=False,
         idle_minutes_to_autostop=0)
-    # Daemon process exists on the head host.
     pid_path = pathlib.Path(handle.head_home) / ".stpu_agent" / \
         "daemon.pid"
-    assert _wait(pid_path.exists)
+    # (With -i 0 the daemon may stop the cluster within one tick of the
+    # job ending, so pid_path existing is racy to observe; the stop
+    # itself — below — is the proof the daemon ran.)
 
     # No further client calls: the daemon notices idleness and stops the
     # cluster via the provider API.

@@ -1,0 +1,240 @@
+"""Head-resident job queue: the VERDICT r2 #1 done-criterion.
+
+The job DB, job logs, and the detached gang driver live on the cluster
+HEAD (reference: _exec_code_on_head + JobLibCodeGen,
+sky/backends/cloud_vm_ray_backend.py:3180, sky/skylet/job_lib.py:803).
+Proven here for a plain (non-controller) cluster:
+
+  * the client process is hard-killed right after submit — the job still
+    runs to completion;
+  * `queue` from a DIFFERENT client process reads the head's state;
+  * the on-host daemon observes idleness from the head DB and autostops
+    the cluster with no client anywhere.
+
+Plus unit coverage of the head-side transports: the SSH-cluster job spec
+(head runs rank 0 as a plain subprocess, reaches workers over internal
+IPs with the cluster-internal key) and gang_exec's "exec" host kind.
+"""
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from skypilot_tpu import core
+from skypilot_tpu.agent import constants as agent_constants
+from skypilot_tpu.agent import gang_exec
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.provision.common import ClusterInfo, InstanceInfo
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+def _wait(pred, timeout=30, interval=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ------------------------------------------------------- the done-criterion
+def test_job_survives_client_death_and_daemon_autostops(
+        tmp_state_dir, monkeypatch):
+    """Client submits and is KILLED; job completes; a fresh client's
+    `queue` reads head state; the daemon autostops the idle cluster."""
+    monkeypatch.setenv("STPU_DISABLE_DAEMON", "0")
+    monkeypatch.setenv("STPU_DAEMON_INTERVAL", "0.2")
+
+    # The "client": a separate process that launches with autostop -i 0,
+    # a job that takes ~1.5s, then hard-exits without waiting.
+    client_script = textwrap.dedent("""
+        import os
+        from skypilot_tpu import execution
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.task import Task
+        task = Task("survivor",
+                    run="sleep 1.5 && echo finished > $HOME/marker.txt")
+        task.set_resources(Resources(cloud="local"))
+        job_id, handle = execution.launch(
+            task, cluster_name="t-headres", detach_run=True,
+            stream_logs=False, idle_minutes_to_autostop=0)
+        print(f"JOBID={job_id} HEAD={handle.head_home}", flush=True)
+        os._exit(0)  # hard death: no cleanup, no atexit, no waiting
+    """)
+    proc = subprocess.run([sys.executable, "-c", client_script],
+                          capture_output=True, text=True, timeout=120,
+                          env=dict(os.environ))
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    fields = dict(kv.split("=", 1)
+                  for kv in proc.stdout.split() if "=" in kv)
+    job_id = int(fields["JOBID"])
+    head_home = pathlib.Path(fields["HEAD"])
+
+    # The job was submitted while the client lived; it finishes AFTER
+    # the client died (the sleep outlives the client by construction).
+    marker = head_home / "marker.txt"
+    assert _wait(marker.exists, timeout=30), \
+        "job did not run to completion after client death"
+
+    # A brand-new client process reads the job from the HEAD's DB.
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from skypilot_tpu import core; import json; "
+         "print(json.dumps(core.queue('t-headres')))"],
+        capture_output=True, text=True, timeout=60, env=dict(os.environ))
+    assert out.returncode == 0, out.stderr[-3000:]
+    jobs = json.loads(out.stdout.strip().splitlines()[-1])
+    by_id = {j["job_id"]: j for j in jobs}
+    assert _wait(lambda: core.job_status(
+        "t-headres", [job_id])[job_id] == "SUCCEEDED", timeout=20)
+    assert by_id[job_id]["job_name"] == "survivor"
+
+    # With zero clients involved, the daemon sees the idle head DB and
+    # stops the cluster via the provider API.
+    from skypilot_tpu.provision import local as local_provider
+
+    def provider_stopped():
+        statuses = local_provider.query_instances("t-headres", {})
+        return statuses and set(statuses.values()) == {"stopped"}
+    assert _wait(provider_stopped, timeout=30), \
+        "daemon never autostopped the idle cluster"
+
+
+# ------------------------------------------------ head-side spec transports
+def _ssh_handle(n_hosts=3):
+    instances = {
+        f"w{i}": InstanceInfo(
+            instance_id=f"w{i}", internal_ip=f"10.0.0.{i}",
+            external_ip=f"34.1.2.{i}", slice_id="s0", host_index=i,
+            tags={})
+        for i in range(n_hosts)
+    }
+    info = ClusterInfo(
+        cluster_name="ssh-c", provider_name="gcp",
+        region="us-central1", zone="us-central1-a",
+        instances=instances, head_instance_id="w0",
+        ssh_user="stpu", ssh_key_path="~/.ssh/id_rsa",
+        provider_config={"ssh_proxy_command": "corp-proxy %h"})
+    res = Resources(cloud="gcp", accelerator="tpu-v5p-32")
+    return slice_backend.SliceHandle("ssh-c", res, 1, info)
+
+
+def test_ssh_cluster_spec_is_head_relative(tmp_state_dir):
+    """Rank 0 = plain subprocess on the head; workers = INTERNAL ips +
+    the cluster-internal key; never the client's key or proxy."""
+    handle = _ssh_handle(3)
+    task = Task("spec", run="echo hi")
+    task.set_resources(handle.launched_resources)
+    backend = slice_backend.SliceBackend()
+    spec = backend._build_job_spec(handle, task, "2026-01-01-00-00-00")
+
+    assert "job_id" not in spec  # assigned on the head by job_cli
+    assert spec["hosts"][0]["kind"] == "exec"
+    for rank, host in enumerate(spec["hosts"][1:], start=1):
+        assert host["kind"] == "ssh"
+        assert host["ip"] == f"10.0.0.{rank}"  # internal, not 34.x
+        assert host["ssh_key_path"] == agent_constants.INTERNAL_KEY_PATH
+        assert host["proxy_command"] is None  # slice-internal network
+    assert spec["node_ips"] == ["10.0.0.0", "10.0.0.1", "10.0.0.2"]
+
+
+def test_gang_exec_kind_exec_runs_on_head(tmp_state_dir, tmp_path,
+                                          monkeypatch):
+    """The "exec" host kind runs the command as the head's own process
+    (no SSH-to-self), with the rank env contract intact."""
+    head = tmp_path / "headhome"
+    head.mkdir()
+    monkeypatch.setenv("HOME", str(head))
+    job_id = job_lib.add_job("t", "u", "ts", "")
+    spec = {
+        "job_id": job_id,
+        "task_id": "t-1",
+        "cluster_name": "c",
+        "node_ips": ["10.0.0.0"],
+        "num_slices": 1,
+        "hosts_per_slice": 1,
+        "chips_per_host": 0,
+        "envs": {},
+        "run_cmd": "echo rank=$SKYPILOT_NODE_RANK > out.txt",
+        "log_dir": str(head / "logs"),
+        "hosts": [{"kind": "exec", "slice_index": 0}],
+        "agent_home": None,
+    }
+    rc = gang_exec.run_gang(spec)
+    assert rc == 0
+    assert (head / "out.txt").read_text().strip() == "rank=0"
+    assert job_lib.get_job(job_id)["status"] == "SUCCEEDED"
+
+
+# ---------------------------------------------------------- job_cli seam
+def test_job_cli_round_trip(tmp_state_dir, tmp_path, monkeypatch):
+    """submit/queue/status/cancel through the CLI seam the client uses."""
+    from skypilot_tpu.agent import job_cli
+
+    head = tmp_path / "head2"
+    head.mkdir()
+    monkeypatch.setenv("HOME", str(head))
+
+    spec = {
+        "job_name": "cli-job", "username": "tester",
+        "run_timestamp": "ts", "cluster_name": "c",
+        "node_ips": ["10.0.0.0"], "num_slices": 1,
+        "hosts_per_slice": 1, "chips_per_host": 0, "envs": {},
+        "run_cmd": "sleep 30",
+        "hosts": [{"kind": "exec", "slice_index": 0}],
+    }
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps(spec))
+
+    def rpc(args):
+        proc = subprocess.run(
+            [sys.executable, "-m", "skypilot_tpu.agent.job_cli"] + args,
+            capture_output=True, text=True, timeout=60,
+            env=dict(os.environ))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return job_cli.parse_reply(proc.stdout)
+
+    reply = rpc(["submit", str(spec_path)])
+    jid = reply["job_id"]
+    assert jid == 1
+    # Spec rewritten in place with head-assigned fields.
+    final = json.loads(spec_path.read_text())
+    assert final["job_id"] == jid
+    assert final["agent_home"] is None
+
+    assert _wait(lambda: rpc(["status", str(jid)])["status"] == "RUNNING")
+    jobs = rpc(["queue"])
+    assert jobs[0]["job_name"] == "cli-job"
+    assert jobs[0]["log_dir"].endswith(f"job-{jid}")
+
+    cancelled = rpc(["cancel", "--jobs", str(jid)])
+    assert cancelled == [jid]
+    assert _wait(
+        lambda: rpc(["status", str(jid)])["status"] == "CANCELLED")
+
+
+def test_cancel_empty_list_cancels_nothing(tmp_state_dir, monkeypatch):
+    """backend.cancel_jobs(handle, []) must be a no-op, not cancel-all
+    (an empty --jobs value would read as 'all live jobs' in job_cli)."""
+    backend = slice_backend.SliceBackend()
+    called = []
+    monkeypatch.setattr(backend, "_job_rpc",
+                        lambda *a, **k: called.append(a) or [])
+    assert backend.cancel_jobs(object(), []) == []
+    assert called == []  # never reached the head
+
+
+def test_parse_reply_ignores_login_shell_noise():
+    from skypilot_tpu.agent import job_cli
+    noisy = ("Welcome to Ubuntu\nmotd chatter\n"
+             'STPU_RPC:{"job_id": 7}\n')
+    assert job_cli.parse_reply(noisy) == {"job_id": 7}
+    with pytest.raises(ValueError, match="no STPU_RPC"):
+        job_cli.parse_reply("just noise\n")

@@ -76,17 +76,20 @@ def test_gang_failure_cancels_all_hosts():
     assert status == "FAILED"
     # Far faster than the 60s sleep: survivors were force-cancelled.
     assert time.time() - t0 < 30
-    # The cancelled node's log is annotated with the gang rc.
-    from skypilot_tpu.backends import slice_backend
-    backend = slice_backend.SliceBackend()
-    log_dir = backend._job_log_dir(handle, job_id)
+    # The cancelled node's log is annotated with the gang rc. Logs are
+    # head-resident: the head's job DB records where they landed.
+    import pathlib
+
+    from skypilot_tpu import core as core_lib
+    job = {j["job_id"]: j for j in core_lib.queue("t-gang")}[job_id]
+    log_dir = pathlib.Path(job["log_dir"])
     combined = "".join(
         p.read_text() for p in log_dir.glob("node-*.log"))
     assert "rc=137" in combined
 
 
 @pytest.mark.usefixtures("tmp_state_dir")
-def test_exec_reuse_queue_cancel_and_logs(capsys):
+def test_exec_reuse_queue_cancel_and_logs(capfd):
     task = Task("first", run="echo hello-from-run", num_nodes=1)
     task.set_resources(_local_res())
     job_id, handle = execution.launch(task, cluster_name="t-reuse",
@@ -108,9 +111,10 @@ def test_exec_reuse_queue_cancel_and_logs(capsys):
     st = core.job_status("t-reuse", [job_id2])[job_id2]
     assert st == "CANCELLED"
 
-    # tail_logs of the finished first job prints its output.
+    # tail_logs of the finished first job prints its output (streamed
+    # from the head-side job_cli subprocess, so capture at fd level).
     rc = core.tail_logs("t-reuse", job_id, follow=False)
-    out = capsys.readouterr().out
+    out = capfd.readouterr().out
     assert "hello-from-run" in out
     assert rc == 0
 
