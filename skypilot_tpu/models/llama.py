@@ -217,7 +217,28 @@ def attention_block(cfg, x: jax.Array, lp: Params, positions: jax.Array,
 
 
 def embed_tokens(params: Params, tokens: jax.Array, constrain) -> jax.Array:
-    x = params["embed"][tokens]
+    """Token embedding lookup, SPMD-aware.
+
+    Under a multi-device mesh the lookup is a one-hot matmul rather than
+    a gather: a gather whose operand is sharded on the embed dim (the
+    fsdp layout of the table) produces output sharded on that dim, and
+    the SPMD partitioner cannot move that sharding to the batch dim
+    without an "involuntary full rematerialization" (replicate + re-
+    partition — the warning the multichip dryrun used to log). A dot is
+    freely repartitionable: XLA all-gathers the table's fsdp shards
+    (exactly FSDP's prefetch-before-use) and psums over a sharded vocab.
+    Single-device paths (serving decode, CPU tests) keep the O(1) gather.
+    """
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    table = params["embed"]
+    ctx = mesh_lib.current_mesh_rules()
+    if ctx is not None and ctx[0].size > 1:
+        one_hot = jax.nn.one_hot(tokens, table.shape[0],
+                                 dtype=table.dtype)
+        one_hot = constrain(one_hot, ("batch", "act_seq", "vocab"))
+        x = one_hot @ table
+    else:
+        x = table[tokens]
     return constrain(x, ("batch", "act_seq", "act_embed"))
 
 
@@ -346,12 +367,23 @@ def decode(cfg: LlamaConfig, params: Params, prompt: jax.Array,
            key: Optional[jax.Array] = None) -> jax.Array:
     """Prefill + cached decode: prompt (B, S_pad) -> (B, max_tokens).
 
-    ``true_len`` is the un-padded prompt length (prompt may be
-    right-padded to a bucket so serving compiles stay bounded). One
-    O(S) prefill pass, then max_tokens steps of O(max_seq) each.
+    ``true_len`` is the un-padded prompt length — a SCALAR shared by
+    the whole batch (prompt may be right-padded to a bucket so serving
+    compiles stay bounded). Per-example lengths of shape (B,) are NOT
+    supported: logits_at feeds dynamic_slice_in_dim and the cache mask
+    broadcast both assume one shared length, so a batch must be grouped
+    by prompt length (the serving recipe batches per-bucket). One O(S)
+    prefill pass, then max_tokens steps of O(max_seq) each.
     temperature == 0 is greedy; > 0 samples from softmax(logits/T)
     (key required).
     """
+    true_len = jnp.asarray(true_len)
+    if true_len.ndim != 0:
+        raise ValueError(
+            f"true_len must be a scalar (shared, un-padded prompt "
+            f"length); got shape {true_len.shape}. Batched serving "
+            f"with per-example lengths is unsupported — group requests "
+            f"into same-length (bucketed) batches instead.")
     b, s_pad = prompt.shape
     if s_pad + max_tokens > max_seq:
         raise ValueError(

@@ -214,31 +214,44 @@ class Optimizer:
             order, per_task: Dict[int, List[Candidate]],
             minimize: OptimizeTarget) -> Dict[int, Candidate]:
         """Exact DP over the chain with inter-task egress cost
-        (reference: sky/optimizer.py:373 _optimize_by_dp)."""
-        # dp[i][j] = best objective for prefix ending with task i using
-        # its candidate j.
-        INF = float("inf")
+        (reference: sky/optimizer.py:373 _optimize_by_dp).
+
+        Shares _optimize_general's objective exactly — TIME minimizes
+        (makespan, cost incl. egress); COST minimizes (cost incl.
+        egress, total runtime) — so both solvers pick the same plan for
+        the same chain. Lexicographic tuples accumulate additively, so
+        prefix-optimality (and thus the DP) holds for the pair.
+        """
         n = len(order)
         cands0 = per_task[id(order[0])]
-        dp: List[List[float]] = [[0.0] * len(per_task[id(t)])
-                                 for t in order]
+        # dp[i][j] = best (primary, secondary) for the prefix ending
+        # with task i using its candidate j. Egress is money: it adds
+        # to the cost component (secondary under TIME, primary under
+        # COST), never to the runtime component.
+        dp: List[List[Tuple[float, float]]] = [
+            [(0.0, 0.0)] * len(per_task[id(t)]) for t in order]
         back: List[List[int]] = [[-1] * len(per_task[id(t)])
                                  for t in order]
+        time_mode = minimize == OptimizeTarget.TIME
         for j, c in enumerate(cands0):
-            dp[0][j] = Optimizer._objective(c, minimize)[0]
+            dp[0][j] = Optimizer._objective(c, minimize)
         for i in range(1, n):
             parent = order[i - 1]
             pc = per_task[id(parent)]
             cc = per_task[id(order[i])]
             for j, child in enumerate(cc):
-                best, arg = INF, -1
-                base = Optimizer._objective(child, minimize)[0]
+                base = Optimizer._objective(child, minimize)
+                best, arg = None, -1
                 for pj, pcand in enumerate(pc):
                     egress = Optimizer._egress_cost(parent, pcand, child)
-                    if minimize == OptimizeTarget.TIME:
-                        egress = 0.0  # egress is money, not time
-                    total = dp[i - 1][pj] + base + egress
-                    if total < best:
+                    prev = dp[i - 1][pj]
+                    if time_mode:
+                        total = (prev[0] + base[0],
+                                 prev[1] + base[1] + egress)
+                    else:
+                        total = (prev[0] + base[0] + egress,
+                                 prev[1] + base[1])
+                    if best is None or total < best:
                         best, arg = total, pj
                 dp[i][j] = best
                 back[i][j] = arg

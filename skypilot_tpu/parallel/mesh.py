@@ -120,9 +120,21 @@ def make_multislice_mesh(ici_axes: Mapping[str, int], num_slices: int,
                 f"{dict(sorted(counts.items()))}, but num_slices="
                 f"{num_slices} x {per_slice} was requested — the DCN "
                 f"axis would not align with slice boundaries.")
-    order = sorted(devices,
-                   key=lambda d: (getattr(d, "slice_index", 0) or 0,
-                                  getattr(d, "id", 0)))
+    # Intra-slice order follows PHYSICAL coordinates when the platform
+    # exposes them: raw device ids need not walk the ICI torus, and an
+    # id-ordered reshape can land a "fast" axis on non-adjacent chips
+    # (correct results, degraded collective bandwidth). Virtual/CPU
+    # devices have no coords and keep the id order.
+    def _physical_key(d):
+        coords = getattr(d, "coords", None)
+        core = getattr(d, "core_on_chip", 0)
+        if coords is not None:
+            return (getattr(d, "slice_index", 0) or 0, tuple(coords),
+                    core)
+        return (getattr(d, "slice_index", 0) or 0, (),
+                getattr(d, "id", 0))
+
+    order = sorted(devices, key=_physical_key)
     dev_array = np.asarray(order).reshape(
         (num_slices,) + tuple(sizes.values()))
     return Mesh(dev_array, (dcn_axis,) + tuple(sizes.keys()))

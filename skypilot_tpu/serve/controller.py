@@ -120,9 +120,12 @@ class SkyServeController:
                 rm.scale_down(rid)
         outdated = set(rm.outdated_alive_ids())
         if rm.ready_current_count() >= target:
-            for rid in outdated & self._draining:
+            terminated = outdated & self._draining
+            for rid in terminated:
                 rm.scale_down(rid)
-            self._draining = outdated
+            # Next tick terminates only the NEWLY draining replicas —
+            # the ones just terminated must not be scaled down twice.
+            self._draining = outdated - terminated
         else:
             self._draining = set()
         ready = rm.ready_urls(exclude_ids=self._draining)
