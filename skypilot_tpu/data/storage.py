@@ -29,6 +29,17 @@ class StorageMode(enum.Enum):
     COPY = "COPY"
 
 
+def shell_path(p: str) -> str:
+    """Quote a destination path for a generated shell command, keeping a
+    leading ``~`` expandable (quoted tildes never expand; translated
+    workdir mounts target ``~/stpu_workdir`` on every host)."""
+    if p == "~":
+        return '"$HOME"'
+    if p.startswith("~/"):
+        return '"$HOME"/' + shlex.quote(p[2:])
+    return shlex.quote(p)
+
+
 class StoreType(enum.Enum):
     GCS = "gcs"
     S3 = "s3"
@@ -92,9 +103,9 @@ class GcsStore(AbstractStore):
         self._run(["gsutil", "-m", "rm", "-r", f"gs://{self.name}"])
 
     def fetch_command(self, dst: str) -> str:
-        q = shlex.quote
-        return (f"mkdir -p {q(dst)} && "
-                f"gsutil -m rsync -r gs://{self.name} {q(dst)}")
+        d = shell_path(dst)
+        return (f"mkdir -p {d} && "
+                f"gsutil -m rsync -r gs://{self.name} {d}")
 
     def mount_fuse_command(self, dst: str) -> str:
         return mounting_utils.get_gcs_mount_command(self.name, dst)
@@ -124,9 +135,9 @@ class S3Store(AbstractStore):
         self._run(["aws", "s3", "rb", f"s3://{self.name}", "--force"])
 
     def fetch_command(self, dst: str) -> str:
-        q = shlex.quote
-        return (f"mkdir -p {q(dst)} && "
-                f"aws s3 sync s3://{self.name} {q(dst)}")
+        d = shell_path(dst)
+        return (f"mkdir -p {d} && "
+                f"aws s3 sync s3://{self.name} {d}")
 
     def mount_fuse_command(self, dst: str) -> str:
         return mounting_utils.get_s3_mount_command(self.name, dst)
@@ -141,8 +152,18 @@ class LocalStore(AbstractStore):
 
     def __init__(self, name: str, source: Optional[str] = None):
         super().__init__(name, source)
+        import pathlib
+
         from skypilot_tpu.utils import paths
-        self.bucket_dir = paths.home() / "buckets" / name
+        # STPU_BUCKET_ROOT makes the fake bucket namespace GLOBAL across
+        # the simulated topology (client + controller + task hosts all on
+        # one machine with different STPU_HOMEs) — the local analog of
+        # GCS buckets being visible from anywhere. controller_command
+        # exports it so self-hosted controllers resolve client-uploaded
+        # buckets.
+        root = os.environ.get("STPU_BUCKET_ROOT")
+        base = pathlib.Path(root) if root else paths.home() / "buckets"
+        self.bucket_dir = base / name
 
     def upload(self) -> None:
         self.bucket_dir.mkdir(parents=True, exist_ok=True)
@@ -164,16 +185,18 @@ class LocalStore(AbstractStore):
 
     def fetch_command(self, dst: str) -> str:
         q = shlex.quote
-        return (f"mkdir -p {q(dst)} && "
-                f"cp -r {q(str(self.bucket_dir))}/. {q(dst)}/")
+        d = shell_path(dst)
+        return (f"mkdir -p {d} && "
+                f"cp -r {q(str(self.bucket_dir))}/. {d}/")
 
     def mount_fuse_command(self, dst: str) -> str:
         # rm -rf first: if dst already exists as a real directory,
         # `ln -s` would create the link *inside* it at the wrong path.
         # (On a symlink, rm -rf removes only the link.)
         q = shlex.quote
-        return (f"mkdir -p $(dirname {q(dst)}) && rm -rf {q(dst)} && "
-                f"ln -s {q(str(self.bucket_dir))} {q(dst)}")
+        d = shell_path(dst)
+        return (f"mkdir -p $(dirname {d}) && rm -rf {d} && "
+                f"ln -s {q(str(self.bucket_dir))} {d}")
 
 
 _STORE_CLASSES = {

@@ -82,6 +82,11 @@ class JobsController:
                                   ManagedJobStatus.FAILED_CONTROLLER,
                                   failure_reason=repr(e))
             raise
+        finally:
+            # Job-scoped translated buckets (workdir/file mounts) die
+            # with the job — they were only ever recovery intermediates.
+            from skypilot_tpu.utils import controller_utils
+            controller_utils.cleanup_translated_buckets(self.dag)
 
     def _handle_cancel_signal(self, signum, frame) -> None:
         del signum, frame

@@ -61,6 +61,16 @@ def launch(entrypoint: Union[Task, dag_lib.Dag],
             "Managed jobs support single tasks or chain pipelines only.")
     dag.name = name or dag.name or dag.tasks[0].name or "unnamed"
 
+    # Client-local workdir/file_mounts become bucket mounts NOW, while
+    # the paths exist: the controller (possibly on another machine) and
+    # every preemption-recovery relaunch restore them from the bucket
+    # (reference: maybe_translate_local_file_mounts_and_sync_up,
+    # sky/utils/controller_utils.py:568).
+    run_id = f"{int(time.time() * 1000) % 10**10}-{os.getpid()}"
+    for i, task in enumerate(dag.tasks):
+        controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+            task, run_id=f"{run_id}-t{i}")
+
     mode = controller or controller_utils.controller_mode(_JOBS)
     if mode == "local" or not detach:
         return _launch_local(dag, detach)

@@ -62,6 +62,13 @@ def up(task: Task, service_name: Optional[str] = None,
             "Task YAML needs a `service:` section for `serve up`.")
     service_name = service_name or task.name or "service"
 
+    # Replica clusters are launched (and preemption-relaunched) by the
+    # controller, which cannot see client-local paths: translate them to
+    # bucket mounts now (same contract as jobs.launch; reference:
+    # sky/utils/controller_utils.py:568).
+    controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+        task, run_id=f"sv-{service_name}-{int(time.time() * 1000)}")
+
     mode = controller or controller_utils.controller_mode(_SERVE)
     if mode == "local":
         return _up_local(task, service_name)
@@ -124,6 +131,8 @@ def update(task: Task, service_name: str,
     if task.service is None:
         raise exceptions.InvalidTaskError(
             "Task YAML needs a `service:` section for `serve update`.")
+    controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+        task, run_id=f"sv-{service_name}-u{int(time.time() * 1000)}")
     mode = controller or controller_utils.controller_mode(_SERVE)
     if mode == "local":
         return _update_local(task, service_name)
@@ -170,11 +179,25 @@ def _update_local(task: Task, service_name: str) -> int:
     if version is None:
         raise exceptions.SkyTpuError(
             f"Service {service_name!r} disappeared during update.")
-    # Prune superseded revision files, keeping the new one and the one
-    # the controller may still be mid-read on (the pre-bump current).
+    # Prune superseded revision files — including the ORIGINAL
+    # {service_name}.yaml from `serve up` once it is no longer current —
+    # keeping the new one and the one the controller may still be
+    # mid-read on (the pre-bump current).
     keep = {str(new_yaml), row["task_yaml_path"]}
-    for old in serve_dir.glob(f"{service_name}-update-*.yaml"):
+    candidates = list(serve_dir.glob(f"{service_name}-update-*.yaml"))
+    initial = serve_dir / f"{service_name}.yaml"
+    if initial.exists():
+        candidates.append(initial)
+    for old in candidates:
         if str(old) not in keep:
+            # The superseded revision's translated buckets go with it
+            # (its replicas are being rolled out; only the live yamls'
+            # buckets remain reachable for recovery).
+            try:
+                controller_utils.cleanup_translated_buckets(
+                    Task.from_yaml(str(old)))
+            except Exception:  # noqa: BLE001
+                pass
             try:
                 old.unlink()
             except OSError:
@@ -225,6 +248,14 @@ def _down_local(service_names: Optional[List[str]], all_services: bool,
                 time.sleep(0.2)
         if serve_state.get_service(name) is not None:
             _finalize_dead_service(name)
+        # Translated (job-scoped) buckets die with the service.
+        yaml_path = svc.get("task_yaml_path")
+        if yaml_path and os.path.exists(yaml_path):
+            try:
+                controller_utils.cleanup_translated_buckets(
+                    Task.from_yaml(yaml_path))
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
         done.append(name)
     return done
 

@@ -118,11 +118,20 @@ def _controller_python(handle) -> str:
 def controller_command(handle, argv: list) -> str:
     """Wrap a framework command for execution on a controller host: state
     isolated under the host's own $HOME, package importable (PYTHONPATH
-    covers the local provider; SSH hosts have the wheel installed)."""
+    covers the local provider; SSH hosts have the wheel installed). On
+    the local provider the client's fake-bucket root is exported so
+    translated storage mounts stay resolvable (the local analog of GCS
+    being globally visible)."""
     inner = " ".join(shlex.quote(a) for a in argv)
-    return (f'export STPU_HOME="$HOME/.stpu"; '
-            f'export PYTHONPATH={shlex.quote(_repo_root())}:"$PYTHONPATH"; '
-            f"{inner}")
+    prefix = (f'export STPU_HOME="$HOME/.stpu"; '
+              f'export PYTHONPATH={shlex.quote(_repo_root())}:'
+              f'"$PYTHONPATH"; ')
+    if getattr(handle, "provider_name", None) == "local":
+        from skypilot_tpu.utils import paths
+        bucket_root = os.environ.get(
+            "STPU_BUCKET_ROOT", str(paths.home() / "buckets"))
+        prefix += f"export STPU_BUCKET_ROOT={shlex.quote(bucket_root)}; "
+    return prefix + inner
 
 
 def run_on_controller(handle, module_argv: list, *,
@@ -159,3 +168,123 @@ def module_command(module: str, *args: str) -> list:
     """[module, *args] for run_on_controller (interpreter resolved
     per-provider there)."""
     return [module, *args]
+
+
+# ------------------------------------------------ local-mount translation
+def _translation_store() -> str:
+    """Store type for translated mounts: explicit config wins; else GCS
+    when GCP is enabled; else the hermetic local store."""
+    configured = config_lib.get_nested(("controller", "bucket_store"),
+                                       None)
+    if configured:
+        return str(configured)
+    enabled = global_user_state.get_enabled_clouds()
+    return "gcs" if "gcp" in (enabled or []) else "local"
+
+
+def maybe_translate_local_file_mounts_and_sync_up(task,
+                                                  run_id: str) -> None:
+    """Rewrite client-local workdir/file_mounts into bucket storage
+    mounts, uploading NOW (reference:
+    sky/utils/controller_utils.py:568).
+
+    A task handed to a self-hosted controller otherwise references paths
+    that exist only on the client: the controller cluster can't see
+    them, and preemption recovery would re-sync nothing. After this
+    call the task carries no client-local paths:
+
+      * ``workdir:`` → bucket ``stpu-jobs-wd-<run_id>`` COPY-mounted at
+        ``~/stpu_workdir`` (where run/setup already cd to);
+      * each local ``file_mounts`` entry → bucket
+        ``stpu-jobs-fm-<n>-<run_id>`` COPY-mounted at its destination;
+      * cloud-store URIs (gs://, s3://, http...) stay as file_mounts —
+        they are already recoverable from anywhere.
+
+    Buckets are marked non-persistent (job-scoped intermediates).
+    Mutates ``task`` in place. No-op when nothing is client-local.
+    """
+    from skypilot_tpu.data import cloud_stores
+    from skypilot_tpu.data import storage as storage_lib
+
+    store = _translation_store()
+
+    def bucket_name(tag: str) -> str:
+        # Bucket names: lowercase, no underscores (GCS naming rules).
+        return f"stpu-jobs-{tag}-{run_id}".lower().replace("_", "-")
+
+    def translated(tag: str, src: str) -> Any:
+        sto = storage_lib.Storage(
+            name=bucket_name(tag), source=src, store=store,
+            persistent=False, mode="COPY")
+        sto.sync()  # upload while the client-local path still exists
+        # Drop the local source: the controller must never re-sync from
+        # a client path, and to_yaml_config must not ship one.
+        sto.source = None
+        sto.store.source = None
+        return sto
+
+    from skypilot_tpu.agent import constants as agent_constants
+    new_storage = {}
+    if task.workdir is not None:
+        # Mounted where setup/run already cd to (slice_backend prepends
+        # `cd ~/{WORKDIR}` to both).
+        new_storage[f"~/{agent_constants.WORKDIR}"] = translated(
+            "wd", task.workdir)
+        task.workdir = None
+
+    remaining = {}
+    for i, (dst, src) in enumerate(sorted(
+            (task.file_mounts or {}).items())):
+        if cloud_stores.is_cloud_store_url(src):
+            remaining[dst] = src
+            continue
+        src_abs = os.path.abspath(os.path.expanduser(src))
+        if os.path.isfile(src_abs):
+            # A single FILE must stay a file at dst — a bucket mount
+            # would turn dst into a directory. Upload it and rewrite the
+            # mount as a bucket URI the backend downloads file-to-file.
+            sto = translated(f"fm{i}", src)
+            remaining[dst] = (f"{_SCHEME.get(store, store)}://"
+                              f"{sto.name}/{os.path.basename(src_abs)}")
+        else:
+            new_storage[dst] = translated(f"fm{i}", src)
+    task.file_mounts = remaining
+    if new_storage:
+        task.storage_mounts = {**(task.storage_mounts or {}),
+                               **new_storage}
+
+
+# URI scheme <-> store-type mapping for translated single-file mounts.
+_SCHEME = {"gcs": "gs", "s3": "s3", "local": "local"}
+_STORE_BY_SCHEME = {v: k for k, v in _SCHEME.items()}
+
+
+def cleanup_translated_buckets(dag_or_task) -> None:
+    """Delete the job-scoped buckets translation created, when the
+    managed job / service that owns them ends (the reference deletes
+    intermediate buckets at job termination). Identified by the
+    non-persistent flag (storage mounts) and the ``stpu-jobs-`` bucket
+    prefix (translated single-file URIs). Best-effort: a half-deleted
+    bucket set must never fail job finalization."""
+    from skypilot_tpu.data import storage as storage_lib
+    tasks = getattr(dag_or_task, "tasks", None) or [dag_or_task]
+    for task in tasks:
+        for sto in (task.storage_mounts or {}).values():
+            if getattr(sto, "persistent", True):
+                continue
+            try:
+                sto.delete()
+            except Exception:  # noqa: BLE001
+                pass
+        for src in (task.file_mounts or {}).values():
+            scheme, sep, rest = str(src).partition("://")
+            bucket = rest.split("/", 1)[0] if sep else ""
+            if (not bucket.startswith("stpu-jobs-")
+                    or scheme not in _STORE_BY_SCHEME):
+                continue
+            try:
+                storage_lib.Storage(
+                    name=bucket, store=_STORE_BY_SCHEME[scheme],
+                    persistent=False).delete()
+            except Exception:  # noqa: BLE001
+                pass

@@ -177,3 +177,96 @@ def test_dag_yaml_roundtrip(tmp_path):
     assert loaded.tasks[0].envs == {"X": "1"}
     assert loaded.tasks[1].num_nodes == 2
     assert loaded.is_chain()
+
+
+# ------------------------------------------- local-mount translation (r2 #3)
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_translate_local_mounts_rewrites_task(tmp_path):
+    """workdir + local file_mounts become source-free bucket mounts;
+    cloud URIs stay (reference: controller_utils.py:568)."""
+    from skypilot_tpu.data.storage import Storage, StorageMode
+    from skypilot_tpu.utils import controller_utils
+
+    wd = tmp_path / "wd"
+    wd.mkdir()
+    (wd / "train.py").write_text("print('hi')")
+    data = tmp_path / "data.txt"
+    data.write_text("payload")
+
+    task = Task("tr", run="cat train.py", workdir=str(wd))
+    task.set_resources(_local_res())
+    task.set_file_mounts({"/data/in.txt": str(data),
+                          "/data/ref": "gs://public-bucket/x"})
+    controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+        task, run_id="test-run-1")
+
+    # Local paths are gone from the task. The single-FILE mount becomes
+    # a bucket URI (downloaded file-to-file — a bucket MOUNT would turn
+    # the dst into a directory); directory mounts become storage mounts.
+    assert task.workdir is None
+    assert set(task.file_mounts) == {"/data/ref", "/data/in.txt"}
+    assert task.file_mounts["/data/ref"] == "gs://public-bucket/x"
+    assert task.file_mounts["/data/in.txt"].startswith("local://")
+    assert task.file_mounts["/data/in.txt"].endswith("/data.txt")
+    assert set(task.storage_mounts) == {"~/stpu_workdir"}
+    for sto in task.storage_mounts.values():
+        assert isinstance(sto, Storage)
+        assert sto.mode == StorageMode.COPY
+        assert sto.source is None
+        assert not sto.persistent
+    # The buckets were uploaded while the paths existed.
+    wd_store = task.storage_mounts["~/stpu_workdir"].store
+    assert (wd_store.bucket_dir / "train.py").read_text() == "print('hi')"
+    # The file-URI download command restores FILE semantics at dst.
+    from skypilot_tpu.data import cloud_stores
+    cmd = cloud_stores.get_storage_from_path(
+        task.file_mounts["/data/in.txt"]).make_download_command(
+            task.file_mounts["/data/in.txt"], "/tmp/x/in.txt")
+    assert "cp -r" in cmd and "/tmp/x/in.txt" in cmd
+    # And the task survives the YAML round-trip the controller does.
+    cfg = task.to_yaml_config()
+    rt = Task.from_yaml_config(cfg)
+    assert set(rt.storage_mounts) == set(task.storage_mounts)
+    assert rt.storage_mounts["~/stpu_workdir"].source is None
+    assert rt.file_mounts["/data/in.txt"] == task.file_mounts["/data/in.txt"]
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_preemption_recovery_restores_translated_workdir(tmp_path):
+    """The r2 VERDICT done-criterion: a managed job with a LOCAL workdir
+    is preempted; the recovered cluster still sees the workdir files —
+    restored from the translated bucket, not from the client path (which
+    is deleted after submission to prove it)."""
+    wd = tmp_path / "wd"
+    wd.mkdir()
+    (wd / "payload.txt").write_text("from-the-bucket")
+    marker = tmp_path / "attempts"
+    out = tmp_path / "result.txt"
+    # Attempt 1 sleeps (gets preempted); attempt 2 reads the restored
+    # workdir file. run: executes under ~/stpu_workdir (COPY-mounted).
+    task = Task("mj-wd", run=(
+        f'n=$(cat {marker} 2>/dev/null || echo 0); '
+        f'echo $((n+1)) > {marker}; '
+        f'if [ "$n" -ge 1 ]; then cat payload.txt > {out}; '
+        f'else sleep 120; fi'), workdir=str(wd))
+    task.set_resources(_local_res(use_spot=True))
+    job_id = jobs.launch(task, detach=True, controller="local")
+
+    _wait_status(job_id, {ManagedJobStatus.RUNNING}, timeout=30)
+    deadline = time.time() + 30
+    while not marker.exists() and time.time() < deadline:
+        time.sleep(0.1)
+    assert marker.exists()
+
+    # Delete the client-local workdir: recovery must NOT depend on it.
+    import shutil
+    shutil.rmtree(wd)
+
+    cluster_name = jobs_state.get_job(job_id)["cluster_name"]
+    local_provider.simulate_preemption(cluster_name)
+
+    status = _wait_status(
+        job_id, {ManagedJobStatus.SUCCEEDED, ManagedJobStatus.FAILED,
+                 ManagedJobStatus.FAILED_CONTROLLER}, timeout=60)
+    assert status == ManagedJobStatus.SUCCEEDED
+    assert out.read_text().strip() == "from-the-bucket"
