@@ -61,6 +61,41 @@ def _build_env(spec: Dict, rank: int) -> Dict[str, str]:
     return env
 
 
+def _ssh_argv_and_script(host: Dict, cmd: str, env: Dict[str, str],
+                         coord_port: Optional[int]):
+    """Build the ssh argv and the stdin script for one worker.
+
+    Separated (and env-free in argv) so tests can assert no secret ever
+    reaches the process list; the script runs under `bash --login -s`.
+    """
+    from skypilot_tpu.utils import command_runner
+    opts = list(command_runner.SSH_COMMON_OPTS)
+    if host.get("proxy_command"):
+        opts += ["-o", f"ProxyCommand={host['proxy_command']}"]
+    if coord_port is not None:
+        # The coordinator lives in this (driver) process; hosts reach it
+        # through an SSH reverse tunnel so NAT between driver and slice
+        # doesn't matter. The remote tunnel port reuses the coordinator's
+        # (OS-assigned, driver-unique) port number so concurrent gangs
+        # don't collide; a bind failure must kill the ssh (fail fast)
+        # rather than silently cross-wire two gangs.
+        env = dict(env)
+        env[constants.GANG_COORD_ADDR] = f"127.0.0.1:{coord_port}"
+        opts += ["-o", "ExitOnForwardFailure=yes",
+                 "-R", f"{coord_port}:127.0.0.1:{coord_port}"]
+        cmd = (f"python3 -m skypilot_tpu.agent.host_wrapper "
+               f"{shlex.quote(cmd)}")
+    exports = "\n".join(
+        f"export {k}={shlex.quote(str(v))}" for k, v in env.items())
+    script = f"{exports}\n{cmd}\n"
+    argv = (["ssh"] + opts +
+            ["-i", os.path.expanduser(host["ssh_key_path"]),
+             "-p", str(host.get("ssh_port", 22)),
+             f"{host['ssh_user']}@{host['ip']}",
+             "bash --login -s"])
+    return argv, script
+
+
 class _HostProc:
     """One host's command, run via the appropriate transport."""
 
@@ -111,39 +146,17 @@ class _HostProc:
                 stderr=subprocess.STDOUT, env=full_env,
                 cwd=host["host_dir"], start_new_session=True)
         else:  # ssh
-            from skypilot_tpu.utils import command_runner
-            opts = list(command_runner.SSH_COMMON_OPTS)
-            if host.get("proxy_command"):
-                opts += ["-o", f"ProxyCommand={host['proxy_command']}"]
-            if coord_port is not None:
-                # The coordinator lives in this (driver) process; hosts
-                # reach it through an SSH reverse tunnel so NAT between
-                # driver and slice doesn't matter. The remote tunnel port
-                # reuses the coordinator's (OS-assigned, driver-unique)
-                # port number so concurrent gangs don't collide; a bind
-                # failure must kill the ssh (fail fast) rather than
-                # silently cross-wire two gangs.
-                env = dict(env)
-                env[constants.GANG_COORD_ADDR] = \
-                    f"127.0.0.1:{coord_port}"
-                opts += ["-o", "ExitOnForwardFailure=yes",
-                         "-R", f"{coord_port}:127.0.0.1:{coord_port}"]
-                cmd = (f"python3 -m skypilot_tpu.agent.host_wrapper "
-                       f"{shlex.quote(cmd)}")
-            env_prefix = " ".join(
-                f"export {k}={shlex.quote(str(v))};"
-                for k, v in env.items())
-            remote = (f"bash --login -c "
-                      f"{shlex.quote(env_prefix + ' ' + cmd)}")
+            argv, script = _ssh_argv_and_script(host, cmd, env,
+                                                coord_port)
+            # The env exports (including user secrets from `envs:`) and
+            # the command travel on STDIN, never in argv: ssh argv is
+            # visible to every user on a shared host via `ps`.
             self.proc = subprocess.Popen(
-                ["ssh"] + opts + ["-i",
-                                  os.path.expanduser(
-                                      host["ssh_key_path"]),
-                                  "-p", str(host.get("ssh_port", 22)),
-                                  f"{host['ssh_user']}@{host['ip']}",
-                                  remote],
-                stdout=log_f, stderr=subprocess.STDOUT,
-                start_new_session=True)
+                argv, stdin=subprocess.PIPE, stdout=log_f,
+                stderr=subprocess.STDOUT, start_new_session=True)
+            assert self.proc.stdin is not None
+            self.proc.stdin.write(script.encode())
+            self.proc.stdin.close()
         self._log_f = log_f
 
     def wait(self) -> int:

@@ -84,6 +84,14 @@ class SliceHandle(backend_lib.ResourceHandle):
             if info.provider_name == "local":
                 runners.append(runner_lib.LocalCommandRunner(
                     inst.instance_id, inst.tags["host_dir"]))
+            elif info.provider_name == "kubernetes":
+                # SSH-free: commands reach pods via kubectl exec
+                # (reference: KubernetesCommandRunner,
+                # sky/utils/command_runner.py:647).
+                runners.append(runner_lib.KubernetesCommandRunner(
+                    inst.instance_id, pod_name=inst.instance_id,
+                    namespace=inst.tags.get("namespace", "default"),
+                    internal_ip=inst.internal_ip))
             else:
                 runners.append(runner_lib.SSHCommandRunner(
                     inst.instance_id,
@@ -104,6 +112,19 @@ class SliceHandle(backend_lib.ResourceHandle):
 def _cluster_lock(cluster_name: str) -> filelock.FileLock:
     return filelock.FileLock(
         str(paths.locks_dir() / f"cluster.{cluster_name}.lock"))
+
+
+# retry_until_up backoff: 10s doubling to a 5-minute cap, +-20% jitter so
+# a fleet of waiting clients doesn't re-sweep the TPU API in lockstep.
+RETRY_BACKOFF_BASE_SECONDS = 10.0
+RETRY_BACKOFF_CAP_SECONDS = 300.0
+
+
+def _retry_backoff_seconds(retry_round: int) -> float:
+    import random
+    base = min(RETRY_BACKOFF_CAP_SECONDS,
+               RETRY_BACKOFF_BASE_SECONDS * (2 ** retry_round))
+    return base * random.uniform(0.8, 1.2)
 
 
 class SliceBackend(backend_lib.Backend[SliceHandle]):
@@ -141,6 +162,7 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
         """
         blocklist = optimizer_lib.Blocklist()
         history: List[Exception] = []
+        retry_round = 0
         while True:
             saved = task.resources
             try:
@@ -152,7 +174,15 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
             candidates.sort(key=lambda c: c.cost)
             if not candidates:
                 if retry_until_up:
-                    time.sleep(5)
+                    # Exponential backoff + jitter before re-sweeping the
+                    # zones (reference: RetryingVmProvisioner's gap; a 5s
+                    # hot loop hammers the TPU API during a stockout).
+                    delay = _retry_backoff_seconds(retry_round)
+                    retry_round += 1
+                    print(f"retry_until_up: all zones exhausted; "
+                          f"retrying in {delay:.0f}s "
+                          f"(round {retry_round})", file=sys.stderr)
+                    time.sleep(delay)
                     blocklist = optimizer_lib.Blocklist()
                     continue
                 raise exceptions.ResourcesUnavailableError(
@@ -170,12 +200,22 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
                                                   e.blocklist_region)
                     elif e.blocklist_zone:
                         blocklist = blocklist.add(device, e.blocklist_zone)
-                    else:
+                    elif res.zone is not None:
                         blocklist = blocklist.add(device, res.zone)
-                    # Clean any partial creation before moving on.
+                    else:
+                        # Zoneless provider (kubernetes/local): block it
+                        # alone — a (device, None) wildcard would kill
+                        # failover to every other cloud.
+                        blocklist = blocklist.add(
+                            device, f"cloud:{res.provider_name}")
+                    # Clean any partial creation before moving on — with
+                    # the placement config (zone/namespace), not {}: the
+                    # provisioner must not guess from client state where
+                    # the partial nodes live.
                     try:
                         provision_api.terminate_instances(
-                            res.provider_name, cluster_name, {})
+                            res.provider_name, cluster_name,
+                            self._cleanup_provider_config(res))
                     except Exception:
                         pass
             if not retry_until_up:
@@ -185,9 +225,8 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
                     f"{[str(e) for e in history]}",
                     failover_history=history)
 
-    def _provision_once(self, task, res: Resources,
-                        cluster_name: str) -> SliceHandle:
-        provider = res.provider_name
+    @staticmethod
+    def _make_provider_config(task, res: Resources) -> Dict[str, Any]:
         info = res.slice_info()
         provider_config: Dict[str, Any] = {
             "num_slices": task.num_nodes,
@@ -203,13 +242,36 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
             "chips_per_host": info.chips_per_host if info else 0,
             "labels": res.labels or {},
         }
+        if res.provider_name == "kubernetes":
+            from skypilot_tpu import config as config_lib
+            provider_config["image"] = res.image_id
+            provider_config["namespace"] = config_lib.get_nested(
+                ("kubernetes", "namespace"), None)
+            for key in ("gke_accelerator_type", "gke_tpu_topology"):
+                val = (res.labels or {}).get(key) or config_lib.get_nested(
+                    ("kubernetes", key), None)
+                if val:
+                    provider_config[key] = val
+        return provider_config
+
+    def _cleanup_provider_config(self, res: Resources) -> Dict[str, Any]:
+        """Enough placement context (zone/project/namespace) for
+        terminate_instances to find partially created nodes after a
+        failed provision attempt."""
+        from skypilot_tpu.task import Task
+        return self._make_provider_config(Task("cleanup"), res)
+
+    def _provision_once(self, task, res: Resources,
+                        cluster_name: str) -> SliceHandle:
+        provider = res.provider_name
+        provider_config = self._make_provider_config(task, res)
         global_user_state.add_or_update_cluster(
             cluster_name, handle=None, requested_resources=res,
             ready=False)
         provision_api.run_instances(provider, res.region, res.zone,
                                     cluster_name, provider_config)
         provision_api.wait_instances(provider, res.region, cluster_name,
-                                     "running")
+                                     "running", provider_config)
         cluster_info = provision_api.get_cluster_info(
             provider, res.region, cluster_name, provider_config)
         handle = SliceHandle(cluster_name, res, task.num_nodes,
@@ -307,11 +369,15 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
     def _restart_cluster(self, handle: SliceHandle) -> SliceHandle:
         provider = handle.provider_name
         res = handle.launched_resources
-        provider_config = {"num_slices": handle.num_slices}
+        # Restart reuses the provisioning-time config (zone/project/...)
+        # recorded in the handle; provision code never reads client state.
+        provider_config = dict(handle.cluster_info.provider_config,
+                               num_slices=handle.num_slices)
         provision_api.run_instances(provider, res.region, res.zone,
                                     handle.cluster_name, provider_config)
         provision_api.wait_instances(provider, res.region,
-                                     handle.cluster_name, "running")
+                                     handle.cluster_name, "running",
+                                     provider_config)
         handle.cluster_info = provision_api.get_cluster_info(
             provider, res.region, handle.cluster_name, provider_config)
         self._post_provision_setup(handle)

@@ -1,0 +1,64 @@
+"""Kubernetes capability object.
+
+Reference analog: sky/clouds/kubernetes.py. The rules that matter here:
+
+  * pods cannot be stopped — deletion is the only lifecycle exit, so
+    `stop` and autostop-to-STOPPED are unsupported for EVERY resource
+    (autostop --down still works: the daemon terminates);
+  * no spot market — preemption exists (node drain) but there is no
+    discounted tier to request;
+  * `image_id` IS supported: it is the pod image;
+  * placement is the cluster itself — no regions/zones, cost 0
+    (on-prem/pre-paid hardware, like the reference prices kubernetes).
+"""
+from __future__ import annotations
+
+import shutil
+import subprocess
+from typing import Dict, Tuple
+
+from skypilot_tpu.clouds.cloud import Cloud, CloudImplementationFeatures
+
+
+class Kubernetes(Cloud):
+    NAME = "kubernetes"
+
+    _UNSUPPORTED = {
+        CloudImplementationFeatures.STOP:
+            "kubernetes pods cannot be stopped, only deleted; use "
+            "`down`",
+        CloudImplementationFeatures.AUTOSTOP:
+            "pods cannot stop; use autostop --down (terminate on idle)",
+        CloudImplementationFeatures.SPOT_INSTANCE:
+            "no spot market on kubernetes; use node-level preemption "
+            "policies out of band",
+        CloudImplementationFeatures.OPEN_PORTS:
+            "expose ports via Services/Ingress out of band (not "
+            "implemented yet)",
+    }
+
+    def unsupported_features_for_resources(
+            self, resources) -> Dict[CloudImplementationFeatures, str]:
+        del resources  # table is resource-independent: pods never stop
+        return dict(self._UNSUPPORTED)
+
+    def check_credentials(self) -> Tuple[bool, str]:
+        """Usable = kubectl exists + a reachable current context."""
+        if shutil.which("kubectl") is None:
+            return False, "kubectl not installed"
+        try:
+            proc = subprocess.run(
+                ["kubectl", "config", "current-context"],
+                capture_output=True, text=True, timeout=20)
+            if proc.returncode != 0 or not proc.stdout.strip():
+                return False, "no current kubectl context"
+            ctx = proc.stdout.strip()
+            probe = subprocess.run(
+                ["kubectl", "get", "--raw", "/version"],
+                capture_output=True, text=True, timeout=20)
+            if probe.returncode != 0:
+                return False, (f"context {ctx!r} unreachable: "
+                               f"{probe.stderr.strip()[:120]}")
+            return True, f"context {ctx}"
+        except (subprocess.SubprocessError, OSError) as e:
+            return False, f"kubectl probe failed: {e}"

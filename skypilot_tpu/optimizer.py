@@ -35,7 +35,10 @@ class Blocklist:
     """Placements to skip: (accelerator|instance_type, zone|region) pairs.
 
     ``None`` fields are wildcards: ("tpu-v5e-16", None) blocks everywhere;
-    (None, "us-central1-a") blocks the zone for everything.
+    (None, "us-central1-a") blocks the zone for everything. A zoneless
+    provider (kubernetes, local) is blocked with the sentinel
+    ``cloud:<name>`` so its failure never wildcard-blocks the same
+    accelerator on other clouds.
     """
     entries: frozenset = frozenset()
 
@@ -47,6 +50,8 @@ class Blocklist:
             if where is None:
                 return True
             if res.zone == where or res.region == where:
+                return True
+            if where == f"cloud:{res.provider_name}":
                 return True
         return False
 

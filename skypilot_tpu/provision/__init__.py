@@ -5,7 +5,7 @@ query/run/wait/stop/terminate/get_cluster_info). Providers implement plain
 functions in ``skypilot_tpu.provision.<provider>``:
 
     run_instances(region, zone, cluster_name, config) -> ProvisionRecord
-    wait_instances(region, cluster_name, state) -> None
+    wait_instances(region, cluster_name, state, provider_config) -> None
     query_instances(cluster_name, provider_config) -> Dict[id, status_str]
     get_cluster_info(region, cluster_name, provider_config) -> ClusterInfo
     stop_instances(cluster_name, provider_config) -> None
@@ -54,9 +54,9 @@ def run_instances(provider_name: str, region, zone, cluster_name: str,
 
 
 def wait_instances(provider_name: str, region, cluster_name: str,
-                   state: str) -> None:
+                   state: str, provider_config: dict) -> None:
     return _route(provider_name, "wait_instances", region, cluster_name,
-                  state)
+                  state, provider_config)
 
 
 def query_instances(provider_name: str, cluster_name: str,

@@ -347,12 +347,12 @@ def _delete_node(project: str, zone: str, node_id: str) -> None:
 
 
 def wait_instances(region: Optional[str], cluster_name: str,
-                   state: str) -> None:
+                   state: str, provider_config: dict) -> None:
     """Poll until every slice reaches ``state`` ("running" == READY).
 
     A queued resource that lands in FAILED is surfaced as a ProvisionError
     with failover scope so the backend's retry loop can move on."""
-    zone, project = _zone_project_from_state(cluster_name)
+    zone, project = _zone_project(provider_config, cluster_name)
     want = {"running": "READY", "stopped": "STOPPED"}[state]
     deadline = time.time() + _CREATE_TIMEOUT_SECONDS
     while time.time() < deadline:
@@ -397,24 +397,20 @@ def _check_queued_resources(project: str, zone: str, cluster_name: str,
                 f"{detail}", blocklist_zone=zone)
 
 
-# Zone/project for post-create calls: recorded by the backend in the
-# cluster's provider_config; fall back to the state DB handle.
-def _zone_project_from_state(cluster_name: str) -> Tuple[str, str]:
-    from skypilot_tpu import global_user_state
-    record = global_user_state.get_cluster_from_name(cluster_name)
-    zone = None
-    if record is not None:
-        res = record.get("requested_resources")
-        handle = record.get("handle")
-        if res is not None and getattr(res, "zone", None):
-            zone = res.zone
-        elif handle is not None:
-            zone = getattr(handle.launched_resources, "zone", None)
+def _zone_project(provider_config: dict,
+                  cluster_name: str) -> Tuple[str, str]:
+    """Zone/project come from provider_config, ALWAYS: the backend
+    records them at provision time and get_cluster_info echoes them into
+    every handle, so provision code never reaches back into the client
+    state DB (which does not exist where a controller cluster runs —
+    the r2 layering inversion this replaces)."""
+    zone = provider_config.get("zone")
     if zone is None:
         raise exceptions.ProvisionError(
-            f"gcp: unknown zone for cluster {cluster_name} "
-            "(no state record)")
-    return zone, _gcloud_project()
+            f"gcp: provider_config for {cluster_name} carries no zone; "
+            "the caller must pass the provisioning-time config "
+            "(handle.cluster_info.provider_config).")
+    return zone, _project_of(provider_config)
 
 
 def query_instances(cluster_name: str,
@@ -423,10 +419,7 @@ def query_instances(cluster_name: str,
     pod slice there is no per-worker lifecycle (the gang lives and dies
     together), which is exactly the slice-atomic semantics the backend's
     status reconciler expects."""
-    zone = provider_config.get("zone")
-    project = _project_of(provider_config)
-    if zone is None:
-        zone, project = _zone_project_from_state(cluster_name)
+    zone, project = _zone_project(provider_config, cluster_name)
     out: Dict[str, str] = {}
     for node_id, node in _list_cluster_nodes(project, zone,
                                              cluster_name).items():
@@ -439,10 +432,7 @@ def query_instances(cluster_name: str,
 
 def get_cluster_info(region: Optional[str], cluster_name: str,
                      provider_config: dict) -> ClusterInfo:
-    zone = provider_config.get("zone")
-    project = _project_of(provider_config)
-    if zone is None:
-        zone, project = _zone_project_from_state(cluster_name)
+    zone, project = _zone_project(provider_config, cluster_name)
     instances: Dict[str, InstanceInfo] = {}
     head_id: Optional[str] = None
     nodes = _list_cluster_nodes(project, zone, cluster_name)
@@ -479,10 +469,7 @@ def stop_instances(cluster_name: str, provider_config: dict) -> None:
     rejects it — so refuse up front (the capability layer routes user
     `stop` requests away from pods before this; reference:
     sky/clouds/gcp.py:558-610 unstoppable-pod handling)."""
-    zone = provider_config.get("zone")
-    project = _project_of(provider_config)
-    if zone is None:
-        zone, project = _zone_project_from_state(cluster_name)
+    zone, project = _zone_project(provider_config, cluster_name)
     # Destructive-path listing: a 403 must raise, not return {} — an empty
     # loop here would report "stopped" while the nodes keep billing.
     for node_id, node in _list_cluster_nodes(project, zone, cluster_name,
@@ -496,13 +483,10 @@ def stop_instances(cluster_name: str, provider_config: dict) -> None:
 
 
 def terminate_instances(cluster_name: str, provider_config: dict) -> None:
-    zone = provider_config.get("zone")
-    project = _project_of(provider_config)
-    if zone is None:
-        try:
-            zone, project = _zone_project_from_state(cluster_name)
-        except exceptions.ProvisionError:
-            return  # nothing recorded → nothing to clean
+    try:
+        zone, project = _zone_project(provider_config, cluster_name)
+    except exceptions.ProvisionError:
+        return  # nothing recorded → nothing to clean
     for node_id in _list_cluster_nodes(project, zone, cluster_name,
                                        lenient_auth=False):
         _delete_node(project, zone, node_id)

@@ -1,0 +1,277 @@
+"""Kubernetes provisioner: pod-per-slice-host behind the provision SPI.
+
+Reference analog: sky/provision/kubernetes/instance.py (815) +
+kubernetes_utils.py (1,654) — pod-based clusters with SSH-free exec.
+TPU-native differences:
+
+* An "instance" is a POD standing in for one slice host. A cluster of
+  ``num_slices`` slices x ``hosts_per_slice`` hosts becomes that many
+  pods, labeled ``stpu-cluster``/``stpu-slice``/``stpu-host-index`` —
+  the same slice-atomic gang boundary the GCP provisioner gets from
+  queuedResources. TPU chips are requested via the ``google.com/tpu``
+  extended resource plus the GKE node selectors
+  (``cloud.google.com/gke-tpu-accelerator``/``-topology``) so the
+  scheduler lands each pod on a host of the right slice type.
+* Exec is SSH-free from the CLIENT: commands reach pods through
+  ``kubectl exec`` (utils/command_runner.KubernetesCommandRunner).
+  INTRA-cluster (head pod -> worker pods, for the head-resident gang
+  driver) uses pod-IP SSH with the cluster-internal key, so the image
+  must run sshd — the same requirement the reference's kubernetes pods
+  have (its images install+start openssh-server at bootstrap).
+* Pods cannot be stopped, only deleted: `stop` raises NotSupportedError
+  (clouds/kubernetes.py declares the capability), exactly like TPU pod
+  slices.
+
+All kubectl traffic goes through :func:`kubectl` so hermetic tests can
+monkeypatch a fake API server (the provision/gcp.py `rest` discipline).
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
+                                           ProvisionRecord)
+
+PROVIDER_NAME = "kubernetes"
+
+_CLUSTER_LABEL = "stpu-cluster"
+_SLICE_LABEL = "stpu-slice"
+_HOST_INDEX_LABEL = "stpu-host-index"
+
+_POLL_INTERVAL_SECONDS = 2
+_CREATE_TIMEOUT_SECONDS = 600
+
+# Pod phase -> SPI status strings (core._refresh_one contract).
+_PHASE_MAP = {
+    "Running": "running",
+    "Pending": "pending",
+    "Succeeded": "terminated",
+    "Failed": "terminated",
+    "Unknown": "terminated",
+}
+
+_DEFAULT_IMAGE = "python:3.11-slim"
+
+
+def kubectl(args: List[str], input_obj: Optional[dict] = None,
+            namespace: Optional[str] = None) -> Dict[str, Any]:
+    """One kubectl invocation returning parsed JSON ({} when the command
+    produces none). Tests monkeypatch this symbol with a fake cluster;
+    everything above it is then hermetically testable."""
+    cmd = ["kubectl"]
+    if namespace:
+        cmd += ["-n", namespace]
+    cmd += args
+    kwargs: Dict[str, Any] = {}
+    if input_obj is not None:
+        cmd += ["-f", "-"]
+        kwargs["input"] = json.dumps(input_obj)
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=120, **kwargs)
+    if proc.returncode != 0:
+        raise exceptions.ProvisionError(
+            f"kubectl {' '.join(args)} failed: "
+            f"{proc.stderr.strip()[:500]}")
+    out = proc.stdout.strip()
+    if not out:
+        return {}
+    try:
+        return json.loads(out)
+    except ValueError:
+        return {"raw": out}
+
+
+def _namespace(config: dict) -> str:
+    return config.get("namespace") or "default"
+
+
+def _pod_name(cluster_name: str, slice_i: int, host_i: int) -> str:
+    return f"{cluster_name}-s{slice_i}-h{host_i}"
+
+
+def _pod_manifest(cluster_name: str, slice_i: int, host_i: int,
+                  config: dict) -> dict:
+    chips = int(config.get("chips_per_host") or 0)
+    accelerator = config.get("accelerator")
+    container: Dict[str, Any] = {
+        "name": "stpu-host",
+        "image": config.get("image") or _DEFAULT_IMAGE,
+        # Long-running host process; work arrives via kubectl exec and
+        # the head-resident gang driver.
+        "command": ["/bin/sh", "-c", "sleep infinity"],
+    }
+    if chips:
+        container["resources"] = {
+            "limits": {"google.com/tpu": str(chips)},
+            "requests": {"google.com/tpu": str(chips)},
+        }
+    spec: Dict[str, Any] = {
+        "restartPolicy": "Never",
+        "containers": [container],
+    }
+    if accelerator and config.get("gke_accelerator_type"):
+        # GKE TPU scheduling contract: the node pool advertises the
+        # slice type/topology; pods select it.
+        spec["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator":
+                config["gke_accelerator_type"],
+            **({"cloud.google.com/gke-tpu-topology":
+                config["gke_tpu_topology"]}
+               if config.get("gke_tpu_topology") else {}),
+        }
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": _pod_name(cluster_name, slice_i, host_i),
+            "labels": {
+                _CLUSTER_LABEL: cluster_name,
+                _SLICE_LABEL: f"slice-{slice_i}",
+                _HOST_INDEX_LABEL: str(host_i),
+                **(config.get("labels") or {}),
+            },
+        },
+        "spec": spec,
+    }
+
+
+def _list_pods(cluster_name: str, namespace: str) -> List[dict]:
+    out = kubectl(["get", "pods", "-l",
+                   f"{_CLUSTER_LABEL}={cluster_name}", "-o", "json"],
+                  namespace=namespace)
+    return out.get("items", [])
+
+
+# ------------------------------------------------------------------- SPI
+def run_instances(region, zone, cluster_name: str,
+                  config: dict) -> ProvisionRecord:
+    """Create (or adopt) the cluster's pods. Slice-atomic semantics: a
+    creation failure deletes everything created this call before
+    raising, so a half-scheduled slice never lingers."""
+    del region, zone  # a kubernetes cluster is its own placement
+    namespace = _namespace(config)
+    num_slices = int(config.get("num_slices") or 1)
+    hosts = int(config.get("hosts_per_slice") or 1)
+    if num_slices * hosts > 1 and not config.get("image"):
+        # Fail BEFORE paying for pods: the head-resident gang driver
+        # reaches worker pods over pod-IP SSH, so multi-host clusters
+        # need an image with sshd + an ssh client (the reference's
+        # kubernetes images install openssh at bootstrap). The default
+        # slim image has neither; single-pod clusters never SSH and
+        # work with any image.
+        raise exceptions.ProvisionError(
+            f"kubernetes cluster {cluster_name} spans "
+            f"{num_slices * hosts} pods but no image_id was given; "
+            "multi-host gangs need an image that runs sshd (workers) "
+            "and ships an ssh client (head). Set `image_id:` in the "
+            "task resources.")
+
+    existing = {p["metadata"]["name"] for p in
+                _list_pods(cluster_name, namespace)}
+    created: List[str] = []
+    try:
+        for s in range(num_slices):
+            for h in range(hosts):
+                name = _pod_name(cluster_name, s, h)
+                if name in existing:
+                    continue
+                kubectl(["create", "-o", "json"],
+                        input_obj=_pod_manifest(cluster_name, s, h,
+                                                config),
+                        namespace=namespace)
+                created.append(name)
+    except exceptions.ProvisionError as e:
+        for name in created:
+            try:
+                kubectl(["delete", "pod", name, "--ignore-not-found"],
+                        namespace=namespace)
+            except exceptions.ProvisionError:
+                pass
+        msg = str(e)
+        # Namespace quota exhaustion is this cluster's stockout: a
+        # retry in the same "zone" cannot help until quota frees.
+        raise exceptions.ProvisionError(
+            msg, retryable_in_zone="exceeded quota" not in msg.lower())
+    return ProvisionRecord(
+        provider_name=PROVIDER_NAME, region=None, zone=None,
+        cluster_name=cluster_name,
+        head_instance_id=_pod_name(cluster_name, 0, 0),
+        created_instance_ids=created,
+        resumed_instance_ids=sorted(existing))
+
+
+def wait_instances(region, cluster_name: str, state: str,
+                   provider_config: dict) -> None:
+    del region
+    namespace = _namespace(provider_config)
+    deadline = time.time() + _CREATE_TIMEOUT_SECONDS
+    while time.time() < deadline:
+        pods = _list_pods(cluster_name, namespace)
+        phases = [p.get("status", {}).get("phase", "Unknown")
+                  for p in pods]
+        if pods and all(
+                _PHASE_MAP.get(ph, "terminated") == state
+                for ph in phases):
+            return
+        if any(ph == "Failed" for ph in phases):
+            failed = [p["metadata"]["name"] for p in pods
+                      if p.get("status", {}).get("phase") == "Failed"]
+            raise exceptions.ProvisionError(
+                f"pod(s) failed during scheduling: {failed}",
+                retryable_in_zone=True)
+        time.sleep(_POLL_INTERVAL_SECONDS)
+    raise exceptions.ProvisionError(
+        f"pods of {cluster_name} not {state} after "
+        f"{_CREATE_TIMEOUT_SECONDS}s", retryable_in_zone=True)
+
+
+def query_instances(cluster_name: str,
+                    provider_config: dict) -> Dict[str, str]:
+    pods = _list_pods(cluster_name, _namespace(provider_config))
+    return {
+        p["metadata"]["name"]: _PHASE_MAP.get(
+            p.get("status", {}).get("phase", "Unknown"), "terminated")
+        for p in pods
+    }
+
+
+def get_cluster_info(region, cluster_name: str,
+                     provider_config: dict) -> ClusterInfo:
+    del region
+    namespace = _namespace(provider_config)
+    instances: Dict[str, InstanceInfo] = {}
+    for pod in _list_pods(cluster_name, namespace):
+        meta = pod["metadata"]
+        labels = meta.get("labels", {})
+        instances[meta["name"]] = InstanceInfo(
+            instance_id=meta["name"],
+            internal_ip=pod.get("status", {}).get("podIP", ""),
+            external_ip=None,
+            slice_id=labels.get(_SLICE_LABEL, "slice-0"),
+            host_index=int(labels.get(_HOST_INDEX_LABEL, 0)),
+            tags={"namespace": namespace},
+        )
+    head = _pod_name(cluster_name, 0, 0)
+    return ClusterInfo(
+        cluster_name=cluster_name, provider_name=PROVIDER_NAME,
+        region=None, zone=None, instances=instances,
+        head_instance_id=head if head in instances else None,
+        ssh_user=provider_config.get("ssh_user", "root"),
+        ssh_key_path=None,
+        provider_config=dict(provider_config))
+
+
+def stop_instances(cluster_name: str, provider_config: dict) -> None:
+    raise exceptions.NotSupportedError(
+        "kubernetes pods cannot be stopped, only terminated "
+        "(`stpu down`); pod state does not survive deletion.")
+
+
+def terminate_instances(cluster_name: str, provider_config: dict) -> None:
+    kubectl(["delete", "pods", "-l", f"{_CLUSTER_LABEL}={cluster_name}",
+             "--ignore-not-found", "--wait=false"],
+            namespace=_namespace(provider_config))

@@ -70,7 +70,8 @@ def run_instances(region: Optional[str], zone: Optional[str],
         created_instance_ids=created)
 
 
-def wait_instances(region, cluster_name: str, state: str) -> None:
+def wait_instances(region, cluster_name: str, state: str,
+                   provider_config: dict) -> None:
     del region, state  # local instances are synchronous
 
 

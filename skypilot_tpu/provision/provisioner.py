@@ -35,7 +35,15 @@ _AGENT_START_CMD = (
 _RUNTIME_INSTALL_CMD = "pip install -q --user ~/.stpu_wheels/*.whl"
 
 
-def _ssh_runner(info: ClusterInfo, inst) -> runner_lib.SSHCommandRunner:
+def _ssh_runner(info: ClusterInfo, inst) -> runner_lib.CommandRunner:
+    """Bring-up transport to one host: SSH for VM hosts, kubectl exec
+    for pods (the readiness wait and runtime setup below are transport-
+    agnostic — they only need run()/rsync())."""
+    if info.provider_name == "kubernetes":
+        return runner_lib.KubernetesCommandRunner(
+            inst.instance_id, pod_name=inst.instance_id,
+            namespace=inst.tags.get("namespace", "default"),
+            internal_ip=inst.internal_ip)
     return runner_lib.SSHCommandRunner(
         inst.instance_id, inst.external_ip or inst.internal_ip,
         ssh_user=info.ssh_user,

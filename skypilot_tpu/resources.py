@@ -71,6 +71,17 @@ class Resources:
                     f"{', '.join(clouds_lib.registered_names())}")
         if self.cloud == "local":
             return  # no catalog validation for the hermetic provider
+        if self.cloud == "kubernetes":
+            # Placement is the cluster itself: no zones to validate.
+            # Accelerator names still canonicalize so slice_info()
+            # (hosts/chips topology math) works for named TPU slices.
+            if self.accelerator is not None:
+                from skypilot_tpu.utils import accelerator_registry
+                object.__setattr__(
+                    self, "accelerator",
+                    accelerator_registry.canonicalize_accelerator_name(
+                        self.accelerator))
+            return
         if self.accelerator is not None:
             # Normalize user spellings (V5E-8, tpu_v5e_8, v5litepod-8)
             # to the canonical catalog name, validating against it.
@@ -144,7 +155,7 @@ class Resources:
     def is_launchable(self) -> bool:
         """Concrete enough to hand to the provisioner: needs a zone and a
         concrete device/VM (local provider needs neither)."""
-        if self.cloud == "local":
+        if self.cloud in ("local", "kubernetes"):
             return True
         return (self.zone is not None and
                 (self.accelerator is not None or
@@ -159,7 +170,10 @@ class Resources:
     # ------------------------------------------------------------------
     def hourly_price(self) -> float:
         """Price of this (concrete) resource per hour."""
-        if self.cloud == "local":
+        if self.cloud in ("local", "kubernetes"):
+            # On-prem / pre-paid hardware: $0 marginal cost (reference
+            # prices kubernetes the same way), so the optimizer prefers
+            # an enabled kubernetes cluster over metered cloud TPUs.
             return 0.0
         if self.accelerator is not None:
             return catalog.tpu_price(self.accelerator, zone=self.zone,

@@ -248,9 +248,18 @@ def _down_local(service_names: Optional[List[str]], all_services: bool,
                 time.sleep(0.2)
         if serve_state.get_service(name) is not None:
             _finalize_dead_service(name)
-        # Translated (job-scoped) buckets die with the service.
-        yaml_path = svc.get("task_yaml_path")
-        if yaml_path and os.path.exists(yaml_path):
+        # Translated (job-scoped) buckets die with the service — for
+        # EVERY revision yaml still on disk, not just the current one
+        # (the pre-bump revision is deliberately kept by update for the
+        # mid-read controller and would otherwise leak its buckets).
+        serve_dir = paths.generated_dir() / "serve"
+        revisions = {svc.get("task_yaml_path")}
+        revisions.update(
+            str(p) for p in serve_dir.glob(f"{name}-update-*.yaml"))
+        revisions.add(str(serve_dir / f"{name}.yaml"))
+        for yaml_path in revisions:
+            if not yaml_path or not os.path.exists(yaml_path):
+                continue
             try:
                 controller_utils.cleanup_translated_buckets(
                     Task.from_yaml(yaml_path))

@@ -150,6 +150,98 @@ class SSHCommandRunner(CommandRunner):
                               "rsync failed")
 
 
+class KubernetesCommandRunner(CommandRunner):
+    """SSH-free exec into a pod via ``kubectl exec`` / ``kubectl cp``
+    (reference: KubernetesCommandRunner, sky/utils/command_runner.py:647).
+    """
+
+    def __init__(self, node_id: str, pod_name: str, namespace: str,
+                 internal_ip: str = "", container: str = "stpu-host"):
+        super().__init__(node_id, internal_ip)
+        self.pod_name = pod_name
+        self.namespace = namespace
+        self.container = container
+
+    def _base(self) -> List[str]:
+        return ["kubectl", "-n", self.namespace]
+
+    def run(self, cmd, *, env=None, log_path=None, stream_logs=False,
+            require_outputs=False):
+        if isinstance(cmd, list):
+            cmd = " ".join(shlex.quote(c) for c in cmd)
+        env_prefix = ""
+        if env:
+            env_prefix = " ".join(
+                f"export {k}={shlex.quote(str(v))};" for k, v in
+                env.items()) + " "
+        remote = f"bash --login -c {shlex.quote(env_prefix + cmd)}"
+        full = self._base() + ["exec", self.pod_name, "-c",
+                               self.container, "--", "bash", "-c",
+                               remote]
+        if require_outputs:
+            proc = subprocess.run(full, capture_output=True, text=True)
+            return proc.returncode, proc.stdout, proc.stderr
+        return _run_with_log(full, log_path=log_path,
+                             stream_logs=stream_logs)
+
+    @staticmethod
+    def _sh(p: str) -> str:
+        """Quote a pod-side path keeping a leading ~ expandable —
+        kubectl cp cannot expand ~, so transfers stream through the
+        pod's shell instead."""
+        if p == "~":
+            return '"$HOME"'
+        if p.startswith("~/"):
+            return '"$HOME"/' + shlex.quote(p[2:])
+        return shlex.quote(p)
+
+    def _exec_stdin(self, remote_sh: str, stdin_cmd: Optional[List[str]],
+                    stdin_file: Optional[str]) -> int:
+        full = self._base() + ["exec", "-i", self.pod_name, "-c",
+                               self.container, "--", "bash", "-c",
+                               remote_sh]
+        if stdin_cmd is not None:
+            feeder = subprocess.Popen(stdin_cmd, stdout=subprocess.PIPE)
+            proc = subprocess.run(full, stdin=feeder.stdout,
+                                  capture_output=True)
+            feeder.stdout.close()
+            feeder.wait()
+            return proc.returncode or feeder.returncode
+        with open(stdin_file, "rb") as f:
+            return subprocess.run(full, stdin=f,
+                                  capture_output=True).returncode
+
+    def rsync(self, source, target, *, up, delete=False, log_path=None):
+        del log_path
+        if not up:
+            # Down: single file via cat (logs/artifacts).
+            full = self._base() + ["exec", self.pod_name, "-c",
+                                   self.container, "--", "bash", "-c",
+                                   f"cat {self._sh(source)}"]
+            with open(target, "wb") as out:
+                rc = subprocess.run(full, stdout=out).returncode
+            self.check_returncode(rc, "kubectl exec cat", source)
+            return
+        t = self._sh(target)
+        if os.path.isdir(source):
+            # Directory: tar pipe with rsync's into-dir semantics;
+            # --delete emulated by clearing the target first.
+            clear = f"rm -rf {t} && " if delete else ""
+            rc = self._exec_stdin(
+                f"{clear}mkdir -p {t} && tar xf - -C {t}",
+                ["tar", "cf", "-", "--exclude=.git", "-C", source, "."],
+                None)
+        elif target.endswith("/"):
+            base = shlex.quote(os.path.basename(source))
+            rc = self._exec_stdin(
+                f"mkdir -p {t} && cat > {t}/{base}", None, source)
+        else:
+            rc = self._exec_stdin(
+                f"mkdir -p $(dirname {t}) && cat > {t}", None, source)
+        self.check_returncode(rc, f"pod transfer {source} -> {target}",
+                              "kubectl exec stream failed")
+
+
 class LocalCommandRunner(CommandRunner):
     """A fake host rooted at a local directory.
 

@@ -36,7 +36,7 @@ def test_launch_dryrun(runner, tmp_state_dir, tmp_path):
     assert "would provision" in result.output
 
 
-def test_launch_local_end_to_end(runner, tmp_state_dir):
+def test_launch_local_end_to_end(runner, tmp_state_dir, capfd):
     result = runner.invoke(cli.cli, [
         "launch", "examples/local_smoke.yaml", "-c", "smoke",
         "--detach-run"])
@@ -58,8 +58,11 @@ def test_launch_local_end_to_end(runner, tmp_state_dir):
         if jobs and jobs[0]["status"] in ("SUCCEEDED", "FAILED"):
             break
         time.sleep(0.2)
+    capfd.readouterr()  # drain
     result = runner.invoke(cli.cli, ["logs", "smoke", "1", "--no-follow"])
-    assert "host rank 0 / 4" in result.output
+    # Log lines stream from the head-side job_cli SUBPROCESS, so they
+    # land on the real fd, not click's captured sys.stdout.
+    assert "host rank 0 / 4" in capfd.readouterr().out
 
     result = runner.invoke(cli.cli, ["down", "smoke", "-y"])
     assert result.exit_code == 0, result.output

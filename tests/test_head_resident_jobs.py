@@ -238,3 +238,36 @@ def test_parse_reply_ignores_login_shell_noise():
     assert job_cli.parse_reply(noisy) == {"job_id": 7}
     with pytest.raises(ValueError, match="no STPU_RPC"):
         job_cli.parse_reply("just noise\n")
+
+
+# ------------------------------------------------- failover ergonomics (r2 #8)
+def test_retry_backoff_schedule():
+    """Exponential with +-20% jitter, capped at 5 minutes — never the r2
+    5-second hot loop."""
+    from skypilot_tpu.backends.slice_backend import _retry_backoff_seconds
+    for rnd, nominal in [(0, 10), (1, 20), (3, 80), (10, 300)]:
+        vals = [_retry_backoff_seconds(rnd) for _ in range(20)]
+        assert all(nominal * 0.8 <= v <= nominal * 1.2 for v in vals), \
+            (rnd, min(vals), max(vals))
+    assert len({round(v, 6) for v in
+                [_retry_backoff_seconds(2) for _ in range(10)]}) > 1
+
+
+def test_ssh_env_not_in_argv():
+    """User env (secrets!) must ride stdin, never the ssh argv that any
+    user on a shared host can read via ps."""
+    host = {"kind": "ssh", "ip": "10.0.0.1", "ssh_user": "stpu",
+            "ssh_key_path": "~/.ssh/stpu_internal_key", "ssh_port": 22,
+            "proxy_command": None}
+    env = {"HF_TOKEN": "hf_secret_value", "SKYPILOT_NODE_RANK": "1"}
+    argv, script = gang_exec._ssh_argv_and_script(
+        host, "python train.py", env, coord_port=9123)
+    joined = " ".join(argv)
+    assert "hf_secret_value" not in joined
+    assert "python train.py" not in joined  # command rides stdin too
+    assert "export HF_TOKEN=hf_secret_value" in script
+    assert "python train.py" in script
+    # Wrapper + tunnel still wired.
+    assert "-R" in argv
+    assert "host_wrapper" in script
+    assert "STPU_GANG_COORD_ADDR" in script

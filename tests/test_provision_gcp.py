@@ -161,9 +161,8 @@ def test_wait_returns_when_ready(fake, monkeypatch):
     monkeypatch.setattr(gcp, "_POLL_INTERVAL_SECONDS", 0)
     gcp.run_instances("us-east5", ZONE, "c1", _config(zone=ZONE))
     fake.make_ready()
-    monkeypatch.setattr(
-        gcp, "_zone_project_from_state", lambda name: (ZONE, "testproj"))
-    gcp.wait_instances("us-east5", "c1", "running")  # no raise
+    gcp.wait_instances("us-east5", "c1", "running",
+                       {"zone": ZONE, "project_id": "testproj"})  # no raise
 
 
 def test_wait_raises_blocklist_on_failed_queued_resource(fake,
@@ -173,10 +172,9 @@ def test_wait_raises_blocklist_on_failed_queued_resource(fake,
     gcp.run_instances("us-east5", ZONE, "c1",
                       _config(accelerator="tpu-v5e-16", hosts_per_slice=4))
     fake.queued["c1-s0"]["state"] = {"state": "FAILED"}
-    monkeypatch.setattr(
-        gcp, "_zone_project_from_state", lambda name: (ZONE, "testproj"))
     with pytest.raises(exceptions.ProvisionError) as exc:
-        gcp.wait_instances("us-east5", "c1", "running")
+        gcp.wait_instances("us-east5", "c1", "running",
+                           {"zone": ZONE, "project_id": "testproj"})
     assert exc.value.blocklist_zone == ZONE
 
 

@@ -1,0 +1,237 @@
+"""Kubernetes provisioner against a hermetic fake kubectl.
+
+Mirror of tests/test_provision_gcp.py: the provider's only transport is
+provision.kubernetes.kubectl(), so a fake in-memory cluster behind that
+seam exercises pod creation, slice labeling, TPU resource requests,
+status mapping, terminate-only semantics, and quota failover — with no
+kubectl binary or cluster anywhere.
+"""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import kubernetes as k8s
+
+
+class FakeKubectl:
+    """In-memory pod store behind the kubectl() seam."""
+
+    def __init__(self):
+        self.pods = {}          # name -> manifest (with injected status)
+        self.calls = []
+        self.fail_create_with = None
+        self.default_phase = "Pending"
+
+    def __call__(self, args, input_obj=None, namespace=None):
+        self.calls.append((tuple(args), namespace))
+        verb = args[0]
+        if verb == "create":
+            if self.fail_create_with:
+                raise exceptions.ProvisionError(self.fail_create_with)
+            name = input_obj["metadata"]["name"]
+            pod = dict(input_obj)
+            pod.setdefault("status", {})["phase"] = self.default_phase
+            pod["metadata"].setdefault("namespace",
+                                       namespace or "default")
+            self.pods[name] = pod
+            return pod
+        if verb == "get":
+            selector = args[args.index("-l") + 1]
+            key, val = selector.split("=", 1)
+            items = [p for p in self.pods.values()
+                     if p["metadata"]["labels"].get(key) == val]
+            return {"items": items}
+        if verb == "delete":
+            if args[1] == "pod":
+                self.pods.pop(args[2], None)
+            else:  # delete pods -l selector
+                selector = args[args.index("-l") + 1]
+                key, val = selector.split("=", 1)
+                for name in [n for n, p in self.pods.items()
+                             if p["metadata"]["labels"].get(key) == val]:
+                    del self.pods[name]
+            return {}
+        raise AssertionError(f"unexpected kubectl verb: {args}")
+
+    def set_phase(self, phase, ip_base="10.4.0."):
+        for i, pod in enumerate(self.pods.values()):
+            pod["status"]["phase"] = phase
+            if phase == "Running":
+                pod["status"]["podIP"] = f"{ip_base}{i}"
+
+
+@pytest.fixture
+def fake(monkeypatch):
+    fk = FakeKubectl()
+    monkeypatch.setattr(k8s, "kubectl", fk)
+    monkeypatch.setattr(k8s, "_POLL_INTERVAL_SECONDS", 0)
+    return fk
+
+
+def _config(**kw):
+    cfg = {"num_slices": 1, "hosts_per_slice": 1, "chips_per_host": 4,
+           "namespace": "tpu-ns", "image": "my/jax:latest"}
+    cfg.update(kw)
+    return cfg
+
+
+# ------------------------------------------------------------------ create
+def test_create_one_pod_per_slice_host(fake):
+    rec = k8s.run_instances(None, None, "c1",
+                            _config(num_slices=2, hosts_per_slice=4))
+    assert len(fake.pods) == 8
+    assert rec.head_instance_id == "c1-s0-h0"
+    assert sorted(rec.created_instance_ids)[0] == "c1-s0-h0"
+    pod = fake.pods["c1-s1-h3"]
+    labels = pod["metadata"]["labels"]
+    assert labels["stpu-cluster"] == "c1"
+    assert labels["stpu-slice"] == "slice-1"
+    assert labels["stpu-host-index"] == "3"
+
+
+def test_pod_requests_tpu_chips_and_image(fake):
+    k8s.run_instances(None, None, "c1", _config(chips_per_host=8))
+    container = fake.pods["c1-s0-h0"]["spec"]["containers"][0]
+    assert container["image"] == "my/jax:latest"
+    assert container["resources"]["limits"]["google.com/tpu"] == "8"
+
+
+def test_gke_node_selector_for_tpu_slices(fake):
+    k8s.run_instances(None, None, "c1", _config(
+        accelerator="tpu-v5e-8",
+        gke_accelerator_type="tpu-v5-lite-podslice",
+        gke_tpu_topology="2x4"))
+    sel = fake.pods["c1-s0-h0"]["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == \
+        "tpu-v5-lite-podslice"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+
+
+def test_create_adopts_existing_pods(fake):
+    k8s.run_instances(None, None, "c1", _config(hosts_per_slice=2))
+    rec = k8s.run_instances(None, None, "c1", _config(hosts_per_slice=2))
+    assert rec.created_instance_ids == []
+    assert sorted(rec.resumed_instance_ids) == ["c1-s0-h0", "c1-s0-h1"]
+
+
+def test_create_failure_cleans_partial_and_classifies_quota(fake):
+    created = []
+    orig = fake.__call__
+
+    def flaky(args, input_obj=None, namespace=None):
+        if args[0] == "create" and len(created) >= 2:
+            raise exceptions.ProvisionError(
+                'pods "c1-s0-h2" is forbidden: exceeded quota')
+        if args[0] == "create":
+            created.append(input_obj["metadata"]["name"])
+        return orig(args, input_obj=input_obj, namespace=namespace)
+
+    fake_call = flaky
+    k8s_kubectl = k8s.kubectl
+    try:
+        k8s.kubectl = fake_call
+        with pytest.raises(exceptions.ProvisionError) as exc:
+            k8s.run_instances(None, None, "c1",
+                              _config(hosts_per_slice=4))
+    finally:
+        k8s.kubectl = k8s_kubectl
+    # Quota exhaustion is not zone-retryable (nothing frees by retrying).
+    assert exc.value.retryable_in_zone is False
+    # Partial creation rolled back: slice-atomic semantics.
+    assert fake.pods == {}
+
+
+# -------------------------------------------------------------- wait/query
+def test_wait_returns_when_running(fake):
+    k8s.run_instances(None, None, "c1", _config(hosts_per_slice=2))
+    fake.set_phase("Running")
+    k8s.wait_instances(None, "c1", "running", _config())  # no raise
+
+
+def test_wait_raises_on_failed_pod(fake):
+    k8s.run_instances(None, None, "c1", _config())
+    fake.set_phase("Failed")
+    with pytest.raises(exceptions.ProvisionError, match="failed"):
+        k8s.wait_instances(None, "c1", "running", _config())
+
+
+def test_query_maps_phases(fake):
+    k8s.run_instances(None, None, "c1", _config(hosts_per_slice=2))
+    assert set(k8s.query_instances("c1", _config()).values()) == \
+        {"pending"}
+    fake.set_phase("Running")
+    assert set(k8s.query_instances("c1", _config()).values()) == \
+        {"running"}
+    fake.set_phase("Failed")
+    assert set(k8s.query_instances("c1", _config()).values()) == \
+        {"terminated"}
+
+
+# ---------------------------------------------------------- info/lifecycle
+def test_get_cluster_info_shape(fake):
+    k8s.run_instances(None, None, "c1",
+                      _config(num_slices=2, hosts_per_slice=2))
+    fake.set_phase("Running")
+    info = k8s.get_cluster_info(None, "c1", _config())
+    assert info.provider_name == "kubernetes"
+    assert info.head_instance_id == "c1-s0-h0"
+    ordered = info.ordered_instances()
+    assert [i.instance_id for i in ordered] == [
+        "c1-s0-h0", "c1-s0-h1", "c1-s1-h0", "c1-s1-h1"]
+    assert all(i.internal_ip.startswith("10.4.0.") for i in ordered)
+    assert ordered[0].tags["namespace"] == "tpu-ns"
+
+
+def test_stop_is_not_supported(fake):
+    with pytest.raises(exceptions.NotSupportedError, match="stopped"):
+        k8s.stop_instances("c1", _config())
+
+
+def test_terminate_deletes_by_label(fake):
+    k8s.run_instances(None, None, "c1", _config(hosts_per_slice=3))
+    k8s.run_instances(None, None, "other", _config())
+    k8s.terminate_instances("c1", _config())
+    assert set(fake.pods) == {"other-s0-h0"}
+
+
+# -------------------------------------------------------- capability layer
+def test_kubernetes_cloud_capabilities():
+    from skypilot_tpu import clouds as clouds_lib
+    cloud = clouds_lib.get_cloud("kubernetes")
+    from skypilot_tpu.resources import Resources
+    res = Resources(cloud="kubernetes", accelerator="tpu-v5e-8")
+    F = clouds_lib.CloudImplementationFeatures
+    unsupported = cloud.unsupported_features_for_resources(res)
+    assert F.STOP in unsupported
+    assert F.AUTOSTOP in unsupported
+    assert F.SPOT_INSTANCE in unsupported
+    assert F.IMAGE_ID not in unsupported  # image_id IS the pod image
+
+
+def test_kubernetes_resources_launchable_and_free():
+    from skypilot_tpu.resources import Resources
+    res = Resources(cloud="kubernetes", accelerator="v5e-8",
+                    image_id="my/jax:latest")
+    assert res.is_launchable
+    assert res.accelerator == "tpu-v5e-8"  # canonicalized
+    assert res.hourly_price() == 0.0
+    assert res.slice_info().chips == 8
+
+
+def test_multihost_without_image_fails_fast(fake):
+    with pytest.raises(exceptions.ProvisionError, match="sshd"):
+        k8s.run_instances(None, None, "c1",
+                          _config(hosts_per_slice=4, image=None))
+    assert fake.pods == {}  # failed BEFORE creating anything
+
+
+def test_zoneless_failure_does_not_wildcard_blocklist():
+    """A kubernetes provision failure must block only kubernetes, never
+    the same accelerator on other clouds (failover to GCP survives)."""
+    from skypilot_tpu.optimizer import Blocklist
+    from skypilot_tpu.resources import Resources
+    k8s_res = Resources(cloud="kubernetes", accelerator="tpu-v5e-8")
+    gcp_res = Resources(cloud="gcp", accelerator="tpu-v5e-8",
+                        zone="us-central1-a")
+    bl = Blocklist().add("tpu-v5e-8", "cloud:kubernetes")
+    assert bl.blocked(k8s_res)
+    assert not bl.blocked(gcp_res)
