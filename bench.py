@@ -1,21 +1,35 @@
 """Headline benchmark: Llama training MFU / tokens-per-sec on one chip.
 
 Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
 
-The north-star target (BASELINE.json) is >=40% MFU for llama finetuning on
-TPU, so ``vs_baseline`` reports achieved-MFU / 40%. The benchmark trains
-the LARGEST Llama config that fits the attached chip (candidates tried
-big-to-small; a compile/OOM failure falls through to the next size) and
-also reports cold-start latency (process start -> first optimizer step
-done, including model init and XLA compile — the single-chip analog of the
-reference's `sky launch`->first-step metric). On CPU (no TPU attached) a
-tiny config keeps the pipeline testable.
+The north-star target (BASELINE.json) is >=40% MFU for llama finetuning
+on TPU, so ``vs_baseline`` reports achieved-MFU / 40%. Three legs, all
+against BASELINE.md's blueprint targets rather than only the
+largest-fitting model (VERDICT r2 weak-item 2):
+
+  * headline  — the LARGEST Llama config that fits the attached chip,
+    seq 2048 (candidates big-to-small; one retry per candidate on the
+    opaque remote_compile 500 before treating it as does-not-fit, and
+    every skip is recorded in the JSON detail so a downsized run is
+    visible in the result);
+  * long_context — seq 8192 through the streamed flash-attention
+    kernel family (the capability built for exactly this);
+  * eight_b_shape — Llama-3.1-8B's layer geometry (dim 4096, mlp
+    14336, GQA 32/8) with as many layers as fit one chip, under remat +
+    gradient accumulation (optax.MultiSteps) — the per-chip behavior of
+    the 8B target whose full weights cannot fit a single 16 GB chip.
+
+Cold-start latency is broken down (imports / init / first-step compile)
+and the JAX persistent compilation cache is enabled, so warm reruns
+skip XLA compilation (target <30 s start-to-first-step warm).
+On CPU (no TPU attached) a tiny config keeps the pipeline testable.
 """
 from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import sys
 import time
 
@@ -23,6 +37,20 @@ _T_START = time.perf_counter()
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
+
+_T_IMPORT = time.perf_counter()
+
+_CACHE_DIR = os.path.expanduser("~/.cache/stpu_jax_cache")
+
+
+def _enable_compilation_cache() -> None:
+    try:
+        jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization
+        print(f"bench: compilation cache unavailable: {e}",
+              file=sys.stderr)
 
 
 # bf16 peak FLOP/s per chip by TPU generation (public spec sheets).
@@ -64,15 +92,29 @@ def _tpu_candidates(llama):
     ]
 
 
-def _run_candidate(cfg, batch, seq, steps, warmup):
+def _does_not_fit(msg: str) -> bool:
+    # The chipless AOT compiler rejects memory-infeasible programs with
+    # an opaque remote_compile HTTP 500 (no OOM marker), so that string
+    # is part of the doesn't-fit set — but only after one retry, since
+    # the same 500 also surfaces transient tunnel errors.
+    return ("RESOURCE_EXHAUSTED" in msg or "remote_compile" in msg
+            or "Out of memory" in msg)
+
+
+def _run_candidate(cfg, batch, seq, steps, warmup, accum_steps=1):
+    import optax
+
+    from skypilot_tpu.models import llama
     from skypilot_tpu.parallel import mesh as mesh_lib
     from skypilot_tpu.train import trainer
 
     mesh = mesh_lib.make_mesh({"dp": 1}, devices=[jax.devices()[0]])
-    from skypilot_tpu.models import llama
     params = llama.init(cfg, jax.random.key(0))
+    t_init = time.perf_counter()
     tx = trainer.make_optimizer(
         trainer.TrainConfig(warmup_steps=2, total_steps=1000))
+    if accum_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=accum_steps)
     state = trainer.init_train_state(params, tx)
     state = jax.device_put(
         state, trainer.state_shardings(mesh, mesh_lib.DEFAULT_RULES,
@@ -90,7 +132,7 @@ def _run_candidate(cfg, batch, seq, steps, warmup):
     # block_until_ready can return before execution completes; a value
     # fetch cannot.
     float(metrics["loss"])
-    t_first = time.perf_counter() - _T_START
+    t_first = time.perf_counter()
 
     for _ in range(warmup - 1):
         state, metrics = step(state, batch_dict)
@@ -102,69 +144,149 @@ def _run_candidate(cfg, batch, seq, steps, warmup):
     final_loss = float(metrics["loss"])  # forces the whole chain
     dt = time.perf_counter() - t0
     assert final_loss == final_loss, "loss is NaN"
-    return batch * seq * steps / dt, t_first
+    timings = {
+        "import_seconds": round(_T_IMPORT - _T_START, 1),
+        "init_seconds": round(t_init - _T_START, 1),
+        "start_to_first_step_seconds": round(t_first - _T_START, 1),
+    }
+    return batch * seq * steps / dt, timings
+
+
+def _try_candidates(candidates, batch, seq, steps, warmup, skipped,
+                    accum_steps=1):
+    """Largest-first with one retry on opaque remote_compile errors.
+    Returns (cfg, tokens_per_sec, timings) or raises SystemExit."""
+    for cfg in candidates:
+        for attempt in (1, 2):
+            try:
+                tps, timings = _run_candidate(cfg, batch, seq, steps,
+                                              warmup, accum_steps)
+                return cfg, tps, timings
+            except Exception as e:  # noqa: BLE001 — OOM/compile reject
+                msg = str(e)
+                if not _does_not_fit(msg):
+                    raise
+                transient = ("remote_compile" in msg
+                             and "RESOURCE_EXHAUSTED" not in msg
+                             and "Out of memory" not in msg)
+                if attempt == 1 and transient:
+                    print(f"bench: {cfg.n_layers}L candidate hit "
+                          f"remote_compile; retrying once: {msg[:200]}",
+                          file=sys.stderr)
+                    # Keep only the string: traceback frames would pin
+                    # the failed candidate's params in HBM.
+                    del e
+                    continue
+                print(f"bench: {cfg.n_layers}L candidate did not "
+                      f"fit/compile: {msg[:300]}", file=sys.stderr)
+                skipped.append({"n_layers": cfg.n_layers,
+                                "dim": cfg.dim,
+                                "reason": msg[:200]})
+                del e
+                break
+    raise SystemExit(f"no candidate config fit; skipped: {skipped}")
+
+
+def _long_context_leg(llama, peak: float) -> dict:
+    """Seq-8192 training through the streamed flash kernel (BASELINE.md
+    long-context target). Smaller model so the 8k activations fit."""
+    cfg = llama.LlamaConfig(
+        vocab_size=32768, dim=2048, n_heads=16, n_kv_heads=8,
+        mlp_dim=8192, n_layers=16, max_seq_len=8192)
+    seq, batch, steps = 8192, 1, 6
+    skipped: list = []
+    try:
+        cfg, tps, _ = _try_candidates([cfg], batch, seq, steps, 2,
+                                      skipped)
+    except SystemExit:
+        return {"error": f"did not fit: {skipped}"}
+    mfu = tps * cfg.flops_per_token() / peak * 100.0
+    return {
+        "seq_len": seq,
+        "tokens_per_sec_per_chip": round(tps, 1),
+        "mfu_pct": round(mfu, 2),
+        "mfu_incl_attention_pct": round(
+            tps * cfg.flops_per_token(seq) / peak * 100.0, 2),
+        "params": cfg.num_params(),
+    }
+
+
+def _eight_b_shape_leg(llama, peak: float) -> dict:
+    """Llama-3.1-8B layer geometry per chip under remat + grad accum.
+    The full 8B cannot fit one 16 GB chip (bf16 params alone are 16 GB);
+    this measures the per-chip behavior of its exact layer shape — the
+    number that, scaled by layers/chips, predicts the v5p-64 target."""
+    candidates = [
+        llama.LlamaConfig(vocab_size=32768, dim=4096, n_heads=32,
+                          n_kv_heads=8, mlp_dim=14336, n_layers=n,
+                          max_seq_len=4096)
+        for n in (6, 4, 2)
+    ]
+    seq, batch, steps, accum = 2048, 4, 8, 2
+    skipped: list = []
+    try:
+        cfg, tps, _ = _try_candidates(candidates, batch, seq, steps, 2,
+                                      skipped, accum_steps=accum)
+    except SystemExit:
+        return {"error": f"no 8B-shape candidate fit: {skipped}"}
+    mfu = tps * cfg.flops_per_token() / peak * 100.0
+    return {
+        "n_layers": cfg.n_layers,
+        "grad_accum_steps": accum,
+        "tokens_per_sec_per_chip": round(tps, 1),
+        "mfu_pct": round(mfu, 2),
+        "mfu_incl_attention_pct": round(
+            tps * cfg.flops_per_token(seq) / peak * 100.0, 2),
+        "params": cfg.num_params(),
+        "skipped": skipped,
+    }
 
 
 def main():
+    _enable_compilation_cache()
     from skypilot_tpu.models import llama
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
+    warm_cache = os.path.isdir(_CACHE_DIR) and bool(os.listdir(_CACHE_DIR))
 
     if on_tpu:
         batch, seq, steps, warmup = 8, 2048, 10, 3
-        last_err = None
-        for cfg in _tpu_candidates(llama):
-            try:
-                tok_per_sec, t_first = _run_candidate(cfg, batch, seq,
-                                                      steps, warmup)
-                break
-            except Exception as e:  # noqa: BLE001 — OOM/compile reject
-                msg = str(e)
-                # The chipless AOT compiler rejects memory-infeasible
-                # programs with an opaque remote_compile HTTP 500 (no OOM
-                # marker), so that string is part of the doesn't-fit set.
-                # Surface each skip on stderr so a genuine lowering bug
-                # (which would fail every size) stays diagnosable.
-                if ("RESOURCE_EXHAUSTED" in msg or "remote_compile" in msg
-                        or "Out of memory" in msg):
-                    print(f"bench: {cfg.n_layers}-layer candidate did "
-                          f"not fit/compile: {msg[:300]}", file=sys.stderr)
-                    # Keep only the string: the exception's traceback
-                    # frames would pin the failed candidate's multi-GB
-                    # params/state in HBM across the next attempt.
-                    last_err = msg
-                    del e
-                    continue
-                raise
-        else:
-            raise SystemExit(f"no candidate config fit; last error: "
-                             f"{last_err}")
+        skipped: list = []
+        cfg, tok_per_sec, timings = _try_candidates(
+            _tpu_candidates(llama), batch, seq, steps, warmup, skipped)
     else:
         cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=512),
                                   attention_impl="reference")
-        tok_per_sec, t_first = _run_candidate(cfg, 4, 256, 4, 2)
+        seq = 256
+        cfg, tok_per_sec, timings = _try_candidates([cfg], 4, seq, 4, 2,
+                                                    [])
 
     peak = _peak_flops(dev)
     if on_tpu and peak > 0:
         # Headline is the conservative 6N convention (no attention term,
-        # comparable across rounds); the attention-inclusive figure is in
-        # detail.
+        # comparable across rounds); the attention-inclusive figure is
+        # in detail.
         mfu = tok_per_sec * cfg.flops_per_token() / peak * 100.0
-        mfu_attn = (tok_per_sec * cfg.flops_per_token(seq) / peak * 100.0)
+        mfu_attn = tok_per_sec * cfg.flops_per_token(seq) / peak * 100.0
+        detail = {
+            "tokens_per_sec_per_chip": round(tok_per_sec, 1),
+            "device": getattr(dev, "device_kind", str(dev)),
+            "params": cfg.num_params(),
+            "seq_len": seq,
+            "mfu_incl_attention": round(mfu_attn, 2),
+            "headline_skipped_candidates": skipped,
+            "compilation_cache_warm": warm_cache,
+            **timings,
+            "long_context": _long_context_leg(llama, peak),
+            "eight_b_shape": _eight_b_shape_leg(llama, peak),
+        }
         print(json.dumps({
             "metric": "llama_train_mfu_1chip",
             "value": round(mfu, 2),
             "unit": "%MFU",
             "vs_baseline": round(mfu / 40.0, 3),
-            "detail": {
-                "tokens_per_sec_per_chip": round(tok_per_sec, 1),
-                "device": getattr(dev, "device_kind", str(dev)),
-                "params": cfg.num_params(),
-                "seq_len": seq,
-                "mfu_incl_attention": round(mfu_attn, 2),
-                "start_to_first_step_seconds": round(t_first, 1),
-            },
+            "detail": detail,
         }))
     else:
         print(json.dumps({
