@@ -512,6 +512,18 @@ def serve_down(service_names, all_services):
     click.echo(f"Tearing down: {', '.join(done) or 'none'}")
 
 
+@serve.command(name="logs")
+@click.argument("service_name")
+@click.argument("replica_id", type=int, required=False)
+@click.option("--no-follow", is_flag=True)
+def serve_logs(service_name, replica_id, no_follow):
+    """Stream service logs: controller+LB by default, or one replica's
+    job logs when REPLICA_ID is given."""
+    from skypilot_tpu.serve import core as serve_core
+    sys.exit(serve_core.logs(service_name, replica_id,
+                             follow=not no_follow))
+
+
 @serve.command(name="status")
 @click.argument("service_names", nargs=-1)
 def serve_status(service_names):

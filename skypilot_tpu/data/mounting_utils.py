@@ -43,6 +43,41 @@ def get_s3_mount_command(bucket: str, mount_path: str) -> str:
             f"goofys {q(bucket)} {q(mount_path)})")
 
 
+BLOBFUSE2_VERSION = "2.3.2"
+
+_INSTALL_BLOBFUSE2 = (
+    "command -v blobfuse2 >/dev/null || ("
+    "sudo curl -fsSL -o /tmp/blobfuse2.deb "
+    "https://github.com/Azure/azure-storage-fuse/releases/download/"
+    f"blobfuse2-{BLOBFUSE2_VERSION}/blobfuse2-{BLOBFUSE2_VERSION}"
+    "-Ubuntu-22.04-x86-64.deb && "
+    "sudo dpkg -i /tmp/blobfuse2.deb)")
+
+
+# az CLI bootstrap for COPY-mode fetches on fresh cluster VMs.
+_INSTALL_AZ_CLI = (
+    "command -v az >/dev/null || "
+    "(curl -sL https://aka.ms/InstallAzureCLIDeb | sudo bash)")
+
+
+def get_az_mount_command(container: str, storage_account: str,
+                         mount_path: str) -> str:
+    """Install blobfuse2 if needed and mount the container; idempotent
+    (reference: mounting_utils blobfuse2 branch,
+    sky/data/mounting_utils.py:100-130). AZURE_STORAGE_AUTH_TYPE=azcli
+    is blobfuse2's knob for az-CLI-login credentials (the host needs an
+    Azure identity: `az login` state synced via file_mounts, or a
+    managed identity)."""
+    q = shlex.quote
+    return (f"{_INSTALL_BLOBFUSE2} && "
+            f"mkdir -p {q(mount_path)} /tmp/blobfuse2-cache && "
+            f"(mountpoint -q {q(mount_path)} || "
+            f"AZURE_STORAGE_AUTH_TYPE=azcli blobfuse2 mount "
+            f"{q(mount_path)} --container-name {q(container)} "
+            f"--account-name {q(storage_account)} "
+            f"--tmp-path /tmp/blobfuse2-cache)")
+
+
 def get_unmount_command(mount_path: str) -> str:
     q = shlex.quote
     return (f"mountpoint -q {q(mount_path)} && "

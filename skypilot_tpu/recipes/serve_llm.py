@@ -43,6 +43,39 @@ def _ceil_to(n: int, b: int) -> int:
     return ((n + b - 1) // b) * b
 
 
+def _pick(logits_row: jax.Array, temperature: float,
+          key: jax.Array) -> jax.Array:
+    if temperature > 0.0:
+        return jax.random.categorical(
+            key, logits_row / temperature, axis=-1).astype(jnp.int32)
+    return jnp.argmax(logits_row, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3, 5))
+def _prefill(cfg: llama.LlamaConfig, params, buf: jax.Array,
+             max_seq: int, start: jax.Array, temperature: float,
+             key: jax.Array):
+    """Streaming path, step 1: one O(S) prefill over the padded prompt;
+    returns (first token (1,), KV cache). Shapes are bucket sizes so
+    all prompts in a bucket share one compile."""
+    cache = llama.init_cache(cfg, 1, max_seq)
+    logits, cache = llama.forward_with_cache(
+        cfg, params, buf[None, :], cache, jnp.int32(0), valid_len=start,
+        logits_at=jnp.asarray(start - 1, jnp.int32))
+    return _pick(logits[:, 0], temperature, key), cache
+
+
+@functools.partial(jax.jit, static_argnums=(0, 5))
+def _gen_step(cfg: llama.LlamaConfig, params, tok: jax.Array, cache,
+              pos: jax.Array, temperature: float, key: jax.Array):
+    """Streaming path, step 2..N: one O(max_seq) cached decode step —
+    called per token so the handler can flush each token to the client
+    as it exists (SSE), instead of waiting for the whole scan."""
+    logits, cache = llama.forward_with_cache(
+        cfg, params, tok[:, None], cache, pos)
+    return _pick(logits[:, -1], temperature, key), cache
+
+
 @functools.partial(jax.jit, static_argnums=(0, 4, 5))
 def _decode(cfg: llama.LlamaConfig, params, buf: jax.Array,
             start: jax.Array, mt_pad: int,
@@ -63,6 +96,7 @@ def _decode(cfg: llama.LlamaConfig, params, buf: jax.Array,
 
 
 class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"  # chunked responses need 1.1
     server_ctx = None  # set by serve()
 
     def log_message(self, *args):
@@ -110,6 +144,10 @@ class _Handler(BaseHTTPRequestHandler):
             mt_pad = _ceil_to(mt, GEN_BUCKET)
             buf = jnp.zeros((s_pad,), jnp.int32).at[:s].set(
                 jnp.asarray(prompt, dtype=jnp.int32))
+            if req.get("stream"):
+                self._stream_generate(ctx, buf, s, s_pad, mt, mt_pad,
+                                      temperature, seed)
+                return
             with ctx["lock"]:
                 toks = _decode(ctx["cfg"], ctx["params"], buf,
                                jnp.int32(s), mt_pad, temperature,
@@ -117,6 +155,43 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, {"tokens": [int(t) for t in toks[:mt]]})
         except (KeyError, ValueError, TypeError) as e:
             self._json(400, {"error": str(e)})
+
+    def _stream_generate(self, ctx, buf, s, s_pad, mt, mt_pad,
+                         temperature, seed) -> None:
+        """SSE token stream: one `data: {"token": N}` event per decoded
+        token, flushed as produced (chunked transfer), then
+        `data: [DONE]` — the OpenAI-style contract LLM clients expect."""
+        from skypilot_tpu.serve.load_balancer import (end_chunks,
+                                                      write_chunk)
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def emit(payload: str) -> None:
+            write_chunk(self.wfile, f"data: {payload}\n\n".encode())
+
+        cfg, params = ctx["cfg"], ctx["params"]
+        key = jax.random.key(seed)
+        # The model lock is held ONLY around each compute step, never
+        # across the socket write: a stalled client (TCP backpressure on
+        # emit) must not block other requests' inference.
+        key, k = jax.random.split(key)
+        with ctx["lock"]:
+            tok, cache = _prefill(cfg, params, buf, s_pad + mt_pad,
+                                  jnp.int32(s), temperature, k)
+            tok.block_until_ready()
+        emit(json.dumps({"token": int(tok[0])}))
+        for i in range(mt - 1):
+            key, k = jax.random.split(key)
+            with ctx["lock"]:
+                tok, cache = _gen_step(cfg, params, tok, cache,
+                                       jnp.int32(s + i), temperature, k)
+                tok.block_until_ready()
+            emit(json.dumps({"token": int(tok[0])}))
+        emit("[DONE]")
+        end_chunks(self.wfile)
 
 
 def serve(cfg: llama.LlamaConfig, params, port: int,

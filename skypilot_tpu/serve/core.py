@@ -284,6 +284,66 @@ def _finalize_dead_service(service_name: str) -> None:
     serve_state.remove_service(service_name)
 
 
+def logs(service_name: str, replica_id: Optional[int] = None,
+         follow: bool = True) -> int:
+    """Stream service logs (reference: sky serve logs, sky/cli.py:4363).
+
+    Without ``replica_id``: the controller+LB process log. With one: the
+    replica cluster's job logs (what the model server prints).
+    """
+    handle = _proxy()
+    if handle is not None:
+        args = ["logs", "--service-name", service_name]
+        if replica_id is not None:
+            args += ["--replica-id", str(replica_id)]
+        if not follow:
+            args += ["--no-follow"]
+        return int(controller_utils.run_on_controller(
+            handle, controller_utils.module_command(
+                "skypilot_tpu.serve.core", *args), stream=True))
+    return _logs_local(service_name, replica_id, follow)
+
+
+def _logs_local(service_name: str, replica_id: Optional[int],
+                follow: bool) -> int:
+    svc = serve_state.get_service(service_name)
+    if svc is None:
+        print(f"Service {service_name!r} not found.")
+        return 1
+    if replica_id is not None:
+        for rep in serve_state.get_replicas(service_name):
+            if rep["replica_id"] == replica_id:
+                record = global_user_state.get_cluster_from_name(
+                    rep["cluster_name"])
+                if record is None or record["handle"] is None:
+                    print(f"Replica {replica_id} has no live cluster "
+                          f"(status {rep['status'].value}).")
+                    return 1
+                backend = slice_backend.SliceBackend()
+                return backend.tail_logs(record["handle"], None,
+                                         follow=follow)
+        print(f"No replica {replica_id} in {service_name!r}.")
+        return 1
+    # Controller + LB process log.
+    log_path = paths.logs_dir() / "serve" / f"{service_name}.log"
+    if not log_path.exists():
+        print(f"(no log yet at {log_path})")
+        return 1
+    with open(log_path, "r", errors="replace") as f:
+        while True:
+            line = f.readline()
+            if line:
+                print(line, end="", flush=True)
+                continue
+            if not follow or serve_state.get_service(
+                    service_name) is None:
+                rest = f.read()
+                if rest:
+                    print(rest, end="", flush=True)
+                return 0
+            time.sleep(0.5)
+
+
 def status(service_names: Optional[List[str]] = None
            ) -> List[Dict[str, Any]]:
     """Service records with replicas; statuses normalized to plain strings
@@ -361,6 +421,11 @@ def main() -> None:
     p.add_argument("--all", action="store_true", dest="all_services")
     p.add_argument("--timeout", type=float, default=60.0)
 
+    p = sub.add_parser("logs")
+    p.add_argument("--service-name", required=True)
+    p.add_argument("--replica-id", type=int, default=None)
+    p.add_argument("--no-follow", action="store_true")
+
     args = parser.parse_args()
     if args.cmd == "submit":
         task = Task.from_yaml(os.path.expanduser(args.task_yaml))
@@ -393,6 +458,9 @@ def main() -> None:
         names = args.names.split(",") if args.names else None
         done = _down_local(names, args.all_services, args.timeout)
         print(json.dumps({"down": done}))
+    elif args.cmd == "logs":
+        raise SystemExit(_logs_local(args.service_name, args.replica_id,
+                                     follow=not args.no_follow))
 
 
 if __name__ == "__main__":

@@ -1,10 +1,16 @@
-"""Load balancer: HTTP reverse proxy over ready replicas.
+"""Load balancer: STREAMING HTTP reverse proxy over ready replicas.
 
 Reference analog: sky/serve/load_balancer.py (FastAPI + httpx proxy,
 RoundRobin select, request-rate reporting to the controller). Stdlib
 implementation: ThreadingHTTPServer + urllib forwarding; the controller
 runs in the same process, so replica sync and QPS reporting are shared
 memory instead of the reference's periodic HTTP sync.
+
+Responses are passed through CHUNK BY CHUNK as the replica produces
+them — token streaming / SSE is table stakes for LLM serving, so the
+proxy must never buffer a whole response: a replica response with a
+Content-Length streams under it; one without (chunked upstream, e.g.
+SSE) is re-chunked to the client with a flush per chunk.
 """
 from __future__ import annotations
 
@@ -21,6 +27,21 @@ from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
 _HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding",
                 "te", "trailer", "upgrade", "proxy-authorization",
                 "proxy-authenticate", "host", "content-length"}
+
+
+def write_chunk(wfile, data: bytes) -> None:
+    """One HTTP/1.1 chunked-transfer frame, flushed immediately (shared
+    by the LB proxy and the serve_llm SSE endpoint)."""
+    wfile.write(f"{len(data):x}\r\n".encode())
+    wfile.write(data)
+    wfile.write(b"\r\n")
+    wfile.flush()
+
+
+def end_chunks(wfile) -> None:
+    """Chunked-transfer terminator."""
+    wfile.write(b"0\r\n\r\n")
+    wfile.flush()
 
 
 class RequestRecorder:
@@ -68,14 +89,7 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                                      method=method)
         try:
             with urllib.request.urlopen(req, timeout=120) as resp:
-                payload = resp.read()
-                self.send_response(resp.status)
-                for k, v in resp.getheaders():
-                    if k.lower() not in _HOP_HEADERS:
-                        self.send_header(k, v)
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
+                self._stream_response(resp)
         except urllib.error.HTTPError as e:
             payload = e.read()
             self.send_response(e.code)
@@ -89,6 +103,35 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)
+
+    def _stream_response(self, resp) -> None:
+        """Forward the replica's response as chunks ARRIVE (read1 =
+        whatever bytes are available), never whole-response buffered."""
+        self.send_response(resp.status)
+        clen = resp.getheader("Content-Length")
+        for k, v in resp.getheaders():
+            if k.lower() not in _HOP_HEADERS:
+                self.send_header(k, v)
+        if clen is not None:
+            self.send_header("Content-Length", clen)
+            self.end_headers()
+            while True:
+                chunk = resp.read1(65536)
+                if not chunk:
+                    break
+                self.wfile.write(chunk)
+                self.wfile.flush()
+        else:
+            # Chunked upstream (SSE/token streams): re-chunk, flushing
+            # per chunk so the client sees tokens as they are produced.
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            while True:
+                chunk = resp.read1(65536)
+                if not chunk:
+                    break
+                write_chunk(self.wfile, chunk)
+            end_chunks(self.wfile)
 
     def do_GET(self):
         self._proxy("GET")

@@ -29,6 +29,25 @@ os.environ.setdefault("STPU_DISABLE_DAEMON", "1")
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    """Opt-in real-cloud smoke tests (reference: tests/conftest.py:49-80
+    --aws/--gcp/--tpu flags gating tests/test_smoke.py)."""
+    parser.addoption(
+        "--gcp-live", action="store_true", default=False,
+        help="run tests that provision REAL GCP TPUs (costs money; "
+             "needs gcloud credentials + a project with TPU quota)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--gcp-live"):
+        return
+    skip = pytest.mark.skip(
+        reason="live-cloud smoke test: pass --gcp-live to run")
+    for item in items:
+        if "gcp_live" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture
 def tmp_state_dir(tmp_path, monkeypatch):
     """Redirect all client-side state (~/.stpu) into a tmpdir."""

@@ -1,0 +1,202 @@
+"""Streaming serve data plane (VERDICT r2 #6).
+
+The LB must pass response chunks through AS THE REPLICA PRODUCES THEM
+(token streaming is table stakes for LLM serving) — proven by a client
+receiving >1 chunk, spaced in time, before the replica finishes. Plus
+the serve_llm recipe's SSE `/generate` stream and `serve logs`.
+"""
+import http.client
+import http.server
+import json
+import socketserver
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve.load_balancing_policies import RoundRobinPolicy
+
+N_CHUNKS = 4
+CHUNK_GAP_S = 0.25
+
+
+class _SlowStreamHandler(http.server.BaseHTTPRequestHandler):
+    """A replica that emits N_CHUNKS chunks, CHUNK_GAP_S apart."""
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        for i in range(N_CHUNKS):
+            data = f"data: chunk-{i}\n\n".encode()
+            self.wfile.write(f"{len(data):x}\r\n".encode())
+            self.wfile.write(data)
+            self.wfile.write(b"\r\n")
+            self.wfile.flush()
+            time.sleep(CHUNK_GAP_S)
+        self.wfile.write(b"0\r\n\r\n")
+
+
+class _Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def _read_chunks_with_times(host, port, path, method="GET", body=None,
+                            headers=None):
+    """Raw chunked read, timestamping each chunk's arrival."""
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request(method, path, body=body, headers=headers or {})
+    resp = conn.getresponse()
+    arrivals = []
+    while True:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        arrivals.append((time.time(), chunk))
+    conn.close()
+    return resp, arrivals
+
+
+@pytest.fixture
+def slow_replica():
+    server = _Server(("127.0.0.1", 0), _SlowStreamHandler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def test_lb_streams_chunks_before_completion(slow_replica):
+    policy = RoundRobinPolicy()
+    policy.set_ready_replicas([slow_replica])
+    recorder = lb_lib.RequestRecorder()
+    lb = lb_lib.run_load_balancer(0, policy, recorder)
+    lb_port = lb.server_address[1]
+    try:
+        t0 = time.time()
+        resp, arrivals = _read_chunks_with_times(
+            "127.0.0.1", lb_port, "/stream")
+        assert resp.status == 200
+        payload = b"".join(c for _, c in arrivals)
+        assert payload.count(b"data: chunk-") == N_CHUNKS
+        # The streaming property: the FIRST chunk arrived well before
+        # the replica finished (N_CHUNKS * gap), and arrivals are
+        # spread over time — a buffering proxy delivers everything at
+        # once at the end.
+        first_at = arrivals[0][0] - t0
+        total = arrivals[-1][0] - t0
+        assert len(arrivals) > 1, "whole response arrived as one blob"
+        assert first_at < total - CHUNK_GAP_S, (
+            f"first chunk at {first_at:.2f}s of {total:.2f}s — "
+            f"proxy buffered the response")
+    finally:
+        lb.shutdown()
+
+
+def test_lb_still_proxies_content_length_responses(slow_replica):
+    """Non-streaming replicas (Content-Length) keep working."""
+
+    class _Plain(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = b'{"ok": true}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = _Server(("127.0.0.1", 0), _Plain)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    policy = RoundRobinPolicy()
+    policy.set_ready_replicas(
+        [f"http://127.0.0.1:{server.server_address[1]}"])
+    lb = lb_lib.run_load_balancer(0, policy, lb_lib.RequestRecorder())
+    try:
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{lb.server_address[1]}/x",
+                timeout=10) as resp:
+            assert json.loads(resp.read()) == {"ok": True}
+    finally:
+        lb.shutdown()
+        server.shutdown()
+
+
+def test_serve_llm_sse_stream_through_lb():
+    """End-to-end: the recipe's SSE /generate streams token events
+    through the LB, client sees >1 chunk before [DONE]."""
+    import jax
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.recipes import serve_llm
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    params = llama.init(cfg, jax.random.key(0))
+    ready = threading.Event()
+    httpd = serve_llm.serve(cfg, params, 0, ready_event=ready)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    assert ready.wait(timeout=120)
+
+    policy = RoundRobinPolicy()
+    policy.set_ready_replicas(
+        [f"http://127.0.0.1:{httpd.server_address[1]}"])
+    lb = lb_lib.run_load_balancer(0, policy, lb_lib.RequestRecorder())
+    try:
+        body = json.dumps({"prompt": [1, 2, 3], "max_tokens": 6,
+                           "stream": True})
+        resp, arrivals = _read_chunks_with_times(
+            "127.0.0.1", lb.server_address[1], "/generate",
+            method="POST", body=body,
+            headers={"Content-Type": "application/json"})
+        assert resp.status == 200
+        text = b"".join(c for _, c in arrivals).decode()
+        events = [ln[len("data: "):] for ln in text.splitlines()
+                  if ln.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        tokens = [json.loads(e)["token"] for e in events[:-1]]
+        assert len(tokens) == 6
+        assert len(arrivals) > 1, "SSE stream arrived as one blob"
+        # Streamed greedy tokens must match the batch decode path.
+        batch = llama.decode(cfg, params,
+                             jax.numpy.asarray([[1, 2, 3]]),
+                             jax.numpy.int32(3), 6, 64)
+        assert tokens == [int(t) for t in batch[0]]
+    finally:
+        lb.shutdown()
+        httpd.shutdown()
+
+
+# ------------------------------------------------------------- serve logs
+def test_serve_logs_controller_log(tmp_state_dir, capsys):
+    """`stpu serve logs <svc>` streams the controller+LB process log."""
+    from skypilot_tpu.serve import core as serve_core
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.utils import paths
+
+    serve_state.add_service("svc-l", "{}", "/tmp/none.yaml", 12345)
+    log_dir = paths.logs_dir() / "serve"
+    log_dir.mkdir(parents=True, exist_ok=True)
+    (log_dir / "svc-l.log").write_text("controller says hi\n")
+    try:
+        rc = serve_core._logs_local("svc-l", None, follow=False)
+    finally:
+        serve_state.remove_service("svc-l")
+    assert rc == 0
+    assert "controller says hi" in capsys.readouterr().out
+
+
+def test_serve_logs_unknown_service(tmp_state_dir, capsys):
+    from skypilot_tpu.serve import core as serve_core
+    assert serve_core._logs_local("nope", None, follow=False) == 1
+    assert "not found" in capsys.readouterr().out

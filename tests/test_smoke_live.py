@@ -1,0 +1,78 @@
+"""Opt-in REAL-cloud smoke tests: ``pytest --gcp-live tests/test_smoke_live.py``.
+
+Reference analog: tests/test_smoke.py (the reference's 5,308-line
+real-cloud suite, gated by conftest --gcp/--tpu flags). This is the
+runnable checklist for the day someone points the GCP provisioner at a
+real project: launch -> run -> queue -> autostop --down -> gone, against
+a real v5e single-host slice (the cheapest TPU the catalog offers).
+
+Never runs in CI: collection skips everything without --gcp-live, and
+even with the flag each test re-checks credentials and SKIPS (not
+fails) when gcloud/project/quota are absent. COSTS REAL MONEY when it
+runs; every cluster is created with a finally-teardown.
+"""
+import time
+import uuid
+
+import pytest
+
+pytestmark = pytest.mark.gcp_live
+
+_ACCELERATOR = "tpu-v5e-8"  # single host: cheapest real slice
+
+
+def _require_gcp():
+    from skypilot_tpu import clouds as clouds_lib
+    ok, reason = clouds_lib.get_cloud("gcp").check_credentials()
+    if not ok:
+        pytest.skip(f"no usable GCP credentials: {reason}")
+
+
+@pytest.mark.timeout(1800)
+def test_launch_run_autostop_down_live():
+    _require_gcp()
+    from skypilot_tpu import core, execution
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.status_lib import ClusterStatus
+    from skypilot_tpu.task import Task
+
+    name = f"stpu-smoke-{uuid.uuid4().hex[:6]}"
+    task = Task("smoke", run="python3 -c 'import socket; "
+                             "print(\"live-ok\", socket.gethostname())'")
+    task.set_resources(Resources(cloud="gcp",
+                                 accelerator=_ACCELERATOR))
+    try:
+        job_id, handle = execution.launch(
+            task, cluster_name=name, detach_run=True, stream_logs=False,
+            retry_until_up=False)
+        assert handle is not None
+
+        # The head-resident queue answers over SSH.
+        deadline = time.time() + 300
+        status = None
+        while time.time() < deadline:
+            status = core.job_status(name, [job_id])[job_id]
+            if status in ("SUCCEEDED", "FAILED", "FAILED_SETUP"):
+                break
+            time.sleep(10)
+        assert status == "SUCCEEDED", f"job ended {status}"
+        assert core.tail_logs(name, job_id, follow=False) == 0
+
+        # Autostop --down: the on-host daemon terminates the idle slice
+        # with zero further client involvement.
+        core.autostop(name, 0, down_after=True)
+        deadline = time.time() + 900
+        while time.time() < deadline:
+            records = core.status([name], refresh=True)
+            if not records or records[0]["status"] is None:
+                return  # daemon tore it down
+            if records[0]["status"] == ClusterStatus.STOPPED:
+                break
+            time.sleep(30)
+        records = core.status([name], refresh=True)
+        assert not records, "cluster still alive after autostop --down"
+    finally:
+        try:
+            core.down(name, purge=True)
+        except Exception:  # noqa: BLE001 — already gone
+            pass

@@ -123,3 +123,52 @@ def test_storage_mount_via_task_yaml(tmp_path):
                                       detach_run=False, stream_logs=False)
     head = handle.cluster_info.get_head_instance()
     assert open(head.tags["host_dir"] + "/got.txt").read() == "yaml-store"
+
+
+def test_azure_command_generation(tmp_state_dir, monkeypatch):
+    from skypilot_tpu import config as config_lib
+    monkeypatch.setattr(
+        config_lib, "get_nested",
+        lambda keys, default=None: "myacct"
+        if keys == ("azure", "storage_account") else default)
+    s = storage_lib.AzureBlobStore("ctr")
+    fetch = s.fetch_command("/data")
+    assert "az storage blob download-batch" in fetch
+    assert "--source ctr" in fetch and "myacct" in fetch
+    mount = s.mount_fuse_command("/data")
+    assert "blobfuse2 mount" in mount
+    assert "--container-name ctr" in mount
+    assert "--account-name myacct" in mount
+    # ~ destinations stay expandable (quoted tildes never expand).
+    assert '"$HOME"/d' in s.fetch_command("~/d")
+
+
+def test_azure_requires_storage_account(tmp_state_dir):
+    s = storage_lib.AzureBlobStore("ctr")
+    with pytest.raises(storage_lib.exceptions.StorageError,
+                       match="storage_account"):
+        s.fetch_command("/data")
+
+
+def test_azure_upload_calls_az_cli(tmp_state_dir, tmp_path, monkeypatch):
+    """Hermetic: capture the az invocations for create + upload-batch."""
+    from skypilot_tpu import config as config_lib
+    monkeypatch.setattr(
+        config_lib, "get_nested",
+        lambda keys, default=None: "myacct"
+        if keys == ("azure", "storage_account") else default)
+    calls = []
+
+    def fake_run(cmd):
+        calls.append(cmd)
+    monkeypatch.setattr(storage_lib.AzureBlobStore, "_run",
+                        lambda self, cmd: fake_run(cmd))
+    monkeypatch.setattr(storage_lib.AzureBlobStore, "_container_exists",
+                        lambda self, account: False)
+    src = tmp_path / "data"
+    src.mkdir()
+    (src / "f.txt").write_text("x")
+    sto = storage_lib.Storage(name="ctr", source=str(src), store="azure")
+    sto.store.upload()
+    assert calls[0][:4] == ["az", "storage", "container", "create"]
+    assert any("upload-batch" in " ".join(c) for c in calls)
