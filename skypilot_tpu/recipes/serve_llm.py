@@ -65,12 +65,14 @@ def _prefill(cfg: llama.LlamaConfig, params, buf: jax.Array,
     return _pick(logits[:, 0], temperature, key), cache
 
 
-@functools.partial(jax.jit, static_argnums=(0, 5))
+@functools.partial(jax.jit, static_argnums=(0, 5), donate_argnums=(3,))
 def _gen_step(cfg: llama.LlamaConfig, params, tok: jax.Array, cache,
               pos: jax.Array, temperature: float, key: jax.Array):
     """Streaming path, step 2..N: one O(max_seq) cached decode step —
     called per token so the handler can flush each token to the client
-    as it exists (SSE), instead of waiting for the whole scan."""
+    as it exists (SSE), instead of waiting for the whole scan. The KV
+    cache is DONATED: XLA aliases it in place instead of copying the
+    whole O(layers * max_seq) buffer every token."""
     logits, cache = llama.forward_with_cache(
         cfg, params, tok[:, None], cache, pos)
     return _pick(logits[:, -1], temperature, key), cache
@@ -144,10 +146,25 @@ class _Handler(BaseHTTPRequestHandler):
             mt_pad = _ceil_to(mt, GEN_BUCKET)
             buf = jnp.zeros((s_pad,), jnp.int32).at[:s].set(
                 jnp.asarray(prompt, dtype=jnp.int32))
-            if req.get("stream"):
+            stream = bool(req.get("stream"))
+        except (KeyError, ValueError, TypeError) as e:
+            self._json(400, {"error": str(e)})
+            return
+        if stream:
+            started = []
+            try:
                 self._stream_generate(ctx, buf, s, s_pad, mt, mt_pad,
-                                      temperature, seed)
-                return
+                                      temperature, seed, started)
+            except Exception as e:  # noqa: BLE001
+                if started:
+                    # Headers/chunks already out — a JSON error response
+                    # would corrupt the stream. Drop the connection; the
+                    # truncated stream is the signal.
+                    self.close_connection = True
+                else:
+                    self._json(400, {"error": str(e)})
+            return
+        try:
             with ctx["lock"]:
                 toks = _decode(ctx["cfg"], ctx["params"], buf,
                                jnp.int32(s), mt_pad, temperature,
@@ -157,12 +174,26 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(400, {"error": str(e)})
 
     def _stream_generate(self, ctx, buf, s, s_pad, mt, mt_pad,
-                         temperature, seed) -> None:
+                         temperature, seed, started) -> None:
         """SSE token stream: one `data: {"token": N}` event per decoded
         token, flushed as produced (chunked transfer), then
         `data: [DONE]` — the OpenAI-style contract LLM clients expect."""
         from skypilot_tpu.serve.load_balancer import (end_chunks,
                                                       write_chunk)
+        cfg, params = ctx["cfg"], ctx["params"]
+        key = jax.random.key(seed)
+        # Prefill BEFORE the headers go out: a trace/compile error on a
+        # fresh bucket must still be reportable as a clean error, not a
+        # corrupted half-stream. The model lock is held ONLY around
+        # compute, never across socket writes — a stalled client (TCP
+        # backpressure on emit) must not block other requests.
+        key, k = jax.random.split(key)
+        with ctx["lock"]:
+            tok, cache = _prefill(cfg, params, buf, s_pad + mt_pad,
+                                  jnp.int32(s), temperature, k)
+            tok.block_until_ready()
+
+        started.append(True)
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
@@ -172,16 +203,6 @@ class _Handler(BaseHTTPRequestHandler):
         def emit(payload: str) -> None:
             write_chunk(self.wfile, f"data: {payload}\n\n".encode())
 
-        cfg, params = ctx["cfg"], ctx["params"]
-        key = jax.random.key(seed)
-        # The model lock is held ONLY around each compute step, never
-        # across the socket write: a stalled client (TCP backpressure on
-        # emit) must not block other requests' inference.
-        key, k = jax.random.split(key)
-        with ctx["lock"]:
-            tok, cache = _prefill(cfg, params, buf, s_pad + mt_pad,
-                                  jnp.int32(s), temperature, k)
-            tok.block_until_ready()
         emit(json.dumps({"token": int(tok[0])}))
         for i in range(mt - 1):
             key, k = jax.random.split(key)

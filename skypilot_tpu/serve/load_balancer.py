@@ -87,9 +87,10 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                    if k.lower() not in _HOP_HEADERS}
         req = urllib.request.Request(url, data=body, headers=headers,
                                      method=method)
+        started: List[bool] = []
         try:
             with urllib.request.urlopen(req, timeout=120) as resp:
-                self._stream_response(resp)
+                self._stream_response(resp, started)
         except urllib.error.HTTPError as e:
             payload = e.read()
             self.send_response(e.code)
@@ -98,15 +99,25 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
             self.wfile.write(payload)
         except (urllib.error.URLError, ConnectionError, OSError,
                 TimeoutError):
+            if started:
+                # The response line/body already went out: a second
+                # response here would corrupt the byte stream. Drop the
+                # connection — the client sees a truncated body, the
+                # one honest signal left.
+                self.close_connection = True
+                return
             self.send_response(502)
             payload = b"Replica unreachable.\n"
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)
 
-    def _stream_response(self, resp) -> None:
+    def _stream_response(self, resp, started: List[bool]) -> None:
         """Forward the replica's response as chunks ARRIVE (read1 =
-        whatever bytes are available), never whole-response buffered."""
+        whatever bytes are available), never whole-response buffered.
+        Appends to ``started`` before the first write so the caller can
+        tell a clean failure from a mid-stream one."""
+        started.append(True)
         self.send_response(resp.status)
         clen = resp.getheader("Content-Length")
         for k, v in resp.getheaders():
