@@ -108,7 +108,12 @@ class Daemon:
             job_lib.last_activity_time(home=str(self.home)),
             float(cfg.get("set_at", self.started_at)))
         idle_for = time.time() - baseline
-        if idle_for < idle_minutes * 60:
+        # Even at -i 0, give an in-flight submission a moment: the
+        # client sets autostop at PRE_EXEC and then ships the job spec
+        # to this head — terminating inside that window would kill the
+        # cluster between rsync and submit.
+        grace = float(os.environ.get("STPU_AUTOSTOP_GRACE_SECONDS", 10))
+        if idle_for < max(idle_minutes * 60, grace):
             return False
         down = bool(cfg.get("down"))
         self.log(f"idle {idle_for:.0f}s >= {idle_minutes}m threshold; "

@@ -15,7 +15,6 @@ from typing import Optional
 from skypilot_tpu.serve import autoscalers
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.load_balancer import RequestRecorder
-from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
 from skypilot_tpu.serve.replica_managers import SkyPilotReplicaManager
 from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
 
@@ -26,21 +25,24 @@ def _tick_seconds() -> float:
 
 class SkyServeController:
     def __init__(self, service_name: str, spec, task,
-                 policy: LoadBalancingPolicy,
-                 recorder: RequestRecorder):
+                 recorder: Optional[RequestRecorder] = None):
         self.service_name = service_name
         self.spec = spec
         self.replica_manager = SkyPilotReplicaManager(service_name, spec,
                                                       task)
         self.autoscaler = autoscalers.Autoscaler.from_spec(spec)
-        self.policy = policy
-        self.recorder = recorder
+        # Request timestamps arrive from the LB process via /sync; the
+        # autoscaler drains them each tick.
+        self.recorder = recorder or RequestRecorder()
         self._stop = False
         self._was_ready = False
+        self._ready_urls: list = []
         self.version = 1
         # Outdated replicas pulled from the LB last tick; terminated next
         # tick so in-flight requests drain before the server dies.
         self._draining: set = set()
+        self._draining_since = 0.0   # when _draining last gained members
+        self._last_sync_at = 0.0     # when the LB last adopted /sync
 
     def stop(self) -> None:
         self._stop = True
@@ -120,17 +122,82 @@ class SkyServeController:
                 rm.scale_down(rid)
         outdated = set(rm.outdated_alive_ids())
         if rm.ready_current_count() >= target:
-            terminated = outdated & self._draining
+            # Terminate a draining replica only once the LB has SYNCED
+            # since the pull (its rotation no longer holds the url) —
+            # one tick of wall time is not proof the LB observed it.
+            # Fallback: after 10 ticks, terminate anyway so a dead LB
+            # cannot pin outdated replicas forever.
+            lb_caught_up = (self._last_sync_at >= self._draining_since or
+                            time.time() - self._draining_since >
+                            10 * _tick_seconds())
+            terminated = ((outdated & self._draining) if lb_caught_up
+                          else set())
             for rid in terminated:
                 rm.scale_down(rid)
             # Next tick terminates only the NEWLY draining replicas —
             # the ones just terminated must not be scaled down twice.
-            self._draining = outdated - terminated
+            new_draining = outdated - terminated
+            newly_pulled = bool(new_draining - self._draining)
+            self._draining = new_draining
         else:
+            newly_pulled = False
             self._draining = set()
         ready = rm.ready_urls(exclude_ids=self._draining)
-        self.policy.set_ready_replicas(ready)
+        self._ready_urls = list(ready)  # served to the LB via /sync
+        if newly_pulled:
+            # Stamp AFTER _ready_urls excludes the pulled replicas: a
+            # sync racing this tick must not count as caught-up.
+            self._draining_since = time.time()
         self._publish_status(ready, given_up)
+
+    # ------------------------------------------------------- LB sync RPC
+    def start_sync_server(self) -> int:
+        """Loopback HTTP endpoint the LB PROCESS syncs against
+        (reference: /controller/load_balancer_sync,
+        sky/serve/controller.py:34). POST /sync with
+        {"request_timestamps": [...]} feeds the autoscaler's recorder
+        and returns {"ready_urls": [...]}. Returns the bound port."""
+        import http.server
+        import json as json_lib
+        import socketserver
+        import threading
+        controller = self
+
+        class _SyncHandler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                if self.path != "/sync":
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    payload = json_lib.loads(
+                        self.rfile.read(length) or b"{}")
+                    controller.recorder.record_many(
+                        payload.get("request_timestamps", []))
+                except (ValueError, TypeError):
+                    pass
+                controller._last_sync_at = time.time()
+                body = json_lib.dumps(
+                    {"ready_urls": controller._ready_urls}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        class _Srv(socketserver.ThreadingMixIn, http.server.HTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._sync_server = _Srv(("127.0.0.1", 0), _SyncHandler)
+        threading.Thread(target=self._sync_server.serve_forever,
+                         daemon=True).start()
+        return self._sync_server.server_address[1]
 
     def _publish_status(self, ready, given_up: bool) -> None:
         if ready:

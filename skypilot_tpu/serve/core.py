@@ -248,6 +248,9 @@ def _down_local(service_names: Optional[List[str]], all_services: bool,
                 time.sleep(0.2)
         if serve_state.get_service(name) is not None:
             _finalize_dead_service(name)
+        # The LB is its own process (it survives controller crashes by
+        # design); make sure it dies with the service.
+        _kill_pid(svc.get("lb_pid"))
         # Translated (job-scoped) buckets die with the service — for
         # EVERY revision yaml still on disk, not just the current one
         # (the pre-bump revision is deliberately kept by update for the
@@ -269,7 +272,19 @@ def _down_local(service_names: Optional[List[str]], all_services: bool,
     return done
 
 
+def _kill_pid(pid: Optional[int]) -> None:
+    if not pid:
+        return
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError, OSError):
+        pass
+
+
 def _finalize_dead_service(service_name: str) -> None:
+    svc = serve_state.get_service(service_name)
+    if svc is not None:
+        _kill_pid(svc.get("lb_pid"))
     backend = slice_backend.SliceBackend()
     for rep in serve_state.get_replicas(service_name):
         record = global_user_state.get_cluster_from_name(

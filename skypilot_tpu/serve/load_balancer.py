@@ -56,6 +56,12 @@ class RequestRecorder:
         with self._lock:
             self._timestamps.append(time.time())
 
+    def record_many(self, timestamps: List[float]) -> None:
+        """Adopt timestamps drained by a remote LB process (sync RPC) —
+        preserved, not re-stamped, so QPS windows stay accurate."""
+        with self._lock:
+            self._timestamps.extend(float(t) for t in timestamps)
+
     def drain(self) -> List[float]:
         with self._lock:
             out, self._timestamps = self._timestamps, []
@@ -177,3 +183,65 @@ def run_load_balancer(port: int, policy: LoadBalancingPolicy,
     if ready_event is not None:
         ready_event.set()
     return server
+
+
+# ---------------------------------------------------------- LB as a process
+def run_lb_process(port: int, controller_url: str,
+                   sync_interval: float) -> None:
+    """Standalone LB process (reference: run_load_balancer,
+    sky/serve/load_balancer.py:226 — a separate process from the
+    controller, syncing over HTTP).
+
+    Every ``sync_interval`` it POSTs drained request timestamps to the
+    controller's /sync endpoint and adopts the returned ready-replica
+    set. A dead/unreachable controller is NOT fatal: the LB keeps
+    serving its last-known ready set — the data plane survives a
+    control-plane crash (the blast-radius isolation the single-process
+    design lacked).
+    """
+    import json
+    import urllib.request
+
+    from skypilot_tpu.serve.load_balancing_policies import \
+        RoundRobinPolicy
+    policy = RoundRobinPolicy()
+    recorder = RequestRecorder()
+    server = _ThreadingHTTPServer(
+        ("0.0.0.0", port),
+        type("Handler", (_ProxyHandler,),
+             {"policy": policy, "recorder": recorder}))
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    while True:
+        # Sync FIRST: the ready set should arrive as soon as the
+        # controller has one, not one interval late.
+        drained = recorder.drain()
+        try:
+            req = urllib.request.Request(
+                controller_url.rstrip("/") + "/sync",
+                data=json.dumps(
+                    {"request_timestamps": drained}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                ready = json.loads(resp.read()).get("ready_urls", [])
+            policy.set_ready_replicas(ready)
+        except Exception:  # noqa: BLE001 — keep serving last-known set
+            # Re-queue the drained timestamps: a transiently unreachable
+            # controller must not erase QPS signal (the autoscaler would
+            # scale below real demand).
+            recorder.record_many(drained)
+        time.sleep(sync_interval)
+
+
+def main() -> None:
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--controller-url", required=True)
+    parser.add_argument("--sync-interval", type=float, default=2.0)
+    args = parser.parse_args()
+    run_lb_process(args.port, args.controller_url, args.sync_interval)
+
+
+if __name__ == "__main__":
+    main()

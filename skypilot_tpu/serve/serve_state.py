@@ -59,6 +59,7 @@ def _conn() -> sqlite3.Connection:
         task_yaml_path TEXT,
         lb_port INTEGER,
         controller_pid INTEGER,
+        lb_pid INTEGER,
         created_at REAL,
         version INTEGER DEFAULT 1,
         update_error TEXT)""")
@@ -88,6 +89,7 @@ def _migrate(conn: sqlite3.Connection) -> None:
     for table, col, decl in (
             ("services", "version", "INTEGER DEFAULT 1"),
             ("services", "update_error", "TEXT"),
+            ("services", "lb_pid", "INTEGER"),
             ("replicas", "version", "INTEGER DEFAULT 1")):
         cols = {r[1] for r in conn.execute(
             f"PRAGMA table_info({table})").fetchall()}
@@ -153,12 +155,22 @@ def set_service_controller_pid(service_name: str, pid: int) -> None:
             (pid, service_name))
 
 
+def set_service_lb_pid(service_name: str, pid: int) -> None:
+    """The load balancer runs as its own PROCESS (data-plane isolation:
+    a controller crash must not stop serving); teardown paths kill this
+    pid."""
+    with _conn() as conn:
+        conn.execute(
+            "UPDATE services SET lb_pid=? WHERE service_name=?",
+            (pid, service_name))
+
+
 def get_service(service_name: str) -> Optional[Dict[str, Any]]:
     with _conn() as conn:
         row = conn.execute(
             "SELECT service_name, status, spec_json, task_yaml_path, "
-            "lb_port, controller_pid, created_at, version, update_error "
-            "FROM services WHERE service_name=?",
+            "lb_port, controller_pid, lb_pid, created_at, version, "
+            "update_error FROM services WHERE service_name=?",
             (service_name,)).fetchone()
     if row is None:
         return None
@@ -169,8 +181,8 @@ def get_services() -> List[Dict[str, Any]]:
     with _conn() as conn:
         rows = conn.execute(
             "SELECT service_name, status, spec_json, task_yaml_path, "
-            "lb_port, controller_pid, created_at, version, update_error "
-            "FROM services").fetchall()
+            "lb_port, controller_pid, lb_pid, created_at, version, "
+            "update_error FROM services").fetchall()
     return [_service_row(r) for r in rows]
 
 
@@ -183,13 +195,14 @@ def remove_service(service_name: str) -> None:
 
 
 def _service_row(row) -> Dict[str, Any]:
-    (name, status, spec_json, task_yaml_path, lb_port, pid,
+    (name, status, spec_json, task_yaml_path, lb_port, pid, lb_pid,
      created_at, version, update_error) = row
     return {
         "service_name": name, "status": ServiceStatus(status),
         "spec": json.loads(spec_json) if spec_json else {},
         "task_yaml_path": task_yaml_path, "lb_port": lb_port,
-        "controller_pid": pid, "created_at": created_at,
+        "controller_pid": pid, "lb_pid": lb_pid,
+        "created_at": created_at,
         "version": version, "update_error": update_error,
     }
 

@@ -1,43 +1,129 @@
-"""Serve service entrypoint: controller + load balancer in one process.
+"""Serve service entrypoint: controller process + load-balancer PROCESS.
 
-Reference analog: sky/serve/service.py:131 (_start forks the controller and
-the load balancer as separate processes on the controller VM). Here both
-run in one process — LB on a daemon thread, controller on the main thread —
-started detached by `serve.core.up`:
+Reference analog: sky/serve/service.py:131 (_start forks the controller
+and the load balancer as separate processes on the controller VM). Same
+split here: the LB is its own process syncing ready replicas + request
+timestamps over the controller's loopback /sync endpoint, so a
+controller crash leaves the data plane serving its last-known replica
+set. Started detached by `serve.core.up`:
 
     python -m skypilot_tpu.serve.service --service-name NAME \
         --task-yaml path.yaml --lb-port 8000
+
+Lifecycle: the LB is SUPERVISED — if it exits (bind conflict, crash)
+it is respawned with backoff and its pid re-recorded; its output goes
+to the service log, never /dev/null. A CLEAN stop (`serve down` →
+SIGTERM) tears the LB down with the controller; a controller CRASH
+leaves the LB running (that is the point) — teardown paths kill the
+recorded lb_pid.
 """
 from __future__ import annotations
 
 import argparse
+import os
 import signal
+import subprocess
+import sys
+import threading
+import time
 
+from skypilot_tpu.serve import controller as controller_lib
 from skypilot_tpu.serve import load_balancer
-from skypilot_tpu.serve import load_balancing_policies
+from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.controller import SkyServeController
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
 from skypilot_tpu.task import Task
+from skypilot_tpu.utils import paths
+
+
+def _lb_sync_seconds() -> float:
+    """LB↔controller sync period. Clamped to half the controller tick:
+    the drain-before-terminate rollover gives the LB exactly one tick
+    to stop routing to a draining replica, so the sync MUST fit inside
+    a tick or rolling updates would 502."""
+    configured = float(os.environ.get("STPU_LB_SYNC_SECONDS", "2"))
+    return min(configured, controller_lib._tick_seconds() / 2)
+
+
+class _LbSupervisor:
+    """Spawn + babysit the LB process; respawn with backoff on exit."""
+
+    def __init__(self, service_name: str, lb_port: int, sync_port: int,
+                 log_f):
+        self.service_name = service_name
+        self.argv = [
+            sys.executable, "-m", "skypilot_tpu.serve.load_balancer",
+            "--port", str(lb_port),
+            "--controller-url", f"http://127.0.0.1:{sync_port}",
+            "--sync-interval", str(_lb_sync_seconds())]
+        self.log_f = log_f
+        self.proc: subprocess.Popen = None
+        self._stop = False
+
+    def spawn(self) -> None:
+        self.proc = subprocess.Popen(
+            self.argv, stdout=self.log_f, stderr=subprocess.STDOUT,
+            start_new_session=True, env=dict(os.environ))
+        serve_state.set_service_lb_pid(self.service_name, self.proc.pid)
+
+    def watch(self) -> None:
+        """Respawn on unexpected exit (e.g. a transient bind conflict);
+        backoff so a hard-broken LB doesn't spin."""
+        backoff = 1.0
+        while not self._stop:
+            rc = self.proc.poll()
+            if rc is not None and not self._stop:
+                print(f"serve[{self.service_name}]: LB exited rc={rc}; "
+                      f"respawning in {backoff:.0f}s", flush=True)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+                if self._stop:
+                    return
+                self.spawn()
+            else:
+                backoff = 1.0
+            time.sleep(0.5)
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self.proc.terminate()
+        except OSError:
+            pass
 
 
 def run_service(service_name: str, task_yaml: str, lb_port: int) -> None:
     task = Task.from_yaml(task_yaml)
     spec = task.service or SkyServiceSpec()
-    policy = load_balancing_policies.RoundRobinPolicy()
-    recorder = load_balancer.RequestRecorder()
-    controller = SkyServeController(service_name, spec, task, policy,
-                                    recorder)
-    server = load_balancer.run_load_balancer(lb_port, policy, recorder)
+    controller = SkyServeController(service_name, spec, task)
+    sync_port = controller.start_sync_server()
 
+    # Signal handlers BEFORE the LB spawns: a `serve down` landing in
+    # the spawn window must still run the clean-exit path that kills
+    # the just-spawned (pid-recorded) LB instead of orphaning it.
     def handle_term(signum, frame):
         del signum, frame
         controller.stop()
     signal.signal(signal.SIGTERM, handle_term)
     signal.signal(signal.SIGINT, handle_term)
+
+    log_dir = paths.logs_dir() / "serve"
+    log_dir.mkdir(parents=True, exist_ok=True)
+    log_f = open(log_dir / f"{service_name}-lb.log", "ab")
+    supervisor = _LbSupervisor(service_name, lb_port, sync_port, log_f)
+    supervisor.spawn()
+    threading.Thread(target=supervisor.watch, daemon=True).start()
+
+    clean_exit = False
     try:
         controller.run()
+        clean_exit = True
     finally:
-        server.shutdown()
+        if clean_exit:
+            # Service is going away on purpose: stop the data plane too.
+            supervisor.stop()
+        # On a controller CRASH the LB is deliberately left serving;
+        # serve down / _finalize_dead_service kill the recorded lb_pid.
 
 
 def main() -> None:

@@ -55,7 +55,8 @@ _STORAGE_SCHEMA = {
         "source": {"anyOf": [{"type": "string"},
                              {"type": "array",
                               "items": {"type": "string"}}]},
-        "store": {"type": "string", "enum": ["gcs", "s3", "local"]},
+        "store": {"type": "string",
+                  "enum": ["gcs", "s3", "azure", "local"]},
         "persistent": {"type": "boolean"},
         "mode": {"type": "string", "enum": ["MOUNT", "COPY"]},
     },

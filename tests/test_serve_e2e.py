@@ -22,6 +22,7 @@ from skypilot_tpu.resources import Resources
 @pytest.fixture(autouse=True)
 def fast_tick(monkeypatch):
     monkeypatch.setenv("STPU_SERVE_TICK_SECONDS", "0.3")
+    monkeypatch.setenv("STPU_LB_SYNC_SECONDS", "0.2")
 
 
 def _server_task(replicas=2):
@@ -191,3 +192,59 @@ def test_serve_rolling_update():
         assert bodies == {"body-v2"}, bodies
     finally:
         serve_core.down([name], timeout=90)
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_lb_survives_controller_crash():
+    """Data-plane isolation: SIGKILL the controller process; the LB (its
+    own process) keeps proxying the last-known replica set. serve down
+    then cleans both up."""
+    import os
+    import signal as signal_lib
+
+    task = _server_task(replicas=1)
+    name, endpoint = serve_core.up(task, "crash-svc", controller="local")
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        svcs = serve_core.status(["crash-svc"])
+        if svcs and any(r["status"] == "READY"
+                        for r in svcs[0]["replicas"]):
+            break
+        time.sleep(0.3)
+    else:
+        pytest.fail(f"never READY: {svcs}")
+    # Give the LB one sync so it holds the ready set.
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            status, _ = _get(endpoint, timeout=3)
+            if status == 200:
+                break
+        except Exception:
+            pass
+        time.sleep(0.2)
+
+    svc = serve_state.get_service("crash-svc")
+    controller_pid, lb_pid = svc["controller_pid"], svc["lb_pid"]
+    assert controller_pid and lb_pid and controller_pid != lb_pid
+    os.kill(controller_pid, signal_lib.SIGKILL)  # crash, not clean stop
+    time.sleep(1.0)
+
+    # Control plane is dead; the data plane still serves.
+    status, body = _get(endpoint, timeout=5)
+    assert status == 200 and "port-" in body
+
+    # Teardown finalizes the dead controller AND kills the LB process.
+    serve_core.down(["crash-svc"], timeout=10)
+    deadline = time.time() + 10
+    lb_dead = False
+    while time.time() < deadline:
+        try:
+            os.kill(lb_pid, 0)
+            time.sleep(0.2)
+        except ProcessLookupError:
+            lb_dead = True
+            break
+    assert lb_dead, "LB process survived serve down"
+    assert serve_state.get_service("crash-svc") is None
