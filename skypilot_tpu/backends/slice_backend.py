@@ -78,29 +78,19 @@ class SliceHandle(backend_lib.ResourceHandle):
         return None
 
     def get_command_runners(self) -> List[runner_lib.CommandRunner]:
+        """One runner per host, rank order. The provider→transport
+        mapping is shared with the provisioner's bring-up
+        (provision.provisioner._ssh_runner) so the two can't diverge;
+        only the local provider's directory-hosts are handled here."""
+        from skypilot_tpu.provision import provisioner as provisioner_lib
         runners: List[runner_lib.CommandRunner] = []
         info = self.cluster_info
         for inst in info.ordered_instances():
             if info.provider_name == "local":
                 runners.append(runner_lib.LocalCommandRunner(
                     inst.instance_id, inst.tags["host_dir"]))
-            elif info.provider_name == "kubernetes":
-                # SSH-free: commands reach pods via kubectl exec
-                # (reference: KubernetesCommandRunner,
-                # sky/utils/command_runner.py:647).
-                runners.append(runner_lib.KubernetesCommandRunner(
-                    inst.instance_id, pod_name=inst.instance_id,
-                    namespace=inst.tags.get("namespace", "default"),
-                    internal_ip=inst.internal_ip))
             else:
-                runners.append(runner_lib.SSHCommandRunner(
-                    inst.instance_id,
-                    inst.external_ip or inst.internal_ip,
-                    ssh_user=info.ssh_user,
-                    ssh_key_path=info.ssh_key_path or "~/.ssh/id_rsa",
-                    port=inst.ssh_port,
-                    proxy_command=info.provider_config.get(
-                        "ssh_proxy_command")))
+                runners.append(provisioner_lib._ssh_runner(info, inst))
         return runners
 
     def __repr__(self) -> str:
@@ -242,6 +232,8 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
             "chips_per_host": info.chips_per_host if info else 0,
             "labels": res.labels or {},
         }
+        if res.provider_name == "docker":
+            provider_config["image"] = res.image_id
         if res.provider_name == "kubernetes":
             from skypilot_tpu import config as config_lib
             provider_config["image"] = res.image_id

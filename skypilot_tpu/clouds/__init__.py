@@ -11,11 +11,13 @@ from typing import Dict, List
 from skypilot_tpu import exceptions
 from skypilot_tpu.clouds.cloud import (  # noqa: F401 — public API
     Cloud, CloudImplementationFeatures)
+from skypilot_tpu.clouds.docker import Docker
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
 
 CLOUD_REGISTRY: Dict[str, Cloud] = {
+    Docker.NAME: Docker(),
     GCP.NAME: GCP(),
     Kubernetes.NAME: Kubernetes(),
     Local.NAME: Local(),

@@ -1,0 +1,51 @@
+"""Docker capability object (dev/debug provider).
+
+Reference analog: the LocalDockerBackend path
+(sky/backends/local_docker_backend.py). Containers CAN stop (disk
+survives `docker stop`), there is no spot market, and accelerators are
+not passed through — this provider exists for orchestration development
+and containerized CPU tasks.
+"""
+from __future__ import annotations
+
+import shutil
+import subprocess
+from typing import Dict, Tuple
+
+from skypilot_tpu.clouds.cloud import Cloud, CloudImplementationFeatures
+
+
+class Docker(Cloud):
+    NAME = "docker"
+
+    _UNSUPPORTED = {
+        CloudImplementationFeatures.SPOT_INSTANCE:
+            "no spot market on a local docker daemon",
+        CloudImplementationFeatures.OPEN_PORTS:
+            "publish ports via docker run -p out of band (not "
+            "implemented yet)",
+        CloudImplementationFeatures.MULTI_NODE:
+            "docker is the single-container dev path (reference "
+            "LocalDockerBackend semantics); use local/kubernetes/gcp "
+            "for multi-host gangs",
+    }
+
+    def unsupported_features_for_resources(
+            self, resources) -> Dict[CloudImplementationFeatures, str]:
+        del resources
+        return dict(self._UNSUPPORTED)
+
+    def check_credentials(self) -> Tuple[bool, str]:
+        if shutil.which("docker") is None:
+            return False, "docker CLI not installed"
+        try:
+            proc = subprocess.run(["docker", "info", "--format",
+                                   "{{.ServerVersion}}"],
+                                  capture_output=True, text=True,
+                                  timeout=20)
+            if proc.returncode != 0:
+                return False, ("docker daemon unreachable: "
+                               f"{proc.stderr.strip()[:120]}")
+            return True, f"daemon {proc.stdout.strip()}"
+        except (subprocess.SubprocessError, OSError) as e:
+            return False, f"docker probe failed: {e}"

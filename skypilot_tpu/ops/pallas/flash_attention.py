@@ -121,16 +121,27 @@ def _flash_fwd_streamed(q: jax.Array, k: jax.Array, v: jax.Array, *,
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
 
+    # Causal DMA elision: a KV block fully right of the Q block is
+    # skipped by the kernel's pl.when — clamping its index to the causal
+    # bound makes the "fetch" re-reference the previous block, which
+    # Pallas elides (same index => no copy), so masked grid steps cost
+    # neither compute nor HBM traffic.
+    if causal:
+        def _kv_idx(bi, hi, qi, ki):
+            bound = (qi * block_q + block_q - 1) // block_k
+            return (bi, hi // groups, jnp.minimum(ki, bound), 0)
+    else:
+        def _kv_idx(bi, hi, qi, ki):
+            return (bi, hi // groups, ki, 0)
+
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal),
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, None, block_q, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi // groups, ki, 0)),
-            pl.BlockSpec((None, None, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi // groups, ki, 0)),
+            pl.BlockSpec((None, None, block_k, d), _kv_idx),
+            pl.BlockSpec((None, None, block_k, d), _kv_idx),
         ],
         out_specs=[
             pl.BlockSpec((None, None, block_q, d),
@@ -285,8 +296,17 @@ def _flash_bwd_streamed(res, do, *, causal: bool, scale: float,
 
     qspec = pl.BlockSpec((None, None, block_q, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0))
-    kvspec = pl.BlockSpec((None, None, block_k, d),
-                          lambda bi, hi, qi, ki: (bi, hi // groups, ki, 0))
+    if causal:
+        # Same DMA elision as the forward: skipped KV blocks re-fetch
+        # the previous index (no copy) instead of staging dead data.
+        def _kv_idx(bi, hi, qi, ki):
+            bound = (qi * block_q + block_q - 1) // block_k
+            return (bi, hi // groups, jnp.minimum(ki, bound), 0)
+        kvspec = pl.BlockSpec((None, None, block_k, d), _kv_idx)
+    else:
+        kvspec = pl.BlockSpec(
+            (None, None, block_k, d),
+            lambda bi, hi, qi, ki: (bi, hi // groups, ki, 0))
     lse_q = pl.BlockSpec((None, None, block_q, LSE_PAD),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0))
 
@@ -306,12 +326,21 @@ def _flash_bwd_streamed(res, do, *, causal: bool, scale: float,
 
     # Grid (batch, kv_block, head, q_block): head × q_block innermost so
     # one KV head's whole group accumulates into the resident output.
-    q_h = pl.BlockSpec((None, None, block_q, d),
-                       lambda bi, ki, hi, qi: (bi, hi, qi, 0))
+    if causal:
+        # Mirror-image elision: Q blocks BEFORE the KV block are masked;
+        # clamp from below so they re-fetch instead of staging dead
+        # data. One index fn serves q/o/do AND lse so their blocks can
+        # never desynchronize.
+        def _q_idx(bi, ki, hi, qi):
+            lo = (ki * block_k) // block_q
+            return (bi, hi, jnp.maximum(qi, lo), 0)
+    else:
+        def _q_idx(bi, ki, hi, qi):
+            return (bi, hi, qi, 0)
+    q_h = pl.BlockSpec((None, None, block_q, d), _q_idx)
     kv_h = pl.BlockSpec((None, None, block_k, d),
                         lambda bi, ki, hi, qi: (bi, hi // groups, ki, 0))
-    lse_h = pl.BlockSpec((None, None, block_q, LSE_PAD),
-                         lambda bi, ki, hi, qi: (bi, hi, qi, 0))
+    lse_h = pl.BlockSpec((None, None, block_q, LSE_PAD), _q_idx)
     dkt, dvt = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           groups=groups),

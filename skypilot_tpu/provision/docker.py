@@ -1,0 +1,182 @@
+"""Docker provisioner: containers as cluster hosts (dev/debug path).
+
+Reference analog: sky/backends/local_docker_backend.py + docker_utils —
+the reference's "run this task in a local container" development path.
+Here it is a provider behind the same provision SPI instead of a
+separate backend: a cluster of N hosts is N long-running containers on
+the local docker daemon, labeled like the kubernetes provider's pods,
+exec'd via ``docker exec``. No TPU passthrough — this is the path for
+orchestration development and CPU tasks with containerized deps; real
+accelerator work goes to gcp/kubernetes.
+
+All docker traffic goes through one :func:`docker` seam so hermetic
+tests can fake the daemon (the provision/gcp.py `rest` discipline).
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
+                                           ProvisionRecord)
+
+PROVIDER_NAME = "docker"
+
+_CLUSTER_LABEL = "stpu-cluster"
+_SLICE_LABEL = "stpu-slice"
+_HOST_INDEX_LABEL = "stpu-host-index"
+
+_DEFAULT_IMAGE = "python:3.11-slim"
+
+# docker container states -> SPI status strings.
+_STATE_MAP = {
+    "running": "running",
+    "created": "pending",
+    "restarting": "pending",
+    "paused": "stopped",
+    "exited": "stopped",
+    "dead": "terminated",
+    "removing": "terminated",
+}
+
+
+def docker(args: List[str]) -> Any:
+    """One docker-CLI invocation returning parsed JSON when the command
+    produces it (``--format {{json .}}`` lines become a list). Tests
+    monkeypatch this symbol with a fake daemon."""
+    proc = subprocess.run(["docker"] + args, capture_output=True,
+                          text=True, timeout=120)
+    if proc.returncode != 0:
+        raise exceptions.ProvisionError(
+            f"docker {' '.join(args[:3])}... failed: "
+            f"{proc.stderr.strip()[:500]}")
+    out = proc.stdout.strip()
+    if not out:
+        return []
+    try:
+        return [json.loads(line) for line in out.splitlines()]
+    except ValueError:
+        return out
+
+
+def _container_name(cluster_name: str, slice_i: int, host_i: int) -> str:
+    return f"stpu-{cluster_name}-s{slice_i}-h{host_i}"
+
+
+def _list_containers(cluster_name: str) -> List[dict]:
+    return docker(["ps", "-a", "--filter",
+                   f"label={_CLUSTER_LABEL}={cluster_name}",
+                   "--format", "{{json .}}"])
+
+
+# ------------------------------------------------------------------- SPI
+def run_instances(region, zone, cluster_name: str,
+                  config: dict) -> ProvisionRecord:
+    del region, zone  # the docker daemon is its own placement
+    num_slices = int(config.get("num_slices") or 1)
+    hosts = int(config.get("hosts_per_slice") or 1)
+    if num_slices * hosts > 1:
+        # Single-container dev path (reference LocalDockerBackend
+        # semantics): containers report loopback IPs, so a rank>0 host
+        # would be unreachable by the gang driver's SSH transport.
+        raise exceptions.ProvisionError(
+            f"docker provider runs ONE container per cluster; "
+            f"{cluster_name} asked for {num_slices * hosts} hosts. Use "
+            "local/kubernetes/gcp for multi-host gangs.")
+    image = config.get("image") or _DEFAULT_IMAGE
+
+    existing = {c["Names"] for c in _list_containers(cluster_name)}
+    created: List[str] = []
+    try:
+        for s in range(num_slices):
+            for h in range(hosts):
+                name = _container_name(cluster_name, s, h)
+                if name in existing:
+                    # Stopped containers restart in place (the provider's
+                    # `start` semantics).
+                    docker(["start", name])
+                    continue
+                docker(["run", "-d", "--name", name,
+                        "--label", f"{_CLUSTER_LABEL}={cluster_name}",
+                        "--label", f"{_SLICE_LABEL}=slice-{s}",
+                        "--label", f"{_HOST_INDEX_LABEL}={h}",
+                        image, "sleep", "infinity"])
+                created.append(name)
+    except exceptions.ProvisionError:
+        for name in created:
+            try:
+                docker(["rm", "-f", name])
+            except exceptions.ProvisionError:
+                pass
+        raise
+    return ProvisionRecord(
+        provider_name=PROVIDER_NAME, region=None, zone=None,
+        cluster_name=cluster_name,
+        head_instance_id=_container_name(cluster_name, 0, 0),
+        created_instance_ids=created,
+        resumed_instance_ids=sorted(existing))
+
+
+def wait_instances(region, cluster_name: str, state: str,
+                   provider_config: dict) -> None:
+    del region, provider_config
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        containers = _list_containers(cluster_name)
+        if containers and all(
+                _STATE_MAP.get(c.get("State", ""), "pending") == state
+                for c in containers):
+            return
+        time.sleep(1)
+    raise exceptions.ProvisionError(
+        f"containers of {cluster_name} not {state} after 120s")
+
+
+def query_instances(cluster_name: str,
+                    provider_config: dict) -> Dict[str, str]:
+    del provider_config
+    return {
+        c["Names"]: _STATE_MAP.get(c.get("State", ""), "pending")
+        for c in _list_containers(cluster_name)
+    }
+
+
+def get_cluster_info(region, cluster_name: str,
+                     provider_config: dict) -> ClusterInfo:
+    del region
+    instances: Dict[str, InstanceInfo] = {}
+    for c in _list_containers(cluster_name):
+        name = c["Names"]
+        labels = dict(
+            part.split("=", 1)
+            for part in (c.get("Labels") or "").split(",") if "=" in part)
+        instances[name] = InstanceInfo(
+            instance_id=name,
+            internal_ip="127.0.0.1",
+            external_ip=None,
+            slice_id=labels.get(_SLICE_LABEL, "slice-0"),
+            host_index=int(labels.get(_HOST_INDEX_LABEL, 0)),
+            tags={"container": name},
+        )
+    head = _container_name(cluster_name, 0, 0)
+    return ClusterInfo(
+        cluster_name=cluster_name, provider_name=PROVIDER_NAME,
+        region=None, zone=None, instances=instances,
+        head_instance_id=head if head in instances else None,
+        ssh_user="root", ssh_key_path=None,
+        provider_config=dict(provider_config))
+
+
+def stop_instances(cluster_name: str, provider_config: dict) -> None:
+    del provider_config
+    for c in _list_containers(cluster_name):
+        docker(["stop", c["Names"]])
+
+
+def terminate_instances(cluster_name: str, provider_config: dict) -> None:
+    del provider_config
+    for c in _list_containers(cluster_name):
+        docker(["rm", "-f", c["Names"]])

@@ -44,6 +44,10 @@ def _ssh_runner(info: ClusterInfo, inst) -> runner_lib.CommandRunner:
             inst.instance_id, pod_name=inst.instance_id,
             namespace=inst.tags.get("namespace", "default"),
             internal_ip=inst.internal_ip)
+    if info.provider_name == "docker":
+        return runner_lib.DockerCommandRunner(
+            inst.instance_id,
+            container=inst.tags.get("container", inst.instance_id))
     return runner_lib.SSHCommandRunner(
         inst.instance_id, inst.external_ip or inst.internal_ip,
         ssh_user=info.ssh_user,

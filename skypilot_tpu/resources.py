@@ -69,8 +69,8 @@ class Resources:
                 raise exceptions.InvalidTaskError(
                     f"Unknown cloud {self.cloud!r}; supported: "
                     f"{', '.join(clouds_lib.registered_names())}")
-        if self.cloud == "local":
-            return  # no catalog validation for the hermetic provider
+        if self.cloud in ("local", "docker"):
+            return  # no catalog validation for these providers
         if self.cloud == "kubernetes":
             # Placement is the cluster itself: no zones to validate.
             # Accelerator names still canonicalize so slice_info()
@@ -155,7 +155,7 @@ class Resources:
     def is_launchable(self) -> bool:
         """Concrete enough to hand to the provisioner: needs a zone and a
         concrete device/VM (local provider needs neither)."""
-        if self.cloud in ("local", "kubernetes"):
+        if self.cloud in ("local", "kubernetes", "docker"):
             return True
         return (self.zone is not None and
                 (self.accelerator is not None or
@@ -170,7 +170,7 @@ class Resources:
     # ------------------------------------------------------------------
     def hourly_price(self) -> float:
         """Price of this (concrete) resource per hour."""
-        if self.cloud in ("local", "kubernetes"):
+        if self.cloud in ("local", "kubernetes", "docker"):
             # On-prem / pre-paid hardware: $0 marginal cost (reference
             # prices kubernetes the same way), so the optimizer prefers
             # an enabled kubernetes cluster over metered cloud TPUs.

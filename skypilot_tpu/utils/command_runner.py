@@ -162,8 +162,14 @@ class KubernetesCommandRunner(CommandRunner):
         self.namespace = namespace
         self.container = container
 
-    def _base(self) -> List[str]:
-        return ["kubectl", "-n", self.namespace]
+    def _exec_argv(self, interactive: bool = False) -> List[str]:
+        """argv prefix that runs `bash -c <script>` inside the host;
+        the one transport-specific piece (overridden by docker)."""
+        argv = ["kubectl", "-n", self.namespace, "exec"]
+        if interactive:
+            argv.append("-i")
+        return argv + [self.pod_name, "-c", self.container, "--",
+                       "bash", "-c"]
 
     def run(self, cmd, *, env=None, log_path=None, stream_logs=False,
             require_outputs=False):
@@ -175,9 +181,7 @@ class KubernetesCommandRunner(CommandRunner):
                 f"export {k}={shlex.quote(str(v))};" for k, v in
                 env.items()) + " "
         remote = f"bash --login -c {shlex.quote(env_prefix + cmd)}"
-        full = self._base() + ["exec", self.pod_name, "-c",
-                               self.container, "--", "bash", "-c",
-                               remote]
+        full = self._exec_argv() + [remote]
         if require_outputs:
             proc = subprocess.run(full, capture_output=True, text=True)
             return proc.returncode, proc.stdout, proc.stderr
@@ -197,9 +201,7 @@ class KubernetesCommandRunner(CommandRunner):
 
     def _exec_stdin(self, remote_sh: str, stdin_cmd: Optional[List[str]],
                     stdin_file: Optional[str]) -> int:
-        full = self._base() + ["exec", "-i", self.pod_name, "-c",
-                               self.container, "--", "bash", "-c",
-                               remote_sh]
+        full = self._exec_argv(interactive=True) + [remote_sh]
         if stdin_cmd is not None:
             feeder = subprocess.Popen(stdin_cmd, stdout=subprocess.PIPE)
             proc = subprocess.run(full, stdin=feeder.stdout,
@@ -215,12 +217,10 @@ class KubernetesCommandRunner(CommandRunner):
         del log_path
         if not up:
             # Down: single file via cat (logs/artifacts).
-            full = self._base() + ["exec", self.pod_name, "-c",
-                                   self.container, "--", "bash", "-c",
-                                   f"cat {self._sh(source)}"]
+            full = self._exec_argv() + [f"cat {self._sh(source)}"]
             with open(target, "wb") as out:
                 rc = subprocess.run(full, stdout=out).returncode
-            self.check_returncode(rc, "kubectl exec cat", source)
+            self.check_returncode(rc, "exec cat", source)
             return
         t = self._sh(target)
         if os.path.isdir(source):
@@ -240,6 +240,23 @@ class KubernetesCommandRunner(CommandRunner):
                 f"mkdir -p $(dirname {t}) && cat > {t}", None, source)
         self.check_returncode(rc, f"pod transfer {source} -> {target}",
                               "kubectl exec stream failed")
+
+
+class DockerCommandRunner(KubernetesCommandRunner):
+    """Exec into a local container via ``docker exec`` — identical
+    transport shape to pods (stdin-streamed transfers, shell-expanded
+    paths), different argv prefix (reference: docker_utils +
+    LocalDockerBackend)."""
+
+    def __init__(self, node_id: str, container: str):
+        super().__init__(node_id, pod_name=container, namespace="",
+                         internal_ip="127.0.0.1")
+
+    def _exec_argv(self, interactive: bool = False) -> List[str]:
+        argv = ["docker", "exec"]
+        if interactive:
+            argv.append("-i")
+        return argv + [self.pod_name, "bash", "-c"]
 
 
 class LocalCommandRunner(CommandRunner):

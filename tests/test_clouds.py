@@ -12,8 +12,8 @@ from skypilot_tpu.resources import Resources
 
 
 def test_registry_and_unknown_cloud():
-    assert clouds_lib.registered_names() == ["gcp", "kubernetes",
-                                             "local"]
+    assert clouds_lib.registered_names() == ["docker", "gcp",
+                                             "kubernetes", "local"]
     assert clouds_lib.get_cloud("gcp").NAME == "gcp"
     with pytest.raises(exceptions.SkyTpuError, match="Unknown cloud"):
         clouds_lib.get_cloud("aws")

@@ -1,0 +1,120 @@
+"""Docker provisioner against a hermetic fake docker CLI.
+
+Reference analog: sky/backends/local_docker_backend.py (the
+single-container dev path), tested the way test_provision_kubernetes
+tests pods: an in-memory daemon behind the provision.docker.docker()
+seam — no docker binary anywhere.
+"""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import docker as docker_provider
+
+
+class FakeDocker:
+    def __init__(self):
+        self.containers = {}   # name -> {"State", "Labels"}
+        self.calls = []
+
+    def __call__(self, args):
+        self.calls.append(tuple(args))
+        verb = args[0]
+        if verb == "run":
+            name = args[args.index("--name") + 1]
+            labels = {}
+            for i, a in enumerate(args):
+                if a == "--label":
+                    k, v = args[i + 1].split("=", 1)
+                    labels[k] = v
+            self.containers[name] = {"Names": name, "State": "running",
+                                     "Labels": ",".join(
+                                         f"{k}={v}"
+                                         for k, v in labels.items())}
+            return name
+        if verb == "ps":
+            sel = args[args.index("--filter") + 1]
+            _, kv = sel.split("=", 1)
+            key, val = kv.split("=", 1)
+            return [c for c in self.containers.values()
+                    if f"{key}={val}" in c["Labels"]]
+        if verb == "start":
+            self.containers[args[1]]["State"] = "running"
+            return []
+        if verb == "stop":
+            self.containers[args[1]]["State"] = "exited"
+            return []
+        if verb == "rm":
+            self.containers.pop(args[-1], None)
+            return []
+        raise AssertionError(f"unexpected docker verb: {args}")
+
+
+@pytest.fixture
+def fake(monkeypatch):
+    fd = FakeDocker()
+    monkeypatch.setattr(docker_provider, "docker", fd)
+    return fd
+
+
+def test_run_creates_labeled_container(fake):
+    rec = docker_provider.run_instances(
+        None, None, "c1", {"image": "my/img:1"})
+    assert rec.head_instance_id == "stpu-c1-s0-h0"
+    c = fake.containers["stpu-c1-s0-h0"]
+    assert "stpu-cluster=c1" in c["Labels"]
+    assert any("my/img:1" in " ".join(call) for call in fake.calls)
+
+
+def test_query_and_info(fake):
+    docker_provider.run_instances(None, None, "c1", {})
+    assert docker_provider.query_instances("c1", {}) == {
+        "stpu-c1-s0-h0": "running"}
+    info = docker_provider.get_cluster_info(None, "c1", {})
+    assert info.provider_name == "docker"
+    assert info.head_instance_id == "stpu-c1-s0-h0"
+    inst = info.ordered_instances()[0]
+    assert inst.tags["container"] == "stpu-c1-s0-h0"
+
+
+def test_stop_start_cycle(fake):
+    docker_provider.run_instances(None, None, "c1", {})
+    docker_provider.stop_instances("c1", {})
+    assert docker_provider.query_instances("c1", {}) == {
+        "stpu-c1-s0-h0": "stopped"}
+    rec = docker_provider.run_instances(None, None, "c1", {})
+    assert rec.created_instance_ids == []  # restarted, not recreated
+    assert docker_provider.query_instances("c1", {}) == {
+        "stpu-c1-s0-h0": "running"}
+
+
+def test_terminate_removes(fake):
+    docker_provider.run_instances(None, None, "c1", {})
+    docker_provider.run_instances(None, None, "other", {})
+    docker_provider.terminate_instances("c1", {})
+    assert set(fake.containers) == {"stpu-other-s0-h0"}
+
+
+def test_docker_capabilities_and_runner():
+    from skypilot_tpu import clouds as clouds_lib
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.utils.command_runner import DockerCommandRunner
+
+    cloud = clouds_lib.get_cloud("docker")
+    F = clouds_lib.CloudImplementationFeatures
+    res = Resources(cloud="docker")
+    unsupported = cloud.unsupported_features_for_resources(res)
+    assert F.MULTI_NODE in unsupported  # single-container dev path
+    assert F.STOP not in unsupported    # containers CAN stop
+    assert res.is_launchable and res.hourly_price() == 0.0
+
+    runner = DockerCommandRunner("n0", container="stpu-c1-s0-h0")
+    argv = runner._exec_argv(interactive=True)
+    assert argv[:3] == ["docker", "exec", "-i"]
+    assert "stpu-c1-s0-h0" in argv
+
+
+def test_multihost_docker_rejected(fake):
+    with pytest.raises(exceptions.ProvisionError, match="ONE container"):
+        docker_provider.run_instances(None, None, "c1",
+                                      {"hosts_per_slice": 2})
+    assert fake.containers == {}
