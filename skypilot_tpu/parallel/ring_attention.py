@@ -27,49 +27,76 @@ from skypilot_tpu.parallel import mesh as mesh_lib
 _NEG_INF = -1e30
 
 
-def _chunk_update(q, kc, vc, qpos, kpos, m, l, acc, *, causal, scale):
-    """One online-softmax update of local queries against one KV chunk.
+# KV sub-block width inside one ring chunk: bounds the live score
+# matrix to (B, H, Sl, _KV_BLOCK) regardless of per-shard length.
+_KV_BLOCK = 512
 
-    q: (B, Sl, H, D); kc/vc: (B, Sl, KVH, D) fp32; m/l: (B, H, Sl, 1);
-    acc: (B, H, Sl, D).
+
+def _chunk_update(q, kc, vc, qpos, kpos0, m, l, acc, *, causal, scale):
+    """One online-softmax update of local queries against one KV chunk,
+    BLOCKWISE over the chunk's KV axis.
+
+    q: (B, Sl, H, D) bf16; kc/vc: (B, Sl, KVH, D) bf16; m/l:
+    (B, H, Sl, 1) f32; acc: (B, H, Sl, D) f32. kpos0 is the chunk's
+    absolute start position (chunk positions are contiguous).
+
+    Two properties real context lengths need: matmuls take bf16 INPUTS
+    with f32 accumulation (fp32 inputs run the MXU ~4x below peak), and
+    scores exist only one (Sl x _KV_BLOCK) sub-block at a time — a full
+    (Sl x Sl) chunk score matrix is gigabytes at 8k+ per shard.
     """
     b, sl, h, d = q.shape
     kvh = kc.shape[2]
     groups = h // kvh
+    block = min(_KV_BLOCK, kc.shape[1])
+    while kc.shape[1] % block:
+        block //= 2
+    n_blocks = kc.shape[1] // block
     # Grouped-query form: keep K/V at KVH heads and fold the group axis
-    # into the einsum instead of materializing repeated K/V (which would
-    # multiply the hot loop's working set by `groups` at long context).
+    # into the einsum instead of materializing repeated K/V.
     qg = q.reshape(b, sl, kvh, groups, d)
-    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, kc) * scale
-    s = s.reshape(b, h, sl, kc.shape[1])  # head = kv_head*groups + g
-    if causal:
-        mask = qpos[:, None] >= kpos[None, :]
-        s = jnp.where(mask[None, None], s, _NEG_INF)
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-    # Guard fully-masked rows: exp(-inf - (-inf)) -> use stable max.
-    p = jnp.exp(s - m_new)
-    alpha = jnp.exp(m - m_new)
-    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    pg = p.reshape(b, kvh, groups, sl, kc.shape[1])
-    av = jnp.einsum("bkgqs,bskd->bkgqd", pg, vc).reshape(b, h, sl, d)
-    acc_new = acc * alpha + av
-    return m_new, l_new, acc_new
+
+    def body(carry, j):
+        m, l, acc = carry
+        # Slice in place: staging a blocks-leading copy of the chunk
+        # would re-write (B, Sl, KVH, D) every ring step (twice with
+        # the checkpoint recompute) — real HBM traffic at long context.
+        kcj = lax.dynamic_slice_in_dim(kc, j * block, block, axis=1)
+        vcj = lax.dynamic_slice_in_dim(vc, j * block, block, axis=1)
+        s = jnp.einsum("bqkgd,bskd->bkgqs", qg, kcj,
+                       preferred_element_type=jnp.float32) * scale
+        s = s.reshape(b, h, sl, block)
+        if causal:
+            kpos = kpos0 + j * block + jnp.arange(block)
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # Guard fully-masked rows: exp(-inf - (-inf)) -> stable max.
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pg = p.reshape(b, kvh, groups, sl, block)
+        av = jnp.einsum("bkgqs,bskd->bkgqd", pg.astype(vcj.dtype), vcj,
+                        preferred_element_type=jnp.float32)
+        acc_new = acc * alpha + av.reshape(b, h, sl, d)
+        return (m_new, l_new, acc_new), None
+
+    (m, l, acc), _ = lax.scan(body, (m, l, acc),
+                              jnp.arange(n_blocks))
+    return m, l, acc
 
 
 def _ring_local(q, k, v, *, axis_name: str, causal: bool,
                 scale: float, axis_size: int):
     idx = lax.axis_index(axis_name)
     b, sl, h, d = q.shape
-    qf = q.astype(jnp.float32)
     qpos = idx * sl + jnp.arange(sl)
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
     def body(carry, step):
         m, l, acc, kc, vc = carry
         chunk_idx = (idx - step) % axis_size
-        kpos = chunk_idx * sl + jnp.arange(sl)
-        m, l, acc = _chunk_update(qf, kc.astype(jnp.float32),
-                                  vc.astype(jnp.float32), qpos, kpos,
+        m, l, acc = _chunk_update(q, kc, vc, qpos, chunk_idx * sl,
                                   m, l, acc, causal=causal, scale=scale)
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)

@@ -80,3 +80,37 @@ def test_llama_with_ring_attention_end_to_end():
     for _ in range(5):
         state, m = step(state, {"tokens": tokens})
     assert float(m["loss"]) < float(m0["loss"])
+
+
+def test_ring_multiblock_chunk_path(monkeypatch):
+    """Force n_blocks > 1 inside each ring chunk (the long-context
+    regime: _KV_BLOCK sub-blocking + kpos offsets + divisor fallback),
+    which default test shapes never reach."""
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.ops import attention as attention_ops
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.parallel import ring_attention
+
+    monkeypatch.setattr(ring_attention, "_KV_BLOCK", 8)
+    mesh = mesh_lib.make_mesh({"sp": 4, "tp": 2})
+    b, s, h, kvh, d = 1, 128, 4, 2, 16   # per-shard 32 -> 4 sub-blocks
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (b, s, kvh, d), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (b, s, kvh, d), jnp.float32)
+    out = jax.jit(lambda q, k, v: ring_attention.ring_attention(
+        q, k, v, mesh=mesh))(q, k, v)
+    ref = attention_ops._reference_attention(q, k, v, causal=True,
+                                             scale=d ** -0.5)
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+    # Odd per-shard length exercises the block //= 2 divisor fallback.
+    s2 = 120   # per-shard 30 -> block halves to 2? (30 % 8 != 0)
+    q2 = jax.random.normal(jax.random.key(3), (b, s2, h, d), jnp.float32)
+    k2 = jax.random.normal(jax.random.key(4), (b, s2, kvh, d), jnp.float32)
+    v2 = jax.random.normal(jax.random.key(5), (b, s2, kvh, d), jnp.float32)
+    out2 = jax.jit(lambda q, k, v: ring_attention.ring_attention(
+        q, k, v, mesh=mesh))(q2, k2, v2)
+    ref2 = attention_ops._reference_attention(q2, k2, v2, causal=True,
+                                              scale=d ** -0.5)
+    assert float(jnp.max(jnp.abs(out2 - ref2))) < 2e-5
