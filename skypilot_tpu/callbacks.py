@@ -112,3 +112,22 @@ def step_iterator(iterable: Iterable) -> Iterator:
 def flush() -> None:
     if _state is not None:
         _state.flush()
+
+
+def device_profile(log_dir: Optional[str] = None,
+                   env_var: str = "STPU_PROFILE_DIR"):
+    """Context manager: capture an on-device XLA profile when armed.
+
+    The TPU analog the reference lacks (SURVEY §5: no on-device
+    profiler): ``with callbacks.device_profile():`` around the training
+    loop writes a TensorBoard-loadable trace (xplane) via
+    ``jax.profiler`` when ``STPU_PROFILE_DIR`` (or ``log_dir``) is set,
+    and is a zero-cost no-op otherwise — recipes can leave it on
+    unconditionally. View: tensorboard --logdir <dir> (Profile tab).
+    """
+    import contextlib
+    target = log_dir or os.environ.get(env_var)
+    if not target:
+        return contextlib.nullcontext()
+    import jax
+    return jax.profiler.trace(target)

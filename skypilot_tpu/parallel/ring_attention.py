@@ -48,10 +48,13 @@ def _chunk_update(q, kc, vc, qpos, kpos0, m, l, acc, *, causal, scale):
     b, sl, h, d = q.shape
     kvh = kc.shape[2]
     groups = h // kvh
-    block = min(_KV_BLOCK, kc.shape[1])
-    while kc.shape[1] % block:
-        block //= 2
-    n_blocks = kc.shape[1] // block
+    # Largest divisor of the chunk length <= _KV_BLOCK (any divisor,
+    # not only powers of two): halving alone degenerates to 1-2-wide
+    # blocks for lengths with small odd factors, wrecking the MXU.
+    n = kc.shape[1]
+    block = max(dv for dv in range(1, min(_KV_BLOCK, n) + 1)
+                if n % dv == 0)
+    n_blocks = n // block
     # Grouped-query form: keep K/V at KVH heads and fold the group axis
     # into the einsum instead of materializing repeated K/V.
     qg = q.reshape(b, sl, kvh, groups, d)

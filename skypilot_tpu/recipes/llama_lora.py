@@ -150,19 +150,25 @@ def main(argv=None) -> dict:
     t0 = time.time()
     loss = None
     losses = []
-    for i, (tokens,) in enumerate(
-            synthetic_data.batches((data,), args.batch_size, args.seed,
-                                   args.steps - start_step)):
-        step = start_step + i + 1
-        lora, opt_state, loss = step_fn(base, lora, opt_state,
-                                        jnp.asarray(tokens))
-        losses.append(float(loss))
-        if mgr is not None and (step % args.save_every == 0
-                                or step == args.steps):
-            mgr.save(step, args=ocp.args.StandardSave(
-                {"lora": lora, "opt_state": opt_state}))
-    if loss is not None:
-        loss.block_until_ready()
+    # On-device XLA profile of the training loop when STPU_PROFILE_DIR
+    # is set (tensorboard-loadable); zero-cost no-op otherwise. The
+    # `with` guarantees the trace is finalized even when a step raises.
+    from skypilot_tpu import callbacks
+    with callbacks.device_profile():
+        for i, (tokens,) in enumerate(
+                synthetic_data.batches((data,), args.batch_size,
+                                       args.seed,
+                                       args.steps - start_step)):
+            step = start_step + i + 1
+            lora, opt_state, loss = step_fn(base, lora, opt_state,
+                                            jnp.asarray(tokens))
+            losses.append(float(loss))
+            if mgr is not None and (step % args.save_every == 0
+                                    or step == args.steps):
+                mgr.save(step, args=ocp.args.StandardSave(
+                    {"lora": lora, "opt_state": opt_state}))
+        if loss is not None:
+            loss.block_until_ready()
     if mgr is not None:
         mgr.wait_until_finished()
 

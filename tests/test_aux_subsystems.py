@@ -195,3 +195,23 @@ def test_ssh_config_helper(tmp_path, monkeypatch):
     ssh_config.remove_cluster("c1")
     assert ssh_config.cluster_config_path("c1") is None
     ssh_config.remove_cluster("c1")  # idempotent
+
+
+def test_device_profile_writes_trace(tmp_path, monkeypatch):
+    """device_profile captures an XLA trace when armed, no-ops when not
+    (SURVEY §5: the on-device profiler the reference lacks)."""
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu import callbacks
+
+    # Unarmed: a null context, zero side effects.
+    monkeypatch.delenv("STPU_PROFILE_DIR", raising=False)
+    with callbacks.device_profile():
+        pass
+
+    prof_dir = tmp_path / "prof"
+    with callbacks.device_profile(log_dir=str(prof_dir)):
+        jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+    traces = list(prof_dir.rglob("*.xplane.pb"))
+    assert traces, f"no xplane trace under {prof_dir}"

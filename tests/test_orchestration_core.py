@@ -192,7 +192,16 @@ def test_dag_chain_and_cycle():
         d2.topo_order()
 
 
-# -------------------------------------------------------------- optimizer
+# ---------------------------------------------------------------------
+# All tests in this module isolate client state: the enabled-clouds set
+# lives in the state DB, and a developer's real ~/.stpu (e.g. after
+# `stpu check` on a machine where only `local` is usable) must not
+# change optimizer planning or cluster bookkeeping.
+
+@pytest.fixture(autouse=True)
+def _isolated_state(tmp_state_dir):
+    pass
+
 
 def _single_task_dag(**task_kw):
     with Dag() as d:
