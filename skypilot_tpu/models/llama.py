@@ -292,11 +292,42 @@ def init_cache(cfg: LlamaConfig, batch: int,
             "v": jnp.zeros(shape, dtype=cfg.dtype)}
 
 
-def forward_with_cache(cfg: LlamaConfig, params: Params,
+def cached_attention_block(cfg, x: jax.Array, lp: Params,
+                           ck: jax.Array, cv: jax.Array,
+                           positions: jax.Array, start_pos: jax.Array,
+                           mask: jax.Array):
+    """One pre-norm GQA attention residual block against the KV cache
+    (shared by llama's and mixtral's decode paths). Returns
+    (x + attn_out, updated ck, updated cv)."""
+    b, t = x.shape[0], x.shape[1]
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    y = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q, k_new, v_new = qkv_proj(cfg, y, lp, positions)
+    ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype),
+                                      (0, start_pos, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype),
+                                      (0, start_pos, 0, 0))
+    # GQA grouped attention against the UNEXPANDED cache (the head-
+    # order convention of ops/attention.py): q regrouped per KV head
+    # so no repeat()ed copy of the cache hits HBM on the hot path.
+    groups = h // kvh
+    qg = q.reshape(b, t, kvh, groups, hd).astype(jnp.float32)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg,
+                        ck.astype(jnp.float32)) * (hd ** -0.5)
+    scores = jnp.where(mask[:, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bkgts,bskd->btkgd", probs,
+                      cv.astype(jnp.float32)).astype(x.dtype)
+    attn = attn.reshape(b, t, h * hd)
+    return x + lora_dense(attn, lp, "wo"), ck, cv
+
+
+def forward_with_cache(cfg, params: Params,
                        tokens: jax.Array, cache: Dict[str, jax.Array],
                        start_pos: jax.Array,
                        valid_len: Optional[jax.Array] = None,
-                       logits_at: Optional[jax.Array] = None
+                       logits_at: Optional[jax.Array] = None, *,
+                       mlp_fn=None
                        ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Incremental forward: process a chunk, reading/writing the cache.
 
@@ -315,7 +346,6 @@ def forward_with_cache(cfg: LlamaConfig, params: Params,
     """
     b, t = tokens.shape
     max_seq = cache["k"].shape[2]
-    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     if valid_len is None:
         valid_len = start_pos + t
     positions = start_pos + jnp.arange(t)[None, :]        # (1, T) bcast
@@ -328,28 +358,16 @@ def forward_with_cache(cfg: LlamaConfig, params: Params,
     mask = ((kpos[None, :] <= positions[..., None]) &
             (kpos[None, None, :] < valid_len))            # (B, T, max_seq)
 
+    # Pluggable residual MLP half — mixtral swaps in its dense-routed
+    # MoE (models/mixtral.py) while the attention/cache/mask contract
+    # (padding K/V never attendable) stays in exactly one place.
+    mlp_fn = mlp_fn or (lambda cfg, x2, lp: mlp_block(cfg, x2, lp))
+
     def layer_fn(x, scanned):
         lp, ck, cv = scanned                               # per-layer
-        y = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q, k_new, v_new = qkv_proj(cfg, y, lp, positions)
-        ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype),
-                                          (0, start_pos, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype),
-                                          (0, start_pos, 0, 0))
-        # GQA grouped attention against the UNEXPANDED cache (the head-
-        # order convention of ops/attention.py): q regrouped per KV head
-        # so no repeat()ed copy of the cache hits HBM on the hot path.
-        groups = h // kvh
-        qg = q.reshape(b, t, kvh, groups, hd).astype(jnp.float32)
-        scores = jnp.einsum("btkgd,bskd->bkgts", qg,
-                            ck.astype(jnp.float32)) * (hd ** -0.5)
-        scores = jnp.where(mask[:, None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bkgts,bskd->btkgd", probs,
-                          cv.astype(jnp.float32)).astype(x.dtype)
-        attn = attn.reshape(b, t, h * hd)
-        x2 = x + lora_dense(attn, lp, "wo")
-        return mlp_block(cfg, x2, lp), (ck, cv)
+        x2, ck, cv = cached_attention_block(cfg, x, lp, ck, cv,
+                                            positions, start_pos, mask)
+        return mlp_fn(cfg, x2, lp), (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
         layer_fn, x, (params["layers"], cache["k"], cache["v"]))
@@ -364,7 +382,8 @@ def forward_with_cache(cfg: LlamaConfig, params: Params,
 def decode(cfg: LlamaConfig, params: Params, prompt: jax.Array,
            true_len: jax.Array, max_tokens: int, max_seq: int,
            temperature: float = 0.0,
-           key: Optional[jax.Array] = None) -> jax.Array:
+           key: Optional[jax.Array] = None, *,
+           fwd_cache=None, cache_init=None) -> jax.Array:
     """Prefill + cached decode: prompt (B, S_pad) -> (B, max_tokens).
 
     ``true_len`` is the un-padded prompt length — a SCALAR shared by
@@ -401,8 +420,12 @@ def decode(cfg: LlamaConfig, params: Params, prompt: jax.Array,
                 k, logits_row / temperature, axis=-1).astype(jnp.int32)
         return jnp.argmax(logits_row, axis=-1).astype(jnp.int32)
 
-    cache = init_cache(cfg, b, max_seq)
-    logits, cache = forward_with_cache(
+    # Pluggable cache fns: mixtral reuses this loop with its MoE layers
+    # (models/mixtral.py decode).
+    fwd_cache = fwd_cache or forward_with_cache
+    cache_init = cache_init or init_cache
+    cache = cache_init(cfg, b, max_seq)
+    logits, cache = fwd_cache(
         cfg, params, prompt, cache, jnp.int32(0), valid_len=true_len,
         logits_at=jnp.asarray(true_len - 1, jnp.int32))
     key, sub = jax.random.split(key)
@@ -410,7 +433,7 @@ def decode(cfg: LlamaConfig, params: Params, prompt: jax.Array,
 
     def step(carry, i):
         tok, cache, key = carry
-        logits, cache = forward_with_cache(
+        logits, cache = fwd_cache(
             cfg, params, tok[:, None], cache, true_len + i)
         key, sub = jax.random.split(key)
         nxt = pick(logits[:, -1], sub)

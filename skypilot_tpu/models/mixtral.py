@@ -215,3 +215,69 @@ def forward(cfg: MixtralConfig, params: Params, tokens: jax.Array,
     if with_aux:
         return logits, cfg.router_aux_weight * aux_total / cfg.n_layers
     return logits
+
+
+# ----------------------------------------------------------- KV-cache decode
+def _moe_mlp_dense(cfg: MixtralConfig, y: jax.Array,
+                   lp: Params) -> jax.Array:
+    """Inference-time MoE: every expert computed, top-2 combined.
+
+    Capacity routing (training) makes a token's output depend on which
+    OTHER tokens compete for expert slots — so incremental decode could
+    never reproduce a full pass. Per-token dense routing is
+    composition-independent (incremental == full by construction) and
+    cheap at decode chunk sizes; it equals the capacity path exactly
+    whenever capacity is not exceeded.
+    """
+    e = cfg.n_experts
+    logits = y.astype(jnp.float32) @ lp["router"]        # (B,T,E)
+    gates = jax.nn.softmax(logits, axis=-1)
+    # Select via top_k INDICES (exactly two experts, matching training's
+    # two argmax picks) — a value threshold would activate 3+ experts on
+    # tied gates and diverge from the capacity path.
+    _, idx = jax.lax.top_k(gates, 2)                     # (B,T,2)
+    sel = jax.nn.one_hot(idx, e, dtype=gates.dtype).sum(axis=-2)
+    w = gates * sel
+    w = w / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-9)
+    gate = jax.nn.silu(jnp.einsum("btd,edm->btem", y, lp["w_gate"]))
+    up = jnp.einsum("btd,edm->btem", y, lp["w_up"])
+    out = jnp.einsum("btem,emd->bted", gate * up, lp["w_down"])
+    return jnp.einsum("bte,bted->btd", w.astype(out.dtype), out)
+
+
+def init_cache(cfg: MixtralConfig, batch: int, max_seq: int):
+    """Layer-stacked KV cache — same layout as llama's (the attention
+    blocks are shared); experts add no per-token state."""
+    return llama.init_cache(cfg, batch, max_seq)
+
+
+def _moe_block(cfg: MixtralConfig, x: jax.Array, lp: Params) -> jax.Array:
+    """Pre-norm dense-routed MoE residual block (inference)."""
+    y = llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    return x + _moe_mlp_dense(cfg, y, lp)
+
+
+def forward_with_cache(cfg: MixtralConfig, params: Params,
+                       tokens: jax.Array, cache, start_pos: jax.Array,
+                       valid_len: Optional[jax.Array] = None,
+                       logits_at: Optional[jax.Array] = None):
+    """Incremental MoE forward: llama's cache loop (attention/mask
+    contract lives there, in one place) with the dense-routed top-2
+    expert MLP swapped in — the serving loop the reference delegates to
+    vLLM for Mixtral (llm/mixtral/serve.yaml). Same scalar
+    valid_len/logits_at contract as llama.forward_with_cache."""
+    return llama.forward_with_cache(
+        cfg, params, tokens, cache, start_pos, valid_len=valid_len,
+        logits_at=logits_at, mlp_fn=_moe_block)
+
+
+def decode(cfg: MixtralConfig, params: Params, prompt: jax.Array,
+           true_len: jax.Array, max_tokens: int, max_seq: int,
+           temperature: float = 0.0,
+           key: Optional[jax.Array] = None) -> jax.Array:
+    """Prefill + cached decode for Mixtral (llama.decode's loop with the
+    MoE cache functions plugged in)."""
+    return llama.decode(cfg, params, prompt, true_len, max_tokens,
+                        max_seq, temperature=temperature, key=key,
+                        fwd_cache=forward_with_cache,
+                        cache_init=init_cache)

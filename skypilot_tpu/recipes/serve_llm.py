@@ -26,8 +26,16 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import jax
 import jax.numpy as jnp
 
-from skypilot_tpu.models import llama
+from skypilot_tpu.models import llama, mixtral
 from skypilot_tpu.train import distributed
+
+
+def _model_api(cfg):
+    """Static dispatch on the (static-argnum) config type: the cache
+    functions of the model family being served."""
+    if isinstance(cfg, mixtral.MixtralConfig):
+        return mixtral
+    return llama
 
 
 # Request limits: prompt/decode lengths are padded to buckets so the jit
@@ -58,8 +66,9 @@ def _prefill(cfg: llama.LlamaConfig, params, buf: jax.Array,
     """Streaming path, step 1: one O(S) prefill over the padded prompt;
     returns (first token (1,), KV cache). Shapes are bucket sizes so
     all prompts in a bucket share one compile."""
-    cache = llama.init_cache(cfg, 1, max_seq)
-    logits, cache = llama.forward_with_cache(
+    api = _model_api(cfg)
+    cache = api.init_cache(cfg, 1, max_seq)
+    logits, cache = api.forward_with_cache(
         cfg, params, buf[None, :], cache, jnp.int32(0), valid_len=start,
         logits_at=jnp.asarray(start - 1, jnp.int32))
     return _pick(logits[:, 0], temperature, key), cache
@@ -73,7 +82,7 @@ def _gen_step(cfg: llama.LlamaConfig, params, tok: jax.Array, cache,
     as it exists (SSE), instead of waiting for the whole scan. The KV
     cache is DONATED: XLA aliases it in place instead of copying the
     whole O(layers * max_seq) buffer every token."""
-    logits, cache = llama.forward_with_cache(
+    logits, cache = _model_api(cfg).forward_with_cache(
         cfg, params, tok[:, None], cache, pos)
     return _pick(logits[:, -1], temperature, key), cache
 
@@ -92,9 +101,9 @@ def _decode(cfg: llama.LlamaConfig, params, buf: jax.Array,
     quadratic recompute.
     """
     max_seq = buf.shape[0] + mt_pad
-    return llama.decode(cfg, params, buf[None, :], start, mt_pad,
-                        max_seq, temperature=temperature,
-                        key=jax.random.key(seed))[0]
+    return _model_api(cfg).decode(
+        cfg, params, buf[None, :], start, mt_pad, max_seq,
+        temperature=temperature, key=jax.random.key(seed))[0]
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -235,15 +244,21 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
 
 def main(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--model", choices=["tiny", "8b"], default="tiny")
+    p.add_argument("--model",
+                   choices=["tiny", "8b", "mixtral-tiny", "mixtral-8x7b"],
+                   default="tiny")
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 
     distributed.initialize_from_env()
-    cfg = (llama.LlamaConfig.llama3_8b() if args.model == "8b"
-           else llama.LlamaConfig.tiny())
-    params = llama.init(cfg, jax.random.PRNGKey(args.seed))
+    cfg = {
+        "tiny": llama.LlamaConfig.tiny,
+        "8b": llama.LlamaConfig.llama3_8b,
+        "mixtral-tiny": mixtral.MixtralConfig.tiny,
+        "mixtral-8x7b": mixtral.MixtralConfig.mixtral_8x7b,
+    }[args.model]()
+    params = _model_api(cfg).init(cfg, jax.random.PRNGKey(args.seed))
     httpd = serve(cfg, params, args.port)
     print(f"serve_llm: listening on :{args.port}", flush=True)
     httpd.serve_forever()
