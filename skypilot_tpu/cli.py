@@ -204,9 +204,21 @@ def queue(cluster, all_jobs):
 @click.argument("cluster", required=True)
 @click.argument("job_id", required=False, type=int)
 @click.option("--no-follow", is_flag=True)
-def logs(cluster, job_id, no_follow):
+@click.option("--sync-down", is_flag=True,
+              help="Download the job's log files instead of tailing.")
+def logs(cluster, job_id, no_follow, sync_down):
     """Tail a job's logs (latest job if no id given)."""
     from skypilot_tpu import core
+    if sync_down:
+        got = core.download_logs(cluster,
+                                 [job_id] if job_id is not None else None)
+        for jid, path in sorted(got.items()):
+            click.echo(f"job {jid}: {path}")
+        if not got:
+            click.echo(f"No logs to download"
+                       + (f" for job {job_id}" if job_id is not None
+                          else "") + f" on {cluster}.")
+        sys.exit(0 if got else 1)
     sys.exit(core.tail_logs(cluster, job_id, follow=not no_follow))
 
 

@@ -122,6 +122,48 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
     return backend.tail_logs(handle, job_id, follow=follow)
 
 
+def download_logs(cluster_name: str,
+                  job_ids: Optional[List[int]] = None,
+                  local_dir: Optional[str] = None) -> Dict[int, str]:
+    """Pull job log trees from the cluster head to the client
+    (reference: sky/core.py download_logs + sync_down_logs,
+    cloud_vm_ray_backend.py:3540). Returns {job_id: local_path}."""
+    import os
+    import pathlib
+
+    from skypilot_tpu.agent import constants as agent_constants
+    from skypilot_tpu.utils import paths
+    handle = _get_handle(cluster_name)
+    backend = slice_backend.SliceBackend()
+    jobs = backend.queue(handle)
+    if job_ids is not None:
+        jobs = [j for j in jobs if j["job_id"] in job_ids]
+    elif jobs:
+        jobs = jobs[:1]  # latest job, matching tail_logs' no-id default
+    base = pathlib.Path(os.path.expanduser(local_dir)) if local_dir \
+        else paths.logs_dir() / "downloaded" / cluster_name
+    runner = handle.get_command_runners()[0]
+    out: Dict[int, str] = {}
+    for job in jobs:
+        jid = job["job_id"]
+        # Per-node log files under the head's job log dir.
+        remote_dir = (job.get("log_dir") or
+                      f"~/{agent_constants.LOGS_DIR}/job-{jid}")
+        rc, listing, _ = runner.run(
+            f"ls {remote_dir} 2>/dev/null", require_outputs=True)
+        names = [n for n in listing.split() if n.endswith(".log")]
+        if rc != 0 or not names:
+            continue  # no logs yet (PENDING job / empty dir): no entry,
+            # no stray empty local directory.
+        dst = base / f"job-{jid}"
+        dst.mkdir(parents=True, exist_ok=True)
+        for name in names:
+            runner.rsync(f"{remote_dir}/{name}", str(dst / name),
+                         up=False)
+        out[jid] = str(dst)
+    return out
+
+
 def job_status(cluster_name: str,
                job_ids: Optional[List[int]] = None
                ) -> Dict[int, Optional[str]]:

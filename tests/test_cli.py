@@ -78,3 +78,29 @@ def test_env_override_required(runner, tmp_state_dir, tmp_path):
     result = runner.invoke(cli.cli, ["launch", str(yaml_path), "--dryrun"])
     assert result.exit_code != 0
     assert "TOKEN" in result.output
+
+
+def test_logs_sync_down(runner, tmp_state_dir):
+    """`stpu logs --sync-down` pulls the head's job log files to the
+    client (reference: sync_down_logs, cloud_vm_ray_backend.py:3540)."""
+    import pathlib
+    import time
+
+    from skypilot_tpu import core
+    result = runner.invoke(cli.cli, [
+        "launch", "examples/local_smoke.yaml", "-c", "dl",
+        "--detach-run"])
+    assert result.exit_code == 0, result.output
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        jobs = core.queue("dl")
+        if jobs and jobs[0]["status"] in ("SUCCEEDED", "FAILED"):
+            break
+        time.sleep(0.2)
+    got = core.download_logs("dl")
+    assert got, "no logs downloaded"
+    path = pathlib.Path(got[jobs[0]["job_id"]])
+    logs = list(path.glob("node-*.log"))
+    assert logs, f"no node logs under {path}"
+    assert "host rank 0" in (path / "node-0.log").read_text()
+    runner.invoke(cli.cli, ["down", "dl", "-y"])
