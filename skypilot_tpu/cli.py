@@ -550,8 +550,9 @@ def serve_status(service_names):
         click.echo(fmt.format(svc["service_name"], svc["status"],
                               svc["endpoint"], n_ready))
         for r in svc["replicas"]:
+            kind = "[spot]" if r.get("is_spot") else ""
             click.echo(f"  replica {r['replica_id']:<3} "
-                       f"{r['status']:<14} {r['url'] or '-'}")
+                       f"{r['status']:<14} {r['url'] or '-'} {kind}")
 
 
 def main():

@@ -21,11 +21,37 @@ class AutoscalerDecision:
     target_num_replicas: int
 
 
-class Autoscaler:
-    """Base: fixed replica count."""
+@dataclasses.dataclass(frozen=True)
+class ScalingPlan:
+    """Per-pool replica targets the controller reconciles against.
 
-    def __init__(self, spec: SkyServiceSpec):
+    Reference analog: FallbackRequestRateAutoscaler.evaluate_scaling
+    (sky/serve/autoscalers.py:527-636) emits per-replica SCALE_UP
+    decisions tagged with a ``{'use_spot': bool}`` resources override;
+    here the same policy is expressed declaratively as two pool targets
+    and the controller diffs each pool against live replicas — the
+    policy stays a pure function of (qps window, ready-spot count).
+    """
+    target_spot: int
+    target_ondemand: int
+
+    @property
+    def total(self) -> int:
+        return self.target_spot + self.target_ondemand
+
+
+class Autoscaler:
+    """Base: fixed replica count.
+
+    ``use_spot`` is whether the service's task requests spot capacity
+    (resources ``use_spot: true``); replicas then launch in the spot
+    pool, with the spec's on-demand fallback knobs carving out /
+    backfilling on-demand capacity (see ``plan``).
+    """
+
+    def __init__(self, spec: SkyServiceSpec, use_spot: bool = False):
         self.spec = spec
+        self.use_spot = use_spot
         self.target_num_replicas = spec.min_replicas
 
     def collect_request_information(
@@ -37,11 +63,42 @@ class Autoscaler:
         del now
         return AutoscalerDecision(self.target_num_replicas)
 
+    def plan(self, now: Optional[float] = None,
+             num_ready_spot: int = 0) -> ScalingPlan:
+        """Split the scalar target into (spot, on-demand) pool targets.
+
+        - No spot anywhere: everything on-demand.
+        - Spot service: ``base_ondemand_fallback_replicas`` are carved
+          out as always-on-demand; the rest of the target is spot.
+        - ``dynamic_ondemand_fallback``: on-demand additionally backfills
+          the gap between the spot target and READY spot replicas, so a
+          preemption wave is absorbed by on-demand within one tick and
+          the on-demand surplus is shed once spot recovers. READY (not
+          merely alive) spot is used, matching the reference
+          (sky/serve/autoscalers.py:596-603): provisioning spot that
+          never becomes ready must not suppress the fallback.
+        """
+        target = self.evaluate_scaling(now).target_num_replicas
+        spec = self.spec
+        if not self.use_spot:
+            # Fallback knobs without a spot task are meaningless (and
+            # rejected at `serve up`): never convert an explicitly
+            # on-demand service into spot replicas.
+            return ScalingPlan(target_spot=0, target_ondemand=target)
+        base = min(spec.base_ondemand_fallback_replicas, target)
+        target_spot = target - base
+        dynamic = 0
+        if spec.dynamic_ondemand_fallback:
+            dynamic = max(0, target_spot - num_ready_spot)
+        return ScalingPlan(target_spot=target_spot,
+                           target_ondemand=base + dynamic)
+
     @classmethod
-    def from_spec(cls, spec: SkyServiceSpec) -> "Autoscaler":
+    def from_spec(cls, spec: SkyServiceSpec,
+                  use_spot: bool = False) -> "Autoscaler":
         if spec.autoscaling_enabled:
-            return RequestRateAutoscaler(spec)
-        return cls(spec)
+            return RequestRateAutoscaler(spec, use_spot=use_spot)
+        return cls(spec, use_spot=use_spot)
 
     def adopt_state(self, old: "Autoscaler") -> None:
         """Carry scaling state across a rolling update: the new revision
@@ -61,8 +118,8 @@ class RequestRateAutoscaler(Autoscaler):
     a higher target must persist for upscale_delay_seconds before scaling
     up (resp. downscale_delay_seconds down) so bursts don't thrash."""
 
-    def __init__(self, spec: SkyServiceSpec):
-        super().__init__(spec)
+    def __init__(self, spec: SkyServiceSpec, use_spot: bool = False):
+        super().__init__(spec, use_spot=use_spot)
         self.request_timestamps: List[float] = []
         self._upscale_candidate_since: Optional[float] = None
         self._downscale_candidate_since: Optional[float] = None

@@ -30,7 +30,8 @@ class SkyServeController:
         self.spec = spec
         self.replica_manager = SkyPilotReplicaManager(service_name, spec,
                                                       task)
-        self.autoscaler = autoscalers.Autoscaler.from_spec(spec)
+        self.autoscaler = autoscalers.Autoscaler.from_spec(
+            spec, use_spot=task.uses_spot)
         # Request timestamps arrive from the LB process via /sync; the
         # autoscaler drains them each tick.
         self.recorder = recorder or RequestRecorder()
@@ -43,6 +44,7 @@ class SkyServeController:
         self._draining: set = set()
         self._draining_since = 0.0   # when _draining last gained members
         self._last_sync_at = 0.0     # when the LB last adopted /sync
+        self._ready_edge_at: Optional[float] = None  # empty→non-empty edge
 
     def stop(self) -> None:
         self._stop = True
@@ -73,7 +75,16 @@ class SkyServeController:
         New replicas launch from the new task; old ones are drained by
         the rollover logic in _tick once replacements are READY."""
         row = serve_state.get_service(self.service_name)
-        if row is None or row.get("version", 1) <= self.version:
+        if row is None:
+            # The service row is gone: `serve down` finalized us from
+            # outside (it can race a controller restart — the recorded
+            # pid is the dead predecessor's, so the SIGTERM never
+            # arrives). Treat it as the down it is: stop and run the
+            # normal shutdown so any replicas this controller adopted
+            # or launched meanwhile are torn down, not leaked.
+            self._stop = True
+            return
+        if row.get("version", 1) <= self.version:
             return
         from skypilot_tpu.serve.service_spec import SkyServiceSpec
         from skypilot_tpu.task import Task
@@ -97,29 +108,43 @@ class SkyServeController:
         self.version = row["version"]
         self.replica_manager.apply_update(self.version, spec, task)
         self.spec = spec
-        new_autoscaler = autoscalers.Autoscaler.from_spec(spec)
+        new_autoscaler = autoscalers.Autoscaler.from_spec(
+            spec, use_spot=task.uses_spot)
         new_autoscaler.adopt_state(self.autoscaler)
         self.autoscaler = new_autoscaler
 
     def _tick(self) -> None:
         rm = self.replica_manager
         self._check_update()
+        if self._stop:      # orphaned (service row deleted): no more
+            return          # scaling work; run() falls through to
+                            # _shutdown which reaps our replicas.
         rm.probe_all()
         self.autoscaler.collect_request_information(self.recorder.drain())
-        target = self.autoscaler.evaluate_scaling().target_num_replicas
+        # Two capacity pools (spot / on-demand), reconciled separately:
+        # a spot preemption wave drops ready-spot, which (under
+        # dynamic_ondemand_fallback) grows the on-demand pool target the
+        # very next tick — the backfill — and sheds it again once spot
+        # replicas are READY. Reference semantics:
+        # sky/serve/autoscalers.py:527-636.
+        plan = self.autoscaler.plan(
+            num_ready_spot=rm.ready_count(spot=True))
+        target = plan.total
         given_up = (rm.consecutive_failure_count >=
                     self.MAX_CONSECUTIVE_REPLICA_FAILURES)
         # Rolling update: bring CURRENT-version capacity to target (old
         # replicas keep serving as surge), then roll outdated replicas
         # out in two phases — pulled from the LB one tick, terminated the
         # next — so availability never dips and in-flight requests drain.
-        alive_current = rm.alive_current_count()
-        if alive_current < target and not given_up:
-            rm.scale_up(target - alive_current)
-        elif alive_current > target:
-            for rid in rm.scale_down_candidates()[
-                    :alive_current - target]:
-                rm.scale_down(rid)
+        for pool_spot, pool_target in ((True, plan.target_spot),
+                                       (False, plan.target_ondemand)):
+            alive = rm.alive_current_count(spot=pool_spot)
+            if alive < pool_target and not given_up:
+                rm.scale_up(pool_target - alive, use_spot=pool_spot)
+            elif alive > pool_target:
+                for rid in rm.scale_down_candidates(spot=pool_spot)[
+                        :alive - pool_target]:
+                    rm.scale_down(rid)
         outdated = set(rm.outdated_alive_ids())
         if rm.ready_current_count() >= target:
             # Terminate a draining replica only once the LB has SYNCED
@@ -143,12 +168,28 @@ class SkyServeController:
             newly_pulled = False
             self._draining = set()
         ready = rm.ready_urls(exclude_ids=self._draining)
+        was_empty = not self._ready_urls
         self._ready_urls = list(ready)  # served to the LB via /sync
+        if ready and was_empty:
+            # Empty→non-empty edge: arm the READY-publish gate (below).
+            # Stamped AFTER the assignment so a /sync racing this tick
+            # can only read the NEW urls once its stamp passes the gate.
+            self._ready_edge_at = time.time()
         if newly_pulled:
             # Stamp AFTER _ready_urls excludes the pulled replicas: a
             # sync racing this tick must not count as caught-up.
             self._draining_since = time.time()
-        self._publish_status(ready, given_up)
+        # Don't publish READY until the LB has SYNCED since the ready
+        # set became non-empty: `wait_ready` returns on the DB status,
+        # and a request fired right after must not race the LB's first
+        # adoption of the urls (it would 503). Mirror of the
+        # drain-before-terminate gate above, with the same dead-LB
+        # fallback so a crashed LB can't hold the status hostage.
+        lb_serving = (self._ready_edge_at is None or
+                      self._last_sync_at >= self._ready_edge_at or
+                      time.time() - self._ready_edge_at >
+                      10 * _tick_seconds())
+        self._publish_status(ready if lb_serving else [], given_up)
 
     # ------------------------------------------------------- LB sync RPC
     def start_sync_server(self) -> int:
@@ -177,13 +218,27 @@ class SkyServeController:
                 try:
                     payload = json_lib.loads(
                         self.rfile.read(length) or b"{}")
+                    if not isinstance(payload, dict):
+                        raise ValueError("sync payload must be an object")
                     controller.recorder.record_many(
                         payload.get("request_timestamps", []))
                 except (ValueError, TypeError):
-                    pass
+                    # A malformed sync must NOT count as the LB having
+                    # caught up — the drain-before-terminate gate keys
+                    # off _last_sync_at (see _tick).
+                    self.send_response(400)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
                 controller._last_sync_at = time.time()
                 body = json_lib.dumps(
-                    {"ready_urls": controller._ready_urls}).encode()
+                    {"ready_urls": controller._ready_urls,
+                     # Per-service LB knobs ride the sync so a rolling
+                     # update to the spec reaches the LB within one
+                     # interval, no LB restart needed.
+                     "upstream_timeout":
+                         controller.spec.upstream_timeout_seconds}
+                ).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))

@@ -60,6 +60,7 @@ def up(task: Task, service_name: Optional[str] = None,
     if task.service is None:
         raise exceptions.InvalidTaskError(
             "Task YAML needs a `service:` section for `serve up`.")
+    _validate_fallback_spec(task)
     service_name = service_name or task.name or "service"
 
     # Replica clusters are launched (and preemption-relaunched) by the
@@ -90,6 +91,23 @@ def up(task: Task, service_name: Optional[str] = None,
         raise exceptions.SkyTpuError(out["error"])
     endpoint = f"http://{_endpoint_host(handle)}:{out['lb_port']}"
     return service_name, endpoint
+
+
+def _validate_fallback_spec(task: Task) -> None:
+    """On-demand fallback only makes sense for a spot fleet: reject the
+    knobs on a non-spot task up front (reference checks this at spec
+    load, sky/serve/service_spec.py use_ondemand_fallback contract)
+    rather than silently launching spot replicas the user never asked
+    for."""
+    spec = task.service
+    if spec is None or not spec.use_ondemand_fallback:
+        return
+    if not task.uses_spot:
+        raise exceptions.InvalidTaskError(
+            "service.replica_policy on-demand fallback "
+            "(base_ondemand_fallback_replicas / "
+            "dynamic_ondemand_fallback) requires spot replicas — set "
+            "resources.use_spot: true.")
 
 
 def _up_local(task: Task, service_name: str) -> Tuple[str, str]:
@@ -131,6 +149,7 @@ def update(task: Task, service_name: str,
     if task.service is None:
         raise exceptions.InvalidTaskError(
             "Task YAML needs a `service:` section for `serve update`.")
+    _validate_fallback_spec(task)
     controller_utils.maybe_translate_local_file_mounts_and_sync_up(
         task, run_id=f"sv-{service_name}-u{int(time.time() * 1000)}")
     mode = controller or controller_utils.controller_mode(_SERVE)

@@ -72,6 +72,11 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     policy: LoadBalancingPolicy = None  # set by make_handler
     recorder: RequestRecorder = None
+    # Per-service upstream (replica) timeout; the sync loop overwrites
+    # this from the controller's spec (service_spec.py
+    # upstream_timeout_seconds) so slow-first-byte services (cold model
+    # compile, long prompts) aren't 502'd at an arbitrary 120s.
+    upstream_timeout: float = 120.0
 
     def log_message(self, fmt, *args):  # quiet
         del fmt, args
@@ -95,7 +100,8 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                                      method=method)
         started: List[bool] = []
         try:
-            with urllib.request.urlopen(req, timeout=120) as resp:
+            with urllib.request.urlopen(
+                    req, timeout=self.upstream_timeout) as resp:
                 self._stream_response(resp, started)
         except urllib.error.HTTPError as e:
             payload = e.read()
@@ -206,10 +212,9 @@ def run_lb_process(port: int, controller_url: str,
         RoundRobinPolicy
     policy = RoundRobinPolicy()
     recorder = RequestRecorder()
-    server = _ThreadingHTTPServer(
-        ("0.0.0.0", port),
-        type("Handler", (_ProxyHandler,),
-             {"policy": policy, "recorder": recorder}))
+    handler_cls = type("Handler", (_ProxyHandler,),
+                       {"policy": policy, "recorder": recorder})
+    server = _ThreadingHTTPServer(("0.0.0.0", port), handler_cls)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     while True:
         # Sync FIRST: the ready set should arrive as soon as the
@@ -223,8 +228,10 @@ def run_lb_process(port: int, controller_url: str,
                 headers={"Content-Type": "application/json"},
                 method="POST")
             with urllib.request.urlopen(req, timeout=5) as resp:
-                ready = json.loads(resp.read()).get("ready_urls", [])
-            policy.set_ready_replicas(ready)
+                payload = json.loads(resp.read())
+            policy.set_ready_replicas(payload.get("ready_urls", []))
+            handler_cls.upstream_timeout = float(
+                payload.get("upstream_timeout", 120.0))
         except Exception:  # noqa: BLE001 — keep serving last-known set
             # Re-queue the drained timestamps: a transiently unreachable
             # controller must not erase QPS signal (the autoscaler would

@@ -43,11 +43,16 @@ def _free_port() -> int:
 class ReplicaInfo:
     def __init__(self, replica_id: int, cluster_name: str, port: int,
                  version: int = 1,
-                 spec: Optional[SkyServiceSpec] = None):
+                 spec: Optional[SkyServiceSpec] = None,
+                 is_spot: bool = False):
         self.replica_id = replica_id
         self.cluster_name = cluster_name
         self.port = port
         self.version = version
+        # Which capacity pool this replica belongs to. The autoscaler's
+        # ScalingPlan reconciles the spot and on-demand pools separately
+        # (reference: ReplicaInfo.is_spot, sky/serve/replica_managers.py).
+        self.is_spot = is_spot
         # The spec THIS replica was launched under: a rolling update must
         # keep probing old replicas with their own readiness contract.
         self.spec = spec
@@ -76,9 +81,90 @@ class SkyPilotReplicaManager:
         self.consecutive_failure_count = 0
         self._threads: List[threading.Thread] = []
         self.backend = slice_backend.SliceBackend()
+        self._recover_replicas()
+
+    def _recover_replicas(self) -> None:
+        """Adopt replicas a previous (crashed) controller left behind.
+
+        Reference analog: sky/serve/replica_managers.py:606 — the
+        SkyPilotReplicaManager constructor re-reads serve state and
+        resumes managing recorded replicas rather than launching a
+        fresh fleet (which would double capacity and leak the old
+        clusters). Rows with a URL are re-probed on the normal cycle;
+        rows that died mid-launch (no URL yet) are cleaned up so the
+        reconcile loop replaces them."""
+        for row in serve_state.get_replicas(self.service_name):
+            # Advance the id counter for EVERY row (including FAILED
+            # ones we keep): reusing a dead replica's id would upsert
+            # over its kept-for-debuggability record and can collide
+            # with a same-named cluster whose teardown the crash
+            # interrupted.
+            self._next_replica_id = max(self._next_replica_id,
+                                        row["replica_id"] + 1)
+            status = row["status"]
+            if status == ReplicaStatus.FAILED:
+                continue    # keep the record; cluster already reaped
+            url = row["url"]
+            port = 0
+            if url:
+                try:
+                    port = int(url.rsplit(":", 1)[1])
+                except (ValueError, IndexError):
+                    pass
+            # Re-attach the spec THIS replica was launched under (a
+            # crash mid-rolling-update leaves old-revision replicas
+            # whose readiness contract differs from the latest spec —
+            # probing them with the new one would tear down healthy
+            # surge capacity as FAILED).
+            spec = self.spec
+            if row.get("spec_json"):
+                try:
+                    spec = SkyServiceSpec(**json.loads(row["spec_json"]))
+                except (TypeError, ValueError):
+                    pass  # forward-compat: unknown fields → latest spec
+            # Pool tag: rows from a pre-upgrade DB (no spec_json) carry
+            # the migration default is_spot=0 regardless of truth — tag
+            # them from the task so an adopted spot fleet isn't
+            # reclassified on-demand and mass-replaced on first tick.
+            is_spot = row.get("is_spot", False)
+            if row.get("spec_json") is None:
+                is_spot = self.task.uses_spot
+            info = ReplicaInfo(row["replica_id"], row["cluster_name"],
+                               port, version=row["version"],
+                               spec=spec, is_spot=is_spot)
+            info.url = url
+            # Keep the recorded launch time (the upsert mirrors the
+            # manager's post-provision stamp): a dead adopted replica
+            # must fail through the normal probe path now, not after a
+            # fresh initial-delay grace.
+            info.launched_at = row["launched_at"]
+            if url and status not in (ReplicaStatus.SHUTTING_DOWN,
+                                      ReplicaStatus.PREEMPTED):
+                # Live (or at least probe-able) replica: adopt as
+                # STARTING — the probe loop promotes it back to READY
+                # within a tick, or walks the normal failure path.
+                info.status = ReplicaStatus.STARTING
+                with self._lock:
+                    self.replicas[info.replica_id] = info
+                self._persist(info)
+            else:
+                # Died mid-launch, or mid-teardown (SHUTTING_DOWN /
+                # PREEMPTED husk the crash interrupted): finish the job
+                # through the normal teardown path — just deleting the
+                # row would leak a half-dead, still-billing cluster.
+                with self._lock:
+                    self.replicas[info.replica_id] = info
+                self.scale_down(info.replica_id)
 
     # ------------------------------------------------------------ scaling
-    def scale_up(self, n: int = 1) -> None:
+    def scale_up(self, n: int = 1,
+                 use_spot: Optional[bool] = None) -> None:
+        """Launch ``n`` replicas. ``use_spot`` overrides the task's
+        resources for this pool (reference: SCALE_UP decisions carry a
+        ``{'use_spot': bool}`` override dict,
+        sky/serve/autoscalers.py:522-525); None keeps the task default."""
+        if use_spot is None:
+            use_spot = self.task.uses_spot
         for _ in range(n):
             with self._lock:
                 replica_id = self._next_replica_id
@@ -92,7 +178,8 @@ class SkyPilotReplicaManager:
                 else:
                     port = 8080
                 info = ReplicaInfo(replica_id, cluster_name, port,
-                                   version=self.version, spec=self.spec)
+                                   version=self.version, spec=self.spec,
+                                   is_spot=use_spot)
                 self.replicas[replica_id] = info
             self._persist(info)
             t = threading.Thread(target=self._launch_replica,
@@ -141,6 +228,12 @@ class SkyPilotReplicaManager:
         import copy as copy_lib
         task = copy_lib.deepcopy(self.task)
         task.service = None
+        if task.resources:
+            # Pin the replica's pool regardless of the task default: a
+            # fallback replica from a spot task must launch on-demand.
+            task.set_resources(tuple(
+                res.copy(use_spot=info.is_spot)
+                for res in task.resources))
         task.update_envs({REPLICA_PORT_ENV: str(info.port)})
         try:
             _, handle = execution.launch(
@@ -286,15 +379,18 @@ class SkyPilotReplicaManager:
         with self._lock:
             return [info.status for info in self.replicas.values()]
 
-    def scale_down_candidates(self) -> List[int]:
+    def scale_down_candidates(
+            self, spot: Optional[bool] = None) -> List[int]:
         """Surplus trim for the autoscaler: CURRENT-version replicas
         only (outdated ones are the rollover's job — killing a READY old
         replica because new capacity over-provisioned would dip
-        availability mid-update). Prefer not-yet-ready, then newest."""
+        availability mid-update). Prefer not-yet-ready, then newest.
+        ``spot`` filters to one capacity pool (None = both)."""
         with self._lock:
             alive = [info for info in self.replicas.values()
                      if info.status.is_alive()
-                     and info.version >= self.version]
+                     and info.version >= self.version
+                     and (spot is None or info.is_spot == spot)]
         alive.sort(key=lambda i: (i.status == ReplicaStatus.READY,
                                   -i.replica_id))
         return [i.replica_id for i in alive]
@@ -313,17 +409,30 @@ class SkyPilotReplicaManager:
             self.task = task
             self.consecutive_failure_count = 0
 
-    def alive_current_count(self) -> int:
+    def alive_current_count(self, spot: Optional[bool] = None) -> int:
         with self._lock:
             return sum(1 for info in self.replicas.values()
                        if info.status.is_alive()
-                       and info.version >= self.version)
+                       and info.version >= self.version
+                       and (spot is None or info.is_spot == spot))
 
-    def ready_current_count(self) -> int:
+    def ready_current_count(self, spot: Optional[bool] = None) -> int:
         with self._lock:
             return sum(1 for info in self.replicas.values()
                        if info.status == ReplicaStatus.READY
-                       and info.version >= self.version)
+                       and info.version >= self.version
+                       and (spot is None or info.is_spot == spot))
+
+    def ready_count(self, spot: Optional[bool] = None) -> int:
+        """READY replicas across ALL versions. The dynamic-fallback
+        backfill keys off this, not the current-version count: during a
+        rolling update the old spot replicas still serve as surge, and
+        counting them as 'gone' would launch a full on-demand fleet for
+        an availability gap that doesn't exist."""
+        with self._lock:
+            return sum(1 for info in self.replicas.values()
+                       if info.status == ReplicaStatus.READY
+                       and (spot is None or info.is_spot == spot))
 
     def outdated_alive_ids(self) -> List[int]:
         with self._lock:
@@ -340,6 +449,13 @@ class SkyPilotReplicaManager:
         with self._lock:
             if info.replica_id not in self.replicas:
                 return
+            spec_json = None
+            if info.spec is not None:
+                import dataclasses as dc
+                spec_json = json.dumps(dc.asdict(info.spec))
             serve_state.upsert_replica(self.service_name, info.replica_id,
                                        info.cluster_name, info.status,
-                                       info.url, version=info.version)
+                                       info.url, version=info.version,
+                                       is_spot=info.is_spot,
+                                       spec_json=spec_json,
+                                       launched_at=info.launched_at)

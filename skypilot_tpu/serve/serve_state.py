@@ -71,6 +71,8 @@ def _conn() -> sqlite3.Connection:
         url TEXT,
         launched_at REAL,
         version INTEGER DEFAULT 1,
+        is_spot INTEGER DEFAULT 0,
+        spec_json TEXT,
         PRIMARY KEY (service_name, replica_id))""")
     _migrate(conn)
     conn.commit()
@@ -90,7 +92,9 @@ def _migrate(conn: sqlite3.Connection) -> None:
             ("services", "version", "INTEGER DEFAULT 1"),
             ("services", "update_error", "TEXT"),
             ("services", "lb_pid", "INTEGER"),
-            ("replicas", "version", "INTEGER DEFAULT 1")):
+            ("replicas", "version", "INTEGER DEFAULT 1"),
+            ("replicas", "is_spot", "INTEGER DEFAULT 0"),
+            ("replicas", "spec_json", "TEXT")):
         cols = {r[1] for r in conn.execute(
             f"PRAGMA table_info({table})").fetchall()}
         if col not in cols:
@@ -210,18 +214,26 @@ def _service_row(row) -> Dict[str, Any]:
 # ------------------------------------------------------------------ replicas
 def upsert_replica(service_name: str, replica_id: int, cluster_name: str,
                    status: ReplicaStatus, url: Optional[str],
-                   version: int = 1) -> None:
+                   version: int = 1, is_spot: bool = False,
+                   spec_json: Optional[str] = None,
+                   launched_at: Optional[float] = None) -> None:
+    # launched_at mirrors the manager's in-memory value (re-stamped
+    # post-provision by _launch_replica) so crash recovery restores an
+    # honest initial-delay grace window, not the row-insert time.
     with _conn() as conn:
         conn.execute(
             "INSERT INTO replicas (service_name, replica_id, cluster_name,"
-            " status, url, launched_at, version) "
-            "VALUES (?, ?, ?, ?, ?, ?, ?) "
+            " status, url, launched_at, version, is_spot, spec_json) "
+            "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?) "
             "ON CONFLICT(service_name, replica_id) DO UPDATE SET "
             "status=excluded.status, url=excluded.url, "
             "cluster_name=excluded.cluster_name, "
-            "version=excluded.version",
+            "launched_at=excluded.launched_at, "
+            "version=excluded.version, is_spot=excluded.is_spot, "
+            "spec_json=excluded.spec_json",
             (service_name, replica_id, cluster_name, status.value, url,
-             time.time(), version))
+             time.time() if launched_at is None else launched_at,
+             version, int(is_spot), spec_json))
 
 
 def remove_replica(service_name: str, replica_id: int) -> None:
@@ -235,8 +247,10 @@ def get_replicas(service_name: str) -> List[Dict[str, Any]]:
     with _conn() as conn:
         rows = conn.execute(
             "SELECT replica_id, cluster_name, status, url, launched_at, "
-            "version FROM replicas WHERE service_name=? ORDER BY "
-            "replica_id", (service_name,)).fetchall()
+            "version, is_spot, spec_json FROM replicas WHERE "
+            "service_name=? ORDER BY replica_id",
+            (service_name,)).fetchall()
     return [{"replica_id": r[0], "cluster_name": r[1],
              "status": ReplicaStatus(r[2]), "url": r[3],
-             "launched_at": r[4], "version": r[5]} for r in rows]
+             "launched_at": r[4], "version": r[5],
+             "is_spot": bool(r[6]), "spec_json": r[7]} for r in rows]

@@ -110,6 +110,16 @@ def run_service(service_name: str, task_yaml: str, lb_port: int) -> None:
     log_dir = paths.logs_dir() / "serve"
     log_dir.mkdir(parents=True, exist_ok=True)
     log_f = open(log_dir / f"{service_name}-lb.log", "ab")
+    # Restart-after-crash: a previous controller's LB may still be
+    # serving (crash isolation keeps it alive on purpose), but it syncs
+    # against the DEAD controller's port and squats ours. Replace it —
+    # the supervisor's respawn loop absorbs any bind-release latency.
+    row = serve_state.get_service(service_name)
+    if row and row.get("lb_pid"):
+        try:
+            os.kill(row["lb_pid"], signal.SIGTERM)
+        except OSError:
+            pass
     supervisor = _LbSupervisor(service_name, lb_port, sync_port, log_f)
     supervisor.spawn()
     threading.Thread(target=supervisor.watch, daemon=True).start()

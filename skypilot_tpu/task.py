@@ -95,6 +95,14 @@ class Task:
         self.envs.update({k: str(v) for k, v in envs.items()})
         return self
 
+    @property
+    def uses_spot(self) -> bool:
+        """Whether this task requests spot (preemptible) capacity — the
+        single source of truth for serve's pool placement and the
+        fallback-spec validation."""
+        return bool(self.resources) and \
+            next(iter(self.resources)).use_spot
+
     def set_time_estimator(
             self, fn: Callable[[Resources], float]) -> "Task":
         self._time_estimator = fn

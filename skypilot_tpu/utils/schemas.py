@@ -80,6 +80,7 @@ _SERVICE_SCHEMA = {
             ],
         },
         "replicas": {"type": "integer"},
+        "upstream_timeout_seconds": {"type": "integer"},
         "replica_policy": {
             "type": "object",
             "additionalProperties": False,
@@ -91,6 +92,7 @@ _SERVICE_SCHEMA = {
                 "upscale_delay_seconds": {"type": "integer"},
                 "downscale_delay_seconds": {"type": "integer"},
                 "base_ondemand_fallback_replicas": {"type": "integer"},
+                "dynamic_ondemand_fallback": {"type": "boolean"},
             },
         },
     },

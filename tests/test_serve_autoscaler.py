@@ -68,3 +68,77 @@ def test_burst_does_not_upscale():
     # Burst is over; window drains before the upscale delay passes.
     assert a.evaluate_scaling(now=t0 + 12).target_num_replicas == 1
     assert a._upscale_candidate_since is None
+
+
+# ---------------------------------------------------------- spot fallback
+def test_plan_all_ondemand_without_spot():
+    a = autoscalers.Autoscaler.from_spec(SkyServiceSpec(min_replicas=3))
+    plan = a.plan()
+    assert (plan.target_spot, plan.target_ondemand) == (0, 3)
+
+
+def test_plan_pure_spot_service():
+    a = autoscalers.Autoscaler.from_spec(SkyServiceSpec(min_replicas=3),
+                                         use_spot=True)
+    plan = a.plan(num_ready_spot=0)
+    assert (plan.target_spot, plan.target_ondemand) == (3, 0)
+
+
+def test_plan_base_ondemand_fallback_carveout():
+    spec = SkyServiceSpec(min_replicas=4,
+                          base_ondemand_fallback_replicas=1)
+    a = autoscalers.Autoscaler.from_spec(spec, use_spot=True)
+    plan = a.plan(num_ready_spot=3)
+    assert (plan.target_spot, plan.target_ondemand) == (3, 1)
+    # base larger than target: never a negative spot pool.
+    spec = SkyServiceSpec(min_replicas=1,
+                          base_ondemand_fallback_replicas=3)
+    a = autoscalers.Autoscaler.from_spec(spec, use_spot=True)
+    plan = a.plan()
+    assert (plan.target_spot, plan.target_ondemand) == (0, 1)
+
+
+def test_plan_dynamic_fallback_preemption_stream():
+    """Synthetic preemption stream: ready-spot drops tick over tick ->
+    the on-demand pool backfills the gap; spot recovery sheds it."""
+    spec = SkyServiceSpec(min_replicas=4,
+                          base_ondemand_fallback_replicas=1,
+                          dynamic_ondemand_fallback=True)
+    a = autoscalers.Autoscaler.from_spec(spec, use_spot=True)
+    # Steady state: 3 ready spot + 1 base on-demand.
+    plan = a.plan(num_ready_spot=3)
+    assert (plan.target_spot, plan.target_ondemand) == (3, 1)
+    # Preemption wave: 2 of 3 spot replicas die -> backfill 2 on-demand.
+    plan = a.plan(num_ready_spot=1)
+    assert (plan.target_spot, plan.target_ondemand) == (3, 3)
+    # Total wipeout.
+    plan = a.plan(num_ready_spot=0)
+    assert (plan.target_spot, plan.target_ondemand) == (3, 4)
+    # Spot recovers -> on-demand shed back to the base carve-out.
+    plan = a.plan(num_ready_spot=3)
+    assert (plan.target_spot, plan.target_ondemand) == (3, 1)
+
+
+def test_plan_dynamic_fallback_with_autoscaling():
+    """dynamic fallback composes with request-rate scaling: the scalar
+    target comes from qps, the split from ready-spot."""
+    spec = _spec(min_replicas=1, max_replicas=5,
+                 dynamic_ondemand_fallback=True)
+    a = autoscalers.Autoscaler.from_spec(spec, use_spot=True)
+    assert isinstance(a, autoscalers.RequestRateAutoscaler)
+    t0 = 1000.0
+    a.collect_request_information([t0 - 10 + k / 3.0 for k in range(48)])
+    a.evaluate_scaling(now=t0)
+    plan = a.plan(now=t0 + 6, num_ready_spot=1)
+    assert (plan.target_spot, plan.target_ondemand) == (3, 2)
+
+
+def test_spec_fallback_yaml_round_trip():
+    spec = SkyServiceSpec(min_replicas=3,
+                          base_ondemand_fallback_replicas=1,
+                          dynamic_ondemand_fallback=True)
+    assert spec.use_ondemand_fallback
+    back = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert back.base_ondemand_fallback_replicas == 1
+    assert back.dynamic_ondemand_fallback
+    assert back.min_replicas == 3
