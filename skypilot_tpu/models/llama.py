@@ -39,6 +39,15 @@ class LlamaConfig:
     tie_embeddings: bool = False
     attention_impl: str = "auto"  # auto|pallas|reference|ring
     remat: bool = True
+    # "full": classic layer remat (everything recomputed in bwd).
+    # "save_flash": layer remat, but the flash kernel's outputs
+    # (named flash_out/flash_lse in its vjp fwd) are pinned — the bwd
+    # recomputes projections/norms/MLP yet never re-runs the quadratic
+    # attention kernel. Costs ~(2*S*D + 4*S*H) bytes per layer; at long
+    # context the kernel re-run it saves dominates.
+    # "save_flash_qkv": save_flash plus the roped q/k/v — also skips
+    # the qkv-projection recompute for another ~2*S*D*2 bytes/layer.
+    remat_policy: str = "full"    # full|save_flash|save_flash_qkv
 
     @property
     def head_dim(self) -> int:
@@ -242,14 +251,41 @@ def embed_tokens(params: Params, tokens: jax.Array, constrain) -> jax.Array:
     return constrain(x, ("batch", "act_seq", "act_embed"))
 
 
+def _vocab_proj(params: Params, x: jax.Array, constrain) -> jax.Array:
+    """(B,S,D) hidden -> fp32 logits. bf16 INPUTS into the MXU with f32
+    accumulation (preferred_element_type) — casting the operands to f32
+    first runs the vocab matmul at the fp32 rate, ~4x below bf16 peak,
+    and at vocab 32k this projection alone is ~1 TFLOP per 8k-token
+    step."""
+    logits = jax.lax.dot_general(
+        x, head_weights(params).astype(x.dtype), (((2,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return constrain(logits, ("batch", "act_seq", "vocab"))
+
+
 def lm_head(cfg, params: Params, x: jax.Array, constrain) -> jax.Array:
     """Final norm + (tied or untied) output projection, fp32 logits."""
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
-    return constrain(logits, ("batch", "act_seq", "vocab"))
+    return _vocab_proj(params, x, constrain)
+
+
+def _remat_policy(cfg):
+    """jax.checkpoint policy for the layer body (see
+    LlamaConfig.remat_policy)."""
+    name = getattr(cfg, "remat_policy", "full")
+    if name == "save_flash":
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse")
+    if name == "save_flash_qkv":
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse", "flash_q", "flash_k", "flash_v")
+    if name != "full":
+        # A typo silently degrading to full remat would re-run the
+        # quadratic kernel every bwd — the exact cost the knob avoids.
+        raise ValueError(
+            f"Unknown remat_policy {name!r}; expected 'full', "
+            "'save_flash' or 'save_flash_qkv'.")
+    return None
 
 
 def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
@@ -268,17 +304,38 @@ def forward(cfg: LlamaConfig, params: Params, tokens: jax.Array,
     trainer to inject with_sharding_constraint under a concrete mesh; the
     default is identity so the model runs un-meshed (single device).
     """
+    x = forward_trunk(cfg, params, tokens, positions, constrain)
+    return _vocab_proj(params, x, constrain)
+
+
+def forward_trunk(cfg: LlamaConfig, params: Params, tokens: jax.Array,
+                  positions: Optional[jax.Array] = None,
+                  constrain=lambda x, spec: x) -> jax.Array:
+    """Token ids (B, S) -> FINAL-NORMED hidden states (B, S, dim) — the
+    trunk without the vocab projection. The chunked-CE training loss
+    (train/trainer.py chunked_cross_entropy_loss) projects chunk-by-
+    chunk so the (B, S, vocab) fp32 logits tensor never materializes in
+    HBM (it is ~1GB at seq 8192 x vocab 32k, and the round-trips through
+    it dominate the loss region's step time)."""
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     x = embed_tokens(params, tokens, constrain)
-
     layer_fn = lambda carry, lp: (_layer(cfg, carry, lp, positions,
                                          constrain), None)
     if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+        layer_fn = jax.checkpoint(layer_fn, prevent_cse=False,
+                                  policy=_remat_policy(cfg))
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
-    return lm_head(cfg, params, x, constrain)
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def head_weights(params: Params) -> jax.Array:
+    """(dim, vocab) output projection — untied head or embed^T."""
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    return head
 
 
 # ----------------------------------------------------------- KV-cache decode

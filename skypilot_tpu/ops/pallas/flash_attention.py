@@ -25,11 +25,17 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+# Softmax runs in the exp2 domain: log2(e) is folded into the logit
+# scale once, so every per-element transcendental is exp2 (cheaper on
+# the VPU than exp) and the lse carries base-2 values end-to-end
+# (fwd and bwd agree; nothing outside the kernel pair reads lse).
+_LOG2E = 1.4426950408889634
 # Measured on v5e (16L, GQA 16/8, d=128, seq 8k): 1024x1024 blocks run
 # fwd+bwd 2.7x faster than 256x256 — the streamed grid's per-step cost
 # dominates at small blocks. 2048-wide q blocks blow VMEM (scores are
@@ -369,6 +375,381 @@ def _flash_bwd_streamed(res, do, *, causal: bool, scale: float,
 
 
 # --------------------------------------------------------------------------
+# Triangular-grid causal family (streamed): the (q_block, kv_block) pairs
+# above the causal diagonal are NEVER SCHEDULED — the grid's last dim
+# enumerates only the lower-triangle pairs, with the (qi, ki) coordinates
+# delivered through scalar prefetch (splash-attention style). Two wins
+# over predicating a rectangular grid: masked pairs cost zero grid steps,
+# and interior (fully-unmasked) pairs skip the iota/compare/select mask
+# entirely — only diagonal-straddling blocks pay it.
+# --------------------------------------------------------------------------
+
+
+def _tri_maps_row(nq: int, nk: int, block_q: int, block_k: int):
+    """Row-major (qi, ki) pairs with any unmasked element:
+    k_start <= q_start + block_q - 1."""
+    qs, ks = [], []
+    for qi in range(nq):
+        bound = min(nk - 1, (qi * block_q + block_q - 1) // block_k)
+        for ki in range(bound + 1):
+            qs.append(qi)
+            ks.append(ki)
+    return (np.asarray(qs, np.int32), np.asarray(ks, np.int32))
+
+
+def _tri_maps_col(nq: int, nk: int, block_q: int, block_k: int,
+                  n_heads: int):
+    """Column-major (ki, hi, qi) triples for the dk/dv kernel: for each
+    KV block, every query head's unmasked q blocks are consecutive so
+    the GQA group-sum accumulates in resident scratch."""
+    kks, hhs, qqs = [], [], []
+    for ki in range(nk):
+        lo = (ki * block_k) // block_q
+        for hi in range(n_heads):
+            for qi in range(lo, nq):
+                kks.append(ki)
+                hhs.append(hi)
+                qqs.append(qi)
+    return (np.asarray(kks, np.int32), np.asarray(hhs, np.int32),
+            np.asarray(qqs, np.int32))
+
+
+def _fwd_kernel_tri(qmap, kmap, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                    m_scr, l_scr, acc_scr, *, scale: float,
+                    block_q: int, block_k: int, nk: int):
+    t = pl.program_id(2)
+    qi = qmap[t]
+    ki = kmap[t]
+    bq, d = q_ref.shape
+    bk = k_ref.shape[0]
+    q_start = qi * bq
+    k_start = ki * bk
+    bound = jnp.minimum(nk - 1, lax.div(q_start + bq - 1, block_k))
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _step(masked: bool):
+        # bf16 MXU inputs, f32 accumulate (preferred_element_type).
+        # q arrives PRE-SCALED by scale*log2e (folded in outside the
+        # kernel): the per-element s*scale pass over the (bq, bk) score
+        # tile — a full VPU/VMEM sweep per grid step — disappears.
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if masked:
+            s = _causal_mask(s, q_start, k_start)
+        m_prev = m_scr[...][:, 0:1]
+        l_prev = l_scr[...][:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    # Only a block straddling the diagonal needs the mask; interior
+    # blocks (k_end - 1 <= q_start) skip the iota/compare/select.
+    diag = k_start + bk - 1 > q_start
+
+    @pl.when(diag)
+    def _():
+        _step(masked=True)
+
+    @pl.when(jnp.logical_not(diag))
+    def _():
+        _step(masked=False)
+
+    @pl.when(ki == bound)
+    def _finish():
+        l = jnp.maximum(l_scr[...][:, 0:1], 1e-30)
+        o_ref[...] = (acc_scr[...] / l).astype(o_ref.dtype)
+        # lse in BASE-2 domain (matches the exp2 softmax above; the bwd
+        # kernels below consume the same convention).
+        lse_ref[...] = jnp.broadcast_to(
+            m_scr[...][:, 0:1] + jnp.log2(l), lse_ref.shape)
+
+
+def _flash_fwd_tri(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   scale: float, block_q: int, block_k: int,
+                   keep_lse_pad: bool = False
+                   ) -> Tuple[jax.Array, jax.Array]:
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    groups = h // kvh
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    nq, nk = s // block_q, s // block_k
+    qmap, kmap = _tri_maps_row(nq, nk, block_q, block_k)
+
+    # Logit scale (and the exp2-domain log2e) folded into q ONCE here —
+    # XLA fuses the scalar mul into the transpose — instead of a
+    # per-step elementwise pass over every (bq, bk) score tile.
+    qt = (q * (scale * _LOG2E)).astype(q.dtype).transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    grid = (b, h, len(qmap))
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel_tri, scale=scale, block_q=block_q,
+                          block_k=block_k, nk=nk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((None, None, block_q, d),
+                             lambda bi, hi, t, qm, km: (bi, hi, qm[t], 0)),
+                pl.BlockSpec(
+                    (None, None, block_k, d),
+                    lambda bi, hi, t, qm, km: (bi, hi // groups,
+                                               km[t], 0)),
+                pl.BlockSpec(
+                    (None, None, block_k, d),
+                    lambda bi, hi, t, qm, km: (bi, hi // groups,
+                                               km[t], 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, None, block_q, d),
+                             lambda bi, hi, t, qm, km: (bi, hi, qm[t], 0)),
+                pl.BlockSpec((None, None, block_q, LSE_PAD),
+                             lambda bi, hi, t, qm, km: (bi, hi, qm[t], 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, _STAT), jnp.float32),
+                pltpu.VMEM((block_q, _STAT), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ]),
+        out_shape=[
+            jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, LSE_PAD), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=jax.default_backend() == "cpu",
+    )(jnp.asarray(qmap), jnp.asarray(kmap), qt, kt, vt)
+    return out.transpose(0, 2, 1, 3), (lse if keep_lse_pad
+                                       else lse[..., 0])
+
+
+def _dq_kernel_tri(qmap, kmap, q_ref, k_ref, v_ref, o_ref, do_ref,
+                   lse_ref, dq_ref, dq_scr, delta_scr, *, scale: float,
+                   block_q: int, block_k: int, nk: int):
+    t = pl.program_id(2)
+    qi = qmap[t]
+    ki = kmap[t]
+    bq, d = q_ref.shape
+    bk = k_ref.shape[0]
+    q_start = qi * bq
+    k_start = ki * bk
+    bound = jnp.minimum(nk - 1, lax.div(q_start + bq - 1, block_k))
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+        do = do_ref[...].astype(jnp.float32)
+        o = o_ref[...].astype(jnp.float32)
+        delta_scr[...] = jnp.broadcast_to(
+            jnp.sum(do * o, axis=-1, keepdims=True), delta_scr.shape)
+
+    def _step(masked: bool):
+        # q arrives pre-scaled by scale*log2e (see _flash_bwd_tri).
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[...][:, 0:1]
+        delta = delta_scr[...][:, 0:1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if masked:
+            s = _causal_mask(s, q_start, k_start)
+        p = jnp.exp2(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    diag = k_start + bk - 1 > q_start
+
+    @pl.when(diag)
+    def _():
+        _step(masked=True)
+
+    @pl.when(jnp.logical_not(diag))
+    def _():
+        _step(masked=False)
+
+    @pl.when(ki == bound)
+    def _finish():
+        dq_ref[...] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel_tri(kmap, hmap, qmap, q_ref, k_ref, v_ref, o_ref,
+                    do_ref, lse_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    scale: float, block_q: int, block_k: int, nq: int,
+                    groups: int):
+    t = pl.program_id(1)
+    ki = kmap[t]
+    hi = hmap[t]
+    qi = qmap[t]
+    bq, d = q_ref.shape
+    bk = k_ref.shape[0]
+    q_start = qi * bq
+    k_start = ki * bk
+    lo = lax.div(k_start, block_q)
+
+    first = jnp.logical_and(hi % groups == 0, qi == lo)
+
+    @pl.when(first)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def _step(masked: bool):
+        # q arrives pre-scaled by c = scale*log2e; dk accumulates
+        # ds^T @ (c*q), so _finish divides the c back out and applies
+        # the true logit scale in one constant.
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
+        o = o_ref[...].astype(jnp.float32)
+        lse = lse_ref[...][:, 0:1]
+        delta = jnp.sum(do.astype(jnp.float32) * o, axis=-1,
+                        keepdims=True)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if masked:
+            s = _causal_mask(s, q_start, k_start)
+        p = jnp.exp2(s - lse)
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    # Mask needed while the q block's first row precedes the KV block's
+    # last column.
+    diag = q_start < k_start + bk - 1
+
+    @pl.when(diag)
+    def _():
+        _step(masked=True)
+
+    @pl.when(jnp.logical_not(diag))
+    def _():
+        _step(masked=False)
+
+    last = jnp.logical_and(hi % groups == groups - 1, qi == nq - 1)
+
+    @pl.when(last)
+    def _finish():
+        # scale / (scale*log2e) = 1/log2e: undo the q pre-scale, apply
+        # the logit scale.
+        dk_ref[...] = (dk_scr[...] * (1.0 / _LOG2E)).astype(
+            dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_tri(res, do, *, scale: float, block_q: int,
+                   block_k: int):
+    q, k, v, o, lse_pad = res
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    groups = h // kvh
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    nq, nk = s // block_q, s // block_k
+    interpret = jax.default_backend() == "cpu"
+
+    # Same q pre-scale as the tri forward (kills the per-step s*scale
+    # pass); the dkv kernel's _finish divides the factor back out of dk.
+    qt = (q * (scale * _LOG2E)).astype(q.dtype).transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    ot = o.transpose(0, 2, 1, 3)
+    dot_ = do.transpose(0, 2, 1, 3)
+
+    qmap, kmap = _tri_maps_row(nq, nk, block_q, block_k)
+    qspec = pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, t, qm, km: (bi, hi, qm[t], 0))
+    kvspec = pl.BlockSpec(
+        (None, None, block_k, d),
+        lambda bi, hi, t, qm, km: (bi, hi // groups, km[t], 0))
+    lse_q = pl.BlockSpec((None, None, block_q, LSE_PAD),
+                         lambda bi, hi, t, qm, km: (bi, hi, qm[t], 0))
+
+    dqt = pl.pallas_call(
+        functools.partial(_dq_kernel_tri, scale=scale, block_q=block_q,
+                          block_k=block_k, nk=nk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, h, len(qmap)),
+            in_specs=[qspec, kvspec, kvspec, qspec, qspec, lse_q],
+            out_specs=qspec,
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                            pltpu.VMEM((block_q, _STAT), jnp.float32)]),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(qmap), jnp.asarray(kmap), qt, kt, vt, ot, dot_,
+      lse_pad)
+
+    kmap3, hmap3, qmap3 = _tri_maps_col(nq, nk, block_q, block_k, h)
+    q_h = pl.BlockSpec(
+        (None, None, block_q, d),
+        lambda bi, t, km, hm, qm: (bi, hm[t], qm[t], 0))
+    kv_h = pl.BlockSpec(
+        (None, None, block_k, d),
+        lambda bi, t, km, hm, qm: (bi, hm[t] // groups, km[t], 0))
+    lse_h = pl.BlockSpec(
+        (None, None, block_q, LSE_PAD),
+        lambda bi, t, km, hm, qm: (bi, hm[t], qm[t], 0))
+    dkt, dvt = pl.pallas_call(
+        functools.partial(_dkv_kernel_tri, scale=scale, block_q=block_q,
+                          block_k=block_k, nq=nq, groups=groups),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(b, len(kmap3)),
+            in_specs=[q_h, kv_h, kv_h, q_h, q_h, lse_h],
+            out_specs=[kv_h, kv_h],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ]),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kvh, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, kvh, s, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(kmap3), jnp.asarray(hmap3), jnp.asarray(qmap3),
+      qt, kt, vt, ot, dot_, lse_pad)
+
+    dq = dqt.transpose(0, 2, 1, 3)
+    dk = dkt.transpose(0, 2, 1, 3)
+    dv = dvt.transpose(0, 2, 1, 3)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# --------------------------------------------------------------------------
 # Resident-KV kernel family: K/V (fwd, dq) and Q/O/dO (dkv) are staged into
 # VMEM once per head and reused across the in-kernel block loop — fastest
 # for short/medium sequences, but the full-sequence staging caps length.
@@ -670,6 +1051,14 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_k,
         return _flash_fwd_resident(q, k, v, causal=causal, scale=scale,
                                    block_q=block_q, block_k=block_k,
                                    keep_lse_pad=keep_lse_pad)
+    if causal:
+        # Long causal sequences: triangular grid — masked block pairs
+        # are never scheduled. (lse is base-2 here; the tri bwd pairs
+        # with it, and family dispatch is shape-deterministic so fwd
+        # and bwd always agree.)
+        return _flash_fwd_tri(q, k, v, scale=scale, block_q=block_q,
+                              block_k=block_k,
+                              keep_lse_pad=keep_lse_pad)
     return _flash_fwd_streamed(q, k, v, causal=causal, scale=scale,
                                block_q=block_q, block_k=block_k,
                                keep_lse_pad=keep_lse_pad)
@@ -680,6 +1069,9 @@ def _flash_bwd(res, do, *, causal, scale, block_q, block_k):
     if _use_resident(q.shape[1], q.shape[3]):
         return _flash_bwd_resident(res, do, causal=causal, scale=scale,
                                    block_q=block_q, block_k=block_k)
+    if causal:
+        return _flash_bwd_tri(res, do, scale=scale, block_q=block_q,
+                              block_k=block_k)
     return _flash_bwd_streamed(res, do, causal=causal, scale=scale,
                                block_q=block_q, block_k=block_k)
 
@@ -695,6 +1087,20 @@ def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
     out, lse_pad = _flash_fwd(q, k, v, causal=causal, scale=scale,
                               block_q=block_q, block_k=block_k,
                               keep_lse_pad=True)
+    # Named so a remat policy can pin EXACTLY the kernel's outputs:
+    # jax.checkpoint_policies.save_only_these_names("flash_out",
+    # "flash_lse") makes layer-remat recompute the cheap projections but
+    # never re-run the quadratic kernel itself (the bwd residuals q/k/v
+    # come from the recomputed projections; o/lse from here). See
+    # models/llama.py remat_policy="save_flash".
+    from jax.ad_checkpoint import checkpoint_name
+    out = checkpoint_name(out, "flash_out")
+    lse_pad = checkpoint_name(lse_pad, "flash_lse")
+    # q/k/v names let a larger policy tier also skip the qkv-projection
+    # recompute (models/llama.py remat_policy="save_flash_qkv").
+    q = checkpoint_name(q, "flash_q")
+    k = checkpoint_name(k, "flash_k")
+    v = checkpoint_name(v, "flash_v")
     return out, (q, k, v, out, lse_pad)
 
 

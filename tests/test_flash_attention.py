@@ -79,3 +79,53 @@ def test_streamed_kernels_match_resident(monkeypatch):
     for a, b in zip(g_s, g_r):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-3, atol=5e-3)
+
+
+def test_tri_family_unequal_blocks(monkeypatch):
+    """Triangular causal family with block_q != block_k (bound / lo
+    arithmetic is exercised off the square-block fast path)."""
+    q, k, v = _make_qkv(jax.random.key(4), s=256)
+    monkeypatch.setattr(fa, "_use_resident", lambda s, d: False)
+
+    def loss(q, k, v):
+        return jnp.sum(fa.flash_attention(
+            q, k, v, causal=True, block_q=128, block_k=64) ** 2)
+
+    out = fa.flash_attention(q, k, v, causal=True, block_q=128,
+                             block_k=64)
+    ref = attention_ops._reference_attention(q, k, v, causal=True,
+                                             scale=None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+    gf = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(
+        attention_ops._reference_attention(q, k, v, causal=True,
+                                           scale=None) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_tri_family_unequal_blocks_kq(monkeypatch):
+    """block_k > block_q: diagonal-straddle predicate must still mask,
+    fwd AND bwd (the dkv kernel's lo/diag arithmetic runs in the
+    wide-KV regime only here)."""
+    q, k, v = _make_qkv(jax.random.key(5), s=256)
+    monkeypatch.setattr(fa, "_use_resident", lambda s, d: False)
+    out = fa.flash_attention(q, k, v, causal=True, block_q=64,
+                             block_k=128)
+    ref = attention_ops._reference_attention(q, k, v, causal=True,
+                                             scale=None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+    gf = jax.grad(lambda q, k, v: jnp.sum(fa.flash_attention(
+        q, k, v, causal=True, block_q=64, block_k=128) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(
+        attention_ops._reference_attention(q, k, v, causal=True,
+                                           scale=None) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-3)
