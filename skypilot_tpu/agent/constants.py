@@ -42,6 +42,15 @@ GANG_FAILED_RC = 137
 # internal network with no client involvement.
 INTERNAL_KEY_PATH = "~/.ssh/stpu_internal_key"
 
+# Wheel tree-hash of the runtime shipped to the cluster, written by
+# provisioner.setup_agent_runtime. A reused cluster whose stamp differs
+# from the client's current wheel gets the runtime re-shipped and the
+# daemon restarted (reference: sky/skylet/attempt_skylet.py:42-47
+# restarts skylet on version mismatch) — otherwise head-side job_cli /
+# daemon code silently drifts from the client after an upgrade.
+RUNTIME_VERSION_BASENAME = "runtime_version"
+RUNTIME_VERSION_PATH = f"~/.stpu_agent/{RUNTIME_VERSION_BASENAME}"
+
 # On-host layout (under the host's $HOME).
 AGENT_DIR = ".stpu_agent"
 JOBS_DB = f"{AGENT_DIR}/jobs.db"

@@ -52,6 +52,37 @@ class Daemon:
         if stpu_home:
             os.environ["STPU_HOME"] = stpu_home
         self.started_at = time.time()
+        # My own code's version, computed once: the on-disk stamp
+        # (written LAST by setup_agent_runtime) moving away from this
+        # means a newer runtime was shipped — exit so the re-shipper's
+        # restart (or the next one) runs the new code. Reference:
+        # sky/skylet/attempt_skylet.py:42-47.
+        try:
+            from skypilot_tpu.utils import wheel_utils
+            self._my_version: Optional[str] = \
+                wheel_utils.runtime_version()
+        except Exception:  # noqa: BLE001 — never block daemon boot
+            self._my_version = None
+        self._stale_ticks = 0
+
+    def runtime_stale(self) -> bool:
+        """True after TWO consecutive ticks of version mismatch (one
+        tick of slack absorbs the bring-up window where the new daemon
+        starts just before the stamp is written)."""
+        if self._my_version is None:
+            return False
+        from skypilot_tpu.agent import constants as agent_constants
+        try:
+            stamp = (self.agent_dir /
+                     agent_constants.RUNTIME_VERSION_BASENAME
+                     ).read_text().strip()
+        except OSError:
+            return False
+        if not stamp or stamp == self._my_version:
+            self._stale_ticks = 0
+            return False
+        self._stale_ticks += 1
+        return self._stale_ticks >= 2
 
     # ------------------------------------------------------------ plumbing
     def _load_json(self, name: str) -> Optional[Dict[str, Any]]:
@@ -171,10 +202,15 @@ class Daemon:
                 self.reconcile_jobs()
                 if self.check_autostop() or self.cluster_gone():
                     break
+                if self.runtime_stale():
+                    self.log("runtime version stamp changed on disk; "
+                             "exiting so the new runtime's daemon "
+                             "takes over")
+                    break
             except Exception as e:  # noqa: BLE001 — keep the loop alive
                 self.log(f"event error: {e!r}")
             time.sleep(self.interval)
-        self.log("cluster no longer running; daemon exiting")
+        self.log("daemon exiting")
         try:
             (self.agent_dir / "daemon.pid").unlink()
         except OSError:

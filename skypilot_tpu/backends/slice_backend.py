@@ -138,6 +138,7 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
                 handle = record["handle"]
                 if record["status"] == ClusterStatus.UP:
                     self.check_resources_fit_cluster(handle, task)
+                    self._ensure_agent_runtime(handle)
                     return handle
                 if record["status"] == ClusterStatus.STOPPED:
                     return self._restart_cluster(handle)
@@ -302,6 +303,43 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
         provisioner.setup_agent_runtime(handle.cluster_info,
                                         self._cluster_identity(handle))
 
+    def _ensure_agent_runtime(self, handle: SliceHandle) -> None:
+        """Repair runtime version drift on a reused UP cluster: compare
+        the head's RUNTIME_VERSION_PATH stamp with the wheel this client
+        would ship; on mismatch re-run setup_agent_runtime (re-ships the
+        wheel everywhere and restarts the head daemon). Reference:
+        sky/skylet/attempt_skylet.py:42-47 — without this, job_cli RPC
+        schema drift after a client upgrade is an undebuggable failure.
+        """
+        if handle.provider_name == "local":
+            return  # local daemon imports the client's tree directly
+        from skypilot_tpu.agent import constants as agent_constants
+        from skypilot_tpu.provision import provisioner
+        from skypilot_tpu.utils import wheel_utils
+        runner = handle.get_command_runners()[0]
+        # Always-exit-0 probe so a non-zero rc is unambiguously a
+        # TRANSPORT failure (ssh/kubectl down), not a missing stamp —
+        # re-shipping the whole runtime over a flaky connection would
+        # fail later with a misleading bring-up error.
+        rc, out, stderr = runner.run(
+            f"cat {agent_constants.RUNTIME_VERSION_PATH} 2>/dev/null"
+            " || echo __UNSTAMPED__",
+            require_outputs=True)
+        if rc != 0:
+            raise exceptions.CommandError(
+                rc, "runtime version probe",
+                f"could not reach head of {handle.cluster_name}: "
+                f"{stderr.strip()[:200]}")
+        local = wheel_utils.runtime_version()
+        remote = out.strip()
+        if remote == local:
+            return
+        print(f"Cluster {handle.cluster_name!r} runs runtime "
+              f"{'<unstamped>' if '__UNSTAMPED__' in remote else remote}"
+              f"; re-shipping {local}.")
+        provisioner.setup_agent_runtime(handle.cluster_info,
+                                        self._cluster_identity(handle))
+
     def _cluster_identity(self, handle: SliceHandle) -> Dict[str, Any]:
         """The daemon's view of who it is + how to stop itself
         (agent/daemon.py cluster.json)."""
@@ -394,37 +432,67 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
             f"{handle.cluster_name} has {handle.launched_resources}")
 
     # ------------------------------------------------------------ sync/setup
+    @staticmethod
+    def _all_hosts(runners, fn, what: str) -> None:
+        """Run ``fn(runner)`` on every host CONCURRENTLY (thread pool
+        like _setup — a serial loop multiplies launch latency by the
+        host count on big slices; reference parallelizes at
+        sky/backends/cloud_vm_ray_backend.py:3062) and aggregate ALL
+        failures, not just the first."""
+        import concurrent.futures as cf
+        with cf.ThreadPoolExecutor(
+                max_workers=min(len(runners), 32)) as pool:
+            futs = {pool.submit(fn, r): r for r in runners}
+            errors = []
+            for fut in cf.as_completed(futs):
+                try:
+                    fut.result()
+                except Exception as e:  # noqa: BLE001 — aggregate
+                    errors.append((futs[fut].node_id, e))
+        if errors:
+            detail = "; ".join(f"{nid}: {e}" for nid, e in errors)
+            raise exceptions.CommandError(
+                1, what, f"{what} failed on {len(errors)} host(s): "
+                f"{detail}")
+
     def _sync_workdir(self, handle: SliceHandle, workdir: str) -> None:
         src = os.path.abspath(os.path.expanduser(workdir))
         if not src.endswith("/"):
             src += "/"
-        for runner in handle.get_command_runners():
-            runner.rsync(src, f"~/{agent_constants.WORKDIR}/", up=True,
-                         delete=True)
+        self._all_hosts(
+            handle.get_command_runners(),
+            lambda r: r.rsync(src, f"~/{agent_constants.WORKDIR}/",
+                              up=True, delete=True),
+            "workdir sync")
 
     def _sync_file_mounts(self, handle, all_file_mounts,
                           storage_mounts) -> None:
         from skypilot_tpu.data import cloud_stores
+        runners = handle.get_command_runners()
         for dst, src in (all_file_mounts or {}).items():
             if cloud_stores.is_cloud_store_url(src):
                 cmd = self._download_cmd(src, dst)
-                for runner in handle.get_command_runners():
-                    rc = runner.run(cmd)
-                    runner.check_returncode(rc, cmd,
-                                            f"download {src} failed")
+
+                def download(r, cmd=cmd, src=src):
+                    r.check_returncode(r.run(cmd), cmd,
+                                       f"download {src} failed")
+                self._all_hosts(runners, download, f"download {src}")
             else:
                 src_abs = os.path.abspath(os.path.expanduser(src))
-                for runner in handle.get_command_runners():
-                    runner.rsync(src_abs, dst, up=True)
+                self._all_hosts(
+                    runners,
+                    lambda r, s=src_abs, d=dst: r.rsync(s, d, up=True),
+                    f"file mount {dst}")
         for dst, store in (storage_mounts or {}).items():
             if store.source:
                 # Client-side: create bucket + upload source (reference:
                 # Task.sync_storage_mounts, sky/task.py:951).
                 store.sync()
             cmd = store.mount_command(dst)
-            for runner in handle.get_command_runners():
-                rc = runner.run(cmd)
-                runner.check_returncode(rc, cmd, f"mount {dst} failed")
+
+            def mount(r, cmd=cmd, dst=dst):
+                r.check_returncode(r.run(cmd), cmd, f"mount {dst} failed")
+            self._all_hosts(runners, mount, f"storage mount {dst}")
 
     @staticmethod
     def _download_cmd(src: str, dst: str) -> str:

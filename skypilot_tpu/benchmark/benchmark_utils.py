@@ -25,34 +25,56 @@ def _cluster_name(benchmark: str, idx: int) -> str:
 
 def launch_benchmark(task, candidates: List[Resources],
                      benchmark: str) -> List[str]:
-    """Launch one cluster per candidate, all running `task` with the
-    callback summary armed. Returns the cluster names."""
+    """Launch one cluster per candidate CONCURRENTLY (a serial sweep
+    pays N× provision latency; reference launches with one thread per
+    candidate, sky/benchmark/benchmark_utils.py:546-547), all running
+    `task` with the callback summary armed. Returns the cluster names.
+
+    Any failed candidate — including Ctrl-C mid-fleet — rolls the whole
+    fleet back: every already-launched cluster is torn down and the
+    benchmark name released, so a broken sweep never leaves clusters
+    billing behind a name that blocks retry.
+    """
+    import concurrent.futures as cf
     import copy
     if not benchmark_state.add_benchmark(
             benchmark, json.dumps(task.to_yaml_config())):
         raise ValueError(
             f"Benchmark {benchmark!r} already exists; "
             f"`stpu bench delete {benchmark}` first.")
-    names = []
+
+    def launch_one(idx_res):
+        i, res = idx_res
+        cand_task = copy.deepcopy(task)
+        cand_task.set_resources(res)
+        cand_task.update_envs({ENV_LOG_DIR: _REMOTE_LOG_DIR})
+        name = _cluster_name(benchmark, i)
+        # Record BEFORE launching so rollback's teardown sweep sees a
+        # half-provisioned candidate too.
+        benchmark_state.add_result(
+            benchmark, name, str(res),
+            res.hourly_price() * cand_task.num_nodes)
+        execution.launch(cand_task, cluster_name=name,
+                         detach_run=True, stream_logs=False)
+        return name
+
+    pool = cf.ThreadPoolExecutor(max_workers=min(len(candidates), 8))
+    futs = [pool.submit(launch_one, (i, res))
+            for i, res in enumerate(candidates)]
     try:
-        for i, res in enumerate(candidates):
-            cand_task = copy.deepcopy(task)
-            cand_task.set_resources(res)
-            cand_task.update_envs({ENV_LOG_DIR: _REMOTE_LOG_DIR})
-            name = _cluster_name(benchmark, i)
-            execution.launch(cand_task, cluster_name=name,
-                             detach_run=True, stream_logs=False)
-            benchmark_state.add_result(
-                benchmark, name, str(res),
-                res.hourly_price() * cand_task.num_nodes)
-            names.append(name)
-    except Exception:
+        names = [f.result() for f in futs]
+    except BaseException:   # incl. KeyboardInterrupt mid-fleet
+        # Stop QUEUED candidates immediately (cancel_futures) — without
+        # it the executor would keep provisioning the rest of the fleet
+        # for minutes before the rollback below could tear it down.
+        pool.shutdown(wait=True, cancel_futures=True)
         # Roll back: tear down what already launched and release the
         # benchmark name, so a failed candidate N doesn't leave earlier
         # candidates billing behind a name that blocks retry.
         teardown_benchmark(benchmark)
         benchmark_state.delete_benchmark(benchmark)
         raise
+    pool.shutdown()
     return names
 
 

@@ -30,6 +30,9 @@ _NEG_INF = -1e30
 # KV sub-block width inside one ring chunk: bounds the live score
 # matrix to (B, H, Sl, _KV_BLOCK) regardless of per-shard length.
 _KV_BLOCK = 512
+# Below this block width the scan's per-step cost dominates the einsum;
+# chunk lengths with no divisor >= the floor take the pad-and-mask path.
+_KV_BLOCK_FLOOR = 128
 
 
 def _chunk_update(q, kc, vc, qpos, kpos0, m, l, acc, *, causal, scale):
@@ -54,7 +57,19 @@ def _chunk_update(q, kc, vc, qpos, kpos0, m, l, acc, *, causal, scale):
     n = kc.shape[1]
     block = max(dv for dv in range(1, min(_KV_BLOCK, n) + 1)
                 if n % dv == 0)
-    n_blocks = n // block
+    if block < _KV_BLOCK_FLOOR and n > block:
+        # Prime / small-odd-factor chunk lengths have no decent
+        # divisor: the exact-divisor path would scan thousands of
+        # 1-2-wide einsum steps (ADVICE r3 #2). Pad the chunk to a
+        # multiple of _KV_BLOCK instead and mask the tail slots out of
+        # the softmax below.
+        block = min(_KV_BLOCK, n)
+        pad = (-n) % block
+        if pad:
+            kc = jnp.pad(kc, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            vc = jnp.pad(vc, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    n_blocks = kc.shape[1] // block
+    padded = kc.shape[1] != n
     # Grouped-query form: keep K/V at KVH heads and fold the group axis
     # into the einsum instead of materializing repeated K/V.
     qg = q.reshape(b, sl, kvh, groups, d)
@@ -69,10 +84,17 @@ def _chunk_update(q, kc, vc, qpos, kpos0, m, l, acc, *, causal, scale):
         s = jnp.einsum("bqkgd,bskd->bkgqs", qg, kcj,
                        preferred_element_type=jnp.float32) * scale
         s = s.reshape(b, h, sl, block)
+        idx = j * block + jnp.arange(block)
         if causal:
-            kpos = kpos0 + j * block + jnp.arange(block)
+            kpos = kpos0 + idx
             mask = qpos[:, None] >= kpos[None, :]
+            if padded:
+                # Zero-padded tail slots would score s=0 and leak
+                # exp(-m) weight into the softmax: mask them too.
+                mask = mask & (idx < n)[None, :]
             s = jnp.where(mask[None, None], s, _NEG_INF)
+        elif padded:
+            s = jnp.where((idx < n)[None, None, None, :], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         # Guard fully-masked rows: exp(-inf - (-inf)) -> stable max.
         p = jnp.exp(s - m_new)

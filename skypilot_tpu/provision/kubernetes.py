@@ -170,8 +170,20 @@ def run_instances(region, zone, cluster_name: str,
             "and ships an ssh client (head). Set `image_id:` in the "
             "task resources.")
 
-    existing = {p["metadata"]["name"] for p in
-                _list_pods(cluster_name, namespace)}
+    existing = {}
+    for p in _list_pods(cluster_name, namespace):
+        existing[p["metadata"]["name"]] = \
+            (p.get("status") or {}).get("phase", "")
+    # A pod already in Failed/Succeeded will never become Ready again:
+    # adopting it as "resumed" makes a provision retry stall the full
+    # wait_instances timeout before failing AGAIN (ADVICE r3 #4).
+    # Delete-and-recreate instead.
+    dead = [n for n, phase in existing.items()
+            if phase in ("Failed", "Succeeded")]
+    for name in dead:
+        kubectl(["delete", "pod", name, "--ignore-not-found"],
+                namespace=namespace)
+        existing.pop(name, None)
     created: List[str] = []
     try:
         for s in range(num_slices):

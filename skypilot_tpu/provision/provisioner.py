@@ -27,6 +27,11 @@ SSH_WAIT_TIMEOUT_SECONDS = 300
 # runs the job DB and enforces autostop.
 _AGENT_START_CMD = (
     "mkdir -p ~/.stpu_agent && "
+    # Replace, never duplicate: a re-ship (version-drift repair on a
+    # reused cluster) must not leave two daemons racing over the job DB.
+    "{ [ -f ~/.stpu_agent/daemon.pid ] && "
+    "kill $(cat ~/.stpu_agent/daemon.pid) 2>/dev/null; "
+    "rm -f ~/.stpu_agent/daemon.pid; } ; "
     "nohup python3 -m skypilot_tpu.agent.daemon "
     "  > ~/.stpu_agent/daemon.out 2>&1 & "
     "echo started")
@@ -142,6 +147,8 @@ def setup_agent_runtime(info: ClusterInfo,
         "provider_config": info.provider_config,
     })
 
+    version = wheel_utils.runtime_version()
+
     def bring_up(inst):
         runner = _ssh_runner(info, inst)
         runner.rsync(str(wheel_path), "~/.stpu_wheels/", up=True)
@@ -161,6 +168,11 @@ def setup_agent_runtime(info: ClusterInfo,
                          agent_constants.INTERNAL_KEY_PATH, up=True)
             cmd += (f" && chmod 600 {agent_constants.INTERNAL_KEY_PATH}"
                     " && " + _AGENT_START_CMD)
+        # Version stamp LAST (after the daemon [re]start on the head):
+        # a partial bring-up must read as stale so the next reuse
+        # repairs it.
+        cmd += (f" && printf '%s' {shlex.quote(version)} "
+                f"> {agent_constants.RUNTIME_VERSION_PATH}")
         rc = runner.run(cmd)
         runner.check_returncode(rc, "agent bring-up",
                                 f"host {inst.instance_id}")

@@ -34,15 +34,16 @@ SSH_COMMON_OPTS = [
 
 def _run_with_log(cmd: List[str], *, log_path: Optional[str],
                   stream_logs: bool, env: Optional[Dict[str, str]] = None,
-                  cwd: Optional[str] = None) -> int:
+                  cwd: Optional[str] = None, stdin=None) -> int:
     """Run, teeing stdout/stderr to log_path; returns returncode."""
     if log_path is None and stream_logs:
-        proc = subprocess.run(cmd, env=env, cwd=cwd)
+        proc = subprocess.run(cmd, env=env, cwd=cwd, stdin=stdin)
         return proc.returncode
     log_f = open(log_path, "ab") if log_path else subprocess.DEVNULL
     try:
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                stderr=subprocess.STDOUT, env=env, cwd=cwd)
+                                stderr=subprocess.STDOUT, env=env,
+                                cwd=cwd, stdin=stdin)
         assert proc.stdout is not None
         for line in proc.stdout:
             if log_path:
@@ -54,6 +55,25 @@ def _run_with_log(cmd: List[str], *, log_path: Optional[str],
     finally:
         if log_path:
             log_f.close()
+
+
+def _script_file(script: str):
+    """Spool a shell script to an anonymous temp file for use as a
+    subprocess's stdin — env exports (task secrets among them) must ride
+    stdin, never the ssh/kubectl/docker argv, where any co-tenant user
+    can read them via `ps` (same exposure gang_exec._ssh_argv_and_script
+    was rewritten to avoid)."""
+    f = tempfile.TemporaryFile("w+b")
+    f.write(script.encode())
+    f.flush()
+    f.seek(0)
+    return f
+
+
+def _env_script(cmd: str, env: Dict[str, str]) -> str:
+    exports = "".join(f"export {k}={shlex.quote(str(v))}\n"
+                      for k, v in env.items())
+    return exports + cmd
 
 
 class CommandRunner:
@@ -117,20 +137,27 @@ class SSHCommandRunner(CommandRunner):
             require_outputs=False):
         if isinstance(cmd, list):
             cmd = " ".join(shlex.quote(c) for c in cmd)
-        env_prefix = ""
-        if env:
-            env_prefix = " ".join(
-                f"export {k}={shlex.quote(str(v))};" for k, v in
-                env.items()) + " "
         # Login shell so PATH includes user installs (reference runs
         # everything under `bash --login -c`, sky/skylet/log_lib.py:261).
-        remote = f"bash --login -c {shlex.quote(env_prefix + cmd)}"
+        # With env: the exports + command ride STDIN (`bash --login -s`)
+        # so secrets never appear in the ssh argv (visible via ps).
+        if env:
+            remote = "bash --login -s"
+            stdin = _script_file(_env_script(cmd, env))
+        else:
+            remote = f"bash --login -c {shlex.quote(cmd)}"
+            stdin = None
         full = self._ssh_base() + [f"{self.ssh_user}@{self.ip}", remote]
-        if require_outputs:
-            proc = subprocess.run(full, capture_output=True, text=True)
-            return proc.returncode, proc.stdout, proc.stderr
-        return _run_with_log(full, log_path=log_path,
-                             stream_logs=stream_logs)
+        try:
+            if require_outputs:
+                proc = subprocess.run(full, capture_output=True,
+                                      text=True, stdin=stdin)
+                return proc.returncode, proc.stdout, proc.stderr
+            return _run_with_log(full, log_path=log_path,
+                                 stream_logs=stream_logs, stdin=stdin)
+        finally:
+            if stdin is not None:
+                stdin.close()
 
     def rsync(self, source, target, *, up, delete=False, log_path=None):
         ssh_cmd = " ".join(self._ssh_base())
@@ -175,18 +202,24 @@ class KubernetesCommandRunner(CommandRunner):
             require_outputs=False):
         if isinstance(cmd, list):
             cmd = " ".join(shlex.quote(c) for c in cmd)
-        env_prefix = ""
+        # env exports over stdin, not argv — see SSHCommandRunner.run.
         if env:
-            env_prefix = " ".join(
-                f"export {k}={shlex.quote(str(v))};" for k, v in
-                env.items()) + " "
-        remote = f"bash --login -c {shlex.quote(env_prefix + cmd)}"
-        full = self._exec_argv() + [remote]
-        if require_outputs:
-            proc = subprocess.run(full, capture_output=True, text=True)
-            return proc.returncode, proc.stdout, proc.stderr
-        return _run_with_log(full, log_path=log_path,
-                             stream_logs=stream_logs)
+            full = self._exec_argv(interactive=True) + ["bash --login -s"]
+            stdin = _script_file(_env_script(cmd, env))
+        else:
+            full = self._exec_argv() + [
+                f"bash --login -c {shlex.quote(cmd)}"]
+            stdin = None
+        try:
+            if require_outputs:
+                proc = subprocess.run(full, capture_output=True,
+                                      text=True, stdin=stdin)
+                return proc.returncode, proc.stdout, proc.stderr
+            return _run_with_log(full, log_path=log_path,
+                                 stream_logs=stream_logs, stdin=stdin)
+        finally:
+            if stdin is not None:
+                stdin.close()
 
     @staticmethod
     def _sh(p: str) -> str:

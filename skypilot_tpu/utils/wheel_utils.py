@@ -6,6 +6,7 @@ content hash of the package tree; rebuilds only when sources change.
 """
 from __future__ import annotations
 
+import functools
 import hashlib
 import pathlib
 import shutil
@@ -20,11 +21,20 @@ _PKG_ROOT = pathlib.Path(__file__).resolve().parent.parent
 _REPO_ROOT = _PKG_ROOT.parent
 
 
+@functools.lru_cache(maxsize=1)
 def _tree_hash() -> str:
+    # Cached: the source tree is fixed for one client invocation, and
+    # launch paths consult the version repeatedly (reuse check, ship).
     h = hashlib.sha256()
     for p in sorted(_PKG_ROOT.rglob("*.py")):
         h.update(p.read_bytes())
     return h.hexdigest()[:16]
+
+
+def runtime_version() -> str:
+    """Content hash identifying the runtime this client would ship —
+    compared against the cluster's RUNTIME_VERSION_PATH stamp on reuse."""
+    return _tree_hash()
 
 
 def wheel_dir() -> pathlib.Path:

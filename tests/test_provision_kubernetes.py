@@ -235,3 +235,23 @@ def test_zoneless_failure_does_not_wildcard_blocklist():
     bl = Blocklist().add("tpu-v5e-8", "cloud:kubernetes")
     assert bl.blocked(k8s_res)
     assert not bl.blocked(gcp_res)
+
+
+def test_dead_pods_recreated_not_adopted(fake):
+    """ADVICE r3 #4: a pod in Failed/Succeeded can never become Ready;
+    adopting it stalls wait_instances for the full timeout. run_instances
+    must delete-and-recreate it."""
+    k8s.run_instances(None, None, "c1", _config(hosts_per_slice=2))
+    fake.set_phase("Failed")
+    rec = k8s.run_instances(None, None, "c1", _config(hosts_per_slice=2))
+    assert sorted(rec.created_instance_ids) == ["c1-s0-h0", "c1-s0-h1"]
+    assert rec.resumed_instance_ids == []
+    for pod in fake.pods.values():
+        assert pod["status"]["phase"] == "Pending"   # fresh pods
+
+    # Mixed: one Succeeded husk among Running pods — only IT recreates.
+    fake.set_phase("Running")
+    fake.pods["c1-s0-h1"]["status"]["phase"] = "Succeeded"
+    rec = k8s.run_instances(None, None, "c1", _config(hosts_per_slice=2))
+    assert rec.created_instance_ids == ["c1-s0-h1"]
+    assert rec.resumed_instance_ids == ["c1-s0-h0"]

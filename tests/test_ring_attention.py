@@ -114,3 +114,26 @@ def test_ring_multiblock_chunk_path(monkeypatch):
     ref2 = attention_ops._reference_attention(q2, k2, v2, causal=True,
                                               scale=d ** -0.5)
     assert float(jnp.max(jnp.abs(out2 - ref2))) < 2e-5
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_prime_chunk_length_pads(causal, monkeypatch):
+    """Per-shard chunk lengths with no decent divisor (ADVICE r3 #2):
+    the pad-and-mask path must stay exact — a degenerate width-1 block
+    scan was correct but pathological, and a WRONG pad mask would leak
+    zero-key weight into the softmax."""
+    # Floor above the largest divisor of 61 (prime) forces padding.
+    monkeypatch.setattr(ring_attention, "_KV_BLOCK", 16)
+    monkeypatch.setattr(ring_attention, "_KV_BLOCK_FLOOR", 8)
+    mesh = mesh_lib.make_mesh({"dp": 4, "sp": 2})
+    b, s, h, kvh, d = 4, 122, 2, 1, 16   # chunk length 61, prime
+    kq, kk, kv = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(kq, (b, s, h, d))
+    k = jax.random.normal(kk, (b, s, kvh, d))
+    v = jax.random.normal(kv, (b, s, kvh, d))
+    out = jax.jit(lambda q, k, v: ring_attention.ring_attention(
+        q, k, v, mesh=mesh, causal=causal))(q, k, v)
+    ref = attention_ops._reference_attention(q, k, v, causal=causal,
+                                             scale=None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
