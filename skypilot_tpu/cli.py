@@ -258,6 +258,35 @@ def check():
     click.echo(f"Enabled clouds: {', '.join(enabled) or 'none'}")
 
 
+@cli.group()
+def local():
+    """Laptop-local Kubernetes cluster via Kind (reference: `sky local
+    up`, sky/cli.py:5054). Tasks target it with `resources: {cloud:
+    kubernetes}`."""
+
+
+@local.command(name="up")
+@click.option("--name", default=None,
+              help="Kind cluster name (default stpu-local).")
+def local_up(name):
+    """Create a local Kind cluster for the kubernetes provider."""
+    from skypilot_tpu.utils import local_up as local_up_lib
+    ctx = local_up_lib.up(name or local_up_lib.DEFAULT_CLUSTER)
+    click.echo(f"Local Kubernetes cluster ready (context {ctx}).")
+    click.echo("Run tasks against it with:\n"
+               "  resources:\n    cloud: kubernetes")
+
+
+@local.command(name="down")
+@click.option("--name", default=None,
+              help="Kind cluster name (default stpu-local).")
+def local_down(name):
+    """Delete the local Kind cluster."""
+    from skypilot_tpu.utils import local_up as local_up_lib
+    local_up_lib.down(name or local_up_lib.DEFAULT_CLUSTER)
+    click.echo("Local Kubernetes cluster deleted.")
+
+
 @cli.command(name="cost-report")
 def cost_report():
     """Accumulated cost per cluster from recorded usage."""

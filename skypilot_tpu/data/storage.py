@@ -43,6 +43,7 @@ def shell_path(p: str) -> str:
 class StoreType(enum.Enum):
     GCS = "gcs"
     S3 = "s3"
+    R2 = "r2"
     AZURE = "azure"
     LOCAL = "local"
 
@@ -114,34 +115,87 @@ class GcsStore(AbstractStore):
 
 class S3Store(AbstractStore):
     """S3 via the aws CLI (reference: S3Store:1079). COPY works anywhere
-    the CLI + credentials exist; MOUNT uses goofys like the reference."""
+    the CLI + credentials exist; MOUNT uses goofys like the reference.
+
+    ``_aws_extra`` / ``_aws_extra_shell`` are the S3-compatibility seam:
+    R2 (and any other S3-compatible endpoint) reuses every operation by
+    appending its ``--endpoint-url``/``--profile`` flags.
+    """
+
+    _aws_extra: List[str] = []       # client-side argv suffix
+    _aws_extra_shell: str = ""       # cluster-side shell suffix
 
     def upload(self) -> None:
         if not self._bucket_exists():
-            self._run(["aws", "s3", "mb", f"s3://{self.name}"])
+            self._run(["aws", "s3", "mb", f"s3://{self.name}"]
+                      + self._aws_extra)
         if self.source:
             src = os.path.abspath(os.path.expanduser(self.source))
             if os.path.isdir(src):
-                self._run(["aws", "s3", "sync", src, f"s3://{self.name}"])
+                self._run(["aws", "s3", "sync", src,
+                           f"s3://{self.name}"] + self._aws_extra)
             else:
-                self._run(["aws", "s3", "cp", src, f"s3://{self.name}/"])
+                self._run(["aws", "s3", "cp", src,
+                           f"s3://{self.name}/"] + self._aws_extra)
 
     def _bucket_exists(self) -> bool:
         proc = subprocess.run(
-            ["aws", "s3api", "head-bucket", "--bucket", self.name],
+            ["aws", "s3api", "head-bucket", "--bucket", self.name]
+            + self._aws_extra,
             capture_output=True, text=True)
         return proc.returncode == 0
 
     def delete(self) -> None:
-        self._run(["aws", "s3", "rb", f"s3://{self.name}", "--force"])
+        self._run(["aws", "s3", "rb", f"s3://{self.name}", "--force"]
+                  + self._aws_extra)
 
     def fetch_command(self, dst: str) -> str:
         d = shell_path(dst)
         return (f"mkdir -p {d} && "
-                f"aws s3 sync s3://{self.name} {d}")
+                f"aws s3 sync s3://{self.name} {d}"
+                f"{self._aws_extra_shell}")
 
     def mount_fuse_command(self, dst: str) -> str:
         return mounting_utils.get_s3_mount_command(self.name, dst)
+
+
+def r2_endpoint_url() -> str:
+    """Cloudflare R2's S3-compatible endpoint for this account.
+
+    Account id from $R2_ACCOUNT_ID or ~/.cloudflare/accountid (the
+    reference's convention, sky/adaptors/cloudflare.py)."""
+    acct = os.environ.get("R2_ACCOUNT_ID")
+    if not acct:
+        path = os.path.expanduser("~/.cloudflare/accountid")
+        if os.path.exists(path):
+            with open(path) as f:
+                acct = f.read().strip()
+    if not acct:
+        raise exceptions.StorageUploadError(
+            "Cloudflare R2 needs an account id: set $R2_ACCOUNT_ID or "
+            "write ~/.cloudflare/accountid.")
+    return f"https://{acct}.r2.cloudflarestorage.com"
+
+
+class R2Store(S3Store):
+    """Cloudflare R2 through its S3-compatible endpoint (reference:
+    R2Store, sky/data/storage.py:2666 — R2 'uses s3:// as a prefix for
+    various aws cli commands' with --endpoint-url + --profile r2).
+    Credentials live in the aws CLI's ``r2`` profile."""
+
+    def __init__(self, name: str, source: Optional[str] = None):
+        super().__init__(name, source)
+        endpoint = r2_endpoint_url()
+        self._aws_extra = ["--endpoint-url", endpoint, "--profile", "r2"]
+        # Quoted: the account id comes from a user file and must not be
+        # able to smuggle shell into cluster-side commands.
+        self._aws_extra_shell = (f" --endpoint-url "
+                                 f"{shlex.quote(endpoint)} --profile r2")
+        self.endpoint = endpoint
+
+    def mount_fuse_command(self, dst: str) -> str:
+        return mounting_utils.get_r2_mount_command(self.name, dst,
+                                                   self.endpoint)
 
 
 class AzureBlobStore(AbstractStore):
@@ -273,6 +327,7 @@ class LocalStore(AbstractStore):
 _STORE_CLASSES = {
     StoreType.GCS: GcsStore,
     StoreType.S3: S3Store,
+    StoreType.R2: R2Store,
     StoreType.AZURE: AzureBlobStore,
     StoreType.LOCAL: LocalStore,
 }
@@ -286,7 +341,7 @@ class Storage:
           /data:
             name: my-bucket
             source: ./local_dir       # optional
-            store: gcs                # gcs | s3 | azure | local
+            store: gcs                # gcs | s3 | r2 | azure | local
             mode: MOUNT               # MOUNT | COPY
             persistent: true
     """

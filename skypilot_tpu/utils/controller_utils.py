@@ -255,7 +255,7 @@ def maybe_translate_local_file_mounts_and_sync_up(task,
 
 
 # URI scheme <-> store-type mapping for translated single-file mounts.
-_SCHEME = {"gcs": "gs", "s3": "s3", "local": "local"}
+_SCHEME = {"gcs": "gs", "s3": "s3", "r2": "r2", "local": "local"}
 _STORE_BY_SCHEME = {v: k for k, v in _SCHEME.items()}
 
 

@@ -1,0 +1,73 @@
+"""`stpu local up/down`: a Kind-backed local Kubernetes cluster.
+
+Reference analog: `sky local up` (sky/cli.py:5054-5185) — creates a
+Kind cluster so the kubernetes provider has a real, free, laptop-local
+target. Tasks then run against it with ``resources: {cloud:
+kubernetes}``. Hermetic tests monkeypatch the ``_run`` seam; the
+``--kind-live`` pytest flag exercises the real path when the binaries
+exist.
+"""
+from __future__ import annotations
+
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+DEFAULT_CLUSTER = "stpu-local"
+
+
+def _run(argv: List[str], timeout: int = 600) -> Tuple[int, str]:
+    proc = subprocess.run(argv, capture_output=True, text=True,
+                          timeout=timeout)
+    return proc.returncode, (proc.stdout + proc.stderr).strip()
+
+
+def _which(binary: str) -> Optional[str]:
+    return shutil.which(binary)
+
+
+def check_binaries() -> Optional[str]:
+    """None when kind+kubectl exist; otherwise a human explanation."""
+    missing = [b for b in ("kind", "kubectl") if _which(b) is None]
+    if missing:
+        return (f"missing {' and '.join(missing)} on PATH — install "
+                "Kind (https://kind.sigs.k8s.io) and kubectl, then "
+                "re-run `stpu local up`.")
+    return None
+
+
+def cluster_exists(name: str = DEFAULT_CLUSTER) -> bool:
+    rc, out = _run(["kind", "get", "clusters"])
+    return rc == 0 and name in out.split()
+
+
+def up(name: str = DEFAULT_CLUSTER) -> str:
+    """Create (or adopt) the Kind cluster; returns its kube context."""
+    problem = check_binaries()
+    if problem:
+        raise exceptions.SkyTpuError(f"`stpu local up`: {problem}")
+    if cluster_exists(name):
+        return f"kind-{name}"
+    rc, out = _run(["kind", "create", "cluster", "--name", name])
+    if rc != 0:
+        raise exceptions.SkyTpuError(
+            f"kind create cluster failed (rc {rc}): {out[-500:]}")
+    # Sanity: the API server answers through the context kind wrote.
+    rc, out = _run(["kubectl", "--context", f"kind-{name}",
+                    "get", "nodes"])
+    if rc != 0:
+        raise exceptions.SkyTpuError(
+            f"kind cluster up but kubectl cannot reach it: {out[-300:]}")
+    return f"kind-{name}"
+
+
+def down(name: str = DEFAULT_CLUSTER) -> None:
+    problem = check_binaries()
+    if problem:
+        raise exceptions.SkyTpuError(f"`stpu local down`: {problem}")
+    rc, out = _run(["kind", "delete", "cluster", "--name", name])
+    if rc != 0:
+        raise exceptions.SkyTpuError(
+            f"kind delete cluster failed (rc {rc}): {out[-500:]}")

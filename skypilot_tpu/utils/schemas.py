@@ -56,7 +56,7 @@ _STORAGE_SCHEMA = {
                              {"type": "array",
                               "items": {"type": "string"}}]},
         "store": {"type": "string",
-                  "enum": ["gcs", "s3", "azure", "local"]},
+                  "enum": ["gcs", "s3", "r2", "azure", "local"]},
         "persistent": {"type": "boolean"},
         "mode": {"type": "string", "enum": ["MOUNT", "COPY"]},
     },

@@ -36,16 +36,22 @@ def pytest_addoption(parser):
         "--gcp-live", action="store_true", default=False,
         help="run tests that provision REAL GCP TPUs (costs money; "
              "needs gcloud credentials + a project with TPU quota)")
+    parser.addoption(
+        "--kind-live", action="store_true", default=False,
+        help="run the Kind-backed kubernetes smoke (needs kind + "
+             "kubectl + docker on PATH; free, local)")
 
 
 def pytest_collection_modifyitems(config, items):
-    if config.getoption("--gcp-live"):
-        return
-    skip = pytest.mark.skip(
-        reason="live-cloud smoke test: pass --gcp-live to run")
-    for item in items:
-        if "gcp_live" in item.keywords:
-            item.add_marker(skip)
+    gates = (("gcp_live", "--gcp-live"), ("kind_live", "--kind-live"))
+    for marker, flag in gates:
+        if config.getoption(flag):
+            continue
+        skip = pytest.mark.skip(
+            reason=f"live smoke test: pass {flag} to run")
+        for item in items:
+            if marker in item.keywords:
+                item.add_marker(skip)
 
 
 @pytest.fixture

@@ -1,0 +1,96 @@
+"""`stpu local up/down` — hermetic (fake kind/kubectl seam) plus an
+opt-in ``--kind-live`` smoke that exercises the kubernetes provider
+end-to-end against a real Kind cluster when the binaries exist.
+
+Reference analog: `sky local up` (sky/cli.py:5054-5185).
+"""
+import shutil
+
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu import cli as cli_mod
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import local_up
+
+
+# ----------------------------------------------------------- hermetic
+class FakeKind:
+    def __init__(self):
+        self.clusters = set()
+        self.calls = []
+
+    def __call__(self, argv, timeout=600):
+        self.calls.append(argv)
+        if argv[:2] == ["kind", "get"]:
+            return 0, "\n".join(sorted(self.clusters))
+        if argv[:3] == ["kind", "create", "cluster"]:
+            self.clusters.add(argv[argv.index("--name") + 1])
+            return 0, "Creating cluster ..."
+        if argv[:3] == ["kind", "delete", "cluster"]:
+            self.clusters.discard(argv[argv.index("--name") + 1])
+            return 0, "Deleted"
+        if argv[0] == "kubectl":
+            return 0, "node/stpu-local-control-plane Ready"
+        raise AssertionError(f"unexpected argv {argv}")
+
+
+@pytest.fixture
+def fake_kind(monkeypatch):
+    fake = FakeKind()
+    monkeypatch.setattr(local_up, "_run", fake)
+    monkeypatch.setattr(local_up, "_which", lambda b: f"/usr/bin/{b}")
+    return fake
+
+
+def test_local_up_creates_and_adopts(fake_kind):
+    assert local_up.up() == "kind-stpu-local"
+    assert "stpu-local" in fake_kind.clusters
+    n_calls = len(fake_kind.calls)
+    # Second up adopts: no second create.
+    assert local_up.up() == "kind-stpu-local"
+    assert not any(c[:3] == ["kind", "create", "cluster"]
+                   for c in fake_kind.calls[n_calls:])
+    local_up.down()
+    assert "stpu-local" not in fake_kind.clusters
+
+
+def test_local_up_missing_binaries(monkeypatch):
+    monkeypatch.setattr(local_up, "_which", lambda b: None)
+    with pytest.raises(exceptions.SkyTpuError, match="missing kind"):
+        local_up.up()
+
+
+def test_cli_local_up_down(fake_kind):
+    r = CliRunner().invoke(cli_mod.cli, ["local", "up"])
+    assert r.exit_code == 0, r.output
+    assert "context kind-stpu-local" in r.output
+    assert "cloud: kubernetes" in r.output
+    r = CliRunner().invoke(cli_mod.cli, ["local", "down"])
+    assert r.exit_code == 0, r.output
+
+
+# ----------------------------------------------------------- live leg
+@pytest.mark.kind_live
+@pytest.mark.timeout(1200)
+def test_kind_launch_exec_down_live(tmp_state_dir):
+    """Real Kind cluster: launch -> exec -> down through the kubernetes
+    provider (single pod; the slim default image needs no sshd)."""
+    if any(shutil.which(b) is None for b in ("kind", "kubectl",
+                                             "docker")):
+        pytest.skip("kind/kubectl/docker not on PATH")
+    from skypilot_tpu import core, execution
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+
+    local_up.up("stpu-test-live")
+    try:
+        task = Task("kind-smoke", run="echo kind-says-$((6*7))")
+        task.set_resources(Resources(cloud="kubernetes"))
+        job_id, handle = execution.launch(task,
+                                          cluster_name="kind-smoke-c")
+        assert handle is not None
+        core.tail_logs("kind-smoke-c", job_id)
+        core.down("kind-smoke-c")
+    finally:
+        local_up.down("stpu-test-live")

@@ -172,3 +172,58 @@ def test_azure_upload_calls_az_cli(tmp_state_dir, tmp_path, monkeypatch):
     sto.store.upload()
     assert calls[0][:4] == ["az", "storage", "container", "create"]
     assert any("upload-batch" in " ".join(c) for c in calls)
+
+
+def test_r2_command_generation(monkeypatch):
+    """R2 = S3 against the account's S3-compatible endpoint, `r2` aws
+    profile (reference: R2Store, sky/data/storage.py:2666)."""
+    monkeypatch.setenv("R2_ACCOUNT_ID", "acct42")
+    s = storage_lib.R2Store("bkt")
+    ep = "https://acct42.r2.cloudflarestorage.com"
+    fetch = s.fetch_command("/data")
+    assert "aws s3 sync s3://bkt /data" in fetch
+    assert f"--endpoint-url {ep}" in fetch and "--profile r2" in fetch
+    mount = s.mount_fuse_command("/data")
+    assert "goofys" in mount and ep in mount
+    assert "AWS_PROFILE=r2" in mount and "mountpoint -q" in mount
+    # Client-side argv carries the endpoint too.
+    calls = []
+    monkeypatch.setattr(storage_lib.subprocess, "run",
+                        lambda cmd, **kw: (calls.append(cmd),
+                                           type("P", (), {
+                                               "returncode": 0,
+                                               "stdout": "",
+                                               "stderr": ""})())[1])
+    s._bucket_exists()
+    s.delete()
+    for cmd in calls:
+        assert "--endpoint-url" in cmd and ep in cmd
+        assert "--profile" in cmd and "r2" in cmd
+
+
+def test_r2_account_id_from_cloudflare_file(tmp_path, monkeypatch):
+    monkeypatch.delenv("R2_ACCOUNT_ID", raising=False)
+    monkeypatch.setenv("HOME", str(tmp_path))
+    (tmp_path / ".cloudflare").mkdir()
+    (tmp_path / ".cloudflare" / "accountid").write_text("abc123\n")
+    assert storage_lib.r2_endpoint_url() == \
+        "https://abc123.r2.cloudflarestorage.com"
+    (tmp_path / ".cloudflare" / "accountid").unlink()
+    with pytest.raises(Exception, match="account id"):
+        storage_lib.r2_endpoint_url()
+
+
+def test_r2_download_command(monkeypatch):
+    monkeypatch.setenv("R2_ACCOUNT_ID", "acct42")
+    cmd = cloud_stores.get_storage_from_path(
+        "r2://b/x").make_download_command("r2://b/x", "/d/x")
+    assert "aws s3 cp s3://b/x" in cmd
+    assert "--endpoint-url https://acct42.r2.cloudflarestorage.com" \
+        in cmd
+    assert cloud_stores.is_cloud_store_url("r2://b")
+
+
+def test_storage_yaml_accepts_r2(monkeypatch):
+    monkeypatch.setenv("R2_ACCOUNT_ID", "acct42")
+    st = storage_lib.Storage(name="b", store="r2", mode="COPY")
+    assert isinstance(st.store, storage_lib.R2Store)

@@ -1,0 +1,78 @@
+"""Mixtral MoE decode throughput on the real chip.
+
+Measures serve-side incremental decode (prefill + cached top-2
+dense-routed expert MLP) in tokens/second at a fixed batch — the number
+behind docs/performance.md's MoE serving row. The model is the 8-expert
+Mixtral structure scaled to fit one v5e chip (the full 8x7B needs a
+pod slice).
+
+Usage: python tools/bench_moe_decode.py [--batch 8] [--tokens 128]
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import mixtral
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--tokens", type=int, default=128)
+    p.add_argument("--dim", type=int, default=1024)
+    p.add_argument("--layers", type=int, default=8)
+    p.add_argument("--experts", type=int, default=8)
+    args = p.parse_args()
+
+    cfg = dataclasses.replace(
+        mixtral.MixtralConfig.mixtral_8x7b(),
+        vocab_size=32768, dim=args.dim, n_layers=args.layers,
+        n_heads=16, n_kv_heads=8, mlp_dim=3584,
+        n_experts=args.experts, max_seq_len=2048)
+    params = mixtral.init(cfg, jax.random.key(0))
+    b, s = args.batch, args.prompt_len
+    prompt = jax.random.randint(jax.random.key(1), (b, s), 0,
+                                cfg.vocab_size)
+    max_seq = s + args.tokens
+
+    # Jitted end-to-end like the serving recipe (recipes/serve_llm.py
+    # _decode): unjitted, every eager op pays the tunnel's dispatch
+    # latency and the measurement is of the host, not the chip.
+    decode_jit = jax.jit(
+        lambda p, pr, tl: mixtral.decode(cfg, p, pr, tl, args.tokens,
+                                         max_seq))
+
+    def run():
+        out = decode_jit(params, prompt, jnp.int32(s))
+        return int(out[0, -1])  # value fetch forces completion
+
+    run()                      # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    toks = b * args.tokens
+    print(json.dumps({
+        "model": {"dim": cfg.dim, "layers": cfg.n_layers,
+                  "experts": cfg.n_experts, "mlp_dim": cfg.mlp_dim,
+                  "params": sum(x.size for x in
+                                jax.tree.leaves(params))},
+        "batch": b,
+        "prompt_len": s,
+        "decode_tokens": args.tokens,
+        "decode_seconds": round(best, 3),
+        "tokens_per_sec": round(toks / best, 1),
+        "ms_per_token_per_seq": round(best / args.tokens * 1e3, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()
