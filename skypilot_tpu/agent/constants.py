@@ -31,6 +31,30 @@ MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"
 # during the run. For SSH hosts the coordinator is reached through an SSH
 # reverse tunnel bound on this fixed remote port.
 GANG_COORD_ADDR = "STPU_GANG_COORD_ADDR"         # host:port for the wrapper
+# Auth token for the DIRECT-CONNECT coordinator mode (agent transport:
+# the coordinator binds the pod network instead of hiding behind an
+# ssh reverse tunnel). Rides the worker's env script, never argv.
+GANG_COORD_TOKEN = "STPU_GANG_COORD_TOKEN"
+# Remote-exec agent (agent/exec_server.py): the sshd replacement for
+# kubernetes worker pods. The token is an independent random secret
+# generated next to the cluster keypair and shipped at bring-up —
+# presenting it grants exec on worker pods, so it must never be
+# derivable from public material.
+EXEC_PORT = 8479
+EXEC_TOKEN_PATH = "~/.stpu_agent/exec_token"
+# Fixed auth-token width shared by the exec protocol and the
+# direct-connect gang coordinator (hostagent.cc kTokenLen is the one
+# unavoidable duplicate).
+TOKEN_LEN = 32
+
+
+def pad_token(token: str) -> str:
+    """Normalize to exactly TOKEN_LEN chars; empty stays empty (it
+    selects the loopback-only, unauthenticated coordinator mode and is
+    REJECTED outright by the exec server)."""
+    if not token:
+        return ""
+    return token[:TOKEN_LEN].ljust(TOKEN_LEN, "0")
 GANG_BARRIER_TIMEOUT_SECONDS = 600               # slowest-host allowance
 HEARTBEAT_TIMEOUT_MS = 15_000
 # Exit code recorded for ranks force-cancelled because the gang failed

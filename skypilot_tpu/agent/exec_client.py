@@ -1,0 +1,76 @@
+"""Client for agent/exec_server.py — the `ssh` drop-in the gang driver
+uses for Kubernetes worker pods.
+
+Reads the script from STDIN (env exports + command, same privacy
+contract as the ssh transport: nothing secret in argv), streams the
+remote output to stdout, exits with the remote return code. Killing
+this process closes the socket, which makes the server kill the remote
+command's process group — ssh-session semantics.
+"""
+from __future__ import annotations
+
+import argparse
+import socket
+import struct
+import sys
+
+from skypilot_tpu.agent.constants import pad_token
+from skypilot_tpu.agent.exec_server import RC_TRAILER, read_token
+
+
+def run(host: str, port: int, script: bytes, token: str,
+        out=None) -> int:
+    out = out or sys.stdout.buffer
+    with socket.create_connection((host, port), timeout=30) as sock:
+        sock.sendall(pad_token(token).encode())
+        sock.sendall(struct.pack(">I", len(script)) + script)
+        sock.settimeout(None)
+        buf = b""
+        while True:
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+            # Stream everything before a potential trailer; keep a tail
+            # large enough that a split trailer is never flushed early.
+            keep = len(RC_TRAILER) + 16
+            if len(buf) > keep:
+                out.write(buf[:-keep])
+                out.flush()
+                buf = buf[-keep:]
+    idx = buf.rfind(RC_TRAILER)
+    if idx < 0:
+        out.write(buf)
+        out.flush()
+        return 255  # server died before reporting a return code
+    out.write(buf[:idx])
+    out.flush()
+    try:
+        return int(buf[idx + len(RC_TRAILER):].split()[0])
+    except (ValueError, IndexError):
+        return 255
+
+
+def main() -> None:
+    import os
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", required=True)
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--token-file", default=None)
+    args = parser.parse_args()
+    # Token sources, most-specific first: explicit file, process env
+    # (the gang driver passes it this way — local env, never argv),
+    # the head's own ~/.stpu_agent/exec_token.
+    if args.token_file:
+        with open(args.token_file) as f:
+            token = f.read().strip()
+    elif os.environ.get("STPU_EXEC_TOKEN"):
+        token = os.environ["STPU_EXEC_TOKEN"]
+    else:
+        token = read_token()
+    script = sys.stdin.buffer.read()
+    sys.exit(run(args.host, args.port, script, token))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,167 @@
+"""Token-authenticated remote-exec server: the sshd replacement for
+Kubernetes worker pods.
+
+The reference reaches worker pods over pod-IP SSH, which forces every
+multi-host image to run sshd (their bootstrap installs openssh). Here
+the head's gang driver connects to this server instead — any image with
+python3 works. Protocol (one TCP connection per command):
+
+    client -> 32-byte token
+    client -> 4-byte big-endian script length + script bytes
+    server -> combined stdout/stderr stream of `bash --login -s` running
+              the script (env exports INSIDE the script, never argv)
+    server -> b"\\n__STPU_RC__ <rc>\\n" trailer, then EOF
+
+Connection drop kills the command's whole process group — exactly the
+ssh-session semantics the gang driver's terminate path relies on. The
+token is sha256 of the cluster's internal PUBLIC key (present on every
+host via authorized_keys; never a private secret), written to
+``~/.stpu_agent/exec_token`` by the provisioner.
+"""
+from __future__ import annotations
+
+import argparse
+import hmac
+import os
+import pathlib
+import signal
+import socket
+import socketserver
+import struct
+import subprocess
+import threading
+
+from skypilot_tpu.agent.constants import (EXEC_PORT as DEFAULT_PORT,
+                                          TOKEN_LEN, pad_token)
+
+RC_TRAILER = b"\n__STPU_RC__ "
+MAX_SCRIPT = 16 * 1024 * 1024
+
+
+def read_token(home: str | None = None) -> str:
+    base = pathlib.Path(home or os.path.expanduser("~"))
+    return (base / ".stpu_agent" / "exec_token").read_text().strip()
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    token: str = ""
+    home: str | None = None
+
+    def handle(self) -> None:
+        sock = self.request
+        sock.settimeout(15)
+        try:
+            got = _recv_exact(sock, TOKEN_LEN)
+            if not hmac.compare_digest(got, self.token.encode()):
+                return  # silent close on bad token
+            (length,) = struct.unpack(">I", _recv_exact(sock, 4))
+            if length > MAX_SCRIPT:
+                return
+            script = _recv_exact(sock, length)
+        except (OSError, ConnectionError):
+            return
+        sock.settimeout(None)
+        env = dict(os.environ)
+        if self.home:
+            env["HOME"] = self.home
+        proc = subprocess.Popen(
+            ["bash", "--login", "-s"], stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            cwd=os.path.expanduser(self.home or "~"), env=env,
+            start_new_session=True)
+        assert proc.stdin is not None and proc.stdout is not None
+
+        def feed():
+            try:
+                proc.stdin.write(script)
+                proc.stdin.close()
+            except OSError:
+                pass
+
+        threading.Thread(target=feed, daemon=True).start()
+
+        # Watch for the CLIENT dropping the connection: that is the
+        # terminate signal (ssh-session semantics) — kill the process
+        # group so the command and its children die with the caller.
+        done = threading.Event()
+
+        def watch_peer():
+            try:
+                sock.settimeout(None)
+                while not done.is_set():
+                    try:
+                        data = sock.recv(1, socket.MSG_DONTWAIT)
+                    except BlockingIOError:
+                        done.wait(0.5)
+                        continue
+                    except OSError:
+                        data = b""
+                    if not data:
+                        break
+                    # Clients never send post-script bytes; ignore any.
+            finally:
+                if not done.is_set():
+                    try:
+                        os.killpg(proc.pid, signal.SIGTERM)
+                    except (ProcessLookupError, OSError):
+                        pass
+
+        threading.Thread(target=watch_peer, daemon=True).start()
+        try:
+            # read1: forward bytes as soon as ANY are available —
+            # read() would buffer a full 64KiB before the head's
+            # node log sees a line (ssh streams incrementally; so
+            # must this).
+            for chunk in iter(lambda: proc.stdout.read1(65536), b""):
+                sock.sendall(chunk)
+            rc = proc.wait()
+            sock.sendall(RC_TRAILER + str(rc).encode() + b"\n")
+        except OSError:
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, OSError):
+                pass
+        finally:
+            done.set()
+
+
+class ExecServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, port: int, token: str,
+                 home: str | None = None):
+        if not token or not token.strip():
+            # A server with a predictable/empty token on 0.0.0.0 would
+            # be unauthenticated remote exec on the pod network.
+            raise ValueError(
+                "exec server refuses to start without a token "
+                "(empty ~/.stpu_agent/exec_token?)")
+        handler = type("Handler", (_Handler,),
+                       {"token": pad_token(token.strip()),
+                        "home": home})
+        super().__init__(("0.0.0.0", port), handler)
+        self.port = self.server_address[1]
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--port", type=int, default=DEFAULT_PORT)
+    parser.add_argument("--home", default=None)
+    args = parser.parse_args()
+    server = ExecServer(args.port, read_token(args.home), args.home)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -62,7 +62,8 @@ def _build_env(spec: Dict, rank: int) -> Dict[str, str]:
 
 
 def _ssh_argv_and_script(host: Dict, cmd: str, env: Dict[str, str],
-                         coord_port: Optional[int]):
+                         coord_port: Optional[int],
+                         coord_token: str = ""):
     """Build the ssh argv and the stdin script for one worker.
 
     Separated (and env-free in argv) so tests can assert no secret ever
@@ -81,6 +82,10 @@ def _ssh_argv_and_script(host: Dict, cmd: str, env: Dict[str, str],
         # rather than silently cross-wire two gangs.
         env = dict(env)
         env[constants.GANG_COORD_ADDR] = f"127.0.0.1:{coord_port}"
+        if coord_token:
+            # Mixed gang with agent workers: the coordinator is in
+            # token mode, so ssh ranks must present the token too.
+            env[constants.GANG_COORD_TOKEN] = coord_token
         opts += ["-o", "ExitOnForwardFailure=yes",
                  "-R", f"{coord_port}:127.0.0.1:{coord_port}"]
         cmd = (f"python3 -m skypilot_tpu.agent.host_wrapper "
@@ -101,7 +106,8 @@ class _HostProc:
 
     def __init__(self, host: Dict, rank: int, cmd: str,
                  env: Dict[str, str], log_path: str,
-                 coord_port: Optional[int] = None):
+                 coord_port: Optional[int] = None,
+                 coord_token: str = "", head_ip: str = ""):
         self.rank = rank
         self.host = host
         self.returncode: Optional[int] = None
@@ -112,6 +118,11 @@ class _HostProc:
             if coord_port is not None:
                 env = dict(env)
                 env[constants.GANG_COORD_ADDR] = f"127.0.0.1:{coord_port}"
+                if coord_token:
+                    # Mixed gang (agent workers): the coordinator runs
+                    # token-authenticated, so EVERY rank must present
+                    # the token — including the head's own.
+                    env[constants.GANG_COORD_TOKEN] = coord_token
                 cmd = (f"{sys.executable} -m "
                        f"skypilot_tpu.agent.host_wrapper "
                        f"{shlex.quote(cmd)}")
@@ -126,6 +137,8 @@ class _HostProc:
                 env = dict(env)
                 env[constants.GANG_COORD_ADDR] = \
                     f"127.0.0.1:{coord_port}"
+                if coord_token:
+                    env[constants.GANG_COORD_TOKEN] = coord_token
                 # The wrapper runs with cwd=host_dir; make the package
                 # importable from wherever this driver imported it.
                 import skypilot_tpu
@@ -145,9 +158,46 @@ class _HostProc:
                 ["bash", "-c", cmd], stdout=log_f,
                 stderr=subprocess.STDOUT, env=full_env,
                 cwd=host["host_dir"], start_new_session=True)
+        elif host["kind"] == "agent":
+            # sshd-free worker transport (kubernetes pods): the exec
+            # agent on the worker runs the script; this local client
+            # process streams its output and mirrors its rc, so the
+            # ssh-shaped wait/terminate machinery applies unchanged
+            # (killing the client drops the socket, which makes the
+            # server kill the remote process group). The worker reaches
+            # the gang coordinator DIRECTLY over the pod network, token
+            # authenticated — no reverse tunnel.
+            if coord_port is not None:
+                env = dict(env)
+                env[constants.GANG_COORD_ADDR] = \
+                    f"{head_ip}:{coord_port}"
+                env[constants.GANG_COORD_TOKEN] = coord_token
+                cmd = (f"python3 -m skypilot_tpu.agent.host_wrapper "
+                       f"{shlex.quote(cmd)}")
+            exports = "\n".join(
+                f"export {k}={shlex.quote(str(v))}"
+                for k, v in env.items())
+            script = f"{exports}\n{cmd}\n"
+            argv = [sys.executable, "-m",
+                    "skypilot_tpu.agent.exec_client",
+                    "--host", host["ip"],
+                    "--port", str(host.get("port",
+                                           constants.EXEC_PORT))]
+            client_env = dict(os.environ)
+            if coord_token:
+                # Exec-server auth token for the client, via its LOCAL
+                # process env (never argv).
+                client_env["STPU_EXEC_TOKEN"] = coord_token
+            self.proc = subprocess.Popen(
+                argv, stdin=subprocess.PIPE, stdout=log_f,
+                stderr=subprocess.STDOUT, start_new_session=True,
+                env=client_env)
+            assert self.proc.stdin is not None
+            self.proc.stdin.write(script.encode())
+            self.proc.stdin.close()
         else:  # ssh
             argv, script = _ssh_argv_and_script(host, cmd, env,
-                                                coord_port)
+                                                coord_port, coord_token)
             # The env exports (including user secrets from `envs:`) and
             # the command travel on STDIN, never in argv: ssh argv is
             # visible to every user on a shared host via `ps`.
@@ -190,12 +240,29 @@ def run_gang(spec: Dict) -> int:
     # host is detected, not just an exited one.
     coord = None
     coord_port = None
+    coord_token = ""
+    # Agent-transport hosts (kubernetes pods) authenticate both the
+    # exec server AND the gang coordinator with the cluster token the
+    # provisioner shipped; the coordinator then network-binds so pods
+    # connect DIRECTLY (no ssh reverse tunnel exists for them).
+    if any(h.get("kind") == "agent" for h in spec["hosts"]):
+        from skypilot_tpu.agent import exec_server
+        coord_token = exec_server.read_token(home)
+        if not coord_token:
+            # An empty token would silently bind the coordinator
+            # loopback-only while agent workers dial the head IP — a
+            # 600s barrier hang instead of an error. Fail fast.
+            job_lib.set_status(job_id, job_lib.JobStatus.FAILED, home)
+            raise RuntimeError(
+                "agent-transport gang needs a non-empty exec token "
+                "(~/.stpu_agent/exec_token on the head)")
     if spec.get("use_gang_agent", True) and len(spec["hosts"]) > 1:
         from skypilot_tpu.agent import native
         try:
             coord = native.Coordinator(
                 len(spec["hosts"]),
-                heartbeat_timeout_ms=constants.HEARTBEAT_TIMEOUT_MS)
+                heartbeat_timeout_ms=constants.HEARTBEAT_TIMEOUT_MS,
+                token=coord_token)
             coord_port = coord.port
         except OSError:
             coord = None
@@ -214,7 +281,9 @@ def run_gang(spec: Dict) -> int:
         env = _build_env(spec, rank)
         procs.append(_HostProc(host, rank, spec["run_cmd"], env,
                                str(log_dir / f"node-{rank}.log"),
-                               coord_port=coord_port))
+                               coord_port=coord_port,
+                               coord_token=coord_token,
+                               head_ip=spec["node_ips"][0]))
 
     # Wait with gang semantics: first failure cancels the rest.
     failed_rank: Optional[int] = None

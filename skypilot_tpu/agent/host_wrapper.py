@@ -57,8 +57,10 @@ def main() -> int:
                 from skypilot_tpu.agent import native
                 host, port = coord_addr.rsplit(":", 1)
                 try:
-                    bad = native.Client(host, int(port), rank,
-                                        timeout_ms=5000)
+                    bad = native.Client(
+                        host, int(port), rank, timeout_ms=5000,
+                        token=os.environ.get(
+                            constants.GANG_COORD_TOKEN, ""))
                     bad.abort()
                     bad.close()
                 except OSError:
@@ -72,7 +74,8 @@ def main() -> int:
         try:
             client = native.Client(
                 host, int(port), rank,
-                timeout_ms=constants.GANG_BARRIER_TIMEOUT_SECONDS * 1000)
+                timeout_ms=constants.GANG_BARRIER_TIMEOUT_SECONDS * 1000,
+                token=os.environ.get(constants.GANG_COORD_TOKEN, ""))
         except OSError as e:
             print(f"[wrapper rank {rank}] coordinator unreachable: {e}",
                   file=sys.stderr, flush=True)

@@ -95,7 +95,7 @@ def _load_lib() -> Optional[ctypes.CDLL]:
             return None
         lib.stpu_coord_create.restype = ctypes.c_void_p
         lib.stpu_coord_create.argtypes = [ctypes.c_int, ctypes.c_int,
-                                          ctypes.c_int]
+                                          ctypes.c_int, ctypes.c_char_p]
         lib.stpu_coord_port.argtypes = [ctypes.c_void_p]
         lib.stpu_coord_wait_ready.argtypes = [ctypes.c_void_p,
                                               ctypes.c_int]
@@ -105,7 +105,7 @@ def _load_lib() -> Optional[ctypes.CDLL]:
         lib.stpu_client_connect.restype = ctypes.c_void_p
         lib.stpu_client_connect.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int]
+            ctypes.c_int, ctypes.c_char_p]
         lib.stpu_client_barrier.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                             ctypes.c_int]
         lib.stpu_client_failed_rank.argtypes = [ctypes.c_void_p]
@@ -124,10 +124,11 @@ def native_available() -> bool:
 # --------------------------------------------------------------------------
 class _NativeCoordinator:
     def __init__(self, num_hosts: int, port: int = 0,
-                 heartbeat_timeout_ms: int = 10_000):
+                 heartbeat_timeout_ms: int = 10_000, token: str = ""):
         self._lib = _load_lib()
-        self._h = self._lib.stpu_coord_create(port, num_hosts,
-                                              heartbeat_timeout_ms)
+        self._h = self._lib.stpu_coord_create(
+            port, num_hosts, heartbeat_timeout_ms,
+            _pad_token(token).encode())
         if not self._h:
             raise OSError("host-agent coordinator failed to bind")
         self.port = self._lib.stpu_coord_port(self._h)
@@ -152,12 +153,12 @@ class _NativeCoordinator:
 class _NativeClient:
     def __init__(self, host: str, port: int, rank: int,
                  timeout_ms: int = 30_000,
-                 heartbeat_interval_ms: int = 1_000):
+                 heartbeat_interval_ms: int = 1_000, token: str = ""):
         self._lib = _load_lib()
         host_ip = socket.gethostbyname(host)
         self._h = self._lib.stpu_client_connect(
             host_ip.encode(), port, rank, timeout_ms,
-            heartbeat_interval_ms)
+            heartbeat_interval_ms, _pad_token(token).encode())
         if not self._h:
             raise OSError(
                 f"host-agent client rank {rank} failed to reach "
@@ -206,11 +207,32 @@ def _send_msg(sock: socket.socket, mtype: int, rank: int,
         return False
 
 
+# Pre-register auth token (hostagent.cc kTokenLen) used by the
+# direct-connect (network-bound) coordinator mode.
+from skypilot_tpu.agent.constants import TOKEN_LEN  # noqa: E402
+from skypilot_tpu.agent.constants import pad_token as _pad_token  # noqa: E402
+
+
+def _recv_token_ok(sock: socket.socket, want: str) -> bool:
+    try:
+        buf = b""
+        while len(buf) < TOKEN_LEN:
+            chunk = sock.recv(TOKEN_LEN - len(buf))
+            if not chunk:
+                return False
+            buf += chunk
+    except OSError:
+        return False
+    import hmac
+    return hmac.compare_digest(buf, want.encode())
+
+
 class _PyCoordinator:
     def __init__(self, num_hosts: int, port: int = 0,
-                 heartbeat_timeout_ms: int = 10_000):
+                 heartbeat_timeout_ms: int = 10_000, token: str = ""):
         self.num_hosts = num_hosts
         self.heartbeat_timeout_ms = heartbeat_timeout_ms
+        self._token = _pad_token(token)
         self._failed_rank = -1
         self._stop = False
         self._cond = threading.Condition()
@@ -219,9 +241,13 @@ class _PyCoordinator:
         self._barrier_waiters: Dict[int, set] = {}
         self._listen = socket.socket()
         self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        # Loopback only (matches hostagent.cc): the protocol is
-        # unauthenticated; remote hosts come in via SSH reverse tunnel.
-        self._listen.bind(("127.0.0.1", port))
+        # Loopback only WITHOUT a token (matches hostagent.cc): the
+        # unauthenticated protocol must not be network-reachable; remote
+        # hosts come in via SSH reverse tunnel. WITH a token the
+        # coordinator binds the network and each connection must present
+        # the 32-char token before REGISTER (direct-connect transports —
+        # kubernetes pods — need no tunnel).
+        self._listen.bind(("" if self._token else "127.0.0.1", port))
         self._listen.listen(num_hosts + 8)
         self.port = self._listen.getsockname()[1]
         threading.Thread(target=self._accept_loop, daemon=True).start()
@@ -277,6 +303,9 @@ class _PyCoordinator:
 
     def _reader_loop(self, conn: socket.socket) -> None:
         conn.settimeout(10.0)  # bound the registration read
+        if self._token and not _recv_token_ok(conn, self._token):
+            conn.close()
+            return
         try:
             msg = _recv_msg(conn)
         except OSError:
@@ -358,9 +387,10 @@ class _PyCoordinator:
 class _PyClient:
     def __init__(self, host: str, port: int, rank: int,
                  timeout_ms: int = 30_000,
-                 heartbeat_interval_ms: int = 1_000):
+                 heartbeat_interval_ms: int = 1_000, token: str = ""):
         self.rank = rank
         self.heartbeat_interval_ms = heartbeat_interval_ms
+        self._token = _pad_token(token)
         self._failed_rank = -1
         self._released = set()
         self._registered = False
@@ -382,6 +412,11 @@ class _PyClient:
                           f"{host}:{port}: {last_err}")
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock.settimeout(None)
+        if self._token:
+            try:
+                self._sock.sendall(self._token.encode())
+            except OSError:
+                raise OSError(f"client rank {rank}: token send failed")
         if not _send_msg(self._sock, _REGISTER, rank, 0):
             raise OSError(f"client rank {rank}: register failed")
         threading.Thread(target=self._reader_loop, daemon=True).start()
@@ -474,15 +509,20 @@ class _PyClient:
 # Public factories: native if buildable, Python otherwise.
 # --------------------------------------------------------------------------
 def Coordinator(num_hosts: int, port: int = 0,
-                heartbeat_timeout_ms: int = 10_000):
+                heartbeat_timeout_ms: int = 10_000, token: str = ""):
+    """``token`` non-empty switches to the authenticated direct-connect
+    mode: network bind + mandatory 32-char token per connection (the
+    sshd-free kubernetes transport); empty keeps loopback-only."""
     if native_available():
-        return _NativeCoordinator(num_hosts, port, heartbeat_timeout_ms)
-    return _PyCoordinator(num_hosts, port, heartbeat_timeout_ms)
+        return _NativeCoordinator(num_hosts, port, heartbeat_timeout_ms,
+                                  token)
+    return _PyCoordinator(num_hosts, port, heartbeat_timeout_ms, token)
 
 
 def Client(host: str, port: int, rank: int, timeout_ms: int = 30_000,
-           heartbeat_interval_ms: int = 1_000):
+           heartbeat_interval_ms: int = 1_000, token: str = ""):
     if native_available():
         return _NativeClient(host, port, rank, timeout_ms,
-                             heartbeat_interval_ms)
-    return _PyClient(host, port, rank, timeout_ms, heartbeat_interval_ms)
+                             heartbeat_interval_ms, token)
+    return _PyClient(host, port, rank, timeout_ms,
+                     heartbeat_interval_ms, token)

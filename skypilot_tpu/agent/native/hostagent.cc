@@ -42,6 +42,20 @@ namespace {
 
 constexpr uint32_t kMagic = 0x53545055;  // "STPU"
 constexpr int kRegisterTimeoutSec = 10;
+// Fixed-width pre-register auth token (hex chars). Empty token => the
+// legacy loopback-only mode; non-empty => the coordinator binds the
+// network (pod/VM internal IP) and every connection must present the
+// token before its REGISTER — the authenticated direct-connect mode
+// the sshd-free Kubernetes transport uses (no reverse tunnel).
+constexpr size_t kTokenLen = 32;
+
+bool TokenMatches(const char* got, const std::string& want) {
+  // Constant-time-ish compare: no early exit on mismatch.
+  unsigned diff = 0;
+  for (size_t i = 0; i < kTokenLen; ++i)
+    diff |= static_cast<unsigned>(got[i] ^ want[i]);
+  return diff == 0;
+}
 
 enum MsgType : uint32_t {
   kRegister = 1,
@@ -101,20 +115,26 @@ bool SendMsg(int fd, uint32_t type, int32_t rank, int32_t arg) {
 
 class Coordinator {
  public:
-  Coordinator(int port, int num_hosts, int heartbeat_timeout_ms)
+  Coordinator(int port, int num_hosts, int heartbeat_timeout_ms,
+              const char* token)
       : num_hosts_(num_hosts),
         heartbeat_timeout_ms_(heartbeat_timeout_ms),
+        token_(token ? token : ""),
         failed_rank_(-1),
         stop_(false) {
+    if (!token_.empty()) token_.resize(kTokenLen, '0');
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     int one = 1;
     ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
-    // Loopback only: local hosts and SSH hosts both reach the coordinator
-    // via 127.0.0.1 (reverse tunnel, gang_exec.py); the protocol is
-    // unauthenticated so it must not be reachable from the network.
-    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    // Loopback only WITHOUT a token: local hosts and SSH hosts both
+    // reach the coordinator via 127.0.0.1 (reverse tunnel,
+    // gang_exec.py); the unauthenticated protocol must not be
+    // network-reachable. WITH a token, bind the network: direct-connect
+    // transports (kubernetes pods) authenticate per connection.
+    addr.sin_addr.s_addr =
+        token_.empty() ? htonl(INADDR_LOOPBACK) : htonl(INADDR_ANY);
     addr.sin_port = htons(static_cast<uint16_t>(port));
     if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
                sizeof(addr)) != 0 ||
@@ -209,6 +229,14 @@ class Coordinator {
   }
 
   void ReaderLoop(int fd) {
+    if (!token_.empty()) {
+      char got[kTokenLen];
+      if (!RecvAll(fd, got, sizeof(got)) || !TokenMatches(got, token_)) {
+        DropPending(fd);
+        ::close(fd);
+        return;
+      }
+    }
     Msg m{};
     if (!RecvAll(fd, &m, sizeof(m)) || m.magic != kMagic ||
         m.type != kRegister) {
@@ -303,6 +331,7 @@ class Coordinator {
 
   int num_hosts_;
   int heartbeat_timeout_ms_;
+  std::string token_;
   int listen_fd_ = -1;
   int port_ = 0;
   std::atomic<int> failed_rank_;
@@ -324,12 +353,14 @@ class Coordinator {
 class Client {
  public:
   Client(const char* host, int port, int rank, int timeout_ms,
-         int heartbeat_interval_ms)
+         int heartbeat_interval_ms, const char* token)
       : rank_(rank),
         heartbeat_interval_ms_(heartbeat_interval_ms),
+        token_(token ? token : ""),
         failed_rank_(-1),
         registered_(false),
         stop_(false) {
+    if (!token_.empty()) token_.resize(kTokenLen, '0');
     fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
@@ -351,6 +382,11 @@ class Client {
     }
     int one = 1;
     ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (!token_.empty() &&
+        !SendAll(fd_, token_.data(), kTokenLen)) {
+      Close();
+      return;
+    }
     if (!SendMsg(fd_, kRegister, rank_, 0)) {
       Close();
       return;
@@ -457,6 +493,7 @@ class Client {
 
   int rank_;
   int heartbeat_interval_ms_;
+  std::string token_;
   std::atomic<int> fd_{-1};
   std::atomic<int> failed_rank_;
   bool registered_;
@@ -477,8 +514,9 @@ class Client {
 extern "C" {
 
 void* stpu_coord_create(int port, int num_hosts,
-                        int heartbeat_timeout_ms) {
-  auto* c = new Coordinator(port, num_hosts, heartbeat_timeout_ms);
+                        int heartbeat_timeout_ms, const char* token) {
+  auto* c = new Coordinator(port, num_hosts, heartbeat_timeout_ms,
+                            token);
   if (!c->ok()) {
     delete c;
     return nullptr;
@@ -505,9 +543,10 @@ int stpu_coord_failed_rank(void* h) {
 void stpu_coord_destroy(void* h) { delete static_cast<Coordinator*>(h); }
 
 void* stpu_client_connect(const char* host, int port, int rank,
-                          int timeout_ms, int heartbeat_interval_ms) {
+                          int timeout_ms, int heartbeat_interval_ms,
+                          const char* token) {
   auto* c = new Client(host, port, rank, timeout_ms,
-                       heartbeat_interval_ms);
+                       heartbeat_interval_ms, token);
   if (!c->ok()) {
     delete c;
     return nullptr;

@@ -561,6 +561,16 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
             elif rank == 0:
                 hosts.append({"kind": "exec",
                               "slice_index": slice_index})
+            elif handle.provider_name == "kubernetes":
+                # Worker pods run the token-authenticated exec agent
+                # (agent/exec_server.py) instead of sshd — any image
+                # with python3 gangs multi-host.
+                hosts.append({
+                    "kind": "agent",
+                    "ip": inst.internal_ip,
+                    "port": agent_constants.EXEC_PORT,
+                    "slice_index": slice_index,
+                })
             else:
                 hosts.append({
                     "kind": "ssh",

@@ -156,19 +156,11 @@ def run_instances(region, zone, cluster_name: str,
     namespace = _namespace(config)
     num_slices = int(config.get("num_slices") or 1)
     hosts = int(config.get("hosts_per_slice") or 1)
-    if num_slices * hosts > 1 and not config.get("image"):
-        # Fail BEFORE paying for pods: the head-resident gang driver
-        # reaches worker pods over pod-IP SSH, so multi-host clusters
-        # need an image with sshd + an ssh client (the reference's
-        # kubernetes images install openssh at bootstrap). The default
-        # slim image has neither; single-pod clusters never SSH and
-        # work with any image.
-        raise exceptions.ProvisionError(
-            f"kubernetes cluster {cluster_name} spans "
-            f"{num_slices * hosts} pods but no image_id was given; "
-            "multi-host gangs need an image that runs sshd (workers) "
-            "and ships an ssh client (head). Set `image_id:` in the "
-            "task resources.")
+    # Multi-host gangs need NO sshd image: worker pods run the
+    # token-authenticated exec agent (agent/exec_server.py) and the
+    # head's gang driver connects over the pod network. python3 is the
+    # only requirement — and the wheel install needs it on every pod
+    # anyway.
 
     existing = {}
     for p in _list_pods(cluster_name, namespace):

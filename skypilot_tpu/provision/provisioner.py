@@ -38,6 +38,16 @@ _AGENT_START_CMD = (
 # Separate so hermetic tests can defang the package install while still
 # executing the real bring-up orchestration.
 _RUNTIME_INSTALL_CMD = "pip install -q --user ~/.stpu_wheels/*.whl"
+# Worker-pod exec agent (kubernetes): the sshd replacement. Replace,
+# never duplicate, mirroring the daemon start above.
+_EXEC_AGENT_START_CMD = (
+    "mkdir -p ~/.stpu_agent && "
+    "{ [ -f ~/.stpu_agent/exec_server.pid ] && "
+    "kill $(cat ~/.stpu_agent/exec_server.pid) 2>/dev/null; "
+    "rm -f ~/.stpu_agent/exec_server.pid; } ; "
+    "nohup python3 -m skypilot_tpu.agent.exec_server "
+    "  > ~/.stpu_agent/exec_server.out 2>&1 & "
+    "echo $! > ~/.stpu_agent/exec_server.pid && echo exec-agent-started")
 
 
 def _ssh_runner(info: ClusterInfo, inst) -> runner_lib.CommandRunner:
@@ -87,6 +97,26 @@ def wait_for_ssh(info: ClusterInfo,
             f"SSH not reachable on {len(pending)} host(s) of "
             f"{info.cluster_name} after {timeout}s",
             retryable_in_zone=True)
+
+
+def _exec_token(cluster_name: str) -> str:
+    """Per-cluster random exec/coordinator auth token — an INDEPENDENT
+    secret (presenting it grants exec on worker pods), never derived
+    from key material that also appears in public places like
+    authorized_keys. Generated once, persisted next to the keypair."""
+    import secrets
+    from skypilot_tpu.agent import constants as agent_constants
+    from skypilot_tpu.utils import paths
+    key_dir = paths.generated_dir() / cluster_name
+    key_dir.mkdir(parents=True, exist_ok=True)
+    tok = key_dir / "exec_token"
+    if not tok.exists():
+        tmp = tok.with_suffix(".tmp")
+        tmp.write_text(
+            secrets.token_hex(agent_constants.TOKEN_LEN // 2))
+        tmp.chmod(0o600)
+        tmp.rename(tok)
+    return tok.read_text().strip()
 
 
 def _internal_keypair(cluster_name: str):
@@ -148,6 +178,10 @@ def setup_agent_runtime(info: ClusterInfo,
     })
 
     version = wheel_utils.runtime_version()
+    # Exec-agent token: per-cluster random secret authenticating the
+    # sshd-free worker transport (agent/exec_server.py) and the
+    # direct-connect gang coordinator.
+    exec_token = _exec_token(info.cluster_name)
 
     def bring_up(inst):
         runner = _ssh_runner(info, inst)
@@ -161,13 +195,20 @@ def setup_agent_runtime(info: ClusterInfo,
                ">> ~/.ssh/authorized_keys; } && "
                "chmod 600 ~/.ssh/authorized_keys && "
                f"printf '%s' {shlex.quote(identity_json)} "
-               "> ~/.stpu_agent/cluster.json")
+               "> ~/.stpu_agent/cluster.json && "
+               f"printf '%s' {shlex.quote(exec_token)} "
+               f"> {agent_constants.EXEC_TOKEN_PATH} && "
+               f"chmod 600 {agent_constants.EXEC_TOKEN_PATH}")
         if is_head:
             runner.run("mkdir -p ~/.ssh && chmod 700 ~/.ssh")
             runner.rsync(str(priv_key),
                          agent_constants.INTERNAL_KEY_PATH, up=True)
             cmd += (f" && chmod 600 {agent_constants.INTERNAL_KEY_PATH}"
                     " && " + _AGENT_START_CMD)
+        elif info.provider_name == "kubernetes":
+            # Worker pods run the exec agent instead of sshd: the gang
+            # driver reaches them over the pod network with the token.
+            cmd += " && " + _EXEC_AGENT_START_CMD
         # Version stamp LAST (after the daemon [re]start on the head):
         # a partial bring-up must read as stale so the next reuse
         # repairs it.
