@@ -11,6 +11,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state
 from skypilot_tpu import provision as provision_api
 from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.utils import usage_lib
 from skypilot_tpu.status_lib import ClusterStatus
 
 
@@ -57,6 +58,7 @@ def _refresh_one(record: Dict[str, Any]) -> Dict[str, Any]:
     return record
 
 
+@usage_lib.entrypoint
 def status(cluster_names: Optional[List[str]] = None,
            refresh: bool = False) -> List[Dict[str, Any]]:
     records = global_user_state.get_clusters()
@@ -68,24 +70,28 @@ def status(cluster_names: Optional[List[str]] = None,
     return records
 
 
+@usage_lib.entrypoint
 def start(cluster_name: str) -> slice_backend.SliceHandle:
     handle = _get_handle(cluster_name)
     backend = slice_backend.SliceBackend()
     return backend._restart_cluster(handle)  # noqa: SLF001
 
 
+@usage_lib.entrypoint
 def stop(cluster_name: str) -> None:
     handle = _get_handle(cluster_name)
     backend = slice_backend.SliceBackend()
     backend.teardown(handle, terminate=False)
 
 
+@usage_lib.entrypoint
 def down(cluster_name: str, purge: bool = False) -> None:
     handle = _get_handle(cluster_name)
     backend = slice_backend.SliceBackend()
     backend.teardown(handle, terminate=True, purge=purge)
 
 
+@usage_lib.entrypoint
 def autostop(cluster_name: str, idle_minutes: int,
              down_after: bool = False) -> None:
     handle = _get_handle(cluster_name)
@@ -93,6 +99,7 @@ def autostop(cluster_name: str, idle_minutes: int,
     backend.set_autostop(handle, idle_minutes, down=down_after)
 
 
+@usage_lib.entrypoint
 def queue(cluster_name: str,
           all_jobs: bool = True) -> List[Dict[str, Any]]:
     handle = _get_handle(cluster_name)

@@ -162,6 +162,40 @@ CONFIG_SCHEMA = {
                 },
             },
         },
+        "usage": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {
+                "loki_url": {"type": "string"},
+                "endpoint": {"type": "string"},
+            },
+        },
+        # Keys the code reads (slice_backend kubernetes plumbing,
+        # AzureBlobStore, controller_utils bucket_store) — they must
+        # also be schema-legal or a configured user crashes at load.
+        "kubernetes": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {
+                "namespace": {"type": "string"},
+                "gke_accelerator_type": {"type": "string"},
+                "gke_tpu_topology": {"type": "string"},
+            },
+        },
+        "azure": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {
+                "storage_account": {"type": "string"},
+            },
+        },
+        "controller": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {
+                "bucket_store": {"type": "string"},
+            },
+        },
     },
 }
 

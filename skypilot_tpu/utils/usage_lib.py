@@ -1,12 +1,22 @@
-"""Usage telemetry: local, append-only entrypoint records.
+"""Usage telemetry: local, append-only entrypoint records, with an
+OPT-IN remote sink.
 
 Reference analog: sky/usage/usage_lib.py (UsageMessageToReport schema,
 the `entrypoint` decorator on every SDK call, yaml redaction, opt-out
-env). Difference by design: the reference fire-and-forgets to a hosted
-Loki; this framework records to a local JSONL
-(``~/.stpu/usage/usage.jsonl``) and never phones home — an operator who
-wants central collection tails that file. Opt out entirely with
-``STPU_DISABLE_USAGE_COLLECTION=1``.
+env; `_send_to_loki`:296 fire-and-forgets to a hosted Loki). Difference
+by design: this framework NEVER phones home by default — records go to
+a local JSONL (``~/.stpu/usage/usage.jsonl``). An operator who wants
+central collection configures their own sink:
+
+    # ~/.stpu/config.yaml
+    usage:
+      loki_url: http://loki.internal:3100/loki/api/v1/push  # Loki shape
+      # or
+      endpoint: https://collector.internal/usage            # plain JSON
+
+Remote sends are best-effort in a daemon thread (a dead collector
+never slows or breaks a call). Opt out of everything with
+``STPU_DISABLE_USAGE_COLLECTION=1`` (wins over any configured sink).
 """
 from __future__ import annotations
 
@@ -15,6 +25,7 @@ import getpass
 import hashlib
 import json
 import os
+import threading
 import time
 import uuid
 from typing import Any, Callable
@@ -49,6 +60,67 @@ def _record(payload: dict) -> None:
     usage_dir.mkdir(parents=True, exist_ok=True)
     with open(usage_dir / "usage.jsonl", "a") as f:
         f.write(json.dumps(payload) + "\n")
+    _maybe_send_remote(payload)
+
+
+def _maybe_send_remote(payload: dict) -> None:
+    """Fire-and-forget to the operator-configured sink (if any).
+    Telemetry must never break the call: a malformed config.yaml (read
+    here on the calling thread) is swallowed like any send failure."""
+    try:
+        from skypilot_tpu import config as config_lib
+        loki_url = config_lib.get_nested(("usage", "loki_url"), None)
+        endpoint = config_lib.get_nested(("usage", "endpoint"), None)
+        if not loki_url and not endpoint:
+            return
+        if loki_url:
+            # Loki push shape (reference: usage_lib._send_to_loki:296).
+            body = json.dumps({"streams": [{
+                "stream": {"type": "usage", "source": "skypilot_tpu"},
+                "values": [[str(int(payload["ts"] * 1e9)),
+                            json.dumps(payload)]],
+            }]}).encode()
+            url = loki_url
+        else:
+            body = json.dumps(payload).encode()
+            url = endpoint
+    except Exception:  # noqa: BLE001 — config/serialize errors
+        return
+
+    def post():
+        import urllib.request
+        try:
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=3).close()
+        except Exception:  # noqa: BLE001 — telemetry must never break
+            pass
+
+    t = threading.Thread(target=post, daemon=True)
+    t.start()
+    # Prune finished sends so a long-lived sink-configured process
+    # (serve controller, jobs daemon) doesn't accumulate Thread objects
+    # forever.
+    _pending_sends[:] = [p for p in _pending_sends if p.is_alive()]
+    _pending_sends.append(t)
+
+
+_pending_sends: list = []
+
+
+def _drain_pending() -> None:
+    """Give in-flight sends a bounded window at process exit — a daemon
+    thread would otherwise be killed before the POST leaves a
+    short-lived CLI process. Capped so a dead collector delays exit by
+    at most ~2s, and ONLY when the operator configured a sink."""
+    deadline = time.time() + 2.0
+    for t in _pending_sends:
+        t.join(max(0.0, deadline - time.time()))
+
+
+import atexit  # noqa: E402
+atexit.register(_drain_pending)
 
 
 def entrypoint(fn: Callable) -> Callable:
