@@ -1,28 +1,32 @@
-"""Mixtral MoE decode throughput on the real chip.
+"""Serve-side decode throughput on the real chip (llama + Mixtral MoE).
 
-Measures serve-side incremental decode (prefill + cached top-2
-dense-routed expert MLP) in tokens/second at a fixed batch — the number
-behind docs/performance.md's MoE serving row. The model is the 8-expert
-Mixtral structure scaled to fit one v5e chip (the full 8x7B needs a
-pod slice).
+Measures incremental decode (prefill + KV-cached per-token steps;
+dense top-2 expert routing for MoE) in tokens/second at a fixed batch —
+the numbers behind docs/performance.md's serving rows. Models are
+scaled to fit one v5e chip (full 8x7B / 8B need a pod slice).
 
-Usage: python tools/bench_moe_decode.py [--batch 8] [--tokens 128]
+Usage: python tools/bench_moe_decode.py [--family mixtral|llama]
+           [--batch 8] [--tokens 128]
 """
 from __future__ import annotations
 
 import argparse
 import dataclasses
 import json
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 
+from skypilot_tpu.models import llama as llama_lib
 from skypilot_tpu.models import mixtral
 
 
 def main() -> None:
     p = argparse.ArgumentParser()
+    p.add_argument("--family", choices=("mixtral", "llama"),
+                   default="mixtral")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--tokens", type=int, default=128)
@@ -31,12 +35,23 @@ def main() -> None:
     p.add_argument("--experts", type=int, default=8)
     args = p.parse_args()
 
-    cfg = dataclasses.replace(
-        mixtral.MixtralConfig.mixtral_8x7b(),
-        vocab_size=32768, dim=args.dim, n_layers=args.layers,
-        n_heads=16, n_kv_heads=8, mlp_dim=3584,
-        n_experts=args.experts, max_seq_len=2048)
-    params = mixtral.init(cfg, jax.random.key(0))
+    if args.family == "llama":
+        if any(f in sys.argv
+               for f in ("--dim", "--layers", "--experts")):
+            p.error("--dim/--layers/--experts only apply to "
+                    "--family mixtral (llama shape is fixed)")
+        mdl = llama_lib
+        cfg = llama_lib.LlamaConfig(
+            vocab_size=32768, dim=2048, n_heads=16, n_kv_heads=8,
+            mlp_dim=8192, n_layers=16, max_seq_len=2048)
+    else:
+        mdl = mixtral
+        cfg = dataclasses.replace(
+            mixtral.MixtralConfig.mixtral_8x7b(),
+            vocab_size=32768, dim=args.dim, n_layers=args.layers,
+            n_heads=16, n_kv_heads=8, mlp_dim=3584,
+            n_experts=args.experts, max_seq_len=2048)
+    params = mdl.init(cfg, jax.random.key(0))
     b, s = args.batch, args.prompt_len
     prompt = jax.random.randint(jax.random.key(1), (b, s), 0,
                                 cfg.vocab_size)
@@ -46,8 +61,8 @@ def main() -> None:
     # _decode): unjitted, every eager op pays the tunnel's dispatch
     # latency and the measurement is of the host, not the chip.
     decode_jit = jax.jit(
-        lambda p, pr, tl: mixtral.decode(cfg, p, pr, tl, args.tokens,
-                                         max_seq))
+        lambda p, pr, tl: mdl.decode(cfg, p, pr, tl, args.tokens,
+                                     max_seq))
 
     def run():
         out = decode_jit(params, prompt, jnp.int32(s))
@@ -61,8 +76,10 @@ def main() -> None:
         best = min(best, time.perf_counter() - t0)
     toks = b * args.tokens
     print(json.dumps({
-        "model": {"dim": cfg.dim, "layers": cfg.n_layers,
-                  "experts": cfg.n_experts, "mlp_dim": cfg.mlp_dim,
+        "model": {"family": args.family, "dim": cfg.dim,
+                  "layers": cfg.n_layers,
+                  "experts": getattr(cfg, "n_experts", 0),
+                  "mlp_dim": cfg.mlp_dim,
                   "params": sum(x.size for x in
                                 jax.tree.leaves(params))},
         "batch": b,
