@@ -43,16 +43,25 @@ def get_s3_mount_command(bucket: str, mount_path: str) -> str:
             f"goofys {q(bucket)} {q(mount_path)})")
 
 
-def get_r2_mount_command(bucket: str, mount_path: str,
-                         endpoint_url: str) -> str:
-    """goofys against R2's S3-compatible endpoint with the `r2` aws
-    profile (reference: mounting_utils.get_r2_mount_cmd)."""
+def get_s3_compat_mount_command(bucket: str, mount_path: str,
+                                endpoint_url: str,
+                                profile: str) -> str:
+    """goofys against any S3-compatible endpoint (R2, IBM COS) with the
+    given aws credentials profile (reference:
+    mounting_utils.get_r2_mount_cmd / get_cos_mount_cmd)."""
     q = shlex.quote
     return (f"{_INSTALL_GOOFYS} && "
             f"mkdir -p {q(mount_path)} && "
             f"(mountpoint -q {q(mount_path)} || "
-            f"AWS_PROFILE=r2 goofys --endpoint {q(endpoint_url)} "
+            f"AWS_PROFILE={q(profile)} goofys "
+            f"--endpoint {q(endpoint_url)} "
             f"{q(bucket)} {q(mount_path)})")
+
+
+def get_r2_mount_command(bucket: str, mount_path: str,
+                         endpoint_url: str) -> str:
+    return get_s3_compat_mount_command(bucket, mount_path,
+                                       endpoint_url, "r2")
 
 
 BLOBFUSE2_VERSION = "2.3.2"

@@ -44,6 +44,7 @@ class StoreType(enum.Enum):
     GCS = "gcs"
     S3 = "s3"
     R2 = "r2"
+    IBM = "ibm"
     AZURE = "azure"
     LOCAL = "local"
 
@@ -198,6 +199,43 @@ class R2Store(S3Store):
                                                    self.endpoint)
 
 
+class IBMCosStore(S3Store):
+    """IBM Cloud Object Storage through its S3-compatible endpoint
+    (reference: IBMCosStore, sky/data/storage.py:3050 — rclone-based
+    there; here the same aws-CLI seam as R2, with HMAC credentials in
+    the aws ``ibm`` profile). Region from config ``ibm.cos_region``
+    or $IBM_COS_REGION (default us-east, the reference's default)."""
+
+    def __init__(self, name: str, source: Optional[str] = None,
+                 region: Optional[str] = None):
+        super().__init__(name, source)
+        self.region = region or ibm_cos_region()
+        endpoint = ibm_cos_endpoint(self.region)
+        self._aws_extra = ["--endpoint-url", endpoint,
+                           "--profile", "ibm"]
+        self._aws_extra_shell = (f" --endpoint-url "
+                                 f"{shlex.quote(endpoint)} "
+                                 "--profile ibm")
+        self.endpoint = endpoint
+
+    def mount_fuse_command(self, dst: str) -> str:
+        return mounting_utils.get_s3_compat_mount_command(
+            self.name, dst, self.endpoint, "ibm")
+
+
+def ibm_cos_region() -> str:
+    from skypilot_tpu import config as config_lib
+    return (os.environ.get("IBM_COS_REGION")
+            or config_lib.get_nested(("ibm", "cos_region"), None)
+            or "us-east")
+
+
+def ibm_cos_endpoint(region: str) -> str:
+    """The ONE place the IBM COS endpoint shape lives (COPY fetches and
+    cos:// downloads must never drift apart)."""
+    return f"https://s3.{region}.cloud-object-storage.appdomain.cloud"
+
+
 class AzureBlobStore(AbstractStore):
     """Azure Blob Storage via the az CLI (reference: AzureBlobStore,
     sky/data/storage.py:1941). A "bucket" is a container; the storage
@@ -328,6 +366,7 @@ _STORE_CLASSES = {
     StoreType.GCS: GcsStore,
     StoreType.S3: S3Store,
     StoreType.R2: R2Store,
+    StoreType.IBM: IBMCosStore,
     StoreType.AZURE: AzureBlobStore,
     StoreType.LOCAL: LocalStore,
 }
@@ -341,7 +380,7 @@ class Storage:
           /data:
             name: my-bucket
             source: ./local_dir       # optional
-            store: gcs                # gcs | s3 | r2 | azure | local
+            store: gcs                # gcs | s3 | r2 | ibm | azure | local
             mode: MOUNT               # MOUNT | COPY
             persistent: true
     """

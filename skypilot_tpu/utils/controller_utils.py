@@ -244,8 +244,17 @@ def maybe_translate_local_file_mounts_and_sync_up(task,
             # would turn dst into a directory. Upload it and rewrite the
             # mount as a bucket URI the backend downloads file-to-file.
             sto = translated(f"fm{i}", src)
-            remaining[dst] = (f"{_SCHEME.get(store, store)}://"
-                              f"{sto.name}/{os.path.basename(src_abs)}")
+            if store == "ibm":
+                # cos:// URLs are region-first (reference shape:
+                # cos://<region>/<bucket>/<key>).
+                from skypilot_tpu.data import storage as storage_lib2
+                remaining[dst] = (
+                    f"cos://{storage_lib2.ibm_cos_region()}/"
+                    f"{sto.name}/{os.path.basename(src_abs)}")
+            else:
+                remaining[dst] = (f"{_SCHEME.get(store, store)}://"
+                                  f"{sto.name}/"
+                                  f"{os.path.basename(src_abs)}")
         else:
             new_storage[dst] = translated(f"fm{i}", src)
     task.file_mounts = remaining
@@ -255,7 +264,8 @@ def maybe_translate_local_file_mounts_and_sync_up(task,
 
 
 # URI scheme <-> store-type mapping for translated single-file mounts.
-_SCHEME = {"gcs": "gs", "s3": "s3", "r2": "r2", "local": "local"}
+_SCHEME = {"gcs": "gs", "s3": "s3", "r2": "r2", "ibm": "cos",
+           "local": "local"}
 _STORE_BY_SCHEME = {v: k for k, v in _SCHEME.items()}
 
 
@@ -278,7 +288,13 @@ def cleanup_translated_buckets(dag_or_task) -> None:
                 pass
         for src in (task.file_mounts or {}).values():
             scheme, sep, rest = str(src).partition("://")
-            bucket = rest.split("/", 1)[0] if sep else ""
+            parts = rest.split("/") if sep else []
+            # cos:// URLs are region-first; the bucket is the SECOND
+            # path component.
+            if scheme == "cos":
+                bucket = parts[1] if len(parts) > 1 else ""
+            else:
+                bucket = parts[0] if parts else ""
             if (not bucket.startswith("stpu-jobs-")
                     or scheme not in _STORE_BY_SCHEME):
                 continue

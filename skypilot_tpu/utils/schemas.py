@@ -56,7 +56,8 @@ _STORAGE_SCHEMA = {
                              {"type": "array",
                               "items": {"type": "string"}}]},
         "store": {"type": "string",
-                  "enum": ["gcs", "s3", "r2", "azure", "local"]},
+                  "enum": ["gcs", "s3", "r2", "ibm", "azure",
+                           "local"]},
         "persistent": {"type": "boolean"},
         "mode": {"type": "string", "enum": ["MOUNT", "COPY"]},
     },
@@ -187,6 +188,13 @@ CONFIG_SCHEMA = {
             "additionalProperties": False,
             "properties": {
                 "storage_account": {"type": "string"},
+            },
+        },
+        "ibm": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {
+                "cos_region": {"type": "string"},
             },
         },
         "controller": {

@@ -227,3 +227,55 @@ def test_storage_yaml_accepts_r2(monkeypatch):
     monkeypatch.setenv("R2_ACCOUNT_ID", "acct42")
     st = storage_lib.Storage(name="b", store="r2", mode="COPY")
     assert isinstance(st.store, storage_lib.R2Store)
+
+
+def test_ibm_cos_command_generation(monkeypatch):
+    """IBM COS rides the same S3-compat seam as R2 (reference:
+    IBMCosStore, sky/data/storage.py:3050), with region-shaped
+    endpoints and the `ibm` aws profile."""
+    monkeypatch.setenv("IBM_COS_REGION", "eu-de")
+    s = storage_lib.IBMCosStore("bkt")
+    ep = "https://s3.eu-de.cloud-object-storage.appdomain.cloud"
+    fetch = s.fetch_command("/data")
+    assert "aws s3 sync s3://bkt /data" in fetch
+    assert ep in fetch and "--profile ibm" in fetch
+    mount = s.mount_fuse_command("/data")
+    assert "AWS_PROFILE=ibm" in mount and ep in mount
+
+    st = storage_lib.Storage(name="b", store="ibm", mode="COPY")
+    assert isinstance(st.store, storage_lib.IBMCosStore)
+
+    # cos://<region>/<bucket>/<key> download URLs (reference shape).
+    cmd = cloud_stores.get_storage_from_path(
+        "cos://us-south/b/x").make_download_command(
+            "cos://us-south/b/x", "/d/x")
+    assert "aws s3 cp s3://b/x" in cmd
+    assert "s3.us-south.cloud-object-storage" in cmd
+    assert cloud_stores.is_cloud_store_url("cos://us-south/b")
+
+
+def test_ibm_translated_single_file_mount_round_trips(monkeypatch,
+                                                      tmp_path):
+    """controller.bucket_store: ibm — a translated single-file mount's
+    cos:// URL must be downloadable AND cleanable (region-first URL
+    shape parses back to the right bucket)."""
+    from skypilot_tpu.utils import controller_utils
+    monkeypatch.setenv("IBM_COS_REGION", "eu-de")
+    url = "cos://eu-de/stpu-jobs-fm0-abc/data.txt"
+    # Downloadable:
+    assert cloud_stores.is_cloud_store_url(url)
+    cmd = cloud_stores.get_storage_from_path(url).make_download_command(
+        url, "/d/data.txt")
+    assert "s3://stpu-jobs-fm0-abc/data.txt" in cmd
+    # Cleanup parses the bucket from the region-first shape:
+    deleted = []
+    monkeypatch.setattr(
+        storage_lib, "Storage",
+        lambda name, store, persistent: type(
+            "S", (), {"delete": lambda self: deleted.append(
+                (name, store))})())
+    class T:
+        storage_mounts = {}
+        file_mounts = {"/d/data.txt": url}
+    controller_utils.cleanup_translated_buckets(T())
+    assert deleted == [("stpu-jobs-fm0-abc", "ibm")]
