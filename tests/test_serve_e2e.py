@@ -448,3 +448,74 @@ def test_fallback_requires_spot_task():
                                   dynamic_ondemand_fallback=True)
     with pytest.raises(exceptions.InvalidTaskError, match="use_spot"):
         serve_core.up(task, "svc-bad-fallback", controller="local")
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_spot_fallback_rolling_update():
+    """Rolling update of a dynamic-fallback spot service: pools stay
+    version-aware (new-version capacity comes up as surge), the
+    backfill never launches an on-demand fleet for old spot that is
+    still READY (ready-spot counts across versions), service stays
+    READY, and the fleet settles to the same 1 spot + 1 on-demand."""
+    def spot_task(body):
+        task = Task("spot-roll", run=(
+            f'cd $(mktemp -d) && echo "{body}" > index.html && '
+            'exec python3 -m http.server $SKYPILOT_SERVE_REPLICA_PORT'))
+        task.set_resources(Resources(cloud="local", use_spot=True))
+        task.service = SkyServiceSpec(readiness_path="/",
+                                      initial_delay_seconds=60,
+                                      min_replicas=2,
+                                      base_ondemand_fallback_replicas=1,
+                                      dynamic_ondemand_fallback=True)
+        return task
+
+    name, endpoint = serve_core.up(spot_task("v1"), "svc-sproll",
+                                   controller="local")
+    try:
+        serve_core.wait_ready(name, timeout=90)
+        deadline = time.time() + 60
+        steady = False
+        while time.time() < deadline:
+            reps = serve_state.get_replicas(name)
+            if (len(reps) == 2 and all(
+                    r["status"] == ReplicaStatus.READY for r in reps)):
+                steady = True
+                break
+            time.sleep(0.3)
+        assert steady, f"v1 fleet never fully READY: {reps}"
+
+        version = serve_core.update(spot_task("v2"), name,
+                                    controller="local")
+        assert version == 2
+
+        max_od_alive = 0
+        deadline = time.time() + 120
+        rolled = False
+        while time.time() < deadline:
+            try:
+                status, _ = _get(endpoint + "/")
+            except urllib.error.HTTPError as e:
+                status = e.code
+            assert status == 200       # availability never dips
+            reps = serve_state.get_replicas(name)
+            od = [r for r in reps if not r["is_spot"]]
+            max_od_alive = max(max_od_alive, len(od))
+            ready_v2 = [r for r in reps
+                        if r["status"] == ReplicaStatus.READY
+                        and r["version"] == 2]
+            if (len(reps) == 2 and len(ready_v2) == 2):
+                rolled = True
+                break
+            time.sleep(0.3)
+        assert rolled, f"rollout incomplete: {serve_state.get_replicas(name)}"
+        reps = serve_state.get_replicas(name)
+        spot = [r for r in reps if r["is_spot"]]
+        od = [r for r in reps if not r["is_spot"]]
+        assert len(spot) == 1 and len(od) == 1
+        # Surge is bounded: old od + its v2 replacement — never a
+        # dynamic-backfill fleet on top (old READY spot counts).
+        assert max_od_alive <= 2, max_od_alive
+        bodies = {_get(endpoint + "/")[1].strip() for _ in range(4)}
+        assert bodies == {"v2"}, bodies
+    finally:
+        serve_core.down([name], timeout=60)
