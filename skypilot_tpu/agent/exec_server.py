@@ -14,9 +14,14 @@ python3 works. Protocol (one TCP connection per command):
 
 Connection drop kills the command's whole process group — exactly the
 ssh-session semantics the gang driver's terminate path relies on. The
-token is sha256 of the cluster's internal PUBLIC key (present on every
-host via authorized_keys; never a private secret), written to
-``~/.stpu_agent/exec_token`` by the provisioner.
+token is a per-cluster random secret (``secrets.token_hex``, generated
+next to the internal keypair in ``provision/provisioner.py``) shipped to
+``~/.stpu_agent/exec_token`` at bring-up. It is deliberately NOT derived
+from any key material: public keys are readable by anyone on the host
+(authorized_keys), so a derivable token would grant remote exec to any
+local reader. Threat model: possession of the token == permission to run
+commands as the agent user on that cluster's hosts, nothing more — it is
+scoped per cluster and dies with it.
 """
 from __future__ import annotations
 

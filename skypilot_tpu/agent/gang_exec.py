@@ -247,7 +247,13 @@ def run_gang(spec: Dict) -> int:
     # connect DIRECTLY (no ssh reverse tunnel exists for them).
     if any(h.get("kind") == "agent" for h in spec["hosts"]):
         from skypilot_tpu.agent import exec_server
-        coord_token = exec_server.read_token(home)
+        try:
+            coord_token = exec_server.read_token(home)
+        except OSError:
+            # Missing token file == empty token: without the fail-fast
+            # below the job would sit RUNNING behind a 600s barrier
+            # hang (or a raw traceback) until the pid reconcile.
+            coord_token = ""
         if not coord_token:
             # An empty token would silently bind the coordinator
             # loopback-only while agent workers dial the head IP — a

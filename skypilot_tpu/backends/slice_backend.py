@@ -139,6 +139,7 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
                 if record["status"] == ClusterStatus.UP:
                     self.check_resources_fit_cluster(handle, task)
                     self._ensure_agent_runtime(handle)
+                    self._ensure_ports_open(handle, task)
                     return handle
                 if record["status"] == ClusterStatus.STOPPED:
                     return self._restart_cluster(handle)
@@ -209,6 +210,18 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
                             self._cleanup_provider_config(res))
                     except Exception:
                         pass
+                    if res.ports:
+                        # The ingress rule may have been created before
+                        # the failure (open_ports runs right after
+                        # run_instances); without this it would outlive
+                        # the failed attempt with no handle to find it.
+                        try:
+                            provision_api.cleanup_ports(
+                                res.provider_name, cluster_name,
+                                list(res.ports),
+                                self._cleanup_provider_config(res))
+                        except Exception:
+                            pass
             if not retry_until_up:
                 raise exceptions.ResourcesUnavailableError(
                     f"All zones failed for {to_provision}. "
@@ -263,6 +276,11 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
             ready=False)
         provision_api.run_instances(provider, res.region, res.zone,
                                     cluster_name, provider_config)
+        if res.ports:
+            # Firewall/Service ingress for the requested ports, before
+            # the (slow) node wait — rule creation and node boot overlap.
+            provision_api.open_ports(provider, cluster_name,
+                                     list(res.ports), provider_config)
         provision_api.wait_instances(provider, res.region, cluster_name,
                                      "running", provider_config)
         cluster_info = provision_api.get_cluster_info(
@@ -302,6 +320,28 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
         provisioner.wait_for_ssh(handle.cluster_info)
         provisioner.setup_agent_runtime(handle.cluster_info,
                                         self._cluster_identity(handle))
+
+    def _ensure_ports_open(self, handle: SliceHandle, task) -> None:
+        """Reused UP cluster: open any task-requested ports the cluster
+        record doesn't already carry (provision-time open_ports only
+        runs on fresh provision), and persist the union on the handle so
+        teardown's cleanup_ports sees them."""
+        want = set()
+        for res in task.resources or ():
+            want.update(str(p) for p in res.ports)
+        launched = handle.launched_resources
+        have = set(launched.ports or ()) if launched is not None else set()
+        if not (want - have) or launched is None:
+            return
+        provision_api.open_ports(handle.provider_name,
+                                 handle.cluster_name,
+                                 sorted(want - have),
+                                 handle.cluster_info.provider_config)
+        handle.launched_resources = launched.copy(
+            ports=tuple(sorted(have | want)))
+        global_user_state.add_or_update_cluster(
+            handle.cluster_name, handle=handle,
+            requested_resources=handle.launched_resources, ready=True)
 
     def _ensure_agent_runtime(self, handle: SliceHandle) -> None:
         """Repair runtime version drift on a reused UP cluster: compare
@@ -704,6 +744,24 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
                 self._kill_local_daemon(handle.head_home)
             try:
                 if terminate:
+                    res_ports = (handle.launched_resources.ports
+                                 if handle.launched_resources else ())
+                    if res_ports:
+                        # Ingress cleanup BEFORE the nodes go: once the
+                        # instances are deleted a failure here would
+                        # leak the firewall rule with no handle left to
+                        # find it by.
+                        try:
+                            provision_api.cleanup_ports(
+                                handle.provider_name,
+                                handle.cluster_name, list(res_ports),
+                                handle.cluster_info.provider_config)
+                        except Exception as e:  # noqa: BLE001
+                            # Best-effort: a firewall API hiccup must
+                            # not leave billing nodes behind.
+                            print("warning: port cleanup failed for "
+                                  f"{handle.cluster_name}: {e}",
+                                  file=sys.stderr)
                     provision_api.terminate_instances(
                         handle.provider_name, handle.cluster_name,
                         handle.cluster_info.provider_config)

@@ -8,8 +8,8 @@ unstoppable-pod special cases). The TPU-specific rules:
   * therefore autostop on a pod must use --down;
   * custom machine images don't apply to TPU VMs (runtime_version is the
     image knob);
-  * firewall/port management is not implemented yet — declared
-    unsupported rather than silently ignored.
+  * firewall/port management: provision/gcp.py open_ports/cleanup_ports
+    (per-cluster tagged VPC ingress rule).
 """
 from __future__ import annotations
 
@@ -27,9 +27,9 @@ class GCP(Cloud):
     _UNSUPPORTED = {
         CloudImplementationFeatures.IMAGE_ID:
             "TPU VMs take a runtime_version, not a machine image",
-        CloudImplementationFeatures.OPEN_PORTS:
-            "firewall management is not implemented yet; open ports via "
-            "VPC firewall rules out of band",
+        # OPEN_PORTS is supported: provision/gcp.py open_ports manages a
+        # per-cluster tagged VPC ingress rule (reference:
+        # sky/provision/gcp/instance.py:571).
     }
 
     def unsupported_features_for_resources(

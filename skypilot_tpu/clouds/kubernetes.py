@@ -32,9 +32,8 @@ class Kubernetes(Cloud):
         CloudImplementationFeatures.SPOT_INSTANCE:
             "no spot market on kubernetes; use node-level preemption "
             "policies out of band",
-        CloudImplementationFeatures.OPEN_PORTS:
-            "expose ports via Services/Ingress out of band (not "
-            "implemented yet)",
+        # OPEN_PORTS is supported: provision/kubernetes.py open_ports
+        # manages a per-cluster NodePort Service on the head pod.
     }
 
     def unsupported_features_for_resources(

@@ -71,6 +71,25 @@ def get_cluster_info(provider_name: str, region, cluster_name: str,
                   provider_config)
 
 
+def open_ports(provider_name: str, cluster_name: str, ports: list,
+               provider_config: dict) -> None:
+    """Open ``ports`` for inbound traffic to the cluster (reference:
+    sky/provision/__init__.py:122). GCP: one tagged VPC ingress rule;
+    kubernetes: a NodePort Service on the head pod; local: no-op
+    (localhost). Idempotent; re-opening merges."""
+    return _route(provider_name, "open_ports", cluster_name, ports,
+                  provider_config)
+
+
+def cleanup_ports(provider_name: str, cluster_name: str, ports: list,
+                  provider_config: dict) -> None:
+    """Delete whatever open_ports created for the cluster (reference:
+    sky/provision/__init__.py:133; like there, ``ports`` is advisory —
+    cleanup is whole-cluster)."""
+    return _route(provider_name, "cleanup_ports", cluster_name, ports,
+                  provider_config)
+
+
 def stop_instances(provider_name: str, cluster_name: str,
                    provider_config: dict) -> None:
     return _route(provider_name, "stop_instances", cluster_name,

@@ -71,3 +71,29 @@ class ProvisionRecord:
         default_factory=list)
     resumed_instance_ids: List[str] = dataclasses.field(
         default_factory=list)
+
+
+def parse_port_ranges(ports) -> List[tuple]:
+    """Validate Resources.ports entries and return (lo, hi) int pairs.
+
+    One grammar for every provider ('80' or '30000-30100' — the
+    reference's resources_utils.port_ranges_to_set grammar), so a task
+    YAML that validates on GCP can't error on Kubernetes. Providers
+    render the pairs into their own API shapes (compute allowed.ports
+    strings, Service port lists).
+    """
+    from skypilot_tpu import exceptions
+    out: List[tuple] = []
+    for p in ports:
+        s = str(p).strip()
+        lo, dash, hi = s.partition("-")
+        if not lo.isdigit() or (dash and not hi.isdigit()):
+            raise exceptions.ProvisionError(
+                f"invalid port spec {p!r} (want '80' or '30000-30100')")
+        lo_i = int(lo)
+        hi_i = int(hi) if dash else lo_i
+        if not (0 < lo_i <= hi_i <= 65535):
+            raise exceptions.ProvisionError(
+                f"port spec {p!r} out of range 1-65535")
+        out.append((lo_i, hi_i))
+    return out

@@ -38,6 +38,7 @@ from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
 
 PROVIDER_NAME = "gcp"
 TPU_API_BASE = "https://tpu.googleapis.com/v2"
+COMPUTE_API_BASE = "https://compute.googleapis.com/compute/v1"
 
 # Node lifecycle states (Cloud TPU v2 API) → SPI status strings consumed by
 # core._refresh_one / jobs.controller / serve.replica_managers.
@@ -132,6 +133,27 @@ def rest(method: str, path: str, body: Optional[dict] = None,
     return payload
 
 
+def compute_rest(method: str, path: str, body: Optional[dict] = None,
+                 params: Optional[dict] = None) -> Dict[str, Any]:
+    """One Compute-API call (firewall rules are a compute resource even
+    for TPU VMs — reference: sky/provision/gcp/instance.py:594 routes
+    TPU firewall ops through GCPComputeInstance). Same monkeypatchable
+    shape as :func:`rest`; ``path`` is relative to the API base."""
+    import requests  # lazy: only a real-cloud path needs it
+    url = f"{COMPUTE_API_BASE}/{path}"
+    resp = requests.request(
+        method, url, params=params or {}, json=body,
+        headers={"Authorization": f"Bearer {_access_token()}"},
+        timeout=60)
+    try:
+        payload = resp.json() if resp.content else {}
+    except ValueError:
+        payload = {"error": {"message": resp.text[:500]}}
+    if resp.status_code >= 400:
+        raise GcpApiError(resp.status_code, payload, f"{method} {path}")
+    return payload
+
+
 def _project_of(config: dict) -> str:
     return config.get("project_id") or _gcloud_project()
 
@@ -203,6 +225,12 @@ def _node_body(cluster_name: str, slice_index: int, config: dict) -> dict:
         "metadata": config.get("metadata") or {},
         "dataDisks": [],
         "networkConfig": {"enableExternalIps": True},
+        # Network tag every host so cluster-scoped firewall rules
+        # (open_ports) can target the cluster without per-instance
+        # mutation (the reference tags instances lazily at open_ports
+        # time, sky/provision/gcp/instance.py:600-608; tagging at
+        # creation makes open/cleanup order-independent here).
+        "tags": [_network_tag(cluster_name)],
     }
     if config.get("use_spot"):
         body["schedulingConfig"] = {"preemptible": True}
@@ -490,3 +518,128 @@ def terminate_instances(cluster_name: str, provider_config: dict) -> None:
     for node_id in _list_cluster_nodes(project, zone, cluster_name,
                                        lenient_auth=False):
         _delete_node(project, zone, node_id)
+
+
+# ------------------------------------------------------------------ ports
+# Firewall management (provision SPI open_ports/cleanup_ports). Reference:
+# sky/provision/__init__.py:122,133 declare the ops;
+# sky/provision/gcp/instance.py:571,626 implement them with one
+# per-cluster ingress rule targeting a cluster network tag. Differences
+# here: SDK-free Compute REST (the repo's `rest` discipline), and hosts
+# are tagged at node CREATION (_node_body) instead of lazily, so the rule
+# applies to later-added slices automatically. The VPC itself is assumed
+# to exist (default network unless provider_config["network"] says
+# otherwise) — the reference's VPC/subnet bootstrap
+# (sky/provision/gcp/config.py:392-540) is out of scope for TPU VMs,
+# which GCP only places in pre-existing networks.
+
+_OP_WAIT_TIMEOUT_SECONDS = 120
+
+
+def _network_tag(cluster_name: str) -> str:
+    """RFC1035-safe network tag for the cluster (lowercase, [a-z0-9-],
+    63 chars)."""
+    tag = "".join(c if c.isalnum() or c == "-" else "-"
+                  for c in cluster_name.lower())
+    return ("stpu-" + tag.strip("-"))[:63].rstrip("-")
+
+
+def _firewall_rule_name(cluster_name: str) -> str:
+    return (_network_tag(cluster_name) + "-ports")[:63]
+
+
+def _normalize_ports(ports) -> List[str]:
+    """Resources.ports entries ("80", 8080, "30000-30100") → the compute
+    API's allowed.ports strings (shared grammar:
+    provision.common.parse_port_ranges)."""
+    from skypilot_tpu.provision.common import parse_port_ranges
+    out = [f"{lo}-{hi}" if hi != lo else str(lo)
+           for lo, hi in parse_port_ranges(ports)]
+    return sorted(set(out))
+
+
+def _wait_compute_op(project: str, op: Dict[str, Any]) -> None:
+    """Block until a global compute operation is DONE; raise on error."""
+    name = op.get("name")
+    if not name:
+        return
+    deadline = time.time() + _OP_WAIT_TIMEOUT_SECONDS
+    while True:
+        if op.get("status") == "DONE":
+            errors = (op.get("error") or {}).get("errors")
+            if errors:
+                raise exceptions.ProvisionError(
+                    f"firewall operation {name} failed: {errors}")
+            return
+        if time.time() > deadline:
+            raise exceptions.ProvisionError(
+                f"firewall operation {name} timed out")
+        time.sleep(_POLL_INTERVAL_SECONDS)
+        op = compute_rest(
+            "GET", f"projects/{project}/global/operations/{name}")
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: dict) -> None:
+    """Ensure one ingress rule allowing ``ports`` (tcp) to this
+    cluster's tagged hosts. Idempotent: re-opening merges with whatever
+    the rule already allows (a serve controller opens its LB range once;
+    a later `launch` against the same cluster with task ports must not
+    clobber it)."""
+    if not ports:
+        return
+    project = _project_of(provider_config)
+    network = provider_config.get("network") or "default"
+    name = _firewall_rule_name(cluster_name)
+    want = _normalize_ports(ports)
+    try:
+        existing = compute_rest(
+            "GET", f"projects/{project}/global/firewalls/{name}")
+    except GcpApiError as e:
+        if e.status != 404:
+            raise
+        existing = None
+    if existing is not None:
+        have = []
+        for allowed in existing.get("allowed", []):
+            if allowed.get("IPProtocol") == "tcp":
+                have.extend(allowed.get("ports", []))
+        merged = sorted(set(have) | set(want))
+        if merged == sorted(set(have)):
+            return  # already open
+        op = compute_rest(
+            "PATCH", f"projects/{project}/global/firewalls/{name}",
+            body={"allowed": [{"IPProtocol": "tcp", "ports": merged}]})
+    else:
+        op = compute_rest(
+            "POST", f"projects/{project}/global/firewalls",
+            body={
+                "name": name,
+                "network": f"projects/{project}/global/networks/"
+                           f"{network}",
+                "direction": "INGRESS",
+                "sourceRanges": ["0.0.0.0/0"],
+                "allowed": [{"IPProtocol": "tcp", "ports": want}],
+                "targetTags": [_network_tag(cluster_name)],
+                "description": f"stpu-managed ingress for cluster "
+                               f"{cluster_name}",
+            })
+    _wait_compute_op(project, op)
+
+
+def cleanup_ports(cluster_name: str, ports: List[str],
+                  provider_config: dict) -> None:
+    """Delete the cluster's ingress rule (the whole rule — ports is
+    advisory, matching the reference's cleanup_ports contract which
+    ignores it, sky/provision/gcp/instance.py:626)."""
+    del ports
+    project = _project_of(provider_config)
+    name = _firewall_rule_name(cluster_name)
+    try:
+        op = compute_rest(
+            "DELETE", f"projects/{project}/global/firewalls/{name}")
+    except GcpApiError as e:
+        if e.status == 404:
+            return  # never created / already gone
+        raise
+    _wait_compute_op(project, op)

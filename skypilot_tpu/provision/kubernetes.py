@@ -279,3 +279,103 @@ def terminate_instances(cluster_name: str, provider_config: dict) -> None:
     kubectl(["delete", "pods", "-l", f"{_CLUSTER_LABEL}={cluster_name}",
              "--ignore-not-found", "--wait=false"],
             namespace=_namespace(provider_config))
+
+
+# ------------------------------------------------------------------ ports
+# Kubernetes analog of the GCP firewall ops (provision SPI
+# open_ports/cleanup_ports; reference declares them in
+# sky/provision/__init__.py:122,133 and implements the k8s side with a
+# NodePort/LoadBalancer service in
+# sky/provision/kubernetes/network.py). One NodePort Service per cluster
+# exposes the requested ports on the HEAD pod (slice 0 / host 0 — where
+# the serve LB and user servers run under the head-resident runtime).
+
+
+def _ports_service_name(cluster_name: str) -> str:
+    return f"{cluster_name}-ports"
+
+
+def _expand_ports(ports: List[str]) -> List[int]:
+    """"8080" / "30000-30010" specs → concrete port list (shared
+    grammar: provision.common.parse_port_ranges). Services have no
+    range syntax, so ranges expand; bounded so a careless "1-65535"
+    cannot create a 65k-entry Service."""
+    from skypilot_tpu.provision.common import parse_port_ranges
+    out: List[int] = []
+    for lo, hi in parse_port_ranges(ports):
+        if hi - lo + 1 > 200:
+            raise exceptions.ProvisionError(
+                f"port range {lo}-{hi} too wide for a kubernetes "
+                "Service (max 200 ports); open individual ports "
+                "instead")
+        out.extend(range(lo, hi + 1))
+    return sorted(set(out))
+
+
+# kube-apiserver's default --service-node-port-range: only ports inside
+# it can be pinned as the Service's nodePort, making node_ip:port work
+# directly (the serve LB range is chosen inside it for exactly this).
+# Ports outside it get a cluster-assigned nodePort; in-cluster access is
+# via ClusterIP:port either way.
+_NODE_PORT_RANGE = (30000, 32767)
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: dict) -> None:
+    """Ensure a NodePort Service exposing ``ports`` on the head pod.
+    Idempotent via `kubectl apply`; re-opening with new ports merges
+    with the existing Service's (the serve LB range must survive a
+    later launch-with-ports on the same cluster)."""
+    if not ports:
+        return
+    namespace = _namespace(provider_config)
+    name = _ports_service_name(cluster_name)
+    want = set(_expand_ports(ports))
+    try:
+        existing = kubectl(["get", "service", name, "-o", "json"],
+                           namespace=namespace)
+        for entry in (existing.get("spec") or {}).get("ports", []):
+            want.add(int(entry["port"]))
+    except exceptions.ProvisionError as e:
+        # Only a genuinely-absent Service may proceed to create: a
+        # transient API error must NOT read as not-found, or the apply
+        # below would clobber already-open ports (e.g. the serve LB
+        # range) with just the new ones.
+        if "not found" not in str(e).lower():
+            raise
+    manifest = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": name,
+            "labels": {_CLUSTER_LABEL: cluster_name},
+        },
+        "spec": {
+            "type": "NodePort",
+            "selector": {
+                _CLUSTER_LABEL: cluster_name,
+                _SLICE_LABEL: "slice-0",
+                _HOST_INDEX_LABEL: "0",
+            },
+            "ports": [dict({"name": f"p{p}", "port": p,
+                            "targetPort": p, "protocol": "TCP"},
+                           # Pin nodePort=port when allowed so
+                           # node_ip:port is reachable as requested;
+                           # outside the apiserver's NodePort range the
+                           # cluster assigns one (ClusterIP:port still
+                           # serves in-cluster traffic).
+                           **({"nodePort": p}
+                              if _NODE_PORT_RANGE[0] <= p
+                              <= _NODE_PORT_RANGE[1] else {}))
+                      for p in sorted(want)],
+        },
+    }
+    kubectl(["apply"], input_obj=manifest, namespace=namespace)
+
+
+def cleanup_ports(cluster_name: str, ports: List[str],
+                  provider_config: dict) -> None:
+    del ports  # whole-service cleanup, matching the SPI contract
+    kubectl(["delete", "service", _ports_service_name(cluster_name),
+             "--ignore-not-found", "--wait=false"],
+            namespace=_namespace(provider_config))

@@ -133,3 +133,16 @@ def terminate_instances(cluster_name: str, provider_config: dict) -> None:
     cdir = _cluster_dir(cluster_name)
     if cdir.exists():
         shutil.rmtree(cdir)
+
+
+def open_ports(cluster_name: str, ports, provider_config: dict) -> None:
+    """Local hosts are directories on this machine: every port a job
+    binds is already reachable on localhost. Validate the spec (same
+    grammar as the real providers) and do nothing."""
+    del cluster_name, provider_config
+    from skypilot_tpu.provision.common import parse_port_ranges
+    parse_port_ranges(ports)
+
+
+def cleanup_ports(cluster_name: str, ports, provider_config: dict) -> None:
+    del cluster_name, ports, provider_config

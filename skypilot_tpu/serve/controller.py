@@ -36,6 +36,7 @@ class SkyServeController:
         # autoscaler drains them each tick.
         self.recorder = recorder or RequestRecorder()
         self._stop = False
+        self._superseded = False
         self._was_ready = False
         self._ready_urls: list = []
         self.version = 1
@@ -62,7 +63,15 @@ class SkyServeController:
                 while time.time() < deadline and not self._stop:
                     time.sleep(0.05)
         finally:
-            self._shutdown()
+            if self._superseded:
+                # A newer controller stamped controller_pid: IT owns the
+                # fleet. Tearing down replicas or removing the service
+                # row here would sabotage the live owner — exit quietly.
+                print(f"controller[{self.service_name}] pid "
+                      f"{os.getpid()}: superseded by a newer controller; "
+                      "exiting without touching replicas", flush=True)
+            else:
+                self._shutdown()
 
     # A broken task fails this many replicas in a row (with no READY in
     # between) before the controller declares the service FAILED and stops
@@ -82,6 +91,17 @@ class SkyServeController:
             # arrives). Treat it as the down it is: stop and run the
             # normal shutdown so any replicas this controller adopted
             # or launched meanwhile are torn down, not leaked.
+            self._stop = True
+            return
+        recorded_pid = row.get("controller_pid")
+        if recorded_pid and recorded_pid != os.getpid():
+            # A NEWER controller re-stamped the row (crash-recovery
+            # respawn racing a not-actually-dead predecessor — e.g. a
+            # killed test run left us session-detached). Two live
+            # controllers would fight over one fleet: the newest stamp
+            # wins, so we stand down. Replicas are left untouched — the
+            # new owner has already adopted them.
+            self._superseded = True
             self._stop = True
             return
         if row.get("version", 1) <= self.version:

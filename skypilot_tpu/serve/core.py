@@ -34,11 +34,35 @@ from skypilot_tpu.serve.serve_state import ServiceStatus
 from skypilot_tpu.task import Task
 from skypilot_tpu.utils import controller_utils
 from skypilot_tpu.utils import paths
+from skypilot_tpu.utils import proc_utils
 
 _SERVE = controller_utils.Controllers.SERVE
 
 
-def _free_port() -> int:
+# LB ports on a CLUSTER-hosted controller come from this fixed range:
+# the controller cluster's firewall/NodePort ingress is opened for the
+# whole range once at bring-up (controller_utils.controller_resources),
+# so each new service's endpoint is reachable without another firewall
+# round-trip. Inside the kubernetes NodePort range on purpose, so
+# node_ip:lb_port works as-is. Reference: LB_PORT_RANGE_START,
+# sky/serve/constants.py (same 30001+ choice, same reasoning).
+LB_PORT_RANGE = (30001, 30100)
+LB_PORT_RANGE_SPEC = f"{LB_PORT_RANGE[0]}-{LB_PORT_RANGE[1]}"
+
+
+def _free_port(use_lb_range: bool = False) -> int:
+    if use_lb_range:
+        for port in range(LB_PORT_RANGE[0], LB_PORT_RANGE[1] + 1):
+            with socket.socket() as s:
+                try:
+                    s.bind(("0.0.0.0", port))
+                except OSError:
+                    continue
+                return port
+        raise exceptions.SkyTpuError(
+            f"no free LB port in {LB_PORT_RANGE_SPEC} on this "
+            f"controller ({LB_PORT_RANGE[1] - LB_PORT_RANGE[0] + 1} "
+            "services max)")
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
@@ -110,10 +134,13 @@ def _validate_fallback_spec(task: Task) -> None:
             "resources.use_spot: true.")
 
 
-def _up_local(task: Task, service_name: str) -> Tuple[str, str]:
+def _up_local(task: Task, service_name: str,
+              use_lb_range: bool = False) -> Tuple[str, str]:
     """Register + spawn the service (controller+LB) on *this* host. Runs
-    on the client in 'local' mode, on the controller head via `submit`."""
-    lb_port = _free_port()
+    on the client in 'local' mode (ephemeral loopback port), on the
+    controller head via `submit` (port from LB_PORT_RANGE — the range
+    the controller cluster's ingress was opened for)."""
+    lb_port = _free_port(use_lb_range)
 
     serve_dir = paths.generated_dir() / "serve"
     serve_dir.mkdir(parents=True, exist_ok=True)
@@ -254,7 +281,7 @@ def _down_local(service_names: Optional[List[str]], all_services: bool,
         name = svc["service_name"]
         pid = svc.get("controller_pid")
         alive = False
-        if pid:
+        if pid and proc_utils.cmdline_matches(pid, "skypilot_tpu.serve"):
             try:
                 os.kill(pid, signal.SIGTERM)
                 alive = True
@@ -291,8 +318,11 @@ def _down_local(service_names: Optional[List[str]], all_services: bool,
     return done
 
 
-def _kill_pid(pid: Optional[int]) -> None:
-    if not pid:
+def _kill_pid(pid: Optional[int],
+              marker: str = "skypilot_tpu.serve") -> None:
+    """SIGTERM pid only if it still looks like one of ours — a recorded
+    pid can be recycled by the kernel after a reboot (see proc_utils)."""
+    if not pid or not proc_utils.cmdline_matches(pid, marker):
         return
     try:
         os.kill(pid, signal.SIGTERM)
@@ -464,7 +494,8 @@ def main() -> None:
     if args.cmd == "submit":
         task = Task.from_yaml(os.path.expanduser(args.task_yaml))
         try:
-            name, endpoint = _up_local(task, args.service_name)
+            name, endpoint = _up_local(task, args.service_name,
+                                       use_lb_range=True)
         except exceptions.SkyTpuError as e:
             print(json.dumps({"error": str(e)}))
             return

@@ -222,6 +222,16 @@ class SkyPilotReplicaManager:
         res = next(iter(self.task.resources))
         return res.provider_name == "local"
 
+    @staticmethod
+    def _cloud_manages_ports(res) -> bool:
+        from skypilot_tpu import clouds as clouds_lib
+        try:
+            cloud = clouds_lib.get_cloud(res.provider_name)
+        except Exception:  # noqa: BLE001 — unknown cloud: don't inject
+            return False
+        return (clouds_lib.CloudImplementationFeatures.OPEN_PORTS
+                not in cloud.unsupported_features_for_resources(res))
+
     def _launch_replica(self, info: ReplicaInfo) -> None:
         info.status = ReplicaStatus.PROVISIONING
         self._persist(info)
@@ -231,8 +241,18 @@ class SkyPilotReplicaManager:
         if task.resources:
             # Pin the replica's pool regardless of the task default: a
             # fallback replica from a spot task must launch on-demand.
+            # And make the replica's serving port part of its resources
+            # so provisioning opens it (firewall rule / NodePort) — the
+            # LB probes and proxies to <replica_ip>:<port> from the
+            # controller host, which is outside the replica's network
+            # on real clouds. Clouds without port management (docker)
+            # keep the old out-of-band contract.
             task.set_resources(tuple(
-                res.copy(use_spot=info.is_spot)
+                res.copy(use_spot=info.is_spot,
+                         ports=(tuple(res.ports) + (str(info.port),)
+                                if self._cloud_manages_ports(res) and
+                                str(info.port) not in res.ports
+                                else res.ports))
                 for res in task.resources))
         task.update_envs({REPLICA_PORT_ENV: str(info.port)})
         try:

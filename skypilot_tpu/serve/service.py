@@ -34,6 +34,7 @@ from skypilot_tpu.serve.controller import SkyServeController
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
 from skypilot_tpu.task import Task
 from skypilot_tpu.utils import paths
+from skypilot_tpu.utils import proc_utils
 
 
 def _lb_sync_seconds() -> float:
@@ -79,6 +80,16 @@ class _LbSupervisor:
                 backoff = min(backoff * 2, 30.0)
                 if self._stop:
                     return
+                row = serve_state.get_service(self.service_name)
+                if row and row.get("controller_pid") not in (
+                        None, os.getpid()):
+                    # Our controller was superseded (a newer service
+                    # process stamped the row and owns the LB slot now —
+                    # it killed our LB on startup, which is why we're
+                    # here). Respawning would fight the new LB for the
+                    # port and overwrite its lb_pid stamp.
+                    self._stop = True
+                    return
                 self.spawn()
             else:
                 backoff = 1.0
@@ -116,10 +127,15 @@ def run_service(service_name: str, task_yaml: str, lb_port: int) -> None:
     # the supervisor's respawn loop absorbs any bind-release latency.
     row = serve_state.get_service(service_name)
     if row and row.get("lb_pid"):
-        try:
-            os.kill(row["lb_pid"], signal.SIGTERM)
-        except OSError:
-            pass
+        # After a host reboot the recorded pid may belong to an
+        # unrelated process (pid recycling) — only kill it if it still
+        # looks like our LB module.
+        if proc_utils.cmdline_matches(row["lb_pid"],
+                                      "skypilot_tpu.serve.load_balancer"):
+            try:
+                os.kill(row["lb_pid"], signal.SIGTERM)
+            except OSError:
+                pass
     supervisor = _LbSupervisor(service_name, lb_port, sync_port, log_f)
     supervisor.spawn()
     threading.Thread(target=supervisor.watch, daemon=True).start()

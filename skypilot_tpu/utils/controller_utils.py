@@ -57,9 +57,18 @@ def controller_mode(kind: Controllers) -> str:
 def controller_resources(kind: Controllers) -> Resources:
     spec = config_lib.get_nested(
         (kind.config_key, "controller", "resources"), None)
-    if spec:
-        return Resources.from_yaml_config(dict(spec))
-    return Resources(cloud="local")
+    res = (Resources.from_yaml_config(dict(spec)) if spec
+           else Resources(cloud="local"))
+    if kind.config_key == "serve":
+        # The serve controller hosts every service's LB: open the whole
+        # LB port range at controller bring-up so each `serve up`
+        # endpoint is reachable without a per-service firewall
+        # round-trip (reference: serve controllers open
+        # LB_PORT_RANGE the same way).
+        from skypilot_tpu.serve.core import LB_PORT_RANGE_SPEC
+        if LB_PORT_RANGE_SPEC not in res.ports:
+            res = res.copy(ports=tuple(res.ports) + (LB_PORT_RANGE_SPEC,))
+    return res
 
 
 def controller_handle(kind: Controllers) -> Optional[Any]:

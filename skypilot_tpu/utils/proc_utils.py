@@ -1,0 +1,28 @@
+"""Process-table checks for pidfile-kill paths.
+
+Recorded pids (serve controller / load-balancer rows) can outlive the
+process they named: after a controller-host reboot or long downtime the
+kernel may have recycled the pid for an unrelated process, and a blind
+SIGTERM would kill it. Before signalling a recorded pid, callers verify
+the live process still looks like the one that was recorded.
+
+Reference analog: sky/serve keeps single-owner pid assumptions in its
+service supervisor; we make the recycled-pid case explicit instead.
+"""
+from __future__ import annotations
+
+
+def cmdline_matches(pid: int, marker: str) -> bool:
+    """True if pid is alive AND its cmdline contains ``marker``.
+
+    Reads /proc/<pid>/cmdline (argv joined by NULs). Any read failure —
+    process gone, permission, non-Linux /proc — returns False so the
+    caller skips the kill rather than signalling an unknown process.
+    """
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            argv = f.read().replace(b"\x00", b" ").decode(
+                "utf-8", "replace")
+    except OSError:
+        return False
+    return marker in argv

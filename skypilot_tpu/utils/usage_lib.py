@@ -97,16 +97,23 @@ def _maybe_send_remote(payload: dict) -> None:
         except Exception:  # noqa: BLE001 — telemetry must never break
             pass
 
-    t = threading.Thread(target=post, daemon=True)
-    t.start()
-    # Prune finished sends so a long-lived sink-configured process
-    # (serve controller, jobs daemon) doesn't accumulate Thread objects
-    # forever.
-    _pending_sends[:] = [p for p in _pending_sends if p.is_alive()]
-    _pending_sends.append(t)
+    # Decorated entrypoints run from multiple threads (serve replica
+    # launchers), so the pending list is lock-guarded, and in-flight
+    # sends are bounded: with a slow collector each POST can hang for
+    # its full 3s timeout, so past the cap we drop the send rather than
+    # pile up threads. Telemetry is lossy by design.
+    with _pending_lock:
+        _pending_sends[:] = [p for p in _pending_sends if p.is_alive()]
+        if len(_pending_sends) >= _MAX_INFLIGHT_SENDS:
+            return
+        t = threading.Thread(target=post, daemon=True)
+        t.start()  # inside the lock: an unstarted thread is not
+        _pending_sends.append(t)  # alive, so a racing prune drops it
 
 
+_MAX_INFLIGHT_SENDS = 8
 _pending_sends: list = []
+_pending_lock = threading.Lock()
 
 
 def _drain_pending() -> None:
@@ -115,7 +122,9 @@ def _drain_pending() -> None:
     short-lived CLI process. Capped so a dead collector delays exit by
     at most ~2s, and ONLY when the operator configured a sink."""
     deadline = time.time() + 2.0
-    for t in _pending_sends:
+    with _pending_lock:
+        pending = list(_pending_sends)
+    for t in pending:
         t.join(max(0.0, deadline - time.time()))
 
 

@@ -336,3 +336,67 @@ def test_usage_survives_malformed_config(monkeypatch):
         return "fine"
 
     assert op() == "fine"
+
+
+def test_proc_utils_cmdline_matches():
+    """Recorded pids are verified against /proc cmdline before any
+    SIGTERM (recycled-pid protection; advisor r4 serve/service.py)."""
+    import os
+    from skypilot_tpu.utils import proc_utils
+    # Our own process is a python invocation.
+    assert proc_utils.cmdline_matches(os.getpid(), "python")
+    assert not proc_utils.cmdline_matches(os.getpid(),
+                                          "definitely-not-in-argv")
+    # A pid that cannot exist: must be False, not an exception.
+    assert not proc_utils.cmdline_matches(2 ** 22 + 12345, "python")
+
+
+def test_usage_remote_sink_bounded(monkeypatch):
+    """In-flight remote sends are bounded: past the cap new sends are
+    dropped, not threaded (advisor r4 usage_lib finding)."""
+    import threading
+    from skypilot_tpu.utils import usage_lib
+
+    release = threading.Event()
+    started = []
+    _RealThread = threading.Thread  # usage_lib.threading IS this module
+
+    class _FakeThread:
+        def __init__(self, target=None, daemon=None):
+            self._t = _RealThread(target=target, daemon=True)
+
+        def start(self):
+            started.append(self)
+            self._t.start()
+
+        def is_alive(self):
+            return self._t.is_alive()
+
+        def join(self, timeout=None):
+            self._t.join(timeout)
+
+    monkeypatch.setattr(usage_lib.threading, "Thread", _FakeThread)
+    monkeypatch.setattr(usage_lib, "_pending_sends", [])
+
+    def slow_post(url, data=None, headers=None):
+        raise AssertionError("unused")
+
+    # Patch the config read + make the POST hang until released.
+    from skypilot_tpu import config as config_lib
+    monkeypatch.setattr(config_lib, "get_nested",
+                        lambda keys, default=None:
+                        "http://127.0.0.1:1/sink"
+                        if keys == ("usage", "endpoint") else None)
+    import urllib.request as _ur
+
+    def hanging_urlopen(req, timeout=None):
+        release.wait(10)
+        raise OSError("sink down")
+
+    monkeypatch.setattr(_ur, "urlopen", hanging_urlopen)
+    try:
+        for _ in range(usage_lib._MAX_INFLIGHT_SENDS + 5):
+            usage_lib._maybe_send_remote({"ts": 0.0, "op": "x"})
+        assert len(started) == usage_lib._MAX_INFLIGHT_SENDS
+    finally:
+        release.set()

@@ -235,3 +235,85 @@ def test_stop_start_cycle_resets_autostop():
                                 stream_logs=False)
     assert _wait_job(handle, job_id2) == "SUCCEEDED"
     core.down("t-cycle")
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_launch_with_ports_opens_and_cleans_up(monkeypatch):
+    """resources.ports drives the provision SPI's open_ports at launch
+    and cleanup_ports at terminate (VERDICT r4 next #1 done-bar). Spied
+    at the SPI routing layer so the full backend path is exercised."""
+    from skypilot_tpu import provision as provision_api
+    from skypilot_tpu.backends import slice_backend
+    events = []
+    real_open, real_cleanup = (provision_api.open_ports,
+                               provision_api.cleanup_ports)
+    monkeypatch.setattr(
+        slice_backend.provision_api, "open_ports",
+        lambda prov, name, ports, cfg:
+            (events.append(("open", prov, name, tuple(ports))),
+             real_open(prov, name, ports, cfg))[1])
+    monkeypatch.setattr(
+        slice_backend.provision_api, "cleanup_ports",
+        lambda prov, name, ports, cfg:
+            (events.append(("cleanup", prov, name, tuple(ports))),
+             real_cleanup(prov, name, ports, cfg))[1])
+
+    task = Task("portful", run="true")
+    task.set_resources(Resources(cloud="local", ports=("8080",)))
+    _, handle = execution.launch(task, cluster_name="t-ports",
+                                 detach_run=True, stream_logs=False)
+    assert ("open", "local", "t-ports", ("8080",)) in events
+    backend = slice_backend.SliceBackend()
+    backend.teardown(handle, terminate=True)
+    assert ("cleanup", "local", "t-ports", ("8080",)) in events
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_launch_without_ports_skips_port_ops(monkeypatch):
+    from skypilot_tpu.backends import slice_backend
+    called = []
+    monkeypatch.setattr(
+        slice_backend.provision_api, "open_ports",
+        lambda *a, **k: called.append(a))
+    task = Task("portless", run="true")
+    task.set_resources(Resources(cloud="local"))
+    _, handle = execution.launch(task, cluster_name="t-noports",
+                                 detach_run=True, stream_logs=False)
+    assert called == []
+    slice_backend.SliceBackend().teardown(handle, terminate=True)
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_reused_cluster_opens_new_ports(monkeypatch):
+    """`launch -c existing` with new ports must open them (fresh-
+    provision open_ports is skipped on reuse) and persist the union so
+    a later teardown cleans them."""
+    from skypilot_tpu.backends import slice_backend
+    events = []
+    monkeypatch.setattr(
+        slice_backend.provision_api, "open_ports",
+        lambda prov, name, ports, cfg: events.append(
+            ("open", name, tuple(ports))))
+    monkeypatch.setattr(
+        slice_backend.provision_api, "cleanup_ports",
+        lambda prov, name, ports, cfg: events.append(
+            ("cleanup", name, tuple(ports))))
+
+    task = Task("first", run="true")
+    task.set_resources(Resources(cloud="local"))
+    _, handle = execution.launch(task, cluster_name="t-reup",
+                                 detach_run=True, stream_logs=False)
+    assert events == []  # portless launch: no port ops
+
+    task2 = Task("second", run="true")
+    task2.set_resources(Resources(cloud="local", ports=("8080",)))
+    _, handle = execution.launch(task2, cluster_name="t-reup",
+                                 detach_run=True, stream_logs=False)
+    assert ("open", "t-reup", ("8080",)) in events
+    # Union persisted: teardown cleans the rule even though the FIRST
+    # launch had no ports.
+    record = global_user_state.get_cluster_from_name("t-reup")
+    assert record["handle"].launched_resources.ports == ("8080",)
+    slice_backend.SliceBackend().teardown(record["handle"],
+                                          terminate=True)
+    assert ("cleanup", "t-reup", ("8080",)) in events

@@ -331,3 +331,90 @@ def test_transient_error_retryable_in_zone(fake):
         gcp.run_instances("us-east5", ZONE, "c1", _config())
     assert exc.value.retryable_in_zone
     assert exc.value.blocklist_zone is None
+
+
+# ---------------------------------------------------------------- ports
+class FakeComputeService:
+    """In-memory twin of compute.googleapis.com firewalls + operations."""
+
+    def __init__(self):
+        self.firewalls = {}   # name -> rule dict
+        self.calls = []       # (method, path)
+        self._op_n = 0
+
+    def _op(self):
+        self._op_n += 1
+        return {"name": f"op-{self._op_n}", "status": "DONE"}
+
+    def __call__(self, method, path, body=None, params=None):
+        self.calls.append((method, path))
+        if "/global/firewalls" in path:
+            name = path.rsplit("/", 1)[-1]
+            if method == "GET":
+                if name not in self.firewalls:
+                    raise gcp.GcpApiError(404, {"error": {
+                        "status": "NOT_FOUND", "message": "no rule"}})
+                return dict(self.firewalls[name])
+            if method == "POST":
+                self.firewalls[body["name"]] = dict(body)
+                return self._op()
+            if method == "PATCH":
+                self.firewalls[name].update(body)
+                return self._op()
+            if method == "DELETE":
+                if name not in self.firewalls:
+                    raise gcp.GcpApiError(404, {"error": {
+                        "status": "NOT_FOUND", "message": "no rule"}})
+                del self.firewalls[name]
+                return self._op()
+        if "/global/operations/" in path:
+            return {"name": path.rsplit("/", 1)[-1], "status": "DONE"}
+        raise AssertionError(f"unexpected compute call {method} {path}")
+
+
+@pytest.fixture()
+def fake_compute(monkeypatch):
+    svc = FakeComputeService()
+    monkeypatch.setattr(gcp, "compute_rest", svc)
+    monkeypatch.setattr(gcp, "_gcloud_project", lambda: "testproj")
+    return svc
+
+
+def test_open_ports_creates_tagged_rule(fake_compute):
+    gcp.open_ports("c1", ["8080", "30000-30100"], _config())
+    rule = fake_compute.firewalls[gcp._firewall_rule_name("c1")]
+    assert rule["direction"] == "INGRESS"
+    assert rule["targetTags"] == [gcp._network_tag("c1")]
+    assert rule["allowed"] == [
+        {"IPProtocol": "tcp", "ports": ["30000-30100", "8080"]}]
+    assert rule["network"].endswith("/global/networks/default")
+
+
+def test_open_ports_idempotent_and_merging(fake_compute):
+    gcp.open_ports("c1", ["8080"], _config())
+    calls_after_create = len(fake_compute.calls)
+    # Same ports again: GET only, no PATCH.
+    gcp.open_ports("c1", ["8080"], _config())
+    assert len(fake_compute.calls) == calls_after_create + 1
+    # New port merges instead of clobbering (serve LB range must survive
+    # a later launch-with-ports against the same cluster).
+    gcp.open_ports("c1", ["9090"], _config())
+    rule = fake_compute.firewalls[gcp._firewall_rule_name("c1")]
+    assert rule["allowed"][0]["ports"] == ["8080", "9090"]
+
+
+def test_cleanup_ports_deletes_rule_and_tolerates_absent(fake_compute):
+    gcp.open_ports("c1", ["8080"], _config())
+    gcp.cleanup_ports("c1", ["8080"], _config())
+    assert not fake_compute.firewalls
+    gcp.cleanup_ports("c1", ["8080"], _config())  # 404 swallowed
+
+
+def test_node_body_carries_network_tag(fake):
+    gcp.run_instances("us-east5", ZONE, "c1", _config())
+    assert fake.nodes["c1-s0"]["tags"] == [gcp._network_tag("c1")]
+
+
+def test_invalid_port_spec_rejected(fake_compute):
+    with pytest.raises(exceptions.ProvisionError):
+        gcp.open_ports("c1", ["not-a-port"], _config())

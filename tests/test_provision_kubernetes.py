@@ -17,6 +17,7 @@ class FakeKubectl:
 
     def __init__(self):
         self.pods = {}          # name -> manifest (with injected status)
+        self.services = {}      # name -> manifest
         self.calls = []
         self.fail_create_with = None
         self.default_phase = "Pending"
@@ -24,6 +25,17 @@ class FakeKubectl:
     def __call__(self, args, input_obj=None, namespace=None):
         self.calls.append((tuple(args), namespace))
         verb = args[0]
+        if verb == "apply":
+            self.services[input_obj["metadata"]["name"]] = dict(input_obj)
+            return {}
+        if verb == "get" and args[1] == "service":
+            if args[2] not in self.services:
+                raise exceptions.ProvisionError(
+                    f'services "{args[2]}" not found')
+            return dict(self.services[args[2]])
+        if verb == "delete" and args[1] == "service":
+            self.services.pop(args[2], None)
+            return {}
         if verb == "create":
             if self.fail_create_with:
                 raise exceptions.ProvisionError(self.fail_create_with)
@@ -257,3 +269,34 @@ def test_dead_pods_recreated_not_adopted(fake):
     rec = k8s.run_instances(None, None, "c1", _config(hosts_per_slice=2))
     assert rec.created_instance_ids == ["c1-s0-h1"]
     assert rec.resumed_instance_ids == ["c1-s0-h0"]
+
+
+# ------------------------------------------------------------------ ports
+def test_open_ports_creates_nodeport_service(fake):
+    k8s.open_ports("c1", ["8080", "30000-30002"], _config())
+    svc = fake.services["c1-ports"]
+    assert svc["spec"]["type"] == "NodePort"
+    # Targets the head pod only (slice 0, host 0).
+    assert svc["spec"]["selector"] == {
+        "stpu-cluster": "c1", "stpu-slice": "slice-0",
+        "stpu-host-index": "0"}
+    assert [p["port"] for p in svc["spec"]["ports"]] == [
+        8080, 30000, 30001, 30002]
+
+
+def test_open_ports_merges_existing(fake):
+    k8s.open_ports("c1", ["8080"], _config())
+    k8s.open_ports("c1", ["9090"], _config())
+    svc = fake.services["c1-ports"]
+    assert [p["port"] for p in svc["spec"]["ports"]] == [8080, 9090]
+
+
+def test_cleanup_ports_deletes_service(fake):
+    k8s.open_ports("c1", ["8080"], _config())
+    k8s.cleanup_ports("c1", ["8080"], _config())
+    assert not fake.services
+
+
+def test_open_ports_rejects_wild_range(fake):
+    with pytest.raises(exceptions.ProvisionError):
+        k8s.open_ports("c1", ["1-65535"], _config())

@@ -1,31 +1,29 @@
-"""Serve-side decode throughput on the real chip (llama + Mixtral MoE).
+"""Serve-side decode throughput on the real chip (llama / Mixtral MoE /
+gemma) — CLI front-end over the shared measurement core
+(skypilot_tpu/benchmark/decode_bench.py), which bench.py's `serving`
+leg also uses so hand runs and the driver-tracked BENCH json can't
+drift.
 
-Measures incremental decode (prefill + KV-cached per-token steps;
-dense top-2 expert routing for MoE) in tokens/second at a fixed batch —
-the numbers behind docs/performance.md's serving rows. Models are
-scaled to fit one v5e chip (full 8x7B / 8B need a pod slice).
-
-Usage: python tools/bench_moe_decode.py [--family mixtral|llama]
+Usage: python tools/bench_moe_decode.py [--family mixtral|llama|gemma]
            [--batch 8] [--tokens 128]
 """
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import json
+import pathlib
 import sys
-import time
 
-import jax
-import jax.numpy as jnp
-
-from skypilot_tpu.models import llama as llama_lib
-from skypilot_tpu.models import mixtral
+# Runnable as `python tools/bench_moe_decode.py` from anywhere: the
+# script dir (tools/) is what lands on sys.path, not the repo root.
+# NEVER via PYTHONPATH=<repo> — that clobbers the axon sitecustomize
+# path and un-registers the TPU tunnel platform.
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--family", choices=("mixtral", "llama"),
+    p.add_argument("--family", choices=("mixtral", "llama", "gemma"),
                    default="mixtral")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=128)
@@ -35,60 +33,18 @@ def main() -> None:
     p.add_argument("--experts", type=int, default=8)
     args = p.parse_args()
 
-    if args.family == "llama":
-        if any(f in sys.argv
-               for f in ("--dim", "--layers", "--experts")):
-            p.error("--dim/--layers/--experts only apply to "
-                    "--family mixtral (llama shape is fixed)")
-        mdl = llama_lib
-        cfg = llama_lib.LlamaConfig(
-            vocab_size=32768, dim=2048, n_heads=16, n_kv_heads=8,
-            mlp_dim=8192, n_layers=16, max_seq_len=2048)
-    else:
-        mdl = mixtral
-        cfg = dataclasses.replace(
-            mixtral.MixtralConfig.mixtral_8x7b(),
-            vocab_size=32768, dim=args.dim, n_layers=args.layers,
-            n_heads=16, n_kv_heads=8, mlp_dim=3584,
-            n_experts=args.experts, max_seq_len=2048)
-    params = mdl.init(cfg, jax.random.key(0))
-    b, s = args.batch, args.prompt_len
-    prompt = jax.random.randint(jax.random.key(1), (b, s), 0,
-                                cfg.vocab_size)
-    max_seq = s + args.tokens
+    shape_kw = {}
+    if args.family == "mixtral":
+        shape_kw = dict(dim=args.dim, layers=args.layers,
+                        experts=args.experts)
+    elif any(f in sys.argv for f in ("--dim", "--layers", "--experts")):
+        p.error("--dim/--layers/--experts only apply to "
+                "--family mixtral (llama/gemma shapes are fixed)")
 
-    # Jitted end-to-end like the serving recipe (recipes/serve_llm.py
-    # _decode): unjitted, every eager op pays the tunnel's dispatch
-    # latency and the measurement is of the host, not the chip.
-    decode_jit = jax.jit(
-        lambda p, pr, tl: mdl.decode(cfg, p, pr, tl, args.tokens,
-                                     max_seq))
-
-    def run():
-        out = decode_jit(params, prompt, jnp.int32(s))
-        return int(out[0, -1])  # value fetch forces completion
-
-    run()                      # compile + warm
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        run()
-        best = min(best, time.perf_counter() - t0)
-    toks = b * args.tokens
-    print(json.dumps({
-        "model": {"family": args.family, "dim": cfg.dim,
-                  "layers": cfg.n_layers,
-                  "experts": getattr(cfg, "n_experts", 0),
-                  "mlp_dim": cfg.mlp_dim,
-                  "params": sum(x.size for x in
-                                jax.tree.leaves(params))},
-        "batch": b,
-        "prompt_len": s,
-        "decode_tokens": args.tokens,
-        "decode_seconds": round(best, 3),
-        "tokens_per_sec": round(toks / best, 1),
-        "ms_per_token_per_seq": round(best / args.tokens * 1e3, 2),
-    }))
+    from skypilot_tpu.benchmark import decode_bench
+    print(json.dumps(decode_bench.measure_decode(
+        args.family, batch=args.batch, prompt_len=args.prompt_len,
+        tokens=args.tokens, **shape_kw)))
 
 
 if __name__ == "__main__":
