@@ -117,6 +117,13 @@ def _retry_backoff_seconds(retry_round: int) -> float:
     return base * random.uniform(0.8, 1.2)
 
 
+def default_cluster_name() -> str:
+    """Cluster name for a nameless `launch` — ONE definition, shared
+    with the CLI's confirm-plan lookup so the prompt and the backend
+    can never target different clusters."""
+    return f"stpu-{getpass.getuser()}"
+
+
 class SliceBackend(backend_lib.Backend[SliceHandle]):
     NAME = "slice"
 
@@ -124,7 +131,7 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
     def _provision(self, task, to_provision: Optional[Resources], dryrun,
                    stream_logs, cluster_name, retry_until_up):
         if cluster_name is None:
-            cluster_name = f"stpu-{getpass.getuser()}"
+            cluster_name = default_cluster_name()
         if to_provision is None:
             to_provision = task.best_resources or task.resources[0]
         if dryrun:

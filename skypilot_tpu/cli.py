@@ -49,6 +49,40 @@ def cli():
     """stpu: launch, manage, and serve AI workloads on TPU slices."""
 
 
+def _confirm_launch_plan(task, cluster_name) -> None:
+    """Print the optimized plan and ask before provisioning a NEW
+    cluster. Pins task.best_resources so execution.launch does not
+    re-optimize (the table prints once)."""
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu import optimizer as optimizer_lib
+    from skypilot_tpu.backends import slice_backend
+    from skypilot_tpu.status_lib import ClusterStatus
+    from skypilot_tpu.utils import dag_utils
+
+    name = cluster_name or slice_backend.default_cluster_name()
+    record = global_user_state.get_cluster_from_name(name)
+    if record is not None and record["status"] == ClusterStatus.UP:
+        click.echo(f"Running on existing cluster {name!r}.")
+        return
+    if record is not None and record["handle"] is not None:
+        # STOPPED cluster: provisioning RESTARTS it with its stored
+        # resources — re-optimizing here would show (and pin) a plan
+        # the backend will ignore. Confirm what will actually run.
+        res = getattr(record["handle"], "launched_resources", None)
+        click.echo(f"Cluster {name!r} is stopped; restarting with its "
+                   f"existing resources: {res!r}.")
+        click.confirm(f"Restart cluster {name!r}. Proceed?",
+                      default=True, abort=True)
+        return
+    dag = dag_utils.convert_entrypoint_to_dag(task)
+    try:
+        optimizer_lib.Optimizer.optimize(dag)  # prints the plan table
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    click.confirm(f"Launching a new cluster {name!r}. Proceed?",
+                  default=True, abort=True)
+
+
 @cli.command()
 @click.argument("entrypoint", required=True)
 @click.option("--cluster", "-c", default=None, help="Cluster name.")
@@ -68,9 +102,11 @@ def cli():
 @click.option("--idle-minutes-to-autostop", "-i", type=int, default=None)
 @click.option("--retry-until-up", is_flag=True)
 @click.option("--no-setup", is_flag=True)
+@click.option("--yes", "-y", is_flag=True,
+              help="Skip the launch confirmation prompt.")
 def launch(entrypoint, cluster, env, num_nodes, accelerator, use_spot,
            zone, region, cloud, dryrun, down, detach_run,
-           idle_minutes_to_autostop, retry_until_up, no_setup):
+           idle_minutes_to_autostop, retry_until_up, no_setup, yes):
     """Launch a task YAML on a (new or existing) slice cluster."""
     from skypilot_tpu import execution
     task = _load_task(entrypoint, env, {
@@ -78,6 +114,12 @@ def launch(entrypoint, cluster, env, num_nodes, accelerator, use_spot,
         "use_spot": use_spot, "zone": zone, "region": region,
         "cloud": cloud,
     })
+    # Plan + confirm before spending money (reference:
+    # sky/cli.py:562-592 click.confirm after the optimizer table).
+    # --yes and --dryrun skip it; reusing an already-UP cluster is not a
+    # new spend, so it proceeds without asking too.
+    if not yes and not dryrun:
+        _confirm_launch_plan(task, cluster)
     try:
         job_id, handle = execution.launch(
             task, cluster_name=cluster, dryrun=dryrun, down=down,
@@ -107,27 +149,68 @@ def exec_cmd(cluster, entrypoint, env, detach_run):
     click.echo(f"Job submitted: {job_id} (cluster {cluster})")
 
 
+def _human_ago(ts) -> str:
+    """Unix seconds -> '42s ago' / '3h ago' / '2d ago'."""
+    import time as time_lib
+    if not ts:
+        return "-"
+    delta = max(0, int(time_lib.time() - ts))
+    for unit, secs in (("d", 86400), ("h", 3600), ("m", 60)):
+        if delta >= secs:
+            return f"{delta // secs}{unit} ago"
+    return f"{delta}s ago"
+
+
+def _head_ip(handle) -> str:
+    info = getattr(handle, "cluster_info", None)
+    if info is None:
+        return "-"
+    try:
+        head = info.get_head_instance()
+    except Exception:  # noqa: BLE001 — partial/stale handle
+        return "-"
+    if head is None:
+        return "-"
+    return head.external_ip or head.internal_ip or "-"
+
+
+def _price_per_hr(handle) -> str:
+    res = getattr(handle, "launched_resources", None)
+    if res is None:
+        return "-"
+    try:
+        nodes = getattr(handle, "num_slices", 1) or 1
+        return f"{res.hourly_price() * nodes:.2f}"
+    except exceptions.SkyTpuError:
+        return "-"  # accelerator missing from the catalog
+
+
 @cli.command()
 @click.option("--refresh", "-r", is_flag=True,
               help="Reconcile with provider truth.")
 def status(refresh):
-    """List clusters."""
+    """List clusters (with launch age, head IP, and $/hr — reference:
+    `sky status` table, sky/cli.py:1571)."""
     from skypilot_tpu import core
     records = core.status(refresh=refresh)
     if not records:
         click.echo("No existing clusters.")
         return
-    fmt = "{:<20} {:<28} {:<8} {:<10} {:>9}"
-    click.echo(fmt.format("NAME", "RESOURCES", "NODES", "STATUS",
-                          "AUTOSTOP"))
+    fmt = "{:<20} {:<10} {:<28} {:<6} {:<10} {:>8} {:<15} {:>7}"
+    click.echo(fmt.format("NAME", "LAUNCHED", "RESOURCES", "NODES",
+                          "STATUS", "AUTOSTOP", "HEAD_IP", "$/HR"))
     for r in records:
         handle = r["handle"]
         res = getattr(handle, "launched_resources", None)
+        autostop = f"{r['autostop']}m" if r["autostop"] >= 0 else "-"
+        if r["autostop"] >= 0 and r.get("to_down"):
+            autostop += "(down)"
         click.echo(fmt.format(
-            r["name"], repr(res) if res else "-",
+            r["name"], _human_ago(r.get("launched_at")),
+            repr(res) if res else "-",
             getattr(handle, "num_slices", "-"),
-            r["status"].value,
-            f"{r['autostop']}m" if r["autostop"] >= 0 else "-"))
+            r["status"].value, autostop, _head_ip(handle),
+            _price_per_hr(handle)))
 
 
 @cli.command()
@@ -190,14 +273,29 @@ def autostop(cluster, idle_minutes, down_after):
 @click.option("--all-jobs", "-a", is_flag=True, default=False,
               help="Include finished jobs.")
 def queue(cluster, all_jobs):
-    """Show the cluster's job queue."""
+    """Show the cluster's job queue (reference `sky queue` columns:
+    ID/NAME/SUBMITTED/STARTED/DURATION/STATUS)."""
     from skypilot_tpu import core
     jobs = core.queue(cluster, all_jobs=all_jobs)
-    fmt = "{:<6} {:<20} {:<12} {:<10}"
-    click.echo(fmt.format("ID", "NAME", "STATUS", "USER"))
+    fmt = "{:<6} {:<20} {:<10} {:<12} {:<12} {:<10} {:<10}"
+    click.echo(fmt.format("ID", "NAME", "USER", "SUBMITTED", "STARTED",
+                          "DURATION", "STATUS"))
+    import time as time_lib
     for j in jobs:
-        click.echo(fmt.format(j["job_id"], j["job_name"] or "-",
-                              j["status"], j["username"] or "-"))
+        start, end = j.get("start_at"), j.get("end_at")
+        if start:
+            dur = int((end or time_lib.time()) - start)
+            duration = (f"{dur // 3600}h{(dur % 3600) // 60}m"
+                        if dur >= 3600 else
+                        f"{dur // 60}m{dur % 60}s" if dur >= 60
+                        else f"{dur}s")
+        else:
+            duration = "-"
+        click.echo(fmt.format(
+            j["job_id"], j["job_name"] or "-", j["username"] or "-",
+            _human_ago(j.get("submitted_at")),
+            _human_ago(start) if start else "-", duration,
+            j["status"]))
 
 
 @cli.command()
@@ -311,7 +409,9 @@ def jobs():
 @click.option("--name", "-n", default=None, help="Managed job name.")
 @click.option("--env", multiple=True, help="KEY=VALUE env overrides.")
 @click.option("--detach-run", "-d", is_flag=True)
-def jobs_launch(entrypoint, name, env, detach_run):
+@click.option("--yes", "-y", is_flag=True,
+              help="Skip the launch confirmation prompt.")
+def jobs_launch(entrypoint, name, env, detach_run, yes):
     """Launch a managed job from a task YAML (single task or multi-doc
     chain pipeline)."""
     from skypilot_tpu import jobs as jobs_sdk
@@ -322,6 +422,18 @@ def jobs_launch(entrypoint, name, env, detach_run):
             entrypoint, env_overrides=_parse_env(env))
     except exceptions.SkyTpuError as e:
         raise click.ClickException(str(e)) from e
+    if not yes:
+        # Managed jobs launch fresh clusters per task (plus recovery
+        # relaunches): always show the plan and ask.
+        from skypilot_tpu import optimizer as optimizer_lib
+        try:
+            optimizer_lib.Optimizer.optimize(dag)  # prints the table
+        except exceptions.SkyTpuError as e:
+            raise click.ClickException(str(e)) from e
+        click.confirm(
+            f"Launching managed job {name or dag.tasks[0].name!r} "
+            f"({len(dag.tasks)} task(s)). Proceed?",
+            default=True, abort=True)
     job_id = jobs_sdk.launch(dag, name=name)
     click.echo(f"Managed job {job_id} submitted.")
     if not detach_run:
@@ -331,13 +443,15 @@ def jobs_launch(entrypoint, name, env, detach_run):
 @jobs.command(name="queue")
 @click.option("--skip-finished", "-s", is_flag=True)
 def jobs_queue(skip_finished):
-    """List managed jobs."""
+    """List managed jobs (reference `sky jobs queue` columns)."""
     from skypilot_tpu.jobs import core as jobs_core
-    fmt = "{:<5} {:<20} {:<18} {:>9} {:<24}"
-    click.echo(fmt.format("ID", "NAME", "STATUS", "#RECOVER", "CLUSTER"))
+    fmt = "{:<5} {:<20} {:<10} {:<18} {:>9} {:<24}"
+    click.echo(fmt.format("ID", "NAME", "SUBMITTED", "STATUS",
+                          "#RECOVER", "CLUSTER"))
     for j in jobs_core.queue(skip_finished=skip_finished):
         click.echo(fmt.format(
-            j["job_id"], (j["job_name"] or "-")[:20], j["status"],
+            j["job_id"], (j["job_name"] or "-")[:20],
+            _human_ago(j.get("submitted_at")), j["status"],
             j["recovery_count"], j["cluster_name"] or "-"))
 
 
@@ -518,10 +632,32 @@ def serve():
 @click.argument("entrypoint", required=True)
 @click.option("--service-name", "-n", default=None)
 @click.option("--env", multiple=True, help="KEY=VALUE env overrides.")
-def serve_up(entrypoint, service_name, env):
+@click.option("--yes", "-y", is_flag=True,
+              help="Skip the confirmation prompt.")
+def serve_up(entrypoint, service_name, env, yes):
     """Start a service from a task YAML with a `service:` section."""
     from skypilot_tpu.serve import core as serve_core
     task = _load_task(entrypoint, env, {})
+    if not yes:
+        # Replica-fleet cost preview: the controller launches
+        # min_replicas clusters of the replica resources (plus the
+        # controller cluster itself in cluster mode).
+        from skypilot_tpu import optimizer as optimizer_lib
+        spec = task.service
+        replicas = getattr(spec, "min_replicas", 1) if spec else 1
+        try:
+            cands = optimizer_lib.launchable_candidates(task)
+        except exceptions.SkyTpuError as e:
+            raise click.ClickException(str(e)) from e
+        if cands:
+            best = min(cands, key=lambda c: c.hourly_price)
+            click.echo(
+                f"Service replicas: {replicas} x {best.resources!r} @ "
+                f"${best.hourly_price:.2f}/hr each "
+                f"(~${replicas * best.hourly_price:.2f}/hr total).")
+        click.confirm(f"Start service "
+                      f"{service_name or task.name or 'service'!r}?",
+                      default=True, abort=True)
     name, endpoint = serve_core.up(task, service_name)
     click.echo(f"Service {name} starting; endpoint: {endpoint}")
 

@@ -146,10 +146,23 @@ def init(cfg: LlamaConfig, key: jax.Array) -> Params:
     return params
 
 
-def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+def rms_norm(x: jax.Array, w: jax.Array, eps: float,
+             offset: float = 0.0) -> jax.Array:
+    """``offset`` generalizes the scale to (offset + w): llama/mixtral
+    use offset 0 (scale = w, init ones); gemma uses offset 1 (scale =
+    1 + w, init zeros — its checkpoint convention). Configs advertise it
+    via ``norm_offset``."""
     x32 = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
-    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+    normed32 = x32 * jax.lax.rsqrt(var + eps)
+    if offset:
+        # Scale applied in fp32: in bf16, eps(1.0)=2^-8, so gemma
+        # checkpoint norm deltas under ~0.002 would vanish into the
+        # (offset + w) addition (and into the product) if done in the
+        # weight dtype.
+        return (normed32 *
+                (w.astype(jnp.float32) + offset)).astype(x.dtype)
+    return normed32.astype(x.dtype) * w
 
 
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -189,12 +202,24 @@ def qkv_proj(cfg, y: jax.Array, lp: Params, positions: jax.Array):
             rope(kk, positions, cfg.rope_theta), vv)
 
 
+def _mlp_activation(cfg):
+    """Gated-MLP nonlinearity by config: SwiGLU (llama/mixtral, the
+    default) or GeGLU with tanh-approx gelu (gemma)."""
+    name = getattr(cfg, "mlp_activation", "silu")
+    if name == "silu":
+        return jax.nn.silu
+    if name == "gelu_tanh":
+        return lambda a: jax.nn.gelu(a, approximate=True)
+    raise ValueError(f"unknown mlp_activation {name!r}")
+
+
 def mlp_block(cfg, x: jax.Array, lp: Params,
               constrain=lambda a, _spec: a) -> jax.Array:
-    """Pre-norm SwiGLU MLP residual block, shared by training and
-    decode."""
-    y = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(y @ lp["w_gate"])
+    """Pre-norm gated-MLP residual block (SwiGLU or GeGLU by config),
+    shared by training and decode."""
+    y = rms_norm(x, lp["mlp_norm"], cfg.norm_eps,
+                 getattr(cfg, "norm_offset", 0.0))
+    gate = _mlp_activation(cfg)(y @ lp["w_gate"])
     up = y @ lp["w_up"]
     mlp = constrain(gate * up, ("batch", "act_seq", "mlp"))
     return x + constrain(mlp @ lp["w_down"],
@@ -210,7 +235,8 @@ def attention_block(cfg, x: jax.Array, lp: Params, positions: jax.Array,
     """
     b, s, d = x.shape
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    y = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    y = rms_norm(x, lp["attn_norm"], cfg.norm_eps,
+                 getattr(cfg, "norm_offset", 0.0))
     q, kk, vv = qkv_proj(cfg, y, lp, positions)
     q = constrain(q, ("batch", "act_seq", "heads", None))
     kk = constrain(kk, ("batch", "act_seq", "kv_heads", None))
@@ -265,7 +291,8 @@ def _vocab_proj(params: Params, x: jax.Array, constrain) -> jax.Array:
 
 def lm_head(cfg, params: Params, x: jax.Array, constrain) -> jax.Array:
     """Final norm + (tied or untied) output projection, fp32 logits."""
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps,
+                 getattr(cfg, "norm_offset", 0.0))
     return _vocab_proj(params, x, constrain)
 
 
@@ -321,13 +348,17 @@ def forward_trunk(cfg: LlamaConfig, params: Params, tokens: jax.Array,
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     x = embed_tokens(params, tokens, constrain)
+    scale = getattr(cfg, "embed_multiplier", 1.0)
+    if scale != 1.0:  # gemma: embeddings scaled by sqrt(dim)
+        x = (x.astype(jnp.float32) * scale).astype(x.dtype)
     layer_fn = lambda carry, lp: (_layer(cfg, carry, lp, positions,
                                          constrain), None)
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn, prevent_cse=False,
                                   policy=_remat_policy(cfg))
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
-    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return rms_norm(x, params["final_norm"], cfg.norm_eps,
+                    getattr(cfg, "norm_offset", 0.0))
 
 
 def head_weights(params: Params) -> jax.Array:
@@ -358,7 +389,8 @@ def cached_attention_block(cfg, x: jax.Array, lp: Params,
     (x + attn_out, updated ck, updated cv)."""
     b, t = x.shape[0], x.shape[1]
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    y = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    y = rms_norm(x, lp["attn_norm"], cfg.norm_eps,
+                 getattr(cfg, "norm_offset", 0.0))
     q, k_new, v_new = qkv_proj(cfg, y, lp, positions)
     ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype),
                                       (0, start_pos, 0, 0))
@@ -408,6 +440,9 @@ def forward_with_cache(cfg, params: Params,
     positions = start_pos + jnp.arange(t)[None, :]        # (1, T) bcast
     positions = jnp.broadcast_to(positions, (b, t))
     x = params["embed"][tokens]
+    scale = getattr(cfg, "embed_multiplier", 1.0)
+    if scale != 1.0:  # gemma: embeddings scaled by sqrt(dim)
+        x = (x.astype(jnp.float32) * scale).astype(x.dtype)
 
     kpos = jnp.arange(max_seq)                            # (max_seq,)
     # Causal over absolute positions, clipped to the valid prefix;

@@ -1,0 +1,33 @@
+"""Gemma LoRA finetune — the llama_lora machinery on the gemma family.
+
+Reference analog: llm/gemma (the reference's Gemma recipes launch HF
+containers; /root/reference/llm/gemma/README.md). Native version: the
+shared LoRA loop (recipes/llama_lora.run_lora) with gemma's config —
+adapters ride the same ``lora_dense`` seam in the shared attention
+blocks, so the only gemma-specific code is model selection. Checkpoints
+to a MOUNT-mode bucket resume across preemptions exactly like the llama
+recipe (examples/gemma_lora.yaml).
+
+    python -m skypilot_tpu.recipes.gemma_lora --model tiny --steps 20 \
+        --checkpoint-dir /checkpoints/run1
+"""
+from __future__ import annotations
+
+from skypilot_tpu.models import gemma
+from skypilot_tpu.recipes import llama_lora
+
+
+def main(argv=None) -> dict:
+    args = llama_lora.build_arg_parser(
+        ["tiny", "2b", "7b"], "tiny").parse_args(argv)
+    cfg = {
+        "tiny": gemma.GemmaConfig.tiny,
+        "2b": gemma.GemmaConfig.gemma_2b,
+        "7b": gemma.GemmaConfig.gemma_7b,
+    }[args.model]()
+    return llama_lora.run_lora(gemma, cfg, args,
+                               recipe_name="gemma_lora")
+
+
+if __name__ == "__main__":
+    main()

@@ -63,9 +63,10 @@ def num_params(tree) -> int:
     return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
 
 
-def main(argv=None) -> dict:
+def build_arg_parser(model_choices, default_model) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser()
-    p.add_argument("--model", choices=["tiny", "8b"], default="tiny")
+    p.add_argument("--model", choices=model_choices,
+                   default=default_model)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--batch-size", type=int, default=4)
     p.add_argument("--seq-len", type=int, default=256)
@@ -76,25 +77,35 @@ def main(argv=None) -> dict:
                    help="orbax checkpoint root; a MOUNT-mode bucket path "
                         "makes runs resumable across preemptions")
     p.add_argument("--save-every", type=int, default=10)
-    args = p.parse_args(argv)
+    return p
 
-    ctx = distributed.initialize_from_env()
+
+def main(argv=None) -> dict:
+    args = build_arg_parser(["tiny", "8b"], "tiny").parse_args(argv)
     cfg = (llama.LlamaConfig.llama3_8b() if args.model == "8b"
            else llama.LlamaConfig.tiny())
+    return run_lora(llama, cfg, args, recipe_name="llama_lora")
+
+
+def run_lora(model_lib, cfg, args, recipe_name: str) -> dict:
+    """LoRA finetune loop, generic over the dense model families (llama
+    and gemma share forward/param_specs/lora_dense; gemma_lora.py passes
+    its module + config here)."""
+    ctx = distributed.initialize_from_env()
     if args.seq_len > cfg.max_seq_len:
         raise SystemExit(f"--seq-len {args.seq_len} exceeds model max "
                          f"{cfg.max_seq_len}")
 
     mesh = mesh_lib.make_mesh({"fsdp": -1})
     rules = mesh_lib.DEFAULT_RULES
-    print(f"llama_lora: model={args.model} devices={jax.device_count()} "
+    print(f"{recipe_name}: model={args.model} devices={jax.device_count()} "
           f"rank={ctx.rank}/{ctx.num_nodes}", flush=True)
 
     # Base params: sharded by the rule table (fsdp over embed axes); the
     # adapters are tiny and stay replicated.
     base_shardings = mesh_lib.tree_shardings(mesh, rules,
-                                             llama.param_specs(cfg))
-    base = jax.jit(lambda k: llama.init(cfg, k),
+                                             model_lib.param_specs(cfg))
+    base = jax.jit(lambda k: model_lib.init(cfg, k),
                    out_shardings=base_shardings)(
                        jax.random.PRNGKey(args.seed))
     lora = init_lora(cfg, args.lora_rank, jax.random.PRNGKey(args.seed + 1))
@@ -125,7 +136,7 @@ def main(argv=None) -> dict:
             opt_state = jax.tree.map(_replicate, opt_state,
                                      restored["opt_state"])
             start_step = latest
-            print(f"llama_lora: resumed from step {latest}", flush=True)
+            print(f"{recipe_name}: resumed from step {latest}", flush=True)
 
     def constrain(x, spec):
         return mesh_lib.constrain(x, mesh, rules, spec)
@@ -137,7 +148,7 @@ def main(argv=None) -> dict:
         def loss_fn(lora):
             params = merge_params(base, lora)
             with mesh_lib.use_mesh(mesh, rules):
-                logits = llama.forward(cfg, params, tokens,
+                logits = model_lib.forward(cfg, params, tokens,
                                        constrain=constrain)
             return trainer.cross_entropy_loss(logits[:, :-1],
                                               tokens[:, 1:])
@@ -176,7 +187,7 @@ def main(argv=None) -> dict:
     steps_run = max(args.steps - start_step, 0)
     tokens_seen = steps_run * args.batch_size * args.seq_len
     metrics = {
-        "recipe": "llama_lora",
+        "recipe": recipe_name,
         "model": args.model,
         "lora_params": num_params(lora),
         "base_params": cfg.num_params(),

@@ -26,7 +26,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import jax
 import jax.numpy as jnp
 
-from skypilot_tpu.models import llama, mixtral
+from skypilot_tpu.models import gemma, llama, mixtral
 from skypilot_tpu.train import distributed
 
 
@@ -35,6 +35,8 @@ def _model_api(cfg):
     functions of the model family being served."""
     if isinstance(cfg, mixtral.MixtralConfig):
         return mixtral
+    if isinstance(cfg, gemma.GemmaConfig):
+        return gemma
     return llama
 
 
@@ -245,7 +247,8 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--model",
-                   choices=["tiny", "8b", "mixtral-tiny", "mixtral-8x7b"],
+                   choices=["tiny", "8b", "mixtral-tiny", "mixtral-8x7b",
+                            "gemma-tiny", "gemma-2b", "gemma-7b"],
                    default="tiny")
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--seed", type=int, default=0)
@@ -257,6 +260,9 @@ def main(argv=None):
         "8b": llama.LlamaConfig.llama3_8b,
         "mixtral-tiny": mixtral.MixtralConfig.tiny,
         "mixtral-8x7b": mixtral.MixtralConfig.mixtral_8x7b,
+        "gemma-tiny": gemma.GemmaConfig.tiny,
+        "gemma-2b": gemma.GemmaConfig.gemma_2b,
+        "gemma-7b": gemma.GemmaConfig.gemma_7b,
     }[args.model]()
     params = _model_api(cfg).init(cfg, jax.random.PRNGKey(args.seed))
     httpd = serve(cfg, params, args.port)

@@ -171,6 +171,17 @@ CONFIG_SCHEMA = {
                 "endpoint": {"type": "string"},
             },
         },
+        "catalog": {
+            "type": "object",
+            "additionalProperties": False,
+            "properties": {
+                # TTL-based refresh: re-run the fetcher when the CSVs
+                # are older than this many hours (catalog/__init__.py
+                # _maybe_refresh; reference:
+                # sky/clouds/service_catalog/constants.py:2-4).
+                "refresh_hours": {"type": "number", "minimum": 0},
+            },
+        },
         # Keys the code reads (slice_backend kubernetes plumbing,
         # AzureBlobStore, controller_utils bucket_store) — they must
         # also be schema-legal or a configured user crashes at load.

@@ -1,0 +1,161 @@
+"""Gemma family: architecture deltas, training, decode consistency.
+
+The family exists to exercise the shared llama kernel stack's
+generality (RMSNorm (1+w) offset, GeGLU, MQA, head_dim decoupled from
+dim/n_heads) — so these tests pin exactly those deltas, then run the
+same train/decode contracts the other families have.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import gemma, llama
+from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.train import trainer
+
+
+def test_architecture_deltas_active():
+    """The three gemma knobs actually change the computation (a silent
+    fall-through to llama semantics would pass every other test)."""
+    cfg = gemma.GemmaConfig.tiny(vocab_size=64)
+    params = gemma.init(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, 64)
+
+    # Norm weights init to ZEROS; with offset 1 the scale is identity,
+    # so the forward must produce finite, non-degenerate logits.
+    assert float(jnp.abs(params["final_norm"]).max()) == 0.0
+    logits = gemma.forward(cfg, params, tokens)
+    assert bool(jnp.isfinite(logits).all())
+    assert float(jnp.std(logits)) > 0.01
+
+    # Tied head: no lm_head leaf; head_weights is embed^T.
+    assert "lm_head" not in params
+    np.testing.assert_array_equal(
+        np.asarray(gemma.head_weights(params)),
+        np.asarray(params["embed"].T))
+
+    # MQA + decoupled head_dim in the actual weight shapes.
+    assert cfg.n_kv_heads == 1
+    assert cfg.head_dim != cfg.dim // cfg.n_heads
+    assert params["layers"]["wk"].shape == (
+        cfg.n_layers, cfg.dim, cfg.head_dim)
+
+    # Each knob changes the logits when disabled -> they are all live.
+    for override in ({"norm_offset": 0.0},
+                     {"mlp_activation": "silu"}):
+        other = dataclasses.replace(cfg, **override)
+        changed = gemma.forward(other, params, tokens)
+        assert not np.allclose(np.asarray(changed), np.asarray(logits)), \
+            f"{override} had no effect"
+    # embed_multiplier is a property (sqrt(dim)); check it is applied by
+    # comparing against the shared trunk with a scale-1 lookalike.
+    class _NoScale(gemma.GemmaConfig):
+        embed_multiplier = 1.0
+    noscale = _NoScale(**dataclasses.asdict(cfg))
+    changed = llama.forward(noscale, params, tokens)
+    assert not np.allclose(np.asarray(changed), np.asarray(logits))
+
+
+def test_gemma_train_loss_decreases():
+    cfg = gemma.GemmaConfig.tiny(vocab_size=128)
+    mesh = mesh_lib.make_mesh({"dp": 1}, devices=[jax.devices()[0]])
+    params = gemma.init(cfg, jax.random.key(0))
+    tx = trainer.make_optimizer(trainer.TrainConfig(
+        warmup_steps=1, total_steps=100, learning_rate=1e-2))
+    state = trainer.init_train_state(params, tx)
+    step = trainer.make_train_step(
+        lambda p, t, constrain: gemma.forward(cfg, p, t,
+                                              constrain=constrain),
+        tx, mesh, mesh_lib.DEFAULT_RULES)
+    batch = {"tokens": jax.random.randint(jax.random.key(1), (4, 64),
+                                          0, 128)}
+    state, m0 = step(state, batch)
+    for _ in range(15):
+        state, m = step(state, batch)
+    assert float(m["loss"]) < float(m0["loss"]) * 0.7, \
+        (float(m0["loss"]), float(m["loss"]))
+
+
+def test_gemma_fsdp_sharded_train_step():
+    """The spec tree drives a multi-device fsdp layout exactly like
+    llama's (the point of sharing the spec vocabulary)."""
+    cfg = gemma.GemmaConfig.tiny(vocab_size=128)
+    mesh = mesh_lib.make_mesh({"fsdp": -1})  # all 8 virtual devices
+    params = gemma.init(cfg, jax.random.key(0))
+    tx = trainer.make_optimizer(trainer.TrainConfig(
+        warmup_steps=1, total_steps=100))
+    state = trainer.init_train_state(params, tx)
+    state = jax.device_put(
+        state, trainer.state_shardings(mesh, mesh_lib.DEFAULT_RULES,
+                                       gemma.param_specs(cfg), state))
+    step = trainer.make_train_step(
+        lambda p, t, constrain: gemma.forward(cfg, p, t,
+                                              constrain=constrain),
+        tx, mesh, mesh_lib.DEFAULT_RULES)
+    batch = {"tokens": jax.random.randint(jax.random.key(1), (8, 64),
+                                          0, 128)}
+    state, metrics = step(state, batch)
+    assert bool(jnp.isfinite(metrics["loss"]))
+
+
+def test_gemma_cached_decode_matches_forward():
+    """Prefill + cached steps == re-running the full forward each step —
+    the serving contract, through the SHARED decode loop."""
+    cfg = gemma.GemmaConfig.tiny(vocab_size=128)
+    params = gemma.init(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, 128)
+    toks = gemma.decode(cfg, params, prompt, jnp.int32(8),
+                        max_tokens=4, max_seq=16)
+    assert toks.shape == (2, 4)
+
+    seq = prompt
+    expected = []
+    for _ in range(4):
+        logits = gemma.forward(cfg, params, seq)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        expected.append(nxt)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    expected = jnp.stack(expected, axis=1)
+    assert (toks == expected).all(), (toks, expected)
+
+
+def test_gemma_lora_recipe_runs(tmp_path):
+    from skypilot_tpu.recipes import gemma_lora
+    m = gemma_lora.main(["--model", "tiny", "--steps", "8",
+                         "--batch-size", "2", "--seq-len", "64",
+                         "--checkpoint-dir", str(tmp_path / "ck")])
+    assert m["recipe"] == "gemma_lora"
+    assert m["final_loss"] < m["first_loss"]
+    # Adapters are the only trainables and they are small.
+    assert m["lora_params"] < m["base_params"] * 0.2
+
+
+def test_serve_llm_gemma_endpoint():
+    """The serving recipe's dispatch covers gemma end-to-end (same
+    contract as the mixtral endpoint test)."""
+    import json
+    import threading
+    import urllib.request
+
+    from skypilot_tpu.recipes import serve_llm
+    cfg = gemma.GemmaConfig.tiny(vocab_size=128)
+    params = gemma.init(cfg, jax.random.key(0))
+    ready = threading.Event()
+    httpd = serve_llm.serve(cfg, params, 0, ready_event=ready)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        assert ready.wait(timeout=180)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{httpd.server_address[1]}/generate",
+            data=json.dumps({"prompt": [1, 2, 3],
+                             "max_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read())
+        assert len(out["tokens"]) == 4
+        assert all(0 <= t < 128 for t in out["tokens"])
+    finally:
+        httpd.shutdown()

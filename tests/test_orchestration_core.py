@@ -326,3 +326,54 @@ def test_sync_runs_hosts_concurrently_and_aggregates_failures():
         backend._sync_workdir(FailHandle(), ".")
     msg = str(ei.value)
     assert "2 host(s)" in msg and "h1" in msg and "h2" in msg
+
+
+def test_catalog_ttl_refresh(tmp_path, monkeypatch):
+    """`catalog.refresh_hours`: older CSV -> fetcher runs before
+    pricing; fresh CSV -> no fetch; fetch failure -> warning + stale
+    prices still served (VERDICT r4 next #9)."""
+    import time as time_lib
+
+    from skypilot_tpu import config as config_lib
+
+    calls = []
+
+    def fake_fetch_main():
+        calls.append(1)
+
+    from skypilot_tpu.catalog.data_fetchers import fetch_gcp_tpu
+    monkeypatch.setattr(fetch_gcp_tpu, "main", fake_fetch_main)
+    monkeypatch.setattr(config_lib, "get_nested",
+                        lambda keys, default=None:
+                        24 if keys == ("catalog", "refresh_hours")
+                        else default)
+
+    csv_mtime = (catalog._DATA_DIR / "gcp_tpus.csv").stat().st_mtime
+
+    # Fresh CSV (now): no fetch.
+    monkeypatch.setattr(catalog, "_refresh_checked", False)
+    monkeypatch.setattr(time_lib, "time", lambda: csv_mtime + 3600)
+    catalog._tpu_df.cache_clear()
+    catalog.tpu_price("tpu-v5e-8")
+    assert calls == []
+
+    # Faked clock 48h past the CSV mtime: fetcher runs (once).
+    monkeypatch.setattr(catalog, "_refresh_checked", False)
+    monkeypatch.setattr(time_lib, "time",
+                        lambda: csv_mtime + 48 * 3600)
+    catalog._tpu_df.cache_clear()
+    catalog.tpu_price("tpu-v5e-8")
+    catalog.tpu_price("tpu-v5e-8")  # same process: checked once
+    assert calls == [1]
+
+    # Fetch failure: warning, stale price still served.
+    def broken_fetch():
+        raise RuntimeError("no network")
+    monkeypatch.setattr(fetch_gcp_tpu, "main", broken_fetch)
+    monkeypatch.setattr(catalog, "_refresh_checked", False)
+    catalog._tpu_df.cache_clear()
+    assert catalog.tpu_price("tpu-v5e-8") > 0
+
+    monkeypatch.setattr(catalog, "_refresh_checked", False)
+    catalog._tpu_df.cache_clear()
+    catalog._vm_df.cache_clear()
