@@ -47,7 +47,13 @@ class LlamaConfig:
     # context the kernel re-run it saves dominates.
     # "save_flash_qkv": save_flash plus the roped q/k/v — also skips
     # the qkv-projection recompute for another ~2*S*D*2 bytes/layer.
-    remat_policy: str = "full"    # full|save_flash|save_flash_qkv
+    # "save_flash_offload_qkv": save_flash's HBM budget with
+    # save_flash_qkv's recompute savings — q/k/v park in pinned host
+    # RAM and stream back for the bwd. Long-context default: measured
+    # to match save_flash_qkv at 8k and beat save_flash by +1.5 MFU pts
+    # at 16k+ where pinned qkv OOMs (docs/performance.md).
+    remat_policy: str = "full"
+    # full|save_flash|save_flash_qkv|save_flash_offload_qkv
 
     @property
     def head_dim(self) -> int:
@@ -306,12 +312,24 @@ def _remat_policy(cfg):
     if name == "save_flash_qkv":
         return jax.checkpoint_policies.save_only_these_names(
             "flash_out", "flash_lse", "flash_q", "flash_k", "flash_v")
+    if name == "save_flash_offload_qkv":
+        # save_flash's HBM budget, save_flash_qkv's recompute savings:
+        # kernel outputs stay on-device, the roped q/k/v park in pinned
+        # host RAM and stream back for the bwd. Whether the PCIe/ICI
+        # round-trip beats the qkv-projection recompute is measured in
+        # docs/performance.md (long-context offload experiment).
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=["flash_out", "flash_lse"],
+            names_which_can_be_offloaded=["flash_q", "flash_k",
+                                          "flash_v"],
+            offload_src="device", offload_dst="pinned_host")
     if name != "full":
         # A typo silently degrading to full remat would re-run the
         # quadratic kernel every bwd — the exact cost the knob avoids.
         raise ValueError(
             f"Unknown remat_policy {name!r}; expected 'full', "
-            "'save_flash' or 'save_flash_qkv'.")
+            "'save_flash', 'save_flash_qkv' or "
+            "'save_flash_offload_qkv'.")
     return None
 
 
