@@ -1,0 +1,26 @@
+"""Framework step-callback integrations for `stpu bench`.
+
+Reference analog: sky/callbacks/sky_callback/integrations (keras.py:14,
+pytorch_lightning.py:11, transformers.py:13) — drop-in callbacks that
+let the benchmark harness time USER training code unchanged. The
+TPU-native set differs by ecosystem: the first-class frameworks here
+are jax/flax/optax loops and transformers Trainers (the torch path the
+reference also covers); keras/lightning users can use the generic
+`callbacks.step_iterator` directly.
+
+    # any python loop:
+    from skypilot_tpu import callbacks as sky_callback
+    for batch in sky_callback.step_iterator(loader): ...
+
+    # jax/flax/optax jitted step:
+    from skypilot_tpu.integrations.flax import wrap_train_step
+    train_step = wrap_train_step(train_step)
+
+    # HF transformers Trainer:
+    from skypilot_tpu.integrations.transformers import (
+        SkyTransformersCallback)
+    trainer = Trainer(..., callbacks=[SkyTransformersCallback()])
+
+All integrations are no-ops unless the benchmark harness armed
+``STPU_BENCHMARK_LOG_DIR`` (callbacks.init contract).
+"""

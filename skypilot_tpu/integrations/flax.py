@@ -1,0 +1,54 @@
+"""jax/flax/optax train-step integration for `stpu bench`.
+
+The tpu-native analog of the reference's keras/lightning callbacks
+(sky/callbacks/sky_callback/integrations/keras.py:14): instead of a
+framework callback object, a jitted-step decorator — the natural unit
+of a jax training loop.
+
+    step = wrap_train_step(make_train_step(...), total_steps=1000)
+    for batch in loader:
+        state, metrics = step(state, batch)
+
+Timing notes: steps dispatch asynchronously, but the steady-state
+seconds/step the recorder computes is still the true device rate —
+dispatch backpressures once the device queue fills, so wall-clock
+deltas between completed dispatches converge to device step time (the
+same property the reference's non-blocking callbacks rely on). The
+first (compile) step is excluded by the recorder.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+from skypilot_tpu import callbacks
+
+
+def wrap_train_step(step_fn: Callable, *,
+                    total_steps: Optional[int] = None) -> Callable:
+    """Wrap a (jitted) train step so each invocation is one bench step.
+
+    Arms the recorder on first call (callbacks.init is an env-gated
+    no-op outside a benchmark run, so wrapping is always safe). Arming
+    is skipped when a recorder is already live — wrapping a second
+    function (an eval step, say) must not reset accumulated timings —
+    and registers an exit flush so short runs (< the recorder's
+    write_every) still land their summary without user code calling
+    flush.
+    """
+    armed = []
+
+    @functools.wraps(step_fn)
+    def wrapped(*args, **kwargs):
+        if not armed:
+            if callbacks._state is None:  # noqa: SLF001 — arm once
+                if callbacks.init(total_steps=total_steps):
+                    import atexit
+                    atexit.register(callbacks.flush)
+            armed.append(True)
+        callbacks.step_begin()
+        out = step_fn(*args, **kwargs)
+        callbacks.step_end()
+        return out
+
+    return wrapped

@@ -1,0 +1,39 @@
+"""HF transformers Trainer integration for `stpu bench`.
+
+Reference analog: sky/callbacks/sky_callback/integrations/
+transformers.py:13 (SkyTransformersCallback wrapping TrainerCallback).
+Add to any Trainer and `stpu bench` times the steps with the user's
+training code unchanged:
+
+    from skypilot_tpu.integrations.transformers import (
+        SkyTransformersCallback)
+    trainer = Trainer(model=..., args=...,
+                      callbacks=[SkyTransformersCallback()])
+
+No-op unless the benchmark harness exported STPU_BENCHMARK_LOG_DIR.
+"""
+from __future__ import annotations
+
+from skypilot_tpu import callbacks
+
+try:
+    from transformers import TrainerCallback as _TrainerCallback
+except ImportError:  # transformers not installed: degrade to a plain
+    _TrainerCallback = object  # class so importing this module works
+
+
+class SkyTransformersCallback(_TrainerCallback):
+    """TrainerCallback bridging HF step events to the bench recorder."""
+
+    def on_train_begin(self, args, state, control, **kwargs):
+        total = getattr(state, "max_steps", None) or None
+        callbacks.init(total_steps=total)
+
+    def on_step_begin(self, args, state, control, **kwargs):
+        callbacks.step_begin()
+
+    def on_step_end(self, args, state, control, **kwargs):
+        callbacks.step_end()
+
+    def on_train_end(self, args, state, control, **kwargs):
+        callbacks.flush()
