@@ -186,13 +186,52 @@ def _price_per_hr(handle) -> str:
 
 
 @cli.command()
+@click.argument("clusters", nargs=-1, required=False)
 @click.option("--refresh", "-r", is_flag=True,
               help="Reconcile with provider truth.")
-def status(refresh):
+@click.option("--endpoints", is_flag=True,
+              help="Show reachable endpoints for each cluster's opened "
+                   "ports (reference: sky status --endpoints).")
+def status(clusters, refresh, endpoints):
     """List clusters (with launch age, head IP, and $/hr — reference:
     `sky status` table, sky/cli.py:1571)."""
     from skypilot_tpu import core
-    records = core.status(refresh=refresh)
+    records = core.status(cluster_names=list(clusters) or None,
+                          refresh=refresh)
+    if endpoints:
+        from skypilot_tpu import provision as provision_api
+        from skypilot_tpu.status_lib import ClusterStatus
+        if not records:
+            click.echo("No matching clusters.")
+            return
+        for r in records:
+            handle = r["handle"]
+            res = getattr(handle, "launched_resources", None)
+            ports = list(res.ports) if res is not None else []
+            if not ports:
+                click.echo(f"{r['name']}: no opened ports")
+                continue
+            # Only an UP cluster has reachable addresses (reference:
+            # sky status --endpoints errors for non-UP clusters).
+            head = _head_ip(handle)
+            if r["status"] != ClusterStatus.UP or head == "-":
+                click.echo(f"{r['name']}: not UP — endpoints "
+                           "unavailable")
+                continue
+            try:
+                eps = provision_api.query_ports(
+                    handle.provider_name, handle.cluster_name, ports,
+                    head, handle.cluster_info.provider_config)
+            except exceptions.SkyTpuError as e:
+                click.echo(f"{r['name']}: {e}")
+                continue
+            if not eps:
+                click.echo(f"{r['name']}: ports {ports} declared but "
+                           "no ingress found (service deleted?)")
+                continue
+            for port in sorted(eps):
+                click.echo(f"{r['name']}: {port} -> http://{eps[port]}")
+        return
     if not records:
         click.echo("No existing clusters.")
         return

@@ -90,6 +90,27 @@ def cleanup_ports(provider_name: str, cluster_name: str, ports: list,
                   provider_config)
 
 
+def query_ports(provider_name: str, cluster_name: str, ports: list,
+                head_ip, provider_config: dict) -> dict:
+    """Reachable endpoints for the cluster's opened ports (reference:
+    sky/provision/__init__.py:145). Returns {port: "host:port"} for
+    every CONCRETE port in ``ports`` (ranges expand). Providers where
+    the requested port passes straight through (GCP firewall, local)
+    build endpoints from ``head_ip``; kubernetes resolves the
+    cluster-assigned nodePorts from the Service."""
+    module = _provider_module(provider_name)
+    fn = getattr(module, "query_ports", None)
+    if fn is not None:
+        return fn(cluster_name, ports, head_ip, provider_config)
+    # Passthrough default: the opened port IS the reachable port.
+    from skypilot_tpu.provision.common import parse_port_ranges
+    out = {}
+    for lo, hi in parse_port_ranges(ports):
+        for p in range(lo, hi + 1):
+            out[p] = f"{head_ip}:{p}"
+    return out
+
+
 def stop_instances(provider_name: str, cluster_name: str,
                    provider_config: dict) -> None:
     return _route(provider_name, "stop_instances", cluster_name,

@@ -15,9 +15,10 @@ TPU-native differences:
 * Exec is SSH-free from the CLIENT: commands reach pods through
   ``kubectl exec`` (utils/command_runner.KubernetesCommandRunner).
   INTRA-cluster (head pod -> worker pods, for the head-resident gang
-  driver) uses pod-IP SSH with the cluster-internal key, so the image
-  must run sshd — the same requirement the reference's kubernetes pods
-  have (its images install+start openssh-server at bootstrap).
+  driver) is ALSO SSH-free since r4: worker pods run the
+  token-authenticated exec agent (agent/exec_server.py), so any image
+  with python3 gangs multi-host — unlike the reference's kubernetes
+  pods, whose bootstrap installs openssh-server.
 * Pods cannot be stopped, only deleted: `stop` raises NotSupportedError
   (clouds/kubernetes.py declares the capability), exactly like TPU pod
   slices.
@@ -379,3 +380,53 @@ def cleanup_ports(cluster_name: str, ports: List[str],
     kubectl(["delete", "service", _ports_service_name(cluster_name),
              "--ignore-not-found", "--wait=false"],
             namespace=_namespace(provider_config))
+
+
+def query_ports(cluster_name: str, ports: List[str], head_ip,
+                provider_config: dict) -> Dict[int, str]:
+    """Resolve reachable endpoints for the cluster's ports Service
+    (reference: sky/provision/kubernetes/network.py query_ports).
+
+    A NodePort Service maps each requested port to a node port — the
+    SAME number when the request was inside the apiserver's NodePort
+    range (open_ports pins it), a cluster-assigned one otherwise. The
+    node address comes from the first node's ExternalIP (InternalIP
+    fallback); ``head_ip`` (the head pod IP) is the last resort and
+    only reachable in-cluster.
+    """
+    namespace = _namespace(provider_config)
+    want = set(_expand_ports(ports))
+    try:
+        svc = kubectl(["get", "service",
+                       _ports_service_name(cluster_name), "-o", "json"],
+                      namespace=namespace)
+    except exceptions.ProvisionError as e:
+        # Only a genuinely-absent Service reads as "no endpoints"; a
+        # transient/auth apiserver error must surface, not print an
+        # empty table (same discrimination as open_ports above).
+        if "not found" in str(e).lower():
+            return {}
+        raise
+    node_addr = None
+    try:
+        nodes = kubectl(["get", "nodes", "-o", "json"]).get("items", [])
+        addrs = {a["type"]: a["address"]
+                 for a in (nodes[0]["status"]["addresses"] if nodes
+                           else [])}
+        node_addr = addrs.get("ExternalIP") or addrs.get("InternalIP")
+    except (exceptions.ProvisionError, KeyError, IndexError):
+        pass
+    out: Dict[int, str] = {}
+    for entry in (svc.get("spec") or {}).get("ports", []):
+        port = int(entry["port"])
+        if port not in want:
+            continue
+        if node_addr is not None:
+            out[port] = f"{node_addr}:{entry.get('nodePort', port)}"
+        else:
+            # No node address visible (nodes RBAC-forbidden): fall back
+            # to the head POD, which listens on the TARGET port — the
+            # nodePort is only bound on nodes. In-cluster reachability
+            # only.
+            out[port] = f"{head_ip}:{port}"
+    return out

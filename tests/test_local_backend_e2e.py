@@ -317,3 +317,24 @@ def test_reused_cluster_opens_new_ports(monkeypatch):
     slice_backend.SliceBackend().teardown(record["handle"],
                                           terminate=True)
     assert ("cleanup", "t-reup", ("8080",)) in events
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_status_endpoints_flag(monkeypatch):
+    """`stpu status --endpoints` maps a cluster's opened ports to
+    reachable endpoints through the provision SPI's query_ports."""
+    from click.testing import CliRunner
+
+    from skypilot_tpu import cli as cli_mod
+    task = Task("portful", run="true")
+    task.set_resources(Resources(cloud="local", ports=("8080",)))
+    execution.launch(task, cluster_name="t-eps", detach_run=True,
+                     stream_logs=False)
+    result = CliRunner().invoke(cli_mod.cli, ["status", "--endpoints",
+                                              "t-eps"])
+    assert result.exit_code == 0, result.output
+    assert "8080 -> http://" in result.output
+    from skypilot_tpu.backends import slice_backend
+    record = global_user_state.get_cluster_from_name("t-eps")
+    slice_backend.SliceBackend().teardown(record["handle"],
+                                          terminate=True)

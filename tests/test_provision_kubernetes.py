@@ -28,6 +28,11 @@ class FakeKubectl:
         if verb == "apply":
             self.services[input_obj["metadata"]["name"]] = dict(input_obj)
             return {}
+        if verb == "get" and args[1] == "nodes":
+            return {"items": [{"status": {"addresses": [
+                {"type": "InternalIP", "address": "10.9.0.1"},
+                {"type": "ExternalIP", "address": "34.1.2.3"},
+            ]}}]}
         if verb == "get" and args[1] == "service":
             if args[2] not in self.services:
                 raise exceptions.ProvisionError(
@@ -300,3 +305,52 @@ def test_cleanup_ports_deletes_service(fake):
 def test_open_ports_rejects_wild_range(fake):
     with pytest.raises(exceptions.ProvisionError):
         k8s.open_ports("c1", ["1-65535"], _config())
+
+
+def test_query_ports_resolves_nodeports(fake):
+    """query_ports returns node_addr:nodePort — the pinned port inside
+    the NodePort range, the cluster-assigned one outside it (reference:
+    sky/provision/__init__.py:145 + kubernetes network query)."""
+    k8s.open_ports("c1", ["8080", "30005"], _config())
+    # Simulate the apiserver assigning a nodePort for the out-of-range
+    # request (open_ports only pins in-range ones).
+    svc = fake.services["c1-ports"]
+    for entry in svc["spec"]["ports"]:
+        if entry["port"] == 8080:
+            entry["nodePort"] = 31234
+    eps = k8s.query_ports("c1", ["8080", "30005"], "10.4.0.0",
+                          _config())
+    assert eps == {8080: "34.1.2.3:31234", 30005: "34.1.2.3:30005"}
+    # No service (ports never opened): empty, not an error.
+    assert k8s.query_ports("nope", ["80"], "10.4.0.0", _config()) == {}
+
+
+def test_query_ports_pod_fallback_uses_target_port(fake, monkeypatch):
+    """Nodes unreadable (RBAC): fall back to head POD ip + TARGET port
+    — the nodePort is only bound on nodes."""
+    k8s.open_ports("c1", ["8080"], _config())
+    svc = fake.services["c1-ports"]
+    svc["spec"]["ports"][0]["nodePort"] = 31234
+    orig = fake.__call__
+
+    def no_nodes(args, input_obj=None, namespace=None):
+        if args[0] == "get" and args[1] == "nodes":
+            raise exceptions.ProvisionError("nodes is forbidden")
+        return orig(args, input_obj=input_obj, namespace=namespace)
+
+    monkeypatch.setattr(k8s, "kubectl", no_nodes)
+    eps = k8s.query_ports("c1", ["8080"], "10.4.0.5", _config())
+    assert eps == {8080: "10.4.0.5:8080"}
+
+
+def test_query_ports_raises_on_transient_api_error(fake, monkeypatch):
+    orig = fake.__call__
+
+    def flaky(args, input_obj=None, namespace=None):
+        if args[0] == "get" and args[1] == "service":
+            raise exceptions.ProvisionError("Unable to connect")
+        return orig(args, input_obj=input_obj, namespace=namespace)
+
+    monkeypatch.setattr(k8s, "kubectl", flaky)
+    with pytest.raises(exceptions.ProvisionError):
+        k8s.query_ports("c1", ["8080"], "10.4.0.5", _config())
