@@ -252,12 +252,39 @@ def status(clusters, refresh, endpoints):
             _price_per_hr(handle)))
 
 
+def _glob_clusters(patterns) -> list:
+    """Expand cluster-name glob patterns against recorded clusters
+    (reference: _get_glob_clusters, sky/cli.py — `sky down "train-*"`).
+    Literal names pass through even when unrecorded so the per-name
+    error message still fires. Matching is fnmatchcase: cluster names
+    are not file paths, so no platform case-folding (the reference's
+    SQL GLOB is case-sensitive too)."""
+    import fnmatch
+
+    from skypilot_tpu import global_user_state
+    known = [r["name"] for r in global_user_state.get_clusters()]
+    out, seen = [], set()
+    for pat in patterns:
+        if any(c in pat for c in "*?["):
+            matches = [n for n in known if fnmatch.fnmatchcase(n, pat)]
+        else:
+            matches = [pat]
+        if not matches:
+            click.echo(f"No clusters match {pat!r}.")
+        for name in matches:
+            if name not in seen:
+                seen.add(name)
+                out.append(name)
+    return out
+
+
 @cli.command()
 @click.argument("clusters", nargs=-1, required=True)
 def stop(clusters):
-    """Stop cluster(s) (single-host slices only; pods are down-only)."""
+    """Stop cluster(s) (single-host slices only; pods are down-only).
+    Names may be glob patterns ("train-*")."""
     from skypilot_tpu import core
-    for name in clusters:
+    for name in _glob_clusters(clusters):
         try:
             core.stop(name)
             click.echo(f"Stopped {name}.")
@@ -268,10 +295,13 @@ def stop(clusters):
 @cli.command()
 @click.argument("clusters", nargs=-1, required=True)
 def start(clusters):
-    """Restart stopped cluster(s)."""
+    """Restart stopped cluster(s). Names may be glob patterns."""
     from skypilot_tpu import core
-    for name in clusters:
-        core.start(name)
+    for name in _glob_clusters(clusters):
+        try:
+            core.start(name)
+        except exceptions.SkyTpuError as e:
+            raise click.ClickException(str(e)) from e
         click.echo(f"Started {name}.")
 
 
@@ -281,13 +311,25 @@ def start(clusters):
               help="Remove state even if cloud teardown fails.")
 @click.option("--yes", "-y", is_flag=True)
 def down(clusters, purge, yes):
-    """Terminate cluster(s)."""
+    """Terminate cluster(s). Names may be glob patterns ("train-*")."""
     from skypilot_tpu import core
+    names = _glob_clusters(clusters)
+    if not names:
+        return
     if not yes:
-        click.confirm(f"Terminate {', '.join(clusters)}?", abort=True)
-    for name in clusters:
-        core.down(name, purge=purge)
+        click.confirm(f"Terminate {', '.join(names)}?", abort=True)
+    failures = []
+    for name in names:
+        # One bad name (typo alongside a glob) must not strand the
+        # clusters after it in the expanded list.
+        try:
+            core.down(name, purge=purge)
+        except exceptions.SkyTpuError as e:
+            failures.append(f"{name}: {e}")
+            continue
         click.echo(f"Terminated {name}.")
+    if failures:
+        raise click.ClickException("; ".join(failures))
 
 
 @cli.command()

@@ -188,3 +188,31 @@ def test_status_and_queue_table_columns(runner, tmp_state_dir):
         assert col in header, header
     assert "ago" in result.output
     runner.invoke(cli.cli, ["down", "tbl", "--yes"])
+
+
+def test_down_accepts_glob_patterns(runner, tmp_state_dir, tmp_path):
+    """`stpu down "pat-*"` expands against recorded clusters
+    (reference: _get_glob_clusters)."""
+    yaml_path = tmp_path / "t.yaml"
+    yaml_path.write_text("resources:\n  cloud: local\nrun: echo hi\n")
+    for name in ("gl-a", "gl-b", "other"):
+        result = runner.invoke(cli.cli, [
+            "launch", str(yaml_path), "-c", name, "--detach-run", "-y"])
+        assert result.exit_code == 0, result.output
+    result = runner.invoke(cli.cli, ["down", "gl-*", "--yes"])
+    assert result.exit_code == 0, result.output
+    assert "Terminated gl-a." in result.output
+    assert "Terminated gl-b." in result.output
+    assert "other" not in result.output
+    result = runner.invoke(cli.cli, ["status"])
+    assert "other" in result.output and "gl-a" not in result.output
+    result = runner.invoke(cli.cli, ["down", "nope-*", "--yes"])
+    assert "No clusters match" in result.output
+    # A typo literal mixed with a glob reports the error AFTER the
+    # matched clusters were still torn down.
+    result = runner.invoke(cli.cli, ["down", "typo-name", "other",
+                                     "--yes"])
+    assert result.exit_code != 0
+    assert "Terminated other." in result.output
+    result = runner.invoke(cli.cli, ["status"])
+    assert "other" not in result.output
