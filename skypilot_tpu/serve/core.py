@@ -78,6 +78,36 @@ def _endpoint_host(handle) -> str:
     return head.external_ip or head.internal_ip or "127.0.0.1"
 
 
+def _lb_endpoints(handle, lb_ports: List[int]) -> Dict[int, str]:
+    """Reachable LB endpoints via the provision SPI's query_ports (ONE
+    call for all ports — on kubernetes each call costs kubectl
+    subprocesses): on GCP/local it's head_ip:port (firewall
+    passthrough); on kubernetes the pod IP is in-cluster-only, so this
+    resolves node_ip:nodePort from the ports Service (the LB range is
+    pinned inside the NodePort range for exactly this). A query failure
+    or missing ingress falls back to head_ip:port WITH a warning — the
+    degraded endpoint may be in-cluster-only, and silence would read as
+    reachable."""
+    from skypilot_tpu import provision as provision_api
+    host = _endpoint_host(handle)
+    try:
+        eps = provision_api.query_ports(
+            handle.provider_name, handle.cluster_name,
+            [str(p) for p in lb_ports], host,
+            handle.cluster_info.provider_config)
+    except Exception as e:  # noqa: BLE001 — endpoint resolution is
+        # ancillary to up/status; degrade loudly, never fail them.
+        print(f"warning: could not resolve LB ingress endpoints "
+              f"({e}); falling back to the controller address, which "
+              f"may be reachable only in-cluster", file=sys.stderr)
+        eps = {}
+    return {p: f"http://{eps.get(p, f'{host}:{p}')}" for p in lb_ports}
+
+
+def _lb_endpoint(handle, lb_port: int) -> str:
+    return _lb_endpoints(handle, [lb_port])[lb_port]
+
+
 def up(task: Task, service_name: Optional[str] = None,
        controller: Optional[str] = None) -> Tuple[str, str]:
     """Start a service; returns (service_name, endpoint URL)."""
@@ -113,7 +143,7 @@ def up(task: Task, service_name: Optional[str] = None,
             "--service-name", service_name))
     if "error" in out:
         raise exceptions.SkyTpuError(out["error"])
-    endpoint = f"http://{_endpoint_host(handle)}:{out['lb_port']}"
+    endpoint = _lb_endpoint(handle, out["lb_port"])
     return service_name, endpoint
 
 
@@ -421,9 +451,9 @@ def status(service_names: Optional[List[str]] = None
     services = controller_utils.run_on_controller(
         handle, controller_utils.module_command(
             "skypilot_tpu.serve.core", *args))
-    host = _endpoint_host(handle)
+    eps = _lb_endpoints(handle, [svc["lb_port"] for svc in services])
     for svc in services:
-        svc["endpoint"] = f"http://{host}:{svc['lb_port']}"
+        svc["endpoint"] = eps[svc["lb_port"]]
     return services
 
 

@@ -614,3 +614,34 @@ def test_spot_fallback_rolling_update():
         assert bodies == {"v2"}, bodies
     finally:
         serve_core.down([name], timeout=60)
+
+
+def test_lb_endpoint_resolves_via_query_ports(monkeypatch):
+    """Cluster-mode endpoints ride the provision SPI's query_ports, so
+    a kubernetes-hosted controller reports node_ip:nodePort instead of
+    its in-cluster-only pod IP."""
+    import skypilot_tpu.provision as provision_api
+    from skypilot_tpu.provision.common import ClusterInfo, InstanceInfo
+
+    info = ClusterInfo(provider_name="kubernetes", cluster_name="ctl",
+                       region=None, zone=None,
+                       instances={"p0": InstanceInfo(
+                           instance_id="p0", internal_ip="10.4.0.5",
+                           external_ip=None, slice_id=0, host_index=0)},
+                       head_instance_id="p0", provider_config={})
+
+    class _Handle:
+        provider_name = "kubernetes"
+        cluster_name = "ctl"
+        cluster_info = info
+
+    monkeypatch.setattr(
+        provision_api, "query_ports",
+        lambda prov, name, ports, head, cfg: {30005: "34.1.2.3:30005"})
+    assert serve_core._lb_endpoint(_Handle(), 30005) == \
+        "http://34.1.2.3:30005"
+    # query_ports empty (ingress gone): head-ip fallback, not a crash.
+    monkeypatch.setattr(provision_api, "query_ports",
+                        lambda *a, **k: {})
+    assert serve_core._lb_endpoint(_Handle(), 30005) == \
+        "http://10.4.0.5:30005"
