@@ -76,3 +76,60 @@ def test_launch_run_autostop_down_live():
             core.down(name, purge=True)
         except Exception:  # noqa: BLE001 — already gone
             pass
+
+
+@pytest.mark.timeout(1800)
+def test_ports_firewall_live():
+    """Launch with resources.ports on real GCP: the per-cluster
+    firewall rule exists while the cluster is up, an HTTP server on the
+    opened port answers from THIS machine (outside the VPC), and the
+    rule is deleted on down (VERDICT r4 #1 done-bar, live leg)."""
+    _require_gcp()
+    import urllib.request
+
+    from skypilot_tpu import core, execution
+    from skypilot_tpu.provision import gcp as gcp_provision
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+
+    name = f"stpu-ports-{uuid.uuid4().hex[:6]}"
+    task = Task("ports-smoke", run=(
+        "nohup python3 -m http.server 8080 >/dev/null 2>&1 & "
+        "sleep 2 && echo serving"))
+    task.set_resources(Resources(cloud="gcp", accelerator=_ACCELERATOR,
+                                 ports=("8080",)))
+    try:
+        _, handle = execution.launch(task, cluster_name=name,
+                                     detach_run=True, stream_logs=False)
+        project = gcp_provision._project_of(
+            handle.cluster_info.provider_config)
+        rule = gcp_provision.compute_rest(
+            "GET", f"projects/{project}/global/firewalls/"
+                   f"{gcp_provision._firewall_rule_name(name)}")
+        assert rule["targetTags"] == [gcp_provision._network_tag(name)]
+        head = handle.cluster_info.get_head_instance()
+        deadline = time.time() + 120
+        reachable = False
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://{head.external_ip}:8080/",
+                        timeout=5) as resp:
+                    reachable = resp.status == 200
+                    break
+            except Exception:  # noqa: BLE001 — server still starting
+                time.sleep(3)
+        assert reachable, "opened port not reachable from outside"
+    finally:
+        try:
+            core.down(name, purge=True)
+        except Exception:  # noqa: BLE001 — cluster may not exist
+            pass
+    # Rule cleaned up with the cluster.
+    import pytest as _pytest
+    with _pytest.raises(gcp_provision.GcpApiError) as err:
+        gcp_provision.compute_rest(
+            "GET", f"projects/{gcp_provision._gcloud_project()}"
+                   f"/global/firewalls/"
+                   f"{gcp_provision._firewall_rule_name(name)}")
+    assert err.value.status == 404
