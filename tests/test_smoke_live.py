@@ -93,6 +93,7 @@ def test_ports_firewall_live():
     from skypilot_tpu.task import Task
 
     name = f"stpu-ports-{uuid.uuid4().hex[:6]}"
+    project = None
     task = Task("ports-smoke", run=(
         "nohup python3 -m http.server 8080 >/dev/null 2>&1 & "
         "sleep 2 && echo serving"))
@@ -125,11 +126,11 @@ def test_ports_firewall_live():
             core.down(name, purge=True)
         except Exception:  # noqa: BLE001 — cluster may not exist
             pass
-    # Rule cleaned up with the cluster.
-    import pytest as _pytest
-    with _pytest.raises(gcp_provision.GcpApiError) as err:
+    # Rule cleaned up with the cluster — checked in the SAME project
+    # the rule was created in (the gcloud default may differ).
+    assert project is not None, "launch never resolved a project"
+    with pytest.raises(gcp_provision.GcpApiError) as err:
         gcp_provision.compute_rest(
-            "GET", f"projects/{gcp_provision._gcloud_project()}"
-                   f"/global/firewalls/"
+            "GET", f"projects/{project}/global/firewalls/"
                    f"{gcp_provision._firewall_rule_name(name)}")
     assert err.value.status == 404
