@@ -159,3 +159,27 @@ def test_serve_llm_gemma_endpoint():
         assert all(0 <= t < 128 for t in out["tokens"])
     finally:
         httpd.shutdown()
+
+
+def test_gemma_tp_sharded_train_step():
+    """dp×tp mesh: MQA is the tp edge case — ONE kv head means the kv
+    projection shards over the flattened (kv_heads × head_dim) columns,
+    not over heads; the shared spec vocabulary must still produce a
+    runnable layout."""
+    cfg = gemma.GemmaConfig.tiny(vocab_size=128)
+    mesh = mesh_lib.make_mesh({"dp": 2, "tp": 4})
+    params = gemma.init(cfg, jax.random.key(0))
+    tx = trainer.make_optimizer(trainer.TrainConfig(
+        warmup_steps=1, total_steps=100))
+    state = trainer.init_train_state(params, tx)
+    state = jax.device_put(
+        state, trainer.state_shardings(mesh, mesh_lib.DEFAULT_RULES,
+                                       gemma.param_specs(cfg), state))
+    step = trainer.make_train_step(
+        lambda p, t, constrain: gemma.forward(cfg, p, t,
+                                              constrain=constrain),
+        tx, mesh, mesh_lib.DEFAULT_RULES)
+    batch = {"tokens": jax.random.randint(jax.random.key(1), (8, 64),
+                                          0, 128)}
+    state, metrics = step(state, batch)
+    assert bool(jnp.isfinite(metrics["loss"]))
