@@ -281,6 +281,8 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
         global_user_state.add_or_update_cluster(
             cluster_name, handle=None, requested_resources=res,
             ready=False)
+        provision_api.bootstrap_instances(provider, res.region,
+                                          cluster_name, provider_config)
         provision_api.run_instances(provider, res.region, res.zone,
                                     cluster_name, provider_config)
         if res.ports:

@@ -71,6 +71,20 @@ def get_cluster_info(provider_name: str, region, cluster_name: str,
                   provider_config)
 
 
+def bootstrap_instances(provider_name: str, region, cluster_name: str,
+                        provider_config: dict) -> None:
+    """Pre-provision environment sanity (reference:
+    sky/provision/__init__.py bootstrap_instances backed by
+    sky/provision/gcp/config.py). GCP verifies the VPC exists and
+    ensures ssh/internal ingress so wait-for-SSH cannot hang on a
+    locked-down project. Providers without environment bootstrap
+    (local, docker, kubernetes) simply don't implement it — no-op."""
+    module = _provider_module(provider_name)
+    fn = getattr(module, "bootstrap_instances", None)
+    if fn is not None:
+        fn(region, cluster_name, provider_config)
+
+
 def open_ports(provider_name: str, cluster_name: str, ports: list,
                provider_config: dict) -> None:
     """Open ``ports`` for inbound traffic to the cluster (reference:

@@ -225,12 +225,14 @@ def _node_body(cluster_name: str, slice_index: int, config: dict) -> dict:
         "metadata": config.get("metadata") or {},
         "dataDisks": [],
         "networkConfig": {"enableExternalIps": True},
-        # Network tag every host so cluster-scoped firewall rules
-        # (open_ports) can target the cluster without per-instance
-        # mutation (the reference tags instances lazily at open_ports
-        # time, sky/provision/gcp/instance.py:600-608; tagging at
-        # creation makes open/cleanup order-independent here).
-        "tags": [_network_tag(cluster_name)],
+        # Network tags: the cluster tag lets open_ports target this
+        # cluster's rule without per-instance mutation (the reference
+        # tags instances lazily at open_ports time,
+        # sky/provision/gcp/instance.py:600-608; tagging at creation
+        # makes open/cleanup order-independent), and the shared "stpu"
+        # tag scopes the bootstrap ssh/internal rules to our hosts
+        # only on shared VPCs.
+        "tags": [_network_tag(cluster_name), _COMMON_TAG],
     }
     if config.get("use_spot"):
         body["schedulingConfig"] = {"preemptible": True}
@@ -643,3 +645,100 @@ def cleanup_ports(cluster_name: str, ports: List[str],
             return  # never created / already gone
         raise
     _wait_compute_op(project, op)
+
+
+# -------------------------------------------------------------- bootstrap
+# Reference analog: bootstrap_instances in the provision SPI
+# (sky/provision/__init__.py) backed by sky/provision/gcp/config.py:392-540
+# + constants.py:57-194 — ensure the VPC is usable BEFORE any instance
+# waits on it. Trimmed to what TPU VMs actually need: the network must
+# exist (TPU VMs only join pre-existing networks — no VPC creation), SSH
+# ingress must be open (or provisioner.wait_for_ssh hangs its full
+# timeout on a locked-down project), and intra-VPC traffic must flow
+# (gang drivers reach workers over internal IPs).
+
+# Shared network tag carried by every stpu-provisioned host: bootstrap
+# rules target it so a shared/pre-existing VPC's unrelated VMs are
+# never exposed by our ingress (open_ports applies the same
+# tag-scoping discipline per cluster).
+_COMMON_TAG = "stpu"
+
+_BOOTSTRAP_RULES = (
+    # (suffix, body) — idempotent per network, targeted at stpu nodes.
+    ("allow-ssh", {
+        "direction": "INGRESS",
+        "sourceRanges": ["0.0.0.0/0"],
+        "targetTags": [_COMMON_TAG],
+        "allowed": [{"IPProtocol": "tcp", "ports": ["22"]}],
+        "description": "stpu bootstrap: ssh ingress for provisioning "
+                       "(stpu-tagged hosts only)",
+    }),
+    ("allow-internal", {
+        "direction": "INGRESS",
+        # GCP auto-mode subnets live in 10.128.0.0/9 (the reference's
+        # range, constants.py:71); custom-mode users with other ranges
+        # manage internal rules themselves.
+        "sourceRanges": ["10.128.0.0/9"],
+        "targetTags": [_COMMON_TAG],
+        "allowed": [{"IPProtocol": "tcp", "ports": ["0-65535"]},
+                    {"IPProtocol": "udp", "ports": ["0-65535"]},
+                    {"IPProtocol": "icmp"}],
+        "description": "stpu bootstrap: intra-VPC traffic (gang "
+                       "drivers, host agents, jax coordinator; "
+                       "stpu-tagged hosts only)",
+    }),
+)
+
+
+def bootstrap_instances(region, cluster_name: str,
+                        provider_config: dict) -> None:
+    """Pre-provision VPC sanity: verify the network exists and ensure
+    the ssh/internal ingress rules a cluster needs are present.
+    Idempotent; rules are per-network (shared by every cluster on it),
+    not per-cluster — cleanup_ports never touches them, matching the
+    reference's persistent bootstrap rules."""
+    del cluster_name
+    project = _project_of(provider_config)
+    network = provider_config.get("network") or "default"
+    try:
+        compute_rest(
+            "GET", f"projects/{project}/global/networks/{network}")
+    except GcpApiError as e:
+        if e.status == 404:
+            # Project-global, permanent: failing over to another zone
+            # cannot fix a missing VPC, so this must NOT be a
+            # (retryable) ProvisionError.
+            raise exceptions.NoCloudAccessError(
+                f"VPC network {network!r} does not exist in project "
+                f"{project!r}. TPU VMs only join pre-existing "
+                "networks: create it (or set provider network config) "
+                "first.") from e
+        raise _classify_provision_error(e, zone=str(region),
+                                        region=region) from e
+    safe_net = "".join(c if c.isalnum() or c == "-" else "-"
+                       for c in network.lower()).strip("-")[:40]
+    for suffix, body in _BOOTSTRAP_RULES:
+        name = f"stpu-{safe_net}-{suffix}"[:63]
+        try:
+            compute_rest(
+                "GET", f"projects/{project}/global/firewalls/{name}")
+            continue  # already bootstrapped
+        except GcpApiError as e:
+            if e.status != 404:
+                raise _classify_provision_error(
+                    e, zone=str(region), region=region) from e
+        try:
+            op = compute_rest(
+                "POST", f"projects/{project}/global/firewalls",
+                body={
+                    "name": name,
+                    "network": f"projects/{project}/global/networks/"
+                               f"{network}",
+                    **body,
+                })
+        except GcpApiError as e:
+            if e.status == 409:
+                continue  # concurrent launch won the create race
+            raise _classify_provision_error(
+                e, zone=str(region), region=region) from e
+        _wait_compute_op(project, op)

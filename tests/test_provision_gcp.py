@@ -412,9 +412,84 @@ def test_cleanup_ports_deletes_rule_and_tolerates_absent(fake_compute):
 
 def test_node_body_carries_network_tag(fake):
     gcp.run_instances("us-east5", ZONE, "c1", _config())
-    assert fake.nodes["c1-s0"]["tags"] == [gcp._network_tag("c1")]
+    # Cluster tag (open_ports scoping) + shared stpu tag (bootstrap
+    # ssh/internal rule scoping on shared VPCs).
+    assert fake.nodes["c1-s0"]["tags"] == [gcp._network_tag("c1"),
+                                           gcp._COMMON_TAG]
 
 
 def test_invalid_port_spec_rejected(fake_compute):
     with pytest.raises(exceptions.ProvisionError):
         gcp.open_ports("c1", ["not-a-port"], _config())
+
+
+# ------------------------------------------------------------- bootstrap
+class FakeComputeWithNetworks(FakeComputeService):
+    def __init__(self, networks=("default",)):
+        super().__init__()
+        self.networks = set(networks)
+
+    def __call__(self, method, path, body=None, params=None):
+        if "/global/networks/" in path and method == "GET":
+            self.calls.append((method, path))
+            name = path.rsplit("/", 1)[-1]
+            if name not in self.networks:
+                raise gcp.GcpApiError(404, {"error": {
+                    "status": "NOT_FOUND", "message": "no network"}})
+            return {"name": name}
+        return super().__call__(method, path, body=body, params=params)
+
+
+def test_bootstrap_creates_ssh_and_internal_rules(monkeypatch):
+    """bootstrap_instances ensures ssh + intra-VPC ingress exist before
+    any instance waits on them (reference:
+    sky/provision/gcp/config.py:392-540, constants.py:57-84)."""
+    svc = FakeComputeWithNetworks()
+    monkeypatch.setattr(gcp, "compute_rest", svc)
+    monkeypatch.setattr(gcp, "_gcloud_project", lambda: "testproj")
+    gcp.bootstrap_instances("us-east5", "c1", _config())
+    names = set(svc.firewalls)
+    assert any(n.endswith("allow-ssh") for n in names)
+    assert any(n.endswith("allow-internal") for n in names)
+    assert "stpu-default-allow-ssh" in names  # no double prefix
+    ssh_rule = svc.firewalls["stpu-default-allow-ssh"]
+    assert ssh_rule["allowed"] == [
+        {"IPProtocol": "tcp", "ports": ["22"]}]
+    # Tag-scoped: a shared VPC's unrelated VMs are never exposed.
+    assert ssh_rule["targetTags"] == [gcp._COMMON_TAG]
+    # Idempotent: second call creates nothing new.
+    count = len(svc.firewalls)
+    gcp.bootstrap_instances("us-east5", "c1", _config())
+    assert len(svc.firewalls) == count
+
+
+def test_bootstrap_missing_network_is_a_clear_error(monkeypatch):
+    svc = FakeComputeWithNetworks(networks=())
+    monkeypatch.setattr(gcp, "compute_rest", svc)
+    monkeypatch.setattr(gcp, "_gcloud_project", lambda: "testproj")
+    # Project-global + permanent -> NOT retryable (a ProvisionError
+    # would make the failover loop sweep every zone, or spin forever
+    # under retry_until_up).
+    with pytest.raises(exceptions.NoCloudAccessError,
+                       match="does not exist"):
+        gcp.bootstrap_instances("us-east5", "c1", _config())
+
+
+def test_bootstrap_create_race_tolerated(monkeypatch):
+    """Two concurrent launches on one network both POST the shared
+    rule; the loser's 409 reads as already-bootstrapped, not a crash
+    (GcpApiError would escape the failover loop's except)."""
+    svc = FakeComputeWithNetworks()
+    orig = svc.__call__
+
+    def racy(method, path, body=None, params=None):
+        if method == "POST" and path.endswith("/global/firewalls"):
+            orig(method, path, body=body, params=params)  # racer wins
+            raise gcp.GcpApiError(409, {"error": {
+                "status": "ALREADY_EXISTS", "message": "conflict"}})
+        return orig(method, path, body=body, params=params)
+
+    monkeypatch.setattr(gcp, "compute_rest", racy)
+    monkeypatch.setattr(gcp, "_gcloud_project", lambda: "testproj")
+    gcp.bootstrap_instances("us-east5", "c1", _config())  # no raise
+    assert any(n.endswith("allow-ssh") for n in svc.firewalls)
