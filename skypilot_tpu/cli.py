@@ -774,12 +774,23 @@ def serve_down(service_names, all_services):
 @click.argument("service_name")
 @click.argument("replica_id", type=int, required=False)
 @click.option("--no-follow", is_flag=True)
-def serve_logs(service_name, replica_id, no_follow):
-    """Stream service logs: controller+LB by default, or one replica's
-    job logs when REPLICA_ID is given."""
+@click.option("--controller", "target", flag_value="controller",
+              default=True,
+              help="Controller process log (default without "
+                   "REPLICA_ID).")
+@click.option("--load-balancer", "target", flag_value="load_balancer",
+              help="Load balancer process log (its own process; "
+                   "survives controller crashes).")
+def serve_logs(service_name, replica_id, no_follow, target):
+    """Stream service logs: the controller's by default, the LB's with
+    --load-balancer, or one replica's job logs when REPLICA_ID is given
+    (reference: sky serve logs --controller/--load-balancer)."""
+    if replica_id is not None and target == "load_balancer":
+        raise click.UsageError(
+            "REPLICA_ID and --load-balancer are mutually exclusive.")
     from skypilot_tpu.serve import core as serve_core
     sys.exit(serve_core.logs(service_name, replica_id,
-                             follow=not no_follow))
+                             follow=not no_follow, target=target))
 
 
 @serve.command(name="status")

@@ -379,15 +379,26 @@ def _finalize_dead_service(service_name: str) -> None:
 
 
 def logs(service_name: str, replica_id: Optional[int] = None,
-         follow: bool = True) -> int:
-    """Stream service logs (reference: sky serve logs, sky/cli.py:4363).
+         follow: bool = True, target: str = "controller") -> int:
+    """Stream service logs (reference: sky serve logs, sky/cli.py:4363,
+    with its --controller/--load-balancer targets).
 
-    Without ``replica_id``: the controller+LB process log. With one: the
-    replica cluster's job logs (what the model server prints).
+    Without ``replica_id``: a service process log — the controller's by
+    default, the load balancer's with ``target="load_balancer"`` (the
+    LB is its own process and survives controller crashes, so its log
+    is a separate file). With ``replica_id``: the replica cluster's job
+    logs (what the model server prints).
     """
     handle = _proxy()
     if handle is not None:
         args = ["logs", "--service-name", service_name]
+        if target != "controller":
+            # Only non-default targets ride the RPC: a controller
+            # provisioned before this flag existed must keep serving
+            # plain `serve logs NAME` (its argparse predates --target;
+            # version drift re-ships on reuse, but logs must not break
+            # in the window before that).
+            args += ["--target", target]
         if replica_id is not None:
             args += ["--replica-id", str(replica_id)]
         if not follow:
@@ -395,11 +406,11 @@ def logs(service_name: str, replica_id: Optional[int] = None,
         return int(controller_utils.run_on_controller(
             handle, controller_utils.module_command(
                 "skypilot_tpu.serve.core", *args), stream=True))
-    return _logs_local(service_name, replica_id, follow)
+    return _logs_local(service_name, replica_id, follow, target)
 
 
 def _logs_local(service_name: str, replica_id: Optional[int],
-                follow: bool) -> int:
+                follow: bool, target: str = "controller") -> int:
     svc = serve_state.get_service(service_name)
     if svc is None:
         print(f"Service {service_name!r} not found.")
@@ -418,8 +429,11 @@ def _logs_local(service_name: str, replica_id: Optional[int],
                                          follow=follow)
         print(f"No replica {replica_id} in {service_name!r}.")
         return 1
-    # Controller + LB process log.
-    log_path = paths.logs_dir() / "serve" / f"{service_name}.log"
+    # Service process logs: the controller's (which also captures LB
+    # supervisor events) or the LB's own.
+    suffix = "-lb" if target == "load_balancer" else ""
+    log_path = (paths.logs_dir() / "serve" /
+                f"{service_name}{suffix}.log")
     if not log_path.exists():
         print(f"(no log yet at {log_path})")
         return 1
@@ -519,6 +533,8 @@ def main() -> None:
     p.add_argument("--service-name", required=True)
     p.add_argument("--replica-id", type=int, default=None)
     p.add_argument("--no-follow", action="store_true")
+    p.add_argument("--target", default="controller",
+                   choices=("controller", "load_balancer"))
 
     args = parser.parse_args()
     if args.cmd == "submit":
@@ -555,7 +571,8 @@ def main() -> None:
         print(json.dumps({"down": done}))
     elif args.cmd == "logs":
         raise SystemExit(_logs_local(args.service_name, args.replica_id,
-                                     follow=not args.no_follow))
+                                     follow=not args.no_follow,
+                                     target=args.target))
 
 
 if __name__ == "__main__":

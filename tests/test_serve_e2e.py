@@ -715,3 +715,25 @@ def test_replica_launch_injects_serving_port(tmp_state_dir, monkeypatch):
     for t in list(mgr2._threads):
         t.join(timeout=30)
     assert captured["ports"] == ("8080",)
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_serve_logs_targets():
+    """`serve logs` reaches all three processes: controller log,
+    load-balancer log (--load-balancer), and a replica's job logs
+    (reference: sky serve logs --controller/--load-balancer)."""
+    name, endpoint = serve_core.up(_server_task(replicas=1), "svc-logs",
+                                   controller="local")
+    try:
+        serve_core.wait_ready(name, timeout=90)
+        from skypilot_tpu.utils import paths
+        # Controller + LB logs exist as separate files.
+        assert (paths.logs_dir() / "serve" / f"{name}.log").exists()
+        assert (paths.logs_dir() / "serve" / f"{name}-lb.log").exists()
+        # The local tailer resolves each target (no-follow: one pass).
+        assert serve_core._logs_local(name, None, follow=False,
+                                      target="controller") == 0
+        assert serve_core._logs_local(name, None, follow=False,
+                                      target="load_balancer") == 0
+    finally:
+        serve_core.down([name], timeout=60)
