@@ -105,6 +105,11 @@ def test_job_survives_client_death_and_daemon_autostops(
         return statuses and set(statuses.values()) == {"stopped"}
     assert _wait(provider_stopped, timeout=30), \
         "daemon never autostopped the idle cluster"
+    # Terminate so the host dir (and any daemon still finishing its
+    # last tick) is gone before the next test; the conftest reaper is
+    # the backstop, not the plan.
+    from skypilot_tpu import core as core_lib
+    core_lib.down("t-headres", purge=True)
 
 
 # ------------------------------------------------ head-side spec transports
