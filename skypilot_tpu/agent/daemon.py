@@ -64,6 +64,31 @@ class Daemon:
         except Exception:  # noqa: BLE001 — never block daemon boot
             self._my_version = None
         self._stale_ticks = 0
+        # Host-agent gauges, dumped to .stpu_agent/metrics.prom each
+        # tick (textfile-collector pattern: a node_exporter picks it
+        # up; the daemon itself binds no port).
+        from skypilot_tpu.observability import metrics
+        self._heartbeat = metrics.gauge(
+            "stpu_agent_heartbeat_timestamp_seconds",
+            "Wall-clock time of the daemon's last completed tick.")
+        self._uptime = metrics.gauge(
+            "stpu_agent_uptime_seconds", "Daemon uptime.")
+        self._running_jobs = metrics.gauge(
+            "stpu_agent_running_jobs",
+            "RUNNING jobs with a live gang driver on this host.")
+        self._reconciled = metrics.counter(
+            "stpu_agent_reconciled_jobs_total",
+            "RUNNING jobs marked FAILED because their driver died.")
+        self._started_mono = time.monotonic()
+
+    def export_metrics(self) -> None:
+        """Write the registry's exposition text next to health.json
+        (atomic replace: a textfile collector reading mid-write must
+        never see a truncated file)."""
+        from skypilot_tpu.observability import metrics
+        self._heartbeat.set(time.time())
+        self._uptime.set(time.monotonic() - self._started_mono)
+        metrics.dump_to_file(self.agent_dir / "metrics.prom")
 
     def runtime_stale(self) -> bool:
         """True after TWO consecutive ticks of version mismatch (one
@@ -108,6 +133,8 @@ class Daemon:
         skylet reconciles ray-job state drift, job_lib.update_job_status).
         """
         from skypilot_tpu.agent import job_lib
+        from skypilot_tpu.observability import events
+        running = 0
         for job in job_lib.queue(home=str(self.home), all_jobs=False):
             status = job_lib.JobStatus(job["status"])
             pid = job.get("pid")
@@ -115,13 +142,20 @@ class Daemon:
                 continue
             try:
                 os.kill(pid, 0)
+                running += 1
             except ProcessLookupError:
                 self.log(f"job {job['job_id']}: driver pid {pid} gone; "
                          "marking FAILED")
                 job_lib.set_status(job["job_id"], job_lib.JobStatus.FAILED,
                                    home=str(self.home))
+                events.emit("agent",
+                            self.cluster.get("cluster_name", "?"),
+                            "job_reconciled_failed",
+                            job_id=job["job_id"], driver_pid=pid)
+                self._reconciled.inc()
             except PermissionError:
-                pass  # pid exists under another uid: alive
+                running += 1  # pid exists under another uid: alive
+        self._running_jobs.set(running)
 
     def check_autostop(self) -> bool:
         """Stop/down the cluster when idle long enough. Returns True when
@@ -149,6 +183,10 @@ class Daemon:
         down = bool(cfg.get("down"))
         self.log(f"idle {idle_for:.0f}s >= {idle_minutes}m threshold; "
                  f"{'terminating' if down else 'stopping'} cluster")
+        from skypilot_tpu.observability import events
+        events.emit("agent", self.cluster.get("cluster_name", "?"),
+                    "autostop", down=down,
+                    idle_seconds=round(idle_for, 1))
         # Only exit when the action actually succeeded; a transient API
         # failure is retried on the next tick instead of silently
         # disabling autostop forever.
@@ -191,15 +229,37 @@ class Daemon:
     # ---------------------------------------------------------------- loop
     def run(self) -> None:
         from skypilot_tpu.agent import tpu_health
+        from skypilot_tpu.observability import events
         (self.agent_dir / "daemon.pid").write_text(str(os.getpid()))
         expected = int(self.cluster.get("chips_per_host", 0))
         report = tpu_health.probe(expected)
         tpu_health.write_report(report, home=str(self.home))
+        tpu_health.export_gauges(report)
         self.log(f"daemon up (pid {os.getpid()}, "
                  f"interval {self.interval}s, health: {report['detail']})")
+        events.emit("agent", self.cluster.get("cluster_name", "?"),
+                    "daemon_up", pid=os.getpid(),
+                    tpu_healthy=report["ok"])
+        last_ok = report["ok"]
         while True:
             try:
                 self.reconcile_jobs()
+                # RE-probe every tick (a /dev/accel* glob — cheap): a
+                # chip lost an hour in must flip the exported gauge,
+                # not fossilize the boot-time verdict next to a fresh
+                # heartbeat.
+                report = tpu_health.probe(expected)
+                tpu_health.export_gauges(report)
+                if report["ok"] != last_ok:
+                    tpu_health.write_report(report, home=str(self.home))
+                    self.log(f"TPU health changed: {report['detail']}")
+                    events.emit("agent",
+                                self.cluster.get("cluster_name", "?"),
+                                "tpu_health_changed",
+                                ok=report["ok"],
+                                detail=report["detail"])
+                    last_ok = report["ok"]
+                self.export_metrics()
                 if self.check_autostop() or self.cluster_gone():
                     break
                 if self.runtime_stale():

@@ -30,14 +30,24 @@ from typing import Dict, List, Optional
 
 from skypilot_tpu.agent import constants
 from skypilot_tpu.agent import job_lib
+from skypilot_tpu.observability import events as events_lib
+from skypilot_tpu.observability import metrics
 
 GANG_FAILED_RC = constants.GANG_FAILED_RC
+
+_GANG_RUNS = metrics.counter(
+    "stpu_gang_runs_total", "Gang executions by outcome.", ("outcome",))
 
 
 def _build_env(spec: Dict, rank: int) -> Dict[str, str]:
     ips: List[str] = spec["node_ips"]
     host = spec["hosts"][rank]
+    # The submitting client stamped its run ID into the spec
+    # (slice_backend._build_job_spec); hand it to every host so job-side
+    # telemetry correlates with the originating CLI invocation.
+    run_id = spec.get("run_id") or events_lib.run_id()
     env = {
+        events_lib.RUN_ID_ENV: run_id,
         constants.NODE_RANK: str(rank),
         constants.NODE_IPS: "\n".join(ips),
         constants.NUM_NODES: str(len(ips)),
@@ -231,8 +241,26 @@ def run_gang(spec: Dict) -> int:
     log_dir = pathlib.Path(spec["log_dir"])
     log_dir.mkdir(parents=True, exist_ok=True)
 
+    # Adopt the submitting client's run ID so this driver's own events
+    # (and its children's, via env inheritance) correlate end to end.
+    if spec.get("run_id"):
+        os.environ[events_lib.RUN_ID_ENV] = str(spec["run_id"])
     job_lib.set_pid(job_id, os.getpid(), home)
     job_lib.set_status(job_id, job_lib.JobStatus.RUNNING, home)
+    task_id = spec.get("task_id", str(job_id))
+    events_lib.emit("gang", task_id, "start", job_id=job_id,
+                    num_hosts=len(spec["hosts"]),
+                    cluster=spec.get("cluster_name"))
+
+    def abort(detail: str) -> None:
+        """A raise-path exit still gets a terminal event + counter —
+        a gang that 'started and never ended' in the log would hide
+        exactly the failures this telemetry exists to count."""
+        job_lib.set_status(job_id, job_lib.JobStatus.FAILED, home)
+        _GANG_RUNS.labels(outcome="error").inc()
+        events_lib.emit("gang", task_id, "error", job_id=job_id,
+                        detail=detail)
+        metrics.dump_to_file(log_dir / "metrics.prom")
 
     # Gang coordinator (native host-agent core): every host's wrapper
     # barriers here before exec — no host runs until all are up
@@ -258,7 +286,7 @@ def run_gang(spec: Dict) -> int:
             # An empty token would silently bind the coordinator
             # loopback-only while agent workers dial the head IP — a
             # 600s barrier hang instead of an error. Fail fast.
-            job_lib.set_status(job_id, job_lib.JobStatus.FAILED, home)
+            abort("missing exec token")
             raise RuntimeError(
                 "agent-transport gang needs a non-empty exec token "
                 "(~/.stpu_agent/exec_token on the head)")
@@ -283,13 +311,21 @@ def run_gang(spec: Dict) -> int:
             p.terminate()
     signal.signal(signal.SIGTERM, handle_term)
 
-    for rank, host in enumerate(spec["hosts"]):
-        env = _build_env(spec, rank)
-        procs.append(_HostProc(host, rank, spec["run_cmd"], env,
-                               str(log_dir / f"node-{rank}.log"),
-                               coord_port=coord_port,
-                               coord_token=coord_token,
-                               head_ip=spec["node_ips"][0]))
+    try:
+        for rank, host in enumerate(spec["hosts"]):
+            env = _build_env(spec, rank)
+            procs.append(_HostProc(host, rank, spec["run_cmd"], env,
+                                   str(log_dir / f"node-{rank}.log"),
+                                   coord_port=coord_port,
+                                   coord_token=coord_token,
+                                   head_ip=spec["node_ips"][0]))
+    except Exception as e:  # noqa: BLE001 — spawn failure (bad ssh key,
+        # unreachable exec agent): kill whatever ranks already started
+        # and record the terminal outcome before propagating.
+        for p in procs:
+            p.terminate()
+        abort(f"host spawn failed: {e!r}")
+        raise
 
     # Wait with gang semantics: first failure cancels the rest.
     failed_rank: Optional[int] = None
@@ -340,9 +376,19 @@ def run_gang(spec: Dict) -> int:
     if coord is not None:
         coord.close()
 
+    def finish(outcome: str, rc: int, **fields) -> int:
+        _GANG_RUNS.labels(outcome=outcome).inc()
+        events_lib.emit("gang", task_id, outcome, job_id=job_id,
+                        **fields)
+        # The driver exits right after this: the .prom dump in the
+        # job's log dir is its exposition path (same textfile pattern
+        # as the daemon; sync_down/logs pick it up with node logs).
+        metrics.dump_to_file(log_dir / "metrics.prom")
+        return rc
+
     if cancelled.is_set():
         job_lib.set_status(job_id, job_lib.JobStatus.CANCELLED, home)
-        return 1
+        return finish("cancelled", 1)
     if failed_rank is not None:
         # Annotate forced-cancel ranks with the gang rc in their logs.
         for p in procs:
@@ -352,9 +398,10 @@ def run_gang(spec: Dict) -> int:
                         f"\n[gang] cancelled because node {failed_rank} "
                         f"failed (rc={GANG_FAILED_RC})\n".encode())
         job_lib.set_status(job_id, job_lib.JobStatus.FAILED, home)
-        return GANG_FAILED_RC
+        return finish("failed", GANG_FAILED_RC,
+                      failed_rank=failed_rank)
     job_lib.set_status(job_id, job_lib.JobStatus.SUCCEEDED, home)
-    return 0
+    return finish("succeeded", 0)
 
 
 def main() -> None:

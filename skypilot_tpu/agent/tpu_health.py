@@ -58,3 +58,20 @@ def write_report(report: Dict[str, Any],
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(json.dumps(report, indent=2))
     return path
+
+
+def export_gauges(report: Dict[str, Any]) -> None:
+    """Mirror a probe verdict into the process metrics registry (the
+    daemon dumps it to ``.stpu_agent/metrics.prom`` each tick — the
+    node_exporter textfile-collector pattern). Kept out of ``probe()``
+    so the gang-start fast path (host_wrapper) stays import-free."""
+    from skypilot_tpu.observability import metrics
+    metrics.gauge("stpu_agent_tpu_healthy",
+                  "1 when this host sees every expected TPU chip."
+                  ).set(1 if report["ok"] else 0)
+    metrics.gauge("stpu_agent_tpu_chips_found",
+                  "TPU chips visible on this host."
+                  ).set(report["chips_found"])
+    metrics.gauge("stpu_agent_tpu_chips_expected",
+                  "TPU chips the launched slice shape expects per host."
+                  ).set(report["chips_expected"])

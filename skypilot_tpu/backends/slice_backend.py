@@ -38,6 +38,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state
 from skypilot_tpu import optimizer as optimizer_lib
 from skypilot_tpu import provision as provision_api
+from skypilot_tpu.observability import events as observability_events
 from skypilot_tpu.agent import constants as agent_constants
 from skypilot_tpu.agent import job_lib
 from skypilot_tpu.backends import backend as backend_lib
@@ -643,6 +644,10 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
             "envs": dict(task.envs),
             "run_cmd": run_cmd,
             "hosts": hosts,
+            # The submitting invocation's run ID: the gang driver
+            # re-exports it to every host (STPU_RUN_ID) so job-side
+            # events/logs correlate with this CLI call end to end.
+            "run_id": observability_events.run_id(),
         }
 
     def _execute(self, handle: SliceHandle, task, detach_run,

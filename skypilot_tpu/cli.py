@@ -185,6 +185,24 @@ def _price_per_hr(handle) -> str:
         return "-"  # accelerator missing from the catalog
 
 
+def _print_events(events, header: bool = True) -> None:
+    """Render lifecycle-event records as one aligned line each."""
+    import time as time_lib
+    if header:
+        click.echo("{:<20} {:<12} {:<24} {:<18} {}".format(
+            "WHEN", "KIND", "NAME", "EVENT", "DETAIL"))
+    for rec in events:
+        stamp = time_lib.strftime("%Y-%m-%d %H:%M:%S",
+                                  time_lib.localtime(rec.get("ts", 0)))
+        detail = " ".join(
+            f"{k}={v}" for k, v in sorted(rec.items())
+            if k not in ("ts", "mono", "run_id", "kind", "name",
+                         "event") and v is not None)
+        click.echo("{:<20} {:<12} {:<24} {:<18} {}".format(
+            stamp, rec.get("kind", "?"), str(rec.get("name", "?"))[:24],
+            str(rec.get("event", "?"))[:18], detail))
+
+
 @cli.command()
 @click.argument("clusters", nargs=-1, required=False)
 @click.option("--refresh", "-r", is_flag=True,
@@ -192,10 +210,36 @@ def _price_per_hr(handle) -> str:
 @click.option("--endpoints", is_flag=True,
               help="Show reachable endpoints for each cluster's opened "
                    "ports (reference: sky status --endpoints).")
-def status(clusters, refresh, endpoints):
+@click.option("--events", "show_events", is_flag=True,
+              help="Show recent lifecycle events (cluster/job/replica/"
+                   "service transitions) from the observability log.")
+@click.option("--limit", "-n", type=int, default=20,
+              help="Max events with --events.")
+def status(clusters, refresh, endpoints, show_events, limit):
     """List clusters (with launch age, head IP, and $/hr — reference:
     `sky status` table, sky/cli.py:1571)."""
     from skypilot_tpu import core
+    if show_events:
+        if refresh or endpoints:
+            raise click.UsageError(
+                "--events cannot be combined with "
+                "--refresh/--endpoints.")
+        # Filter BEFORE limiting: a busy neighbor's events at the tail
+        # of the log must not evict the requested cluster's older ones.
+        recs = core.recent_events(limit=None if clusters else limit)
+        if clusters:
+            # Honor the positional filter: keep events whose subject
+            # or recorded cluster/service matches a requested name.
+            wanted = set(clusters)
+            recs = [r for r in recs
+                    if r.get("name") in wanted
+                    or r.get("cluster") in wanted
+                    or r.get("service") in wanted][-limit:]
+        if not recs:
+            click.echo("No recorded events.")
+            return
+        _print_events(recs)
+        return
     records = core.status(cluster_names=list(clusters) or None,
                           refresh=refresh)
     if endpoints:
@@ -435,6 +479,58 @@ def check():
     from skypilot_tpu import check as check_lib
     enabled = check_lib.check()
     click.echo(f"Enabled clouds: {', '.join(enabled) or 'none'}")
+
+
+@cli.command(name="metrics")
+@click.option("--url", default=None,
+              help="Scrape a remote /metrics endpoint (e.g. a serve "
+                   "load balancer) instead of rendering locally.")
+@click.option("--service", "-s", default=None,
+              help="Scrape the named service's LB endpoint.")
+@click.option("--watch", "-w", is_flag=True,
+              help="Refresh every 2 seconds until interrupted.")
+def metrics_cmd(url, service, watch):
+    """Render Prometheus metrics: the local registry by default, a serve
+    LB's /metrics with --url/--service (same exposition `curl
+    $LB/metrics` returns)."""
+    import time as time_lib
+
+    from skypilot_tpu import core
+
+    def resolve_url():
+        if url is not None:
+            return url
+        if service is not None:
+            from skypilot_tpu.serve import core as serve_core
+            matches = serve_core.status([service])
+            if not matches:
+                raise click.ClickException(
+                    f"Service {service!r} not found.")
+            return matches[0]["endpoint"]
+        return None
+
+    # Resolve once: the endpoint cannot change mid-watch, and with
+    # --service each resolution is a full serve status() call.
+    target = resolve_url()
+
+    def render_once():
+        import http.client
+        try:
+            text = core.metrics_snapshot(target)
+        except (OSError, ValueError, http.client.HTTPException) as e:
+            # HTTPException covers http.client.InvalidURL from a
+            # malformed --url; ValueError covers unknown URL types.
+            # All must read as a scrape failure, not a crash.
+            raise click.ClickException(f"scrape failed: {e}") from e
+        click.echo(text if text.strip() else "(no metrics recorded)")
+
+    if not watch:
+        render_once()
+        return
+    while True:
+        click.clear()
+        render_once()
+        time_lib.sleep(2.0)
 
 
 @cli.group()
@@ -810,6 +906,13 @@ def serve_status(service_names):
             kind = "[spot]" if r.get("is_spot") else ""
             click.echo(f"  replica {r['replica_id']:<3} "
                        f"{r['status']:<14} {r['url'] or '-'} {kind}")
+        scale = svc.get("last_scale_event")
+        if scale:
+            click.echo(
+                f"  last scale action: {scale.get('event')} "
+                f"{scale.get('previous')}->{scale.get('target')} "
+                f"replicas at {scale.get('qps')} qps "
+                f"({_human_ago(scale.get('ts'))})")
 
 
 def main():

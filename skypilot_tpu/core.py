@@ -216,6 +216,29 @@ def cost_report() -> List[Dict[str, Any]]:
     return out
 
 
+def recent_events(kind: Optional[str] = None,
+                  name: Optional[str] = None,
+                  limit: int = 50) -> List[Dict[str, Any]]:
+    """Recent lifecycle events from the local observability log
+    (cluster/job/replica/service transitions; `stpu status --events`)."""
+    from skypilot_tpu.observability import events
+    return events.read(kind=kind, name=name, limit=limit)
+
+
+def metrics_snapshot(url: Optional[str] = None) -> str:
+    """Prometheus exposition text: this process's registry, or a remote
+    scrape when ``url`` is given (e.g. a serve LB's /metrics)."""
+    if url is None:
+        from skypilot_tpu.observability import metrics
+        return metrics.render()
+    import urllib.request
+    target = url if "://" in url else f"http://{url}"
+    if not target.rstrip("/").endswith("/metrics"):
+        target = target.rstrip("/") + "/metrics"
+    with urllib.request.urlopen(target, timeout=10) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
 def storage_ls() -> List[Dict[str, Any]]:
     """Registered storage objects (reference: sky/core.py storage_ls)."""
     return global_user_state.get_storage()

@@ -29,7 +29,20 @@ from skypilot_tpu.backends import slice_backend
 from skypilot_tpu.jobs import recovery_strategy
 from skypilot_tpu.jobs import state as jobs_state
 from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.observability import metrics
 from skypilot_tpu.utils import dag_utils
+
+_RECOVERIES = metrics.counter(
+    "stpu_jobs_recoveries_total",
+    "Managed-job recovery attempts (relaunch after loss).")
+_PREEMPTIONS = metrics.counter(
+    "stpu_jobs_preemptions_total",
+    "Recoveries triggered by provider-confirmed instance loss "
+    "(vs. a lost job record on a healthy cluster).")
+_RECOVERY_SECONDS = metrics.histogram(
+    "stpu_jobs_recovery_duration_seconds",
+    "Wall time from loss detection to the job RUNNING again.",
+    buckets=(1, 5, 15, 30, 60, 120, 300, 600, 1800, 3600))
 
 # Poll gap between on-cluster job status checks (reference:
 # JOB_STATUS_CHECK_GAP_SECONDS). Overridable for hermetic tests.
@@ -49,6 +62,18 @@ class JobsController:
         self._cancel_requested = False
 
     # ------------------------------------------------------------------
+    def _export_metrics(self) -> None:
+        """Dump this controller's registry next to its log (textfile
+        pattern — the controller is its own process with no HTTP
+        surface, so the .prom file IS its exposition path)."""
+        from skypilot_tpu.utils import paths
+        log_dir = paths.logs_dir() / "managed_jobs"
+        try:
+            log_dir.mkdir(parents=True, exist_ok=True)
+        except OSError:
+            return
+        metrics.dump_to_file(log_dir / f"controller-{self.job_id}.prom")
+
     def run(self) -> None:
         jobs_state.set_controller_pid(self.job_id, os.getpid())
         installed = []
@@ -83,6 +108,9 @@ class JobsController:
                                   failure_reason=repr(e))
             raise
         finally:
+            # Final metrics state survives the process (recovery counts
+            # of a finished job stay inspectable).
+            self._export_metrics()
             # Job-scoped translated buckets (workdir/file mounts) die
             # with the job — they were only ever recovery intermediates.
             from skypilot_tpu.utils import controller_utils
@@ -134,6 +162,7 @@ class JobsController:
         missing_count = 0
         while True:
             self._check_cancelled()
+            self._export_metrics()
             time.sleep(_poll_seconds())
             self._check_cancelled()
             status = self._job_status(cluster_name, cluster_job_id)
@@ -164,7 +193,12 @@ class JobsController:
                 if missing_count < recovery_strategy.MAX_JOB_CHECKING_RETRY:
                     continue
             jobs_state.set_recovering(self.job_id)
+            _RECOVERIES.inc()
+            if not healthy:
+                _PREEMPTIONS.inc()
+            t0 = time.perf_counter()
             cluster_job_id = strategy.recover()
+            _RECOVERY_SECONDS.observe(time.perf_counter() - t0)
             jobs_state.set_status(self.job_id, ManagedJobStatus.RUNNING)
             missing_count = 0
 

@@ -19,8 +19,15 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import execution
 from skypilot_tpu import global_user_state
 from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.observability import events
+from skypilot_tpu.observability import metrics
 
 RECOVERY_REGISTRY: Dict[str, Type["StrategyExecutor"]] = {}
+
+_LAUNCH_ATTEMPTS = metrics.counter(
+    "stpu_jobs_launch_attempts_total",
+    "Task-cluster launch attempts by the recovery strategy.",
+    ("outcome",))
 
 DEFAULT_RECOVERY_STRATEGY = "EAGER_NEXT_REGION"
 MAX_JOB_CHECKING_RETRY = 10
@@ -99,14 +106,17 @@ class StrategyExecutor:
                     self.task, cluster_name=self.cluster_name,
                     detach_run=True, stream_logs=False)
                 assert handle is not None
+                _LAUNCH_ATTEMPTS.labels(outcome="ok").inc()
                 return job_id
             except exceptions.ResourcesUnavailableError as e:
+                _LAUNCH_ATTEMPTS.labels(outcome="unavailable").inc()
                 if raise_on_failure and attempt == max_retry - 1:
                     raise exceptions.ResourcesUnavailableError(
                         f"Failed to launch cluster after {max_retry} "
                         f"attempts: {e}",
                         failover_history=e.failover_history) from e
             except Exception:  # noqa: BLE001 — surfaced via controller log
+                _LAUNCH_ATTEMPTS.labels(outcome="error").inc()
                 if raise_on_failure and attempt == max_retry - 1:
                     raise
                 traceback.print_exc()
@@ -122,6 +132,8 @@ class FailoverStrategyExecutor(StrategyExecutor, name="FAILOVER"):
     """
 
     def recover(self) -> Optional[int]:
+        events.emit("recovery", self.cluster_name, "recover_start",
+                    strategy=self.NAME)
         self._cleanup_cluster()
         # 1. Same placement (zone pinned from the last launch). The
         #    original resource set (incl. any_of alternatives) is restored
@@ -155,6 +167,8 @@ class EagerNextRegionStrategyExecutor(FailoverStrategyExecutor,
     """
 
     def recover(self) -> Optional[int]:
+        events.emit("recovery", self.cluster_name, "recover_start",
+                    strategy=self.NAME)
         self._cleanup_cluster()
         self._relax_placement()
         return self._launch(raise_on_failure=True)

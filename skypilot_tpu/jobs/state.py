@@ -99,25 +99,38 @@ def add_job(job_name: str, dag_yaml_path: str, resources_str: str,
         return int(cur.lastrowid)
 
 
+def _emit_job_event(job_id: int, status_value: str,
+                    failure_reason: Optional[str] = None) -> None:
+    """One lifecycle event per successful status write — emitted from
+    this DB layer so every writer (controller, cancel path, finalizer)
+    is covered by the same hook."""
+    from skypilot_tpu.observability import events
+    events.emit("job", str(job_id), status_value,
+                failure_reason=failure_reason)
+
+
 def set_status(job_id: int, status: ManagedJobStatus,
                failure_reason: Optional[str] = None) -> None:
     now = time.time()
     with _conn() as conn:
         if status == ManagedJobStatus.RUNNING:
-            conn.execute(
+            cur = conn.execute(
                 "UPDATE managed_jobs SET status=?, start_at="
                 "COALESCE(start_at, ?) WHERE job_id=?",
                 (status.value, now, job_id))
         elif status.is_terminal():
-            conn.execute(
+            cur = conn.execute(
                 "UPDATE managed_jobs SET status=?, end_at=?, "
                 "failure_reason=COALESCE(?, failure_reason) "
                 "WHERE job_id=?",
                 (status.value, now, failure_reason, job_id))
         else:
-            conn.execute(
+            cur = conn.execute(
                 "UPDATE managed_jobs SET status=? WHERE job_id=?",
                 (status.value, job_id))
+        updated = cur.rowcount > 0
+    if updated:   # a nonexistent job_id must not log a transition
+        _emit_job_event(job_id, status.value, failure_reason)
 
 
 def set_cancelling(job_id: int) -> bool:
@@ -131,7 +144,10 @@ def set_cancelling(job_id: int) -> bool:
             ",".join("?" * len(_TERMINAL)),
             (ManagedJobStatus.CANCELLING.value, job_id,
              *[s.value for s in _TERMINAL]))
-        return cur.rowcount > 0
+        updated = cur.rowcount > 0
+    if updated:
+        _emit_job_event(job_id, ManagedJobStatus.CANCELLING.value)
+    return updated
 
 
 def finalize_status(job_id: int, status: ManagedJobStatus,
@@ -152,7 +168,10 @@ def finalize_status(job_id: int, status: ManagedJobStatus,
             ",".join("?" * len(_TERMINAL)),
             (status.value, time.time(), failure_reason, job_id,
              *[s.value for s in _TERMINAL]))
-        return cur.rowcount > 0
+        updated = cur.rowcount > 0
+    if updated:
+        _emit_job_event(job_id, status.value, failure_reason)
+    return updated
 
 
 def set_recovering(job_id: int) -> None:
@@ -161,6 +180,7 @@ def set_recovering(job_id: int) -> None:
             "UPDATE managed_jobs SET status=?, recovery_count="
             "recovery_count+1, last_recovered_at=? WHERE job_id=?",
             (ManagedJobStatus.RECOVERING.value, time.time(), job_id))
+    _emit_job_event(job_id, ManagedJobStatus.RECOVERING.value)
 
 
 def set_dag_yaml_path(job_id: int, dag_yaml_path: str) -> None:

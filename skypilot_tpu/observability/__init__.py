@@ -1,0 +1,20 @@
+"""Runtime observability: metrics registry + structured event log.
+
+Two stdlib-only primitives every long-running stpu process shares:
+
+* ``metrics`` — a thread-safe registry of Counter/Gauge/Histogram
+  families with label support and Prometheus text exposition
+  (``/metrics`` on the serve load balancer, ``stpu metrics`` locally,
+  a textfile dump from the host agent).
+* ``events`` — an append-only JSONL lifecycle log (cluster/job/replica
+  state transitions) stamped with wall + monotonic time and a run ID
+  that propagates CLI -> gang driver -> job environment.
+
+Neither may ever break the instrumented call: all I/O failures are
+swallowed, and recording is lock-free on hot paths except for the
+single child-update lock held for the increment itself.
+"""
+from skypilot_tpu.observability import events
+from skypilot_tpu.observability import metrics
+
+__all__ = ["events", "metrics"]

@@ -1,0 +1,150 @@
+"""Structured JSONL lifecycle-event log.
+
+Reference analog: the reference scatters lifecycle breadcrumbs across
+per-subsystem logs (skylet events log, serve controller prints,
+jobs controller prints); here every state transition lands in ONE
+append-only JSONL file so `stpu status --events` / `stpu serve status`
+can answer "what just happened" without grepping five logs.
+
+Record shape (one JSON object per line):
+
+    {"ts": <wall seconds>, "mono": <perf_counter seconds>,
+     "run_id": "abc123def456", "kind": "replica",
+     "name": "svc/3", "event": "READY", ...free-form fields}
+
+``ts`` is wall clock for cross-host alignment; ``mono`` is the
+process-local monotonic stamp so in-process durations between two
+events survive NTP steps. ``run_id`` identifies the originating CLI
+invocation and propagates through ``STPU_RUN_ID`` (subprocess env) and
+the gang job spec, CLI -> controller -> gang driver -> job env.
+
+Emission must never break the instrumented call: all I/O errors are
+swallowed. Disable entirely with ``STPU_DISABLE_EVENTS=1``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+DISABLE_ENV = "STPU_DISABLE_EVENTS"
+RUN_ID_ENV = "STPU_RUN_ID"
+
+# Rotate past this size: events.jsonl -> events.jsonl.1 (one generation
+# kept). Lifecycle transitions are low-rate; 4 MB is months of them.
+_MAX_BYTES = 4 * 1024 * 1024
+
+_lock = threading.Lock()
+
+
+def _enabled() -> bool:
+    return os.environ.get(DISABLE_ENV, "0") != "1"
+
+
+def run_id() -> str:
+    """This invocation's run ID. First call generates one and exports it
+    via the environment so every child process (serve controller, LB,
+    jobs controller, gang driver) inherits the same ID."""
+    rid = os.environ.get(RUN_ID_ENV)
+    if not rid:
+        rid = uuid.uuid4().hex[:12]
+        os.environ[RUN_ID_ENV] = rid
+    return rid
+
+
+def log_path() -> "os.PathLike[str]":
+    from skypilot_tpu.utils import paths
+    return paths.logs_dir() / "events.jsonl"
+
+
+def _rotate_if_needed(path) -> None:
+    try:
+        if path.stat().st_size < _MAX_BYTES:
+            return
+        os.replace(path, str(path) + ".1")
+    except OSError:
+        pass
+
+
+def emit(kind: str, name: str, event: str, **fields: Any) -> None:
+    """Append one lifecycle record. Never raises."""
+    if not _enabled():
+        return
+    record: Dict[str, Any] = {
+        "ts": time.time(),
+        "mono": time.perf_counter(),
+        "run_id": run_id(),
+        "kind": kind,
+        "name": name,
+        "event": event,
+    }
+    record.update(fields)
+    try:
+        line = json.dumps(record, default=str)
+    except (TypeError, ValueError):
+        return
+    try:
+        path = log_path()
+        with _lock:
+            _rotate_if_needed(path)
+            with open(path, "a") as f:
+                f.write(line + "\n")
+    except OSError:
+        pass
+
+
+def read(kind: Optional[str] = None, name: Optional[str] = None,
+         limit: Optional[int] = 50,
+         path: Optional[str] = None,
+         max_bytes: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Most-recent-last matching records (garbage lines skipped — a
+    crash mid-append leaves at most one truncated line).
+
+    ``max_bytes`` tails only the newest that many bytes of the current
+    generation (skipping the rotated one) — for hot callers that only
+    want recent records and must not pay a full multi-MB parse."""
+    target = path or log_path()
+    out: List[Dict[str, Any]] = []
+    # Include the rotated generation so a read right after rotation
+    # still sees recent history (unless a bounded tail was asked for).
+    files = ([str(target)] if max_bytes is not None
+             else [str(target) + ".1", str(target)])
+    for p in files:
+        try:
+            with open(p, "rb") as f:
+                if max_bytes is not None:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    if size > max_bytes:
+                        f.seek(size - max_bytes)
+                        f.readline()   # drop the partial first line
+                    else:
+                        f.seek(0)
+                data = f.read().decode("utf-8", "replace")
+        except OSError:
+            continue
+        for line in data.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            if kind is not None and rec.get("kind") != kind:
+                continue
+            if name is not None and rec.get("name") != name:
+                continue
+            out.append(rec)
+    if limit is not None:
+        out = out[-limit:] if limit > 0 else []
+    return out
+
+
+def last(kind: str, name: Optional[str] = None
+         ) -> Optional[Dict[str, Any]]:
+    """The most recent record of ``kind`` (optionally for ``name``)."""
+    recs = read(kind=kind, name=name, limit=1)
+    return recs[-1] if recs else None

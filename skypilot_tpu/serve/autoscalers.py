@@ -3,17 +3,38 @@
 Reference analog: sky/serve/autoscalers.py (Autoscaler:57,
 RequestRateAutoscaler:141 — QPS over a sliding window divided by
 target_qps_per_replica, with upscale/downscale delay hysteresis).
-Pure logic, no I/O — unit-testable with synthetic timestamps
-(reference test: tests/test_serve_autoscaler.py).
+Pure logic, no file I/O — unit-testable with synthetic timestamps
+(reference test: tests/test_serve_autoscaler.py). Observability here
+is in-memory only (gauges/counters + the decision-history deque); the
+event-log WRITE for a scale action is the controller's job — it pops
+``pop_scale_event()`` each tick, keeping this module side-effect-free.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import math
 import time
-from typing import List, Optional
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
+from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+# Controller-process metrics; exposed on the LB's /metrics via the
+# snapshot that rides the /sync reply (see serve/controller.py).
+_QPS_GAUGE = metrics.gauge(
+    "stpu_autoscaler_qps",
+    "Requests/sec over the autoscaler's sliding window.", ("service",))
+_TARGET_GAUGE = metrics.gauge(
+    "stpu_autoscaler_target_replicas",
+    "Current autoscaler replica target.", ("service",))
+_DECISIONS = metrics.counter(
+    "stpu_autoscaler_decisions_total",
+    "Scale decisions that changed the replica target.",
+    ("service", "direction"))
+
+# Bounded per-autoscaler decision history: (ts, qps, target, ready).
+DECISION_HISTORY_LEN = 64
 
 
 @dataclasses.dataclass
@@ -49,14 +70,59 @@ class Autoscaler:
     backfilling on-demand capacity (see ``plan``).
     """
 
-    def __init__(self, spec: SkyServiceSpec, use_spot: bool = False):
+    def __init__(self, spec: SkyServiceSpec, use_spot: bool = False,
+                 service_name: str = ""):
         self.spec = spec
         self.use_spot = use_spot
+        self.service_name = service_name
         self.target_num_replicas = spec.min_replicas
+        # (ts, qps, target, ready) per plan() evaluation — the
+        # in-process record (debugger/tests). What `stpu serve status`
+        # reads is the event log: target-CHANGING decisions are queued
+        # via pop_scale_event() and written there by the controller.
+        self.decision_history: Deque[
+            Tuple[float, float, int, Optional[int]]] = collections.deque(
+                maxlen=DECISION_HISTORY_LEN)
+        self._last_qps = 0.0
+        self._last_recorded_target: Optional[int] = None
+        self._pending_scale_event: Optional[Dict[str, Any]] = None
+        # Pre-seed both directions so the decision counter families are
+        # present in exposition from the first scrape, not only after
+        # the first scale action.
+        for direction in ("up", "down"):
+            _DECISIONS.labels(service=self.service_name,
+                              direction=direction).inc(0)
 
     def collect_request_information(
             self, request_timestamps: List[float]) -> None:
         del request_timestamps
+
+    def _record_decision(self, now: float, target: int,
+                         num_ready: Optional[int]) -> None:
+        """History + gauges each evaluation; counter + pending event
+        only when the target actually moved (the scale *action*)."""
+        self.decision_history.append(
+            (now, self._last_qps, target, num_ready))
+        _QPS_GAUGE.labels(service=self.service_name).set(self._last_qps)
+        _TARGET_GAUGE.labels(service=self.service_name).set(target)
+        previous = self._last_recorded_target
+        self._last_recorded_target = target
+        if previous is None or target == previous:
+            return
+        direction = "up" if target > previous else "down"
+        _DECISIONS.labels(service=self.service_name,
+                          direction=direction).inc()
+        self._pending_scale_event = {
+            "event": "scale_" + direction,
+            "qps": round(self._last_qps, 3), "target": target,
+            "previous": previous, "ready": num_ready}
+
+    def pop_scale_event(self) -> Optional[Dict[str, Any]]:
+        """The last target-changing decision, once (the controller
+        emits it to the lifecycle log; this module stays I/O-free)."""
+        event, self._pending_scale_event = self._pending_scale_event, \
+            None
+        return event
 
     def evaluate_scaling(self,
                          now: Optional[float] = None) -> AutoscalerDecision:
@@ -64,7 +130,8 @@ class Autoscaler:
         return AutoscalerDecision(self.target_num_replicas)
 
     def plan(self, now: Optional[float] = None,
-             num_ready_spot: int = 0) -> ScalingPlan:
+             num_ready_spot: int = 0,
+             num_ready: Optional[int] = None) -> ScalingPlan:
         """Split the scalar target into (spot, on-demand) pool targets.
 
         - No spot anywhere: everything on-demand.
@@ -79,6 +146,8 @@ class Autoscaler:
           never becomes ready must not suppress the fallback.
         """
         target = self.evaluate_scaling(now).target_num_replicas
+        self._record_decision(time.time() if now is None else now,
+                              target, num_ready)
         spec = self.spec
         if not self.use_spot:
             # Fallback knobs without a spot task are meaningless (and
@@ -94,11 +163,12 @@ class Autoscaler:
                            target_ondemand=base + dynamic)
 
     @classmethod
-    def from_spec(cls, spec: SkyServiceSpec,
-                  use_spot: bool = False) -> "Autoscaler":
+    def from_spec(cls, spec: SkyServiceSpec, use_spot: bool = False,
+                  service_name: str = "") -> "Autoscaler":
         if spec.autoscaling_enabled:
-            return RequestRateAutoscaler(spec, use_spot=use_spot)
-        return cls(spec, use_spot=use_spot)
+            return RequestRateAutoscaler(spec, use_spot=use_spot,
+                                         service_name=service_name)
+        return cls(spec, use_spot=use_spot, service_name=service_name)
 
     def adopt_state(self, old: "Autoscaler") -> None:
         """Carry scaling state across a rolling update: the new revision
@@ -108,6 +178,11 @@ class Autoscaler:
                                           self.spec.min_replicas)
         self.target_num_replicas = max(lo, min(old.target_num_replicas,
                                                hi))
+        # Decision history survives the rollover too: "why did we last
+        # scale" must not be amnesiac right after an update.
+        self.decision_history.extend(old.decision_history)
+        self._last_recorded_target = old._last_recorded_target
+        self._pending_scale_event = old._pending_scale_event
         if isinstance(old, RequestRateAutoscaler) and isinstance(
                 self, RequestRateAutoscaler):
             self.request_timestamps = list(old.request_timestamps)
@@ -118,8 +193,10 @@ class RequestRateAutoscaler(Autoscaler):
     a higher target must persist for upscale_delay_seconds before scaling
     up (resp. downscale_delay_seconds down) so bursts don't thrash."""
 
-    def __init__(self, spec: SkyServiceSpec, use_spot: bool = False):
-        super().__init__(spec, use_spot=use_spot)
+    def __init__(self, spec: SkyServiceSpec, use_spot: bool = False,
+                 service_name: str = ""):
+        super().__init__(spec, use_spot=use_spot,
+                         service_name=service_name)
         self.request_timestamps: List[float] = []
         self._upscale_candidate_since: Optional[float] = None
         self._downscale_candidate_since: Optional[float] = None
@@ -136,6 +213,7 @@ class RequestRateAutoscaler(Autoscaler):
     def _raw_target(self, now: float) -> int:
         self._trim_window(now)
         qps = len(self.request_timestamps) / self.spec.qps_window_seconds
+        self._last_qps = qps
         target = math.ceil(qps / self.spec.target_qps_per_replica)
         lo = self.spec.min_replicas
         # No max_replicas = no growth budget: autoscaling can only shed

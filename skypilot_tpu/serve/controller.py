@@ -12,11 +12,21 @@ import os
 import time
 from typing import Optional
 
+from skypilot_tpu.observability import events
+from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve import autoscalers
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.load_balancer import RequestRecorder
 from skypilot_tpu.serve.replica_managers import SkyPilotReplicaManager
 from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+
+_REPLICA_GAUGE = metrics.gauge(
+    "stpu_serve_replicas",
+    "Replicas per lifecycle state (refreshed every controller tick).",
+    ("service", "state"))
+_TICKS = metrics.counter(
+    "stpu_serve_controller_ticks_total",
+    "Controller reconcile ticks.", ("service",))
 
 
 def _tick_seconds() -> float:
@@ -31,7 +41,7 @@ class SkyServeController:
         self.replica_manager = SkyPilotReplicaManager(service_name, spec,
                                                       task)
         self.autoscaler = autoscalers.Autoscaler.from_spec(
-            spec, use_spot=task.uses_spot)
+            spec, use_spot=task.uses_spot, service_name=service_name)
         # Request timestamps arrive from the LB process via /sync; the
         # autoscaler drains them each tick.
         self.recorder = recorder or RequestRecorder()
@@ -40,8 +50,12 @@ class SkyServeController:
         self._was_ready = False
         self._ready_urls: list = []
         self.version = 1
+        self._last_status: Optional[ServiceStatus] = None
         # Outdated replicas pulled from the LB last tick; terminated next
         # tick so in-flight requests drain before the server dies.
+        # All three gate stamps below are same-process comparisons:
+        # monotonic, so an NTP step can neither hold the READY publish
+        # hostage nor terminate a draining replica early.
         self._draining: set = set()
         self._draining_since = 0.0   # when _draining last gained members
         self._last_sync_at = 0.0     # when the LB last adopted /sync
@@ -59,8 +73,10 @@ class SkyServeController:
         try:
             while not self._stop:
                 self._tick()
-                deadline = time.time() + _tick_seconds()
-                while time.time() < deadline and not self._stop:
+                # Monotonic pacing: an NTP step back must not freeze
+                # probing/scaling for the size of the step.
+                deadline = time.monotonic() + _tick_seconds()
+                while time.monotonic() < deadline and not self._stop:
                     time.sleep(0.05)
         finally:
             if self._superseded:
@@ -128,8 +144,11 @@ class SkyServeController:
         self.version = row["version"]
         self.replica_manager.apply_update(self.version, spec, task)
         self.spec = spec
+        events.emit("service", self.service_name, "update_adopted",
+                    version=self.version)
         new_autoscaler = autoscalers.Autoscaler.from_spec(
-            spec, use_spot=task.uses_spot)
+            spec, use_spot=task.uses_spot,
+            service_name=self.service_name)
         new_autoscaler.adopt_state(self.autoscaler)
         self.autoscaler = new_autoscaler
 
@@ -140,6 +159,14 @@ class SkyServeController:
             return          # scaling work; run() falls through to
                             # _shutdown which reaps our replicas.
         rm.probe_all()
+        _TICKS.labels(service=self.service_name).inc()
+        snapshot = rm.status_snapshot()
+        # Refresh the per-state replica gauges EVERY tick (including
+        # zeroes: a state a replica just left must read 0, not linger).
+        for state in ReplicaStatus:
+            _REPLICA_GAUGE.labels(
+                service=self.service_name, state=state.value).set(
+                    sum(1 for s in snapshot if s == state))
         self.autoscaler.collect_request_information(self.recorder.drain())
         # Two capacity pools (spot / on-demand), reconciled separately:
         # a spot preemption wave drops ready-spot, which (under
@@ -148,7 +175,14 @@ class SkyServeController:
         # replicas are READY. Reference semantics:
         # sky/serve/autoscalers.py:527-636.
         plan = self.autoscaler.plan(
-            num_ready_spot=rm.ready_count(spot=True))
+            num_ready_spot=rm.ready_count(spot=True),
+            num_ready=rm.ready_count())
+        # The autoscaler stays file-I/O-free: it queues the decision,
+        # the controller (which owns the I/O boundary) logs it.
+        scale_event = self.autoscaler.pop_scale_event()
+        if scale_event:
+            events.emit("autoscaler", self.service_name,
+                        scale_event.pop("event"), **scale_event)
         target = plan.total
         given_up = (rm.consecutive_failure_count >=
                     self.MAX_CONSECUTIVE_REPLICA_FAILURES)
@@ -173,7 +207,7 @@ class SkyServeController:
             # Fallback: after 10 ticks, terminate anyway so a dead LB
             # cannot pin outdated replicas forever.
             lb_caught_up = (self._last_sync_at >= self._draining_since or
-                            time.time() - self._draining_since >
+                            time.monotonic() - self._draining_since >
                             10 * _tick_seconds())
             terminated = ((outdated & self._draining) if lb_caught_up
                           else set())
@@ -194,11 +228,11 @@ class SkyServeController:
             # Empty→non-empty edge: arm the READY-publish gate (below).
             # Stamped AFTER the assignment so a /sync racing this tick
             # can only read the NEW urls once its stamp passes the gate.
-            self._ready_edge_at = time.time()
+            self._ready_edge_at = time.monotonic()
         if newly_pulled:
             # Stamp AFTER _ready_urls excludes the pulled replicas: a
             # sync racing this tick must not count as caught-up.
-            self._draining_since = time.time()
+            self._draining_since = time.monotonic()
         # Don't publish READY until the LB has SYNCED since the ready
         # set became non-empty: `wait_ready` returns on the DB status,
         # and a request fired right after must not race the LB's first
@@ -207,7 +241,7 @@ class SkyServeController:
         # fallback so a crashed LB can't hold the status hostage.
         lb_serving = (self._ready_edge_at is None or
                       self._last_sync_at >= self._ready_edge_at or
-                      time.time() - self._ready_edge_at >
+                      time.monotonic() - self._ready_edge_at >
                       10 * _tick_seconds())
         self._publish_status(ready if lb_serving else [], given_up)
 
@@ -250,14 +284,18 @@ class SkyServeController:
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
-                controller._last_sync_at = time.time()
+                controller._last_sync_at = time.monotonic()
                 body = json_lib.dumps(
                     {"ready_urls": controller._ready_urls,
                      # Per-service LB knobs ride the sync so a rolling
                      # update to the spec reaches the LB within one
                      # interval, no LB restart needed.
                      "upstream_timeout":
-                         controller.spec.upstream_timeout_seconds}
+                         controller.spec.upstream_timeout_seconds,
+                     # Controller-registry snapshot (autoscaler + replica
+                     # gauges) for the LB's /metrics: one scrape of the
+                     # LB covers both processes.
+                     "metrics_text": metrics.render()}
                 ).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -288,6 +326,10 @@ class SkyServeController:
                 s == ReplicaStatus.FAILED for s in statuses)
             status = (ServiceStatus.FAILED if all_failed
                       else ServiceStatus.REPLICA_INIT)
+        if status != self._last_status:
+            events.emit("service", self.service_name, status.value,
+                        ready_replicas=len(ready))
+            self._last_status = status
         serve_state.set_service_status(self.service_name, status)
 
     def _shutdown(self) -> None:

@@ -477,12 +477,25 @@ def _status_local(service_names: Optional[List[str]],
     if service_names is not None:
         services = [s for s in services
                     if s["service_name"] in service_names]
+    from skypilot_tpu.observability import events
+    # The controller's last scale action per service (autoscaler
+    # decision history rides the event log), so `stpu serve status` can
+    # say WHY each fleet is its current size. ONE pass over the log for
+    # all services; runs controller-side in cluster mode — the event
+    # log lives where the controller does.
+    # Bounded tail read: status() is polled hot (wait_ready every
+    # 0.3s), so never pay a full multi-MB log parse for one record
+    # per service — recent history is all "last scale action" needs.
+    last_scale = {rec.get("name"): rec
+                  for rec in events.read(kind="autoscaler", limit=None,
+                                         max_bytes=256 * 1024)}
     for svc in services:
         svc["replicas"] = serve_state.get_replicas(svc["service_name"])
         svc["endpoint"] = f"http://{host}:{svc['lb_port']}"
         svc["status"] = getattr(svc["status"], "value", svc["status"])
         for rep in svc["replicas"]:
             rep["status"] = getattr(rep["status"], "value", rep["status"])
+        svc["last_scale_event"] = last_scale.get(svc["service_name"])
     return services
 
 

@@ -20,13 +20,31 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
+from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
 
 _HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding",
                 "te", "trailer", "upgrade", "proxy-authorization",
                 "proxy-authenticate", "host", "content-length"}
+
+# Proxy-path metrics. Observed AFTER the upstream response completes —
+# no metric lock is ever held during upstream I/O; the per-request cost
+# on the hot path is the label-child dict lookup plus the observe.
+_REQUESTS = metrics.counter(
+    "stpu_lb_requests_total",
+    "Requests proxied by the serve load balancer.",
+    ("method", "code"))
+_LATENCY = metrics.histogram(
+    "stpu_lb_request_duration_seconds",
+    "Wall time from request receipt to last proxied byte.",
+    ("code",))
+_STREAMED = metrics.histogram(
+    "stpu_lb_streamed_bytes",
+    "Response bytes streamed to the client per request.",
+    buckets=(256, 1024, 4096, 16384, 65536, 262144, 1048576,
+             4194304, 16777216))
 
 
 def write_chunk(wfile, data: bytes) -> None:
@@ -77,15 +95,51 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
     # upstream_timeout_seconds) so slow-first-byte services (cold model
     # compile, long prompts) aren't 502'd at an arbitrary 120s.
     upstream_timeout: float = 120.0
+    # Latest Prometheus snapshot of the CONTROLLER process's registry
+    # (autoscaler decisions, replica-state gauges) — rides the /sync
+    # reply in LB-as-a-process mode and is merged into /metrics.
+    controller_metrics_text: str = ""
 
     def log_message(self, fmt, *args):  # quiet
         del fmt, args
 
+    def _serve_metrics(self) -> None:
+        """GET /metrics: this process's registry merged with the
+        controller's latest snapshot. merge_text drops the snapshot's
+        copies of families this process also registers (the controller
+        imports this module, so zero-valued stpu_lb_* families exist
+        over there too — duplicates would invalidate the scrape).
+        Scrapes are not counted as proxied requests."""
+        body = metrics.merge_text(
+            metrics.render(), self.controller_metrics_text).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", metrics.CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _proxy(self, method: str) -> None:
         self.recorder.record()
+        t0 = time.perf_counter()
+        stats = {"code": 0, "bytes": 0}
+        try:
+            self._proxy_inner(method, stats)
+        finally:
+            # A replica dying mid-stream already sent the upstream's
+            # 2xx status line — record it as "aborted", not a clean
+            # 200, or a crash wave reads as healthy traffic.
+            code = ("aborted" if stats.get("aborted")
+                    else str(stats["code"] or 0))
+            _REQUESTS.labels(method=method, code=code).inc()
+            _LATENCY.labels(code=code).observe(
+                time.perf_counter() - t0)
+            _STREAMED.observe(stats["bytes"])
+
+    def _proxy_inner(self, method: str, stats: Dict[str, int]) -> None:
         target = self.policy.select_replica()
         if target is None:
             self.send_response(503)
+            stats["code"] = 503
             body = b"No ready replicas.\n"
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
@@ -102,13 +156,16 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
         try:
             with urllib.request.urlopen(
                     req, timeout=self.upstream_timeout) as resp:
-                self._stream_response(resp, started)
+                stats["code"] = resp.status
+                self._stream_response(resp, started, stats)
         except urllib.error.HTTPError as e:
             payload = e.read()
             self.send_response(e.code)
+            stats["code"] = e.code
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)
+            stats["bytes"] += len(payload)
         except (urllib.error.URLError, ConnectionError, OSError,
                 TimeoutError):
             if started:
@@ -116,15 +173,19 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                 # response here would corrupt the byte stream. Drop the
                 # connection — the client sees a truncated body, the
                 # one honest signal left.
+                stats["aborted"] = True
                 self.close_connection = True
                 return
             self.send_response(502)
+            stats["code"] = 502
             payload = b"Replica unreachable.\n"
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)
+            stats["bytes"] += len(payload)
 
-    def _stream_response(self, resp, started: List[bool]) -> None:
+    def _stream_response(self, resp, started: List[bool],
+                         stats: Dict[str, int]) -> None:
         """Forward the replica's response as chunks ARRIVE (read1 =
         whatever bytes are available), never whole-response buffered.
         Appends to ``started`` before the first write so the caller can
@@ -144,6 +205,7 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                     break
                 self.wfile.write(chunk)
                 self.wfile.flush()
+                stats["bytes"] += len(chunk)
         else:
             # Chunked upstream (SSE/token streams): re-chunk, flushing
             # per chunk so the client sees tokens as they are produced.
@@ -154,9 +216,13 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                 if not chunk:
                     break
                 write_chunk(self.wfile, chunk)
+                stats["bytes"] += len(chunk)
             end_chunks(self.wfile)
 
     def do_GET(self):
+        if self.path == "/metrics":
+            self._serve_metrics()
+            return
         self._proxy("GET")
 
     def do_POST(self):
@@ -232,6 +298,10 @@ def run_lb_process(port: int, controller_url: str,
             policy.set_ready_replicas(payload.get("ready_urls", []))
             handler_cls.upstream_timeout = float(
                 payload.get("upstream_timeout", 120.0))
+            # Controller-process metrics snapshot (autoscaler decisions,
+            # replica-state gauges) for this LB's /metrics.
+            handler_cls.controller_metrics_text = str(
+                payload.get("metrics_text", ""))
         except Exception:  # noqa: BLE001 — keep serving last-known set
             # Re-queue the drained timestamps: a transiently unreachable
             # controller must not erase QPS signal (the autoscaler would

@@ -21,9 +21,18 @@ from skypilot_tpu import execution
 from skypilot_tpu import global_user_state
 from skypilot_tpu import provision as provision_api
 from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.observability import events
+from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+_LAUNCHES = metrics.counter(
+    "stpu_serve_replica_launches_total",
+    "Replica cluster launches.", ("service", "outcome"))
+_PREEMPTIONS = metrics.counter(
+    "stpu_serve_preemptions_total",
+    "Replicas lost to provider preemption.", ("service",))
 
 PROBE_TIMEOUT_SECONDS = 4
 # Probe failures tolerated after a replica has been READY before it is
@@ -61,6 +70,9 @@ class ReplicaInfo:
         self.launched_at = time.time()
         self.first_ready_at: Optional[float] = None
         self.consecutive_failures = 0
+        # Last status written to the lifecycle event log (so _persist
+        # emits one event per TRANSITION, not one per probe tick).
+        self.last_event_status: Optional[ReplicaStatus] = None
         # In-flight _launch_replica thread; _terminate_replica joins it so
         # teardown never races a half-finished execution.launch.
         self.launch_thread: Optional[threading.Thread] = None
@@ -263,6 +275,8 @@ class SkyPilotReplicaManager:
             print(f"[replica {info.replica_id}] launch failed: {e}")
             info.status = ReplicaStatus.FAILED
             self.consecutive_failure_count += 1
+            _LAUNCHES.labels(service=self.service_name,
+                             outcome="failed").inc()
             self._persist(info)
             # Clean whatever half-provisioned cluster remains.
             self.scale_down(info.replica_id, keep_record=True)
@@ -272,6 +286,7 @@ class SkyPilotReplicaManager:
             head.external_ip or head.internal_ip)
         info.url = f"http://{host}:{info.port}"
         info.launched_at = time.time()
+        _LAUNCHES.labels(service=self.service_name, outcome="ok").inc()
         if info.status != ReplicaStatus.SHUTTING_DOWN:
             info.status = ReplicaStatus.STARTING
         self._persist(info)
@@ -348,6 +363,7 @@ class SkyPilotReplicaManager:
             self.scale_down(info.replica_id, keep_record=True)
         else:
             info.status = ReplicaStatus.PREEMPTED
+            _PREEMPTIONS.labels(service=self.service_name).inc()
             self._persist(info)
             # Reference _handle_preemption:777: clean the husk; the
             # controller's reconcile loop launches a replacement.
@@ -479,3 +495,13 @@ class SkyPilotReplicaManager:
                                        is_spot=info.is_spot,
                                        spec_json=spec_json,
                                        launched_at=info.launched_at)
+            changed = info.status != info.last_event_status
+            info.last_event_status = info.status
+        if changed:
+            # Every replica state TRANSITION lands in the lifecycle log
+            # (one hook covers launch, readiness, preemption, teardown).
+            events.emit("replica",
+                        f"{self.service_name}/{info.replica_id}",
+                        info.status.value, service=self.service_name,
+                        cluster=info.cluster_name,
+                        is_spot=info.is_spot, version=info.version)

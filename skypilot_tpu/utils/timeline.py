@@ -31,9 +31,14 @@ class Event:
         self._name = name
         self._message = message
         self._start = 0.0
+        self._start_perf = 0.0
 
     def __enter__(self) -> "Event":
+        # Wall clock for the trace's absolute placement (ts aligns
+        # events across processes/hosts); monotonic for the duration —
+        # an NTP step mid-block must not yield a negative dur.
         self._start = time.time()
+        self._start_perf = time.perf_counter()
         return self
 
     def __exit__(self, *exc) -> None:
@@ -44,7 +49,7 @@ class Event:
             "cat": "stpu",
             "ph": "X",
             "ts": self._start * 1e6,
-            "dur": (time.time() - self._start) * 1e6,
+            "dur": (time.perf_counter() - self._start_perf) * 1e6,
             "pid": os.getpid(),
             "tid": threading.get_ident(),
         }

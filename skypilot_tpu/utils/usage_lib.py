@@ -27,12 +27,17 @@ import json
 import os
 import threading
 import time
-import uuid
 from typing import Any, Callable
 
 DISABLE_ENV = "STPU_DISABLE_USAGE_COLLECTION"
 
-_run_id = uuid.uuid4().hex[:12]
+
+def _run_id() -> str:
+    # Shared with the lifecycle event log (observability.events): one
+    # ID correlates a CLI invocation's usage records, events, and the
+    # job-side logs it spawned.
+    from skypilot_tpu.observability import events
+    return events.run_id()
 
 
 def _enabled() -> bool:
@@ -121,11 +126,11 @@ def _drain_pending() -> None:
     thread would otherwise be killed before the POST leaves a
     short-lived CLI process. Capped so a dead collector delays exit by
     at most ~2s, and ONLY when the operator configured a sink."""
-    deadline = time.time() + 2.0
+    deadline = time.monotonic() + 2.0
     with _pending_lock:
         pending = list(_pending_sends)
     for t in pending:
-        t.join(max(0.0, deadline - time.time()))
+        t.join(max(0.0, deadline - time.monotonic()))
 
 
 import atexit  # noqa: E402
@@ -135,13 +140,23 @@ atexit.register(_drain_pending)
 def entrypoint(fn: Callable) -> Callable:
     """Record one line per SDK entrypoint call: name, duration, outcome.
     Arguments are NOT recorded (no YAML/env contents — stricter than the
-    reference's redaction, same spirit)."""
+    reference's redaction, same spirit). The call also lands in the
+    process metrics registry, so `stpu metrics` shows per-entrypoint
+    latency for whatever this process did."""
+    from skypilot_tpu.observability import metrics
+    calls = metrics.counter(
+        "stpu_entrypoint_calls_total",
+        "SDK entrypoint invocations.", ("entrypoint", "outcome"))
+    latency = metrics.histogram(
+        "stpu_entrypoint_duration_seconds",
+        "SDK entrypoint wall time.", ("entrypoint",))
 
     @functools.wraps(fn)
     def wrapper(*args: Any, **kwargs: Any) -> Any:
         if not _enabled():
             return fn(*args, **kwargs)
         t0 = time.time()
+        t0_perf = time.perf_counter()
         outcome, exc_type = "ok", None
         try:
             return fn(*args, **kwargs)
@@ -150,13 +165,19 @@ def entrypoint(fn: Callable) -> Callable:
             exc_type = type(e).__name__
             raise
         finally:
+            # Duration from the monotonic clock: an NTP step mid-call
+            # must not record a negative (or wildly long) duration.
+            duration = time.perf_counter() - t0_perf
+            calls.labels(entrypoint=fn.__qualname__,
+                         outcome=outcome).inc()
+            latency.labels(entrypoint=fn.__qualname__).observe(duration)
             try:
                 _record({
                     "ts": t0,
-                    "run_id": _run_id,
+                    "run_id": _run_id(),
                     "user": _user_hash(),
                     "entrypoint": fn.__qualname__,
-                    "duration_seconds": round(time.time() - t0, 3),
+                    "duration_seconds": round(duration, 3),
                     "outcome": outcome,
                     "exception": exc_type,
                 })

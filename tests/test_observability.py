@@ -1,0 +1,392 @@
+"""Observability subsystem: metrics registry, event log, clock lint,
+and the serve LB's /metrics end to end.
+
+Covers the PR-1 acceptance bar: registry concurrency, Prometheus
+exposition golden text, LB /metrics histogram counts matching proxied
+request counts (with the controller's autoscaler/replica metrics riding
+the /sync snapshot), the autoscaler decision history, the timeline
+NTP-step fix, and the check_clocks tier-1 lint.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu.observability import events
+from skypilot_tpu.observability import metrics
+
+
+# ------------------------------------------------------------- registry
+def test_counter_concurrent_increments():
+    reg = metrics.Registry()
+    counter = reg.counter("hits_total", "Hits.", ("tenant",))
+    n_threads, per_thread = 8, 2000
+
+    def worker():
+        child = counter.labels(tenant="a")
+        for _ in range(per_thread):
+            child.inc()
+
+    threads = [threading.Thread(target=worker)
+               for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert counter.labels(tenant="a").get() == n_threads * per_thread
+
+
+def test_histogram_concurrent_observes_consistent():
+    reg = metrics.Registry()
+    hist = reg.histogram("lat", "L.", buckets=(1.0, 10.0))
+
+    def worker():
+        for i in range(1000):
+            hist.observe(0.5 if i % 2 else 5.0)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    cumulative, total, count = hist.labels().snapshot()
+    assert count == 4000
+    assert cumulative[-1] == 4000          # +Inf bucket sees all
+    assert cumulative[0] == 2000           # le=1.0
+    assert total == pytest.approx(2000 * 0.5 + 2000 * 5.0)
+
+
+def test_exposition_golden():
+    """Exact Prometheus text format 0.0.4 output."""
+    reg = metrics.Registry()
+    c = reg.counter("stpu_requests_total", "Requests.",
+                    ("method", "code"))
+    c.labels(method="GET", code="200").inc(3)
+    c.labels(method="POST", code="502").inc()
+    g = reg.gauge("stpu_replicas", "Replicas.", ("state",))
+    g.labels(state="READY").set(2)
+    h = reg.histogram("stpu_latency_seconds", "Latency.",
+                      buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(7.0)
+    assert reg.render() == """\
+# HELP stpu_latency_seconds Latency.
+# TYPE stpu_latency_seconds histogram
+stpu_latency_seconds_bucket{le="0.1"} 1
+stpu_latency_seconds_bucket{le="1"} 2
+stpu_latency_seconds_bucket{le="+Inf"} 3
+stpu_latency_seconds_sum 7.55
+stpu_latency_seconds_count 3
+# HELP stpu_replicas Replicas.
+# TYPE stpu_replicas gauge
+stpu_replicas{state="READY"} 2
+# HELP stpu_requests_total Requests.
+# TYPE stpu_requests_total counter
+stpu_requests_total{method="GET",code="200"} 3
+stpu_requests_total{method="POST",code="502"} 1
+"""
+
+
+def test_label_escaping_and_validation():
+    reg = metrics.Registry()
+    c = reg.counter("esc_total", "E.", ("msg",))
+    c.labels(msg='a"b\\c\nd').inc()
+    text = reg.render()
+    assert r'msg="a\"b\\c\nd"' in text
+    with pytest.raises(ValueError):
+        c.labels("x", "y")            # wrong arity
+    with pytest.raises(ValueError):
+        reg.gauge("esc_total", "conflict")  # name/type clash
+
+
+def test_merge_text_drops_duplicate_families():
+    """Two processes can register the same family (controller imports
+    the LB module); the merged /metrics document must keep exactly one
+    copy or Prometheus rejects the whole scrape."""
+    a = metrics.Registry()
+    a.counter("shared_total", "S.").inc(5)
+    a.gauge("lb_only", "L.").set(1)
+    b = metrics.Registry()
+    b.counter("shared_total", "S.")             # zero-valued twin
+    b.gauge("ctl_only", "C.").set(9)
+    merged = metrics.merge_text(a.render(), b.render())
+    assert merged.count("# HELP shared_total") == 1
+    assert "shared_total 5" in merged           # live copy wins
+    assert "ctl_only 9" in merged and "lb_only 1" in merged
+    # Fully-duplicate extra degenerates to the primary document.
+    assert metrics.merge_text(a.render(), a.render()) == a.render()
+
+
+def test_dump_to_file_atomic(tmp_path):
+    reg = metrics.Registry()
+    reg.gauge("g", "G.").set(4)
+    target = tmp_path / "out.prom"
+    metrics.dump_to_file(target, reg)
+    assert target.read_text() == reg.render()
+    assert not (tmp_path / "out.prom.tmp").exists()
+    # Unwritable destination is swallowed, never raised.
+    metrics.dump_to_file(tmp_path / "missing" / "out.prom", reg)
+
+
+def test_registry_factories_idempotent():
+    reg = metrics.Registry()
+    a = reg.counter("same_total", "S.")
+    b = reg.counter("same_total", "S.")
+    assert a is b
+    a.inc()
+    assert b.get() == 1
+
+
+# ------------------------------------------------------------ event log
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_events_roundtrip_and_filtering():
+    events.emit("job", "7", "RUNNING")
+    events.emit("job", "7", "SUCCEEDED")
+    events.emit("replica", "svc/1", "READY", is_spot=True)
+    jobs = events.read(kind="job", name="7")
+    assert [r["event"] for r in jobs] == ["RUNNING", "SUCCEEDED"]
+    rep = events.last("replica")
+    assert rep["event"] == "READY" and rep["is_spot"] is True
+    # Every record carries wall + monotonic stamps and the run id.
+    for rec in jobs:
+        assert rec["ts"] > 0 and rec["mono"] > 0
+        assert rec["run_id"] == events.run_id()
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_events_run_id_propagates_via_env(monkeypatch):
+    monkeypatch.setenv(events.RUN_ID_ENV, "fixedrunid123")
+    events.emit("cluster", "c", "UP")
+    assert events.last("cluster")["run_id"] == "fixedrunid123"
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_events_skip_garbage_lines():
+    events.emit("job", "1", "RUNNING")
+    with open(events.log_path(), "a") as f:
+        f.write("{truncated json\n[1,2]\n")
+    events.emit("job", "1", "SUCCEEDED")
+    assert [r["event"] for r in events.read(kind="job")] == \
+        ["RUNNING", "SUCCEEDED"]
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_events_read_limit_and_tail():
+    for i in range(10):
+        events.emit("job", "1", f"E{i}")
+    assert events.read(kind="job", limit=0) == []
+    assert [r["event"] for r in events.read(kind="job", limit=3)] == \
+        ["E7", "E8", "E9"]
+    # Bounded tail read skips the head of the file but keeps whole
+    # records (the partial first line is dropped, never mis-parsed).
+    tail = events.read(kind="job", limit=None, max_bytes=200)
+    assert 0 < len(tail) < 10
+    assert tail[-1]["event"] == "E9"
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_events_disabled_by_env(monkeypatch):
+    monkeypatch.setenv(events.DISABLE_ENV, "1")
+    events.emit("job", "1", "RUNNING")
+    assert events.read() == []
+
+
+# -------------------------------------------- autoscaler decision history
+def test_autoscaler_decision_history_and_event():
+    """Pure-logic contract: plan() records history and QUEUES the scale
+    event; the controller pops and writes it (the module itself does no
+    file I/O, so unit tests never touch a real event log)."""
+    from skypilot_tpu.serve import autoscalers
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    spec = SkyServiceSpec(min_replicas=1, max_replicas=5,
+                          target_qps_per_replica=1.0,
+                          qps_window_seconds=10,
+                          upscale_delay_seconds=5,
+                          downscale_delay_seconds=20)
+    a = autoscalers.Autoscaler.from_spec(spec, service_name="svc-hist")
+    t0 = 1000.0
+    a.collect_request_information([t0 - 10 + k / 3.0 for k in range(48)])
+    a.plan(now=t0, num_ready=1)
+    assert a.pop_scale_event() is None     # hysteresis: no action yet
+    a.plan(now=t0 + 6, num_ready=1)        # upscale fires here
+    assert len(a.decision_history) == 2
+    ts, qps, target, ready = a.decision_history[-1]
+    assert target == 3 and qps > 0 and ready == 1
+    scale = a.pop_scale_event()
+    assert scale["event"] == "scale_up"
+    assert scale["previous"] == 1 and scale["target"] == 3
+    assert a.pop_scale_event() is None     # consumed exactly once
+    # History survives a rolling-update autoscaler swap.
+    new = autoscalers.Autoscaler.from_spec(spec,
+                                           service_name="svc-hist")
+    new.adopt_state(a)
+    assert list(new.decision_history) == list(a.decision_history)
+
+
+# ------------------------------------------------------------- timeline
+def test_timeline_duration_survives_clock_step(tmp_path, monkeypatch):
+    from skypilot_tpu.utils import timeline
+    monkeypatch.setenv("STPU_TIMELINE_FILE", str(tmp_path / "t.json"))
+    real_time = time.time
+    # Wall clock steps BACKWARD 1h mid-block (NTP correction).
+    monkeypatch.setattr(timeline.time, "time",
+                        lambda: real_time() - 3600)
+    with timeline.Event("stepped"):
+        pass
+    monkeypatch.undo()
+    with timeline._lock:
+        event = next(e for e in timeline._events
+                     if e["name"] == "stepped")
+    assert event["dur"] >= 0
+
+
+# ------------------------------------------------------------ clock lint
+def test_clock_lint_clean():
+    """Tier-1 enforcement: no unannotated time.time() duration
+    arithmetic inside skypilot_tpu/."""
+    import importlib.util
+    import pathlib
+    spec = importlib.util.spec_from_file_location(
+        "check_clocks",
+        pathlib.Path(__file__).parent.parent / "tools" /
+        "check_clocks.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.check() == []
+    # And the lint actually catches the pattern.
+    bad = pathlib.Path(str(mod.TARGET_DIR))  # scan a synthetic tree
+    tmp = pathlib.Path(__file__).parent / "_clock_lint_probe"
+    tmp.mkdir(exist_ok=True)
+    try:
+        probe = tmp / "probe.py"
+        probe.write_text("import time\nd = time.time() - t0\n"
+                         "ok = time.time() - t1  "
+                         "# wallclock: intentional\n")
+        violations = mod.check(tmp)
+        assert len(violations) == 1 and "probe.py:2" in violations[0]
+    finally:
+        for p in tmp.iterdir():
+            p.unlink()
+        tmp.rmdir()
+    del bad
+
+
+# ------------------------------------------------------------------ CLI
+def test_cli_metrics_and_events(tmp_state_dir):
+    runner = CliRunner()
+    # Local registry render: seed one metric in-process.
+    metrics.counter("stpu_cli_probe_total", "Probe.").inc()
+    result = runner.invoke(__import__("skypilot_tpu.cli",
+                                      fromlist=["cli"]).cli,
+                           ["metrics"])
+    assert result.exit_code == 0, result.output
+    assert "stpu_cli_probe_total 1" in result.output
+    # Event log render.
+    events.emit("job", "42", "RUNNING")
+    from skypilot_tpu import cli as cli_mod
+    result = runner.invoke(cli_mod.cli, ["status", "--events"])
+    assert result.exit_code == 0, result.output
+    assert "RUNNING" in result.output and "job" in result.output
+
+
+# ------------------------------------------------------------- LB e2e
+@pytest.fixture
+def fast_tick(monkeypatch):
+    monkeypatch.setenv("STPU_SERVE_TICK_SECONDS", "0.3")
+    monkeypatch.setenv("STPU_LB_SYNC_SECONDS", "0.2")
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _metric_value(text: str, prefix: str) -> float:
+    """Sum all samples whose name+labels start with ``prefix``."""
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(prefix) and not line.startswith("#"):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+@pytest.mark.usefixtures("tmp_state_dir", "fast_tick")
+def test_lb_metrics_end_to_end():
+    """`curl $LB/metrics` after proxied requests: request histogram
+    counts match the request count, and the controller's autoscaler /
+    replica-state metrics ride the sync into the same exposition."""
+    from skypilot_tpu.serve import core as serve_core
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    from skypilot_tpu.task import Task
+    from skypilot_tpu.resources import Resources
+
+    task = Task("metrics-svc", run=(
+        'cd $(mktemp -d) && echo "hello" > index.html && '
+        'exec python3 -m http.server $SKYPILOT_SERVE_REPLICA_PORT'))
+    task.set_resources(Resources(cloud="local"))
+    task.service = SkyServiceSpec(readiness_path="/",
+                                  initial_delay_seconds=60,
+                                  min_replicas=1)
+    name, endpoint = serve_core.up(task, "svc-metrics",
+                                   controller="local")
+    try:
+        serve_core.wait_ready(name, timeout=90)
+        n_requests = 5
+        for _ in range(n_requests):
+            status, body = _get(endpoint + "/")
+            assert status == 200 and "hello" in body
+
+        # The LB observes each request synchronously after the last
+        # byte; the controller snapshot arrives on the next /sync.
+        # Poll briefly for both.
+        deadline = time.time() + 20
+        text = ""
+        while time.time() < deadline:
+            status, text = _get(endpoint + "/metrics")
+            assert status == 200
+            if (_metric_value(text, "stpu_lb_requests_total")
+                    >= n_requests and "stpu_serve_replicas" in text):
+                break
+            time.sleep(0.3)
+
+        # Request counter and latency histogram agree with the traffic.
+        assert _metric_value(
+            text, 'stpu_lb_requests_total{method="GET",code="200"}'
+        ) == n_requests
+        assert _metric_value(
+            text, "stpu_lb_request_duration_seconds_count") == \
+            n_requests
+        assert _metric_value(
+            text, "stpu_lb_request_duration_seconds_bucket"
+            '{code="200",le="+Inf"}') == n_requests
+        assert _metric_value(text, "stpu_lb_streamed_bytes_count") == \
+            n_requests
+        # /metrics scrapes are NOT proxied requests.
+        assert _metric_value(text, "stpu_lb_requests_total") == \
+            n_requests
+
+        # The merged document is VALID exposition: one HELP/TYPE block
+        # per family, even though the controller process registers the
+        # LB families too (it imports the LB module).
+        help_names = [line.split()[2] for line in text.splitlines()
+                      if line.startswith("# HELP ")]
+        assert len(help_names) == len(set(help_names)), help_names
+
+        # Controller-process metrics ride the /sync snapshot:
+        # replica-state gauges and autoscaler decision counters.
+        assert 'stpu_serve_replicas{service="svc-metrics",' \
+            'state="READY"} 1' in text
+        assert "stpu_autoscaler_decisions_total" in text
+        assert 'stpu_autoscaler_target_replicas{service="svc-metrics"}'\
+            in text
+
+        # The same exposition is reachable through `stpu metrics --url`.
+        from skypilot_tpu import core as sdk_core
+        scraped = sdk_core.metrics_snapshot(endpoint)
+        assert "stpu_lb_requests_total" in scraped
+    finally:
+        serve_core.down([name], timeout=60)

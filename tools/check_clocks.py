@@ -1,0 +1,101 @@
+#!/usr/bin/env python3
+"""Lint: reject ``time.time()`` used in duration arithmetic.
+
+``time.time() - t0`` is wrong for measuring elapsed time: an NTP step
+(or a VM migration's clock slew) mid-interval yields negative or wildly
+wrong durations — exactly the bug this PR fixed in utils/timeline.py.
+Durations must come from ``time.perf_counter()`` / ``time.monotonic()``;
+``time.time()`` is for wall-clock *stamps* (cross-process comparison,
+persisted timestamps, trace alignment).
+
+Flagged pattern: ``time.time()`` adjacent to a ``-`` on the same line,
+inside ``skypilot_tpu/``. Wall-clock-INTENTIONAL sites — arithmetic
+against a timestamp persisted by another process/boot, where monotonic
+clocks are meaningless — are either allowlisted below or annotated
+inline with ``# wallclock: intentional``.
+
+Runs as a tier-1 test (tests/test_observability.py) and standalone:
+
+    python tools/check_clocks.py        # exit 1 on violations
+"""
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+from typing import List, Tuple
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+TARGET_DIR = REPO_ROOT / "skypilot_tpu"
+
+PATTERN = re.compile(r"time\.time\(\)\s*-|-\s*time\.time\(\)")
+INLINE_MARKER = "# wallclock: intentional"
+
+# (path suffix, line substring, why wall clock is right there).
+ALLOWLIST: Tuple[Tuple[str, str, str], ...] = (
+    ("catalog/__init__.py", "csv_path.stat().st_mtime",
+     "age of an on-disk catalog file: mtime is wall clock"),
+    ("jobs/core.py", "job.get(\"submitted_at\")",
+     "submitted_at was persisted by another process"),
+    ("serve/replica_managers.py", "info.launched_at",
+     "launched_at is persisted to serve state and re-read after "
+     "controller restarts; monotonic clocks don't survive a process"),
+    ("agent/daemon.py", "time.time() - baseline",
+     "idle baseline mixes job-DB wall stamps with autostop.json "
+     "set_at written by the remote client"),
+    ("agent/native.py", "deadline - time.time()",
+     "socket-deadline bookkeeping in the gang coordinator; deadlines "
+     "are exchanged with code that stamps wall clock"),
+    # Recipes are user-workload exemplars reporting elapsed *wall* time
+    # of a training run — the number an operator compares to a wall
+    # clock, not an interval the framework acts on.
+    ("recipes/", "time.time() - t0",
+     "workload wall-time report"),
+    ("recipes/resnet_ddp.py", "iter_times.append",
+     "workload wall-time report"),
+)
+
+
+def _allowed(rel_path: str, line: str) -> bool:
+    if INLINE_MARKER in line:
+        return True
+    for suffix, substring, _reason in ALLOWLIST:
+        if suffix in rel_path and substring in line:
+            return True
+    return False
+
+
+def check(root: pathlib.Path = TARGET_DIR) -> List[str]:
+    """Return violation strings ('path:lineno: line')."""
+    violations = []
+    for path in sorted(root.rglob("*.py")):
+        rel = str(path.relative_to(REPO_ROOT))
+        try:
+            text = path.read_text(errors="replace")
+        except OSError:
+            continue
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            stripped = line.strip()
+            if stripped.startswith("#"):
+                continue
+            if PATTERN.search(line) and not _allowed(rel, line):
+                violations.append(f"{rel}:{lineno}: {stripped}")
+    return violations
+
+
+def main() -> int:
+    violations = check()
+    if violations:
+        print("time.time() used in duration arithmetic (use "
+              "time.perf_counter()/time.monotonic(), or annotate "
+              f"'{INLINE_MARKER}' / extend the allowlist in "
+              "tools/check_clocks.py if wall clock is intentional):")
+        for v in violations:
+            print(f"  {v}")
+        return 1
+    print("clock discipline OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
