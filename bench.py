@@ -302,40 +302,63 @@ def _eight_b_shape_leg(llama, peak: float) -> dict:
 
 def _serving_leg() -> dict:
     """Driver-tracked decode throughput (VERDICT r4 next #3): llama /
-    MoE / gemma decode tok/s at batch 8 and 32, fixed config, through
+    MoE / gemma decode tok/s at batch 8/32/64, fixed config, through
     the same measurement core the hand-run tool uses — each leg in a
     FRESH subprocess so it is independent of earlier legs' device
-    state and measured exactly the way users run the tool. Honesty
-    note: decode numbers on the tunneled chip carry ±5-8% run-to-run
-    variance (dispatch conditions, not HBM state — subprocess vs
-    in-process runs bounce equally); best-of-5 inside each run narrows
-    but does not remove it. r4 hand-run floors: llama 1778/4168,
-    mixtral 2578/6821 tok/s (b8/b32, warm cache)."""
+    state and measured exactly the way users run the tool. Each
+    fixed-batch point now also records the prefill/steady-state split
+    (prefill_ms / decode_ms_per_token_steady), and a per-family
+    ``engine_ragged_tok_s`` leg measures the continuous-batching
+    decode engine under a ragged arrival mix — the traffic the
+    fixed-batch path cannot batch. Honesty note: decode numbers on the
+    tunneled chip carry ±5-8% run-to-run variance (dispatch
+    conditions, not HBM state — subprocess vs in-process runs bounce
+    equally); best-of-5 inside each run narrows but does not remove
+    it. r4 hand-run floors: llama 1778/4168, mixtral 2578/6821 tok/s
+    (b8/b32, warm cache)."""
     import subprocess
 
     out: dict = {}
     tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "tools", "bench_moe_decode.py")
+
+    def run_tool(extra_args, timeout=900):
+        proc = subprocess.run(
+            [sys.executable, tool] + extra_args,
+            capture_output=True, text=True, timeout=timeout)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                proc.stderr.strip().splitlines()[-1]
+                if proc.stderr.strip() else f"exit {proc.returncode}")
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
     for family in ("llama", "mixtral", "gemma"):
-        for batch in (8, 32):
+        for batch in (8, 32, 64):
             key = f"{family}_decode_tok_s_b{batch}"
             try:
-                proc = subprocess.run(
-                    [sys.executable, tool, "--family", family,
-                     "--batch", str(batch), "--repeats", "5"],
-                    capture_output=True, text=True, timeout=900)
-                if proc.returncode != 0:
-                    raise RuntimeError(
-                        proc.stderr.strip().splitlines()[-1]
-                        if proc.stderr.strip() else
-                        f"exit {proc.returncode}")
-                r = json.loads(proc.stdout.strip().splitlines()[-1])
+                r = run_tool(["--family", family, "--batch", str(batch),
+                              "--repeats", "5"])
                 out[key] = r["tokens_per_sec"]
+                out[f"{family}_prefill_ms_b{batch}"] = r.get(
+                    "prefill_ms")
+                out[f"{family}_decode_ms_tok_b{batch}"] = r.get(
+                    "decode_ms_per_token_steady")
                 out.setdefault(f"{family}_model", r["model"])
             except Exception as e:  # noqa: BLE001 — a failed leg must
                 # be visible in the json, not sink the whole bench run.
                 out[key] = None
                 out[f"{key}_error"] = str(e)[:200]
+        key = f"{family}_engine_ragged_tok_s"
+        try:
+            r = run_tool(["--family", family, "--mode", "engine"],
+                         timeout=1200)
+            out[key] = r["engine_ragged_tok_s"]
+            out[f"{family}_engine_ragged_detail"] = {
+                k: r[k] for k in ("slots", "requests",
+                                  "generated_tokens", "wall_seconds")}
+        except Exception as e:  # noqa: BLE001
+            out[key] = None
+            out[f"{key}_error"] = str(e)[:200]
     return out
 
 

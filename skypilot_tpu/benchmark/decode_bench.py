@@ -7,13 +7,27 @@ never reached the round-over-round record. Reference bar: serving
 throughput is the reference's headline README metric
 (/root/reference/README.md:49).
 
-Measures incremental decode (prefill + KV-cached per-token steps; dense
-top-2 expert routing for MoE) in tokens/second at a fixed batch. Models
-are scaled to fit one v5e chip (full 8x7B / 8B need a pod slice).
+Two measurements:
+
+  * ``measure_decode`` — fixed-batch incremental decode (prefill +
+    KV-cached per-token steps; dense top-2 expert routing for MoE) in
+    tokens/second, comparable with rounds r01-r05, now split into
+    prefill latency and steady-state per-token decode latency. The KV
+    cache is allocated by the caller and DONATED through the jit
+    boundary so each step updates it in place (no second full-size
+    cache in HBM).
+  * ``measure_engine_ragged`` — the continuous-batching decode engine
+    (serve/decode_engine.py) under a RAGGED arrival mix (heterogeneous
+    prompt lengths and token budgets), the traffic shape the
+    fixed-batch path cannot batch at all.
+
+Models are scaled to fit one v5e chip (full 8x7B / 8B need a pod
+slice).
 """
 from __future__ import annotations
 
 import dataclasses
+import random
 import time
 from typing import Any, Dict
 
@@ -44,12 +58,27 @@ def build(family: str, dim: int = 1024, layers: int = 8,
     return mdl, cfg
 
 
+def _model_info(family: str, cfg, params) -> Dict[str, Any]:
+    return {"family": family, "dim": cfg.dim,
+            "layers": cfg.n_layers,
+            "experts": getattr(cfg, "n_experts", 0),
+            "mlp_dim": cfg.mlp_dim,
+            "params": sum(x.size for x in jax.tree.leaves(params))}
+
+
 def measure_decode(family: str, batch: int = 8, prompt_len: int = 128,
                    tokens: int = 128, repeats: int = 3,
                    **shape_kw) -> Dict[str, Any]:
     """Best-of-N jitted end-to-end decode (recipes/serve_llm.py
     _decode contract): unjitted, every eager op pays the tunnel's
-    dispatch latency and the measurement is of the host, not the chip."""
+    dispatch latency and the measurement is of the host, not the chip.
+
+    Besides the end-to-end number (comparable with r01-r05), the
+    prefill and steady-state decode phases are timed separately: a
+    single end-to-end figure hides whether a regression sits in the
+    O(S) prefill or the per-token loop, and TTFT (prefill) vs
+    tokens/sec (steady state) are different serving SLOs.
+    """
     mdl, cfg = build(family, **shape_kw)
     params = mdl.init(cfg, jax.random.key(0))
     b, s = batch, prompt_len
@@ -57,11 +86,29 @@ def measure_decode(family: str, batch: int = 8, prompt_len: int = 128,
                                 cfg.vocab_size)
     max_seq = s + tokens
 
+    # KV caches are allocated OUTSIDE the jitted programs, donated, and
+    # RETURNED (then dropped): XLA only aliases a donated input to an
+    # output, so returning the final cache is what makes the
+    # O(layers * batch * max_seq) buffer update in place instead of
+    # double-buffering in HBM every call.
     decode_jit = jax.jit(
-        lambda p, pr, tl: mdl.decode(cfg, p, pr, tl, tokens, max_seq))
+        lambda p, pr, tl, cache: mdl.decode(cfg, p, pr, tl, tokens,
+                                            max_seq, cache=cache,
+                                            return_cache=True),
+        donate_argnums=(3,))
+    prefill_jit = jax.jit(
+        lambda p, pr, tl, cache: mdl.forward_with_cache(
+            cfg, p, pr, cache, jnp.int32(0), valid_len=tl,
+            logits_at=tl - 1),
+        donate_argnums=(3,))
+    step_jit = jax.jit(
+        lambda p, tok, cache, pos: mdl.forward_with_cache(
+            cfg, p, tok, cache, pos),
+        donate_argnums=(2,))
 
     def run():
-        out = decode_jit(params, prompt, jnp.int32(s))
+        cache = mdl.init_cache(cfg, b, max_seq)
+        out, _ = decode_jit(params, prompt, jnp.int32(s), cache)
         return int(out[0, -1])  # value fetch forces completion
 
     run()                      # compile + warm
@@ -70,18 +117,93 @@ def measure_decode(family: str, batch: int = 8, prompt_len: int = 128,
         t0 = time.perf_counter()
         run()
         best = min(best, time.perf_counter() - t0)
+
+    # Prefill alone (compile + warm, then best-of-N).
+    def run_prefill():
+        cache = mdl.init_cache(cfg, b, max_seq)
+        logits, cache = prefill_jit(params, prompt, jnp.int32(s), cache)
+        return float(logits[0, 0, 0]), cache
+
+    _, cache = run_prefill()
+    best_prefill = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        _, cache = run_prefill()
+        best_prefill = min(best_prefill, time.perf_counter() - t0)
+
+    # Steady-state per-token decode: timed jitted single steps against
+    # the warm cache (the cache row frontier advances each step, like a
+    # live serving loop).
+    tok = jnp.zeros((b, 1), jnp.int32)
+    logits, cache = step_jit(params, tok, cache, jnp.int32(s))  # warm
+    jax.block_until_ready(logits)   # keep the warm step out of the timer
+    n_steps = min(max(tokens // 4, 8), tokens - 1)
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        logits, cache = step_jit(params, tok, cache,
+                                 jnp.int32(s + 1 + i))
+    float(logits[0, 0, 0])     # force the chain
+    steady = (time.perf_counter() - t0) / n_steps
+
     toks = b * tokens
     return {
-        "model": {"family": family, "dim": cfg.dim,
-                  "layers": cfg.n_layers,
-                  "experts": getattr(cfg, "n_experts", 0),
-                  "mlp_dim": cfg.mlp_dim,
-                  "params": sum(x.size for x in
-                                jax.tree.leaves(params))},
+        "model": _model_info(family, cfg, params),
         "batch": b,
         "prompt_len": s,
         "decode_tokens": tokens,
         "decode_seconds": round(best, 3),
         "tokens_per_sec": round(toks / best, 1),
         "ms_per_token_per_seq": round(best / tokens * 1e3, 2),
+        "prefill_ms": round(best_prefill * 1e3, 2),
+        "decode_ms_per_token_steady": round(steady * 1e3, 3),
+        "steady_tokens_per_sec": round(b / steady, 1),
+    }
+
+
+def measure_engine_ragged(family: str, slots: int = 8,
+                          n_requests: int = 32, max_prompt: int = 192,
+                          max_tokens: int = 64,
+                          **shape_kw) -> Dict[str, Any]:
+    """Continuous-batching engine throughput under ragged arrivals.
+
+    A deterministic (seeded) mix of prompt lengths in [8, max_prompt]
+    and token budgets in [8, max_tokens] is submitted all at once; the
+    engine packs them into ``slots`` cache rows, prefilling joiners in
+    chunks between decode steps. Reported tokens/sec counts GENERATED
+    tokens over the whole wall (including prefill) — the number a
+    heterogeneous traffic mix actually observes, which per-bucket
+    fixed-batch serving cannot reach because it only co-schedules
+    same-length prompts.
+    """
+    from skypilot_tpu.serve.decode_engine import DecodeEngine
+
+    mdl, cfg = build(family, **shape_kw)
+    params = mdl.init(cfg, jax.random.key(0))
+    engine = DecodeEngine(cfg, params, slots=slots,
+                          max_seq=max_prompt + max_tokens,
+                          prefill_chunk=64)
+    engine.start()
+    engine.warmup()
+
+    rng = random.Random(0)
+    specs = [( [rng.randint(1, cfg.vocab_size - 1)
+                for _ in range(rng.randint(8, max_prompt))],
+               rng.randint(8, max_tokens))
+             for _ in range(n_requests)]
+    try:
+        t0 = time.perf_counter()
+        reqs = [engine.submit(p, max_tokens=mt) for p, mt in specs]
+        total = sum(len(r.result(timeout=1800.0)) for r in reqs)
+        dt = time.perf_counter() - t0
+    finally:
+        engine.shutdown()
+    return {
+        "model": _model_info(family, cfg, params),
+        "slots": slots,
+        "requests": n_requests,
+        "max_prompt": max_prompt,
+        "max_tokens": max_tokens,
+        "generated_tokens": total,
+        "wall_seconds": round(dt, 3),
+        "engine_ragged_tok_s": round(total / dt, 1),
     }

@@ -1,0 +1,18 @@
+"""Model families (llama / mixtral / gemma) sharing one attention,
+KV-cache, and serving-decode stack (models/llama.py)."""
+from __future__ import annotations
+
+
+def model_api(cfg):
+    """Config-type -> model module (init/forward/decode/cache fns).
+
+    Static dispatch on the (static-argnum) config dataclass, shared by
+    the serving recipe, the decode engine, and the benches so a fourth
+    family plugs in at exactly one place.
+    """
+    from skypilot_tpu.models import gemma, llama, mixtral
+    if isinstance(cfg, mixtral.MixtralConfig):
+        return mixtral
+    if isinstance(cfg, gemma.GemmaConfig):
+        return gemma
+    return llama

@@ -201,7 +201,10 @@ def forward_with_cache(cfg: GemmaConfig, params: Params,
 
 def decode(cfg: GemmaConfig, params: Params, prompt: jax.Array,
            true_len, max_tokens: int, max_seq: int,
-           temperature: float = 0.0, key=None) -> jax.Array:
-    """Prefill + KV-cached decode through the shared serving loop."""
+           temperature: float = 0.0, key=None, *,
+           cache=None, return_cache: bool = False) -> jax.Array:
+    """Prefill + KV-cached decode through the shared serving loop
+    (scalar or ragged (B,) true_len; optional donated cache)."""
     return llama.decode(cfg, params, prompt, true_len, max_tokens,
-                        max_seq, temperature, key)
+                        max_seq, temperature, key, cache=cache,
+                        return_cache=return_cache)

@@ -389,6 +389,12 @@ def head_weights(params: Params) -> jax.Array:
 
 # ----------------------------------------------------------- KV-cache decode
 
+# KV rows read per split-KV block. 256 keeps tiny test caches (< 256
+# rows) on a single block — bit-identical to the dense softmax — while
+# bounding VMEM working set at serving cache sizes.
+SPLIT_KV_BLOCK = 256
+
+
 def init_cache(cfg: LlamaConfig, batch: int,
                max_seq: int) -> Dict[str, jax.Array]:
     """Per-layer KV cache, stacked on the layer axis like the params
@@ -398,34 +404,97 @@ def init_cache(cfg: LlamaConfig, batch: int,
             "v": jnp.zeros(shape, dtype=cfg.dtype)}
 
 
+def _split_kv_attention(qg: jax.Array, ck: jax.Array, cv: jax.Array,
+                        positions: jax.Array, valid_len: jax.Array,
+                        block: Optional[int] = None) -> jax.Array:
+    """Flash-decode-style attention against the ragged KV cache.
+
+    Instead of one dense (T, max_seq) score einsum that reads every
+    cache row, the cache is consumed in key blocks with an online
+    softmax (running max / normalizer / accumulator), and the block loop
+    is a ``lax.while_loop`` bounded by the LONGEST valid prefix in the
+    batch — cache rows past every slot's frontier are never read, so a
+    batch of short sequences in a long-max_seq cache pays for its actual
+    tokens, not the allocation.
+
+    qg: (B, T, KVH, G, D) grouped queries; ck/cv: (B, max_seq, KVH, D).
+    positions: (B, T) absolute query positions. valid_len: (B,) — rows
+    >= valid_len[b] are masked even though they hold (stale) data; this
+    is the padding-KV-never-attendable invariant slot reuse relies on.
+    Returns f32 (B, T, KVH, G, D).
+    """
+    b, t, kvh, g, d = qg.shape
+    max_seq = ck.shape[1]
+    block = min(block or SPLIT_KV_BLOCK, max_seq)
+    qf = qg.astype(jnp.float32)
+    scale = d ** -0.5
+    # Rows a query of slot b can ever attend stop at
+    # min(its position + 1, valid_len[b]); the loop bound is the batch
+    # max so every slot's frontier is covered.
+    limit = jnp.max(jnp.minimum(positions[:, -1] + 1, valid_len))
+    limit = jnp.minimum(limit, max_seq)
+
+    def body(carry):
+        s0, m, el, acc = carry
+        # When block does not divide max_seq, the final window clamps
+        # back to max_seq - block; rows before the nominal start s0
+        # (already consumed by earlier blocks) are masked out below, so
+        # the overlap never double-counts.
+        start = jnp.minimum(s0, max_seq - block)
+        kb = jax.lax.dynamic_slice_in_dim(ck, start, block,
+                                          axis=1).astype(jnp.float32)
+        vb = jax.lax.dynamic_slice_in_dim(cv, start, block,
+                                          axis=1).astype(jnp.float32)
+        kpos = start + jnp.arange(block)
+        msk = ((kpos[None, None, :] >= s0) &
+               (kpos[None, None, :] <= positions[..., None]) &
+               (kpos[None, None, :] < valid_len[:, None, None]))
+        s_blk = jnp.einsum("btkgd,bskd->bkgts", qf, kb) * scale
+        s_blk = jnp.where(msk[:, None, None], s_blk, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s_blk, axis=-1))
+        corr = jnp.exp(m - m_new)
+        # Masked entries multiplied to exactly 0 (not just exp(-big)):
+        # a fully-masked slot (free engine slot) must stay finite.
+        p = jnp.exp(s_blk - m_new[..., None]) * msk[:, None, None]
+        el = el * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bkgts,bskd->btkgd", p, vb)
+        corr_t = corr.transpose(0, 3, 1, 2)[..., None]
+        return s0 + block, m_new, el, acc * corr_t + pv
+
+    carry = (jnp.int32(0),
+             jnp.full((b, kvh, g, t), -1e30, jnp.float32),
+             jnp.zeros((b, kvh, g, t), jnp.float32),
+             jnp.zeros((b, t, kvh, g, d), jnp.float32))
+    _, _, el, acc = jax.lax.while_loop(lambda c: c[0] < limit, body,
+                                       carry)
+    el_t = el.transpose(0, 3, 1, 2)[..., None]
+    return jnp.where(el_t > 0, acc / jnp.maximum(el_t, 1e-30), 0.0)
+
+
 def cached_attention_block(cfg, x: jax.Array, lp: Params,
                            ck: jax.Array, cv: jax.Array,
                            positions: jax.Array, start_pos: jax.Array,
-                           mask: jax.Array):
+                           valid_len: jax.Array):
     """One pre-norm GQA attention residual block against the KV cache
-    (shared by llama's and mixtral's decode paths). Returns
-    (x + attn_out, updated ck, updated cv)."""
+    (shared by llama's and mixtral's decode paths). ``start_pos`` and
+    ``valid_len`` are per-slot (B,) vectors — every slot in the batch
+    may sit at a different sequence position (continuous batching).
+    Returns (x + attn_out, updated ck, updated cv)."""
     b, t = x.shape[0], x.shape[1]
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     y = rms_norm(x, lp["attn_norm"], cfg.norm_eps,
                  getattr(cfg, "norm_offset", 0.0))
     q, k_new, v_new = qkv_proj(cfg, y, lp, positions)
-    ck = jax.lax.dynamic_update_slice(ck, k_new.astype(ck.dtype),
-                                      (0, start_pos, 0, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v_new.astype(cv.dtype),
-                                      (0, start_pos, 0, 0))
+    upd = lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (s, 0, 0))
+    ck = jax.vmap(upd)(ck, k_new.astype(ck.dtype), start_pos)
+    cv = jax.vmap(upd)(cv, v_new.astype(cv.dtype), start_pos)
     # GQA grouped attention against the UNEXPANDED cache (the head-
     # order convention of ops/attention.py): q regrouped per KV head
     # so no repeat()ed copy of the cache hits HBM on the hot path.
     groups = h // kvh
-    qg = q.reshape(b, t, kvh, groups, hd).astype(jnp.float32)
-    scores = jnp.einsum("btkgd,bskd->bkgts", qg,
-                        ck.astype(jnp.float32)) * (hd ** -0.5)
-    scores = jnp.where(mask[:, None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    attn = jnp.einsum("bkgts,bskd->btkgd", probs,
-                      cv.astype(jnp.float32)).astype(x.dtype)
-    attn = attn.reshape(b, t, h * hd)
+    qg = q.reshape(b, t, kvh, groups, hd)
+    attn = _split_kv_attention(qg, ck, cv, positions, valid_len)
+    attn = attn.astype(x.dtype).reshape(b, t, h * hd)
     return x + lora_dense(attn, lp, "wo"), ck, cv
 
 
@@ -440,9 +509,16 @@ def forward_with_cache(cfg, params: Params,
 
     tokens (B, T) are positions [start_pos, start_pos+T); returns
     (logits (B, T, vocab), updated cache). T == prompt length for
-    prefill, T == 1 for each decode step; per-token cost is O(max_seq),
-    not O(seq^2) — the property a serving endpoint needs (vLLM/JetStream
-    analog; the reference delegates this entirely to vLLM).
+    prefill, T == 1 for each decode step; per-token cost is
+    O(longest valid prefix), not O(seq^2) — the property a serving
+    endpoint needs (vLLM/JetStream analog; the reference delegates this
+    entirely to vLLM).
+
+    ``start_pos``, ``valid_len`` and ``logits_at`` each accept a scalar
+    (whole batch at one position — the bucketed fixed-batch path) OR a
+    per-slot (B,) vector: under continuous batching every slot sits at
+    its own sequence position, so the cache write offset, the
+    attendable prefix, and the read-out index are all per-example.
 
     ``valid_len`` (default start_pos + T): cache positions >= valid_len
     are masked out of attention. Right-padded prefill chunks pass their
@@ -452,21 +528,19 @@ def forward_with_cache(cfg, params: Params,
     just that position, returning (B, 1, vocab).
     """
     b, t = tokens.shape
-    max_seq = cache["k"].shape[2]
+    start_pos = jnp.asarray(start_pos, jnp.int32)
+    if start_pos.ndim == 0:
+        start_pos = jnp.broadcast_to(start_pos, (b,))
     if valid_len is None:
         valid_len = start_pos + t
-    positions = start_pos + jnp.arange(t)[None, :]        # (1, T) bcast
-    positions = jnp.broadcast_to(positions, (b, t))
+    valid_len = jnp.asarray(valid_len, jnp.int32)
+    if valid_len.ndim == 0:
+        valid_len = jnp.broadcast_to(valid_len, (b,))
+    positions = start_pos[:, None] + jnp.arange(t)[None, :]  # (B, T)
     x = params["embed"][tokens]
     scale = getattr(cfg, "embed_multiplier", 1.0)
     if scale != 1.0:  # gemma: embeddings scaled by sqrt(dim)
         x = (x.astype(jnp.float32) * scale).astype(x.dtype)
-
-    kpos = jnp.arange(max_seq)                            # (max_seq,)
-    # Causal over absolute positions, clipped to the valid prefix;
-    # future/garbage cache slots are masked even though they hold data.
-    mask = ((kpos[None, :] <= positions[..., None]) &
-            (kpos[None, None, :] < valid_len))            # (B, T, max_seq)
 
     # Pluggable residual MLP half — mixtral swaps in its dense-routed
     # MoE (models/mixtral.py) while the attention/cache/mask contract
@@ -476,7 +550,8 @@ def forward_with_cache(cfg, params: Params,
     def layer_fn(x, scanned):
         lp, ck, cv = scanned                               # per-layer
         x2, ck, cv = cached_attention_block(cfg, x, lp, ck, cv,
-                                            positions, start_pos, mask)
+                                            positions, start_pos,
+                                            valid_len)
         return mlp_fn(cfg, x2, lp), (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -484,7 +559,11 @@ def forward_with_cache(cfg, params: Params,
     if logits_at is not None:
         # Serving prefill reads exactly one position — skip the
         # O(T x vocab) head on the padded chunk.
-        x = jax.lax.dynamic_slice_in_dim(x, logits_at, 1, axis=1)
+        logits_at = jnp.asarray(logits_at, jnp.int32)
+        if logits_at.ndim == 0:
+            x = jax.lax.dynamic_slice_in_dim(x, logits_at, 1, axis=1)
+        else:  # per-slot read-out (ragged prompt lengths)
+            x = x[jnp.arange(b), logits_at][:, None]
     logits = lm_head(cfg, params, x, lambda a, _spec: a)
     return logits, {"k": new_k, "v": new_v}
 
@@ -493,27 +572,37 @@ def decode(cfg: LlamaConfig, params: Params, prompt: jax.Array,
            true_len: jax.Array, max_tokens: int, max_seq: int,
            temperature: float = 0.0,
            key: Optional[jax.Array] = None, *,
-           fwd_cache=None, cache_init=None) -> jax.Array:
+           fwd_cache=None, cache_init=None,
+           cache=None, return_cache: bool = False) -> jax.Array:
     """Prefill + cached decode: prompt (B, S_pad) -> (B, max_tokens).
 
-    ``true_len`` is the un-padded prompt length — a SCALAR shared by
-    the whole batch (prompt may be right-padded to a bucket so serving
-    compiles stay bounded). Per-example lengths of shape (B,) are NOT
-    supported: logits_at feeds dynamic_slice_in_dim and the cache mask
-    broadcast both assume one shared length, so a batch must be grouped
-    by prompt length (the serving recipe batches per-bucket). One O(S)
-    prefill pass, then max_tokens steps of O(max_seq) each.
-    temperature == 0 is greedy; > 0 samples from softmax(logits/T)
-    (key required).
+    ``true_len`` is the un-padded prompt length — a scalar shared by
+    the whole batch, or a per-example (B,) vector: a RAGGED batch
+    (heterogeneous prompt lengths right-padded to one bucket) decodes
+    in a single batched call, each row masked to its own valid prefix
+    and read out at its own last prompt token. One O(S) prefill pass,
+    then max_tokens steps each bounded by the longest live prefix
+    (split-KV attention). temperature == 0 is greedy; > 0 samples from
+    softmax(logits/T) (key required).
+
+    ``cache``: optional preallocated KV cache (init_cache layout).
+    Callers that jit this function should allocate the cache outside,
+    DONATE it (``donate_argnums``), and pass ``return_cache=True`` so
+    the final cache is part of the jit output — XLA only aliases a
+    donated input to an output, so without returning it the donation
+    is inert and each call still materializes a second full-size cache
+    in HBM. With it, the O(layers * batch * max_seq) buffer updates in
+    place (the caller simply drops the returned cache).
     """
-    true_len = jnp.asarray(true_len)
-    if true_len.ndim != 0:
-        raise ValueError(
-            f"true_len must be a scalar (shared, un-padded prompt "
-            f"length); got shape {true_len.shape}. Batched serving "
-            f"with per-example lengths is unsupported — group requests "
-            f"into same-length (bucketed) batches instead.")
+    true_len = jnp.asarray(true_len, jnp.int32)
     b, s_pad = prompt.shape
+    if true_len.ndim == 0:
+        true_len = jnp.broadcast_to(true_len, (b,))
+    elif true_len.shape != (b,):
+        raise ValueError(
+            f"true_len must be a scalar or a (batch,) vector of "
+            f"un-padded prompt lengths; got shape {true_len.shape} "
+            f"for batch {b}.")
     if s_pad + max_tokens > max_seq:
         raise ValueError(
             f"prompt ({s_pad}) + max_tokens ({max_tokens}) exceeds the "
@@ -534,10 +623,11 @@ def decode(cfg: LlamaConfig, params: Params, prompt: jax.Array,
     # (models/mixtral.py decode).
     fwd_cache = fwd_cache or forward_with_cache
     cache_init = cache_init or init_cache
-    cache = cache_init(cfg, b, max_seq)
+    if cache is None:
+        cache = cache_init(cfg, b, max_seq)
     logits, cache = fwd_cache(
         cfg, params, prompt, cache, jnp.int32(0), valid_len=true_len,
-        logits_at=jnp.asarray(true_len - 1, jnp.int32))
+        logits_at=true_len - 1)
     key, sub = jax.random.split(key)
     first = pick(logits[:, 0], sub)
 
@@ -549,9 +639,11 @@ def decode(cfg: LlamaConfig, params: Params, prompt: jax.Array,
         nxt = pick(logits[:, -1], sub)
         return (nxt, cache, key), tok
 
-    (_, _, _), toks = jax.lax.scan(
+    (_, cache, _), toks = jax.lax.scan(
         step, (first, cache, key),
         jnp.arange(max_tokens, dtype=jnp.int32))
+    if return_cache:
+        return toks.T, cache
     return toks.T                                          # (B, max_tokens)
 
 

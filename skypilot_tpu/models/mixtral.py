@@ -264,8 +264,9 @@ def forward_with_cache(cfg: MixtralConfig, params: Params,
     """Incremental MoE forward: llama's cache loop (attention/mask
     contract lives there, in one place) with the dense-routed top-2
     expert MLP swapped in — the serving loop the reference delegates to
-    vLLM for Mixtral (llm/mixtral/serve.yaml). Same scalar
-    valid_len/logits_at contract as llama.forward_with_cache."""
+    vLLM for Mixtral (llm/mixtral/serve.yaml). Same scalar-or-(B,)
+    start_pos/valid_len/logits_at contract as
+    llama.forward_with_cache."""
     return llama.forward_with_cache(
         cfg, params, tokens, cache, start_pos, valid_len=valid_len,
         logits_at=logits_at, mlp_fn=_moe_block)
@@ -274,10 +275,12 @@ def forward_with_cache(cfg: MixtralConfig, params: Params,
 def decode(cfg: MixtralConfig, params: Params, prompt: jax.Array,
            true_len: jax.Array, max_tokens: int, max_seq: int,
            temperature: float = 0.0,
-           key: Optional[jax.Array] = None) -> jax.Array:
+           key: Optional[jax.Array] = None, *,
+           cache=None, return_cache: bool = False) -> jax.Array:
     """Prefill + cached decode for Mixtral (llama.decode's loop with the
-    MoE cache functions plugged in)."""
+    MoE cache functions plugged in; scalar or ragged (B,) true_len)."""
     return llama.decode(cfg, params, prompt, true_len, max_tokens,
                         max_seq, temperature=temperature, key=key,
                         fwd_cache=forward_with_cache,
-                        cache_init=init_cache)
+                        cache_init=init_cache, cache=cache,
+                        return_cache=return_cache)

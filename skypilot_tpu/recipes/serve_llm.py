@@ -2,16 +2,23 @@
 
 Reference analog: llm/vllm/serve.yaml and llm/mixtral/serve.yaml (the
 reference points SkyServe at a vLLM container). Native version: a
-stdlib-http server around models/llama.py greedy decoding, exposing the
+stdlib-http server around the shared model decode stack, exposing the
 endpoints SkyServe probes and balances:
 
     GET  /health    -> 200 once the model is compiled (readiness probe)
+    GET  /metrics   -> Prometheus exposition (engine slot/queue/token
+                       metrics; merged into the LB's /metrics snapshot)
     POST /generate  {"prompt": [ids...], "max_tokens": N,
                      "temperature": 0.7, "seed": 1} -> {"tokens": [...]}
 
-Decoding is a jitted lax.scan over a preallocated KV cache (static shapes,
-one compile per bucket) — the shape a real TPU decode loop takes; batching,
-streaming, and continuous scheduling live above this in SkyServe's LB.
+Requests are served by the slot-based continuous-batching decode engine
+(serve/decode_engine.py): concurrent requests of ANY prompt length
+share one KV cache batch, joining mid-flight into free slots (chunked
+prefill interleaved with decode) and streaming per slot — no
+model-lock-per-request serialization, no same-bucket-only batching.
+``engine_slots=0`` falls back to the legacy locked fixed-batch path
+(kept for apples-to-apples measurement; both paths donate their KV
+cache through the jit boundary).
 
     python -m skypilot_tpu.recipes.serve_llm --model tiny --port 8080
 """
@@ -20,24 +27,17 @@ from __future__ import annotations
 import argparse
 import functools
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import jax
 import jax.numpy as jnp
 
-from skypilot_tpu.models import gemma, llama, mixtral
+from skypilot_tpu.models import gemma, llama, mixtral, model_api
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.serve import decode_engine
 from skypilot_tpu.train import distributed
-
-
-def _model_api(cfg):
-    """Static dispatch on the (static-argnum) config type: the cache
-    functions of the model family being served."""
-    if isinstance(cfg, mixtral.MixtralConfig):
-        return mixtral
-    if isinstance(cfg, gemma.GemmaConfig):
-        return gemma
-    return llama
 
 
 # Request limits: prompt/decode lengths are padded to buckets so the jit
@@ -47,6 +47,10 @@ PROMPT_BUCKET = 64
 MAX_PROMPT_TOKENS = 1024
 MAX_GEN_TOKENS = 256
 GEN_BUCKET = 16
+
+# Engine defaults (overridable per serve() call / env).
+ENGINE_SLOTS = int(os.environ.get("STPU_ENGINE_SLOTS", "4"))
+ENGINE_PREFILL_CHUNK = 64
 
 
 def _ceil_to(n: int, b: int) -> int:
@@ -65,10 +69,10 @@ def _pick(logits_row: jax.Array, temperature: float,
 def _prefill(cfg: llama.LlamaConfig, params, buf: jax.Array,
              max_seq: int, start: jax.Array, temperature: float,
              key: jax.Array):
-    """Streaming path, step 1: one O(S) prefill over the padded prompt;
-    returns (first token (1,), KV cache). Shapes are bucket sizes so
-    all prompts in a bucket share one compile."""
-    api = _model_api(cfg)
+    """Legacy streaming path, step 1: one O(S) prefill over the padded
+    prompt; returns (first token (1,), KV cache). Shapes are bucket
+    sizes so all prompts in a bucket share one compile."""
+    api = model_api(cfg)
     cache = api.init_cache(cfg, 1, max_seq)
     logits, cache = api.forward_with_cache(
         cfg, params, buf[None, :], cache, jnp.int32(0), valid_len=start,
@@ -79,33 +83,48 @@ def _prefill(cfg: llama.LlamaConfig, params, buf: jax.Array,
 @functools.partial(jax.jit, static_argnums=(0, 5), donate_argnums=(3,))
 def _gen_step(cfg: llama.LlamaConfig, params, tok: jax.Array, cache,
               pos: jax.Array, temperature: float, key: jax.Array):
-    """Streaming path, step 2..N: one O(max_seq) cached decode step —
+    """Legacy streaming path, step 2..N: one cached decode step —
     called per token so the handler can flush each token to the client
-    as it exists (SSE), instead of waiting for the whole scan. The KV
-    cache is DONATED: XLA aliases it in place instead of copying the
-    whole O(layers * max_seq) buffer every token."""
-    logits, cache = _model_api(cfg).forward_with_cache(
+    as it exists (SSE). The KV cache is DONATED: XLA aliases it in
+    place instead of copying the whole O(layers * max_seq) buffer every
+    token."""
+    logits, cache = model_api(cfg).forward_with_cache(
         cfg, params, tok[:, None], cache, pos)
     return _pick(logits[:, -1], temperature, key), cache
 
 
-@functools.partial(jax.jit, static_argnums=(0, 4, 5))
+@functools.partial(jax.jit, static_argnums=(0, 4, 5),
+                   donate_argnums=(6,))
 def _decode(cfg: llama.LlamaConfig, params, buf: jax.Array,
             start: jax.Array, mt_pad: int,
-            temperature: float, seed: jax.Array) -> jax.Array:
-    """Continuation over a padded prompt buffer.
+            temperature: float, cache, seed: jax.Array) -> jax.Array:
+    """Legacy fixed-batch continuation over a padded prompt buffer.
 
     buf: (s_pad,) int32 with the prompt in [0, start). Shapes are bucket
     sizes and the true prompt length is a dynamic scalar, so all prompts
     in a bucket share one compile (plus one per distinct temperature).
-    Decoding is KV-cached (models/llama.decode): one O(S) prefill, then
-    O(max_seq) per token — the vLLM/JetStream-shaped serving loop, not a
-    quadratic recompute.
+    ``cache`` is allocated by the caller, DONATED, and returned (so XLA
+    can alias it to the output) — the decode scan updates it in place
+    instead of materializing a second full-size cache in HBM each step.
+    Returns (tokens (mt_pad,), cache).
     """
     max_seq = buf.shape[0] + mt_pad
-    return _model_api(cfg).decode(
+    toks, cache = model_api(cfg).decode(
         cfg, params, buf[None, :], start, mt_pad, max_seq,
-        temperature=temperature, key=jax.random.key(seed))[0]
+        temperature=temperature, key=jax.random.key(seed),
+        cache=cache, return_cache=True)
+    return toks[0], cache
+
+
+def _decode_locked(ctx, buf, s, mt_pad, temperature, seed):
+    """Legacy path: allocate + donate a fresh cache under the model
+    lock (the returned cache exists only for donation aliasing)."""
+    cfg = ctx["cfg"]
+    cache = model_api(cfg).init_cache(cfg, 1, buf.shape[0] + mt_pad)
+    with ctx["lock"]:
+        toks, _ = _decode(cfg, ctx["params"], buf, jnp.int32(s),
+                          mt_pad, temperature, cache, jnp.uint32(seed))
+        return toks
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -128,6 +147,15 @@ class _Handler(BaseHTTPRequestHandler):
             ready = self.server_ctx["ready"].is_set()
             self._json(200 if ready else 503,
                        {"status": "ok" if ready else "warming"})
+        elif self.path == "/metrics":
+            # Replica-local registry (engine slot/queue/token families);
+            # the LB pulls this into its merged /metrics snapshot.
+            body = metrics.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", metrics.CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._json(404, {"error": "not found"})
 
@@ -152,59 +180,98 @@ class _Handler(BaseHTTPRequestHandler):
             # out-of-range value must not escape the 400 contract.
             seed = int(req.get("seed", 0)) & 0xFFFFFFFF
             ctx = self.server_ctx
-            s = len(prompt)
-            s_pad = _ceil_to(s, PROMPT_BUCKET)
-            mt_pad = _ceil_to(mt, GEN_BUCKET)
-            buf = jnp.zeros((s_pad,), jnp.int32).at[:s].set(
-                jnp.asarray(prompt, dtype=jnp.int32))
             stream = bool(req.get("stream"))
         except (KeyError, ValueError, TypeError) as e:
             self._json(400, {"error": str(e)})
             return
-        if stream:
-            started = []
-            try:
-                self._stream_generate(ctx, buf, s, s_pad, mt, mt_pad,
-                                      temperature, seed, started)
-            except Exception as e:  # noqa: BLE001
-                if started:
-                    # Headers/chunks already out — a JSON error response
-                    # would corrupt the stream. Drop the connection; the
-                    # truncated stream is the signal.
-                    self.close_connection = True
-                else:
-                    self._json(400, {"error": str(e)})
-            return
+        engine = ctx.get("engine")
         try:
-            with ctx["lock"]:
-                toks = _decode(ctx["cfg"], ctx["params"], buf,
-                               jnp.int32(s), mt_pad, temperature,
-                               jnp.uint32(seed))
-            self._json(200, {"tokens": [int(t) for t in toks[:mt]]})
+            if engine is not None:
+                self._engine_generate(engine, prompt, mt, temperature,
+                                      seed, stream)
+            else:
+                self._legacy_generate(ctx, prompt, mt, temperature,
+                                      seed, stream)
+        except decode_engine.EngineError as e:
+            self._json(503, {"error": str(e)})
         except (KeyError, ValueError, TypeError) as e:
             self._json(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — pre-header failures
+            # (jit compile/runtime errors on a fresh bucket) must still
+            # produce a clean JSON error; once headers are out, _sse
+            # has already swallowed the exception and dropped the
+            # connection, so this catch never corrupts a stream.
+            self._json(500, {"error": f"{type(e).__name__}: {e}"})
 
-    def _stream_generate(self, ctx, buf, s, s_pad, mt, mt_pad,
-                         temperature, seed, started) -> None:
-        """SSE token stream: one `data: {"token": N}` event per decoded
-        token, flushed as produced (chunked transfer), then
-        `data: [DONE]` — the OpenAI-style contract LLM clients expect."""
-        from skypilot_tpu.serve.load_balancer import (end_chunks,
-                                                      write_chunk)
+    # ----------------------------------------------------- engine path
+    def _engine_generate(self, engine, prompt, mt, temperature, seed,
+                         stream) -> None:
+        req = engine.submit(prompt, max_tokens=mt,
+                            temperature=temperature, seed=seed)
+        if not stream:
+            self._json(200, {"tokens": req.result()})
+            return
+        it = req.stream()
+        try:
+            # First token BEFORE the headers go out: a prefill/compile
+            # error must still be reportable as a clean JSON error, not
+            # a corrupted half-stream.
+            first = next(it)
+        except decode_engine.EngineError as e:
+            self._json(503, {"error": str(e)})
+            return
+        except StopIteration:
+            self._json(200, {"tokens": []})
+            return
+        self._sse(req, [first], it)
+
+    # ----------------------------------------------------- legacy path
+    def _legacy_generate(self, ctx, prompt, mt, temperature, seed,
+                         stream) -> None:
+        s = len(prompt)
+        s_pad = _ceil_to(s, PROMPT_BUCKET)
+        mt_pad = _ceil_to(mt, GEN_BUCKET)
+        buf = jnp.zeros((s_pad,), jnp.int32).at[:s].set(
+            jnp.asarray(prompt, dtype=jnp.int32))
+        if not stream:
+            toks = _decode_locked(ctx, buf, s, mt_pad, temperature,
+                                  seed)
+            self._json(200, {"tokens": [int(t) for t in toks[:mt]]})
+            return
         cfg, params = ctx["cfg"], ctx["params"]
         key = jax.random.key(seed)
-        # Prefill BEFORE the headers go out: a trace/compile error on a
-        # fresh bucket must still be reportable as a clean error, not a
-        # corrupted half-stream. The model lock is held ONLY around
-        # compute, never across socket writes — a stalled client (TCP
-        # backpressure on emit) must not block other requests.
+        # Prefill BEFORE the headers go out (clean-error contract, as
+        # above). The model lock is held ONLY around compute, never
+        # across socket writes — a stalled client (TCP backpressure on
+        # emit) must not block other requests.
         key, k = jax.random.split(key)
         with ctx["lock"]:
             tok, cache = _prefill(cfg, params, buf, s_pad + mt_pad,
                                   jnp.int32(s), temperature, k)
             tok.block_until_ready()
 
-        started.append(True)
+        def tokens():
+            nonlocal tok, cache, key
+            for i in range(mt - 1):
+                key, k2 = jax.random.split(key)
+                with ctx["lock"]:
+                    tok, cache = _gen_step(cfg, params, tok, cache,
+                                           jnp.int32(s + i),
+                                           temperature, k2)
+                    tok.block_until_ready()
+                yield int(tok[0])
+
+        self._sse(None, [int(tok[0])], tokens())
+
+    # ------------------------------------------------------------- SSE
+    def _sse(self, req, first_tokens, rest_iter) -> None:
+        """SSE token stream: one `data: {"token": N}` event per decoded
+        token, flushed as produced (chunked transfer), then
+        `data: [DONE]` — the OpenAI-style contract LLM clients expect.
+        A mid-stream failure drops the connection (a JSON error would
+        corrupt the stream; the truncated stream is the signal)."""
+        from skypilot_tpu.serve.load_balancer import (end_chunks,
+                                                      write_chunk)
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
@@ -214,30 +281,46 @@ class _Handler(BaseHTTPRequestHandler):
         def emit(payload: str) -> None:
             write_chunk(self.wfile, f"data: {payload}\n\n".encode())
 
-        emit(json.dumps({"token": int(tok[0])}))
-        for i in range(mt - 1):
-            key, k = jax.random.split(key)
-            with ctx["lock"]:
-                tok, cache = _gen_step(cfg, params, tok, cache,
-                                       jnp.int32(s + i), temperature, k)
-                tok.block_until_ready()
-            emit(json.dumps({"token": int(tok[0])}))
-        emit("[DONE]")
-        end_chunks(self.wfile)
+        try:
+            for tok in first_tokens:
+                emit(json.dumps({"token": int(tok)}))
+            for tok in rest_iter:
+                emit(json.dumps({"token": int(tok)}))
+            emit("[DONE]")
+            end_chunks(self.wfile)
+        except Exception:  # noqa: BLE001 — client gone / engine died
+            if req is not None:
+                req.cancel()  # free the slot; don't decode into a void
+            self.close_connection = True
 
 
 def serve(cfg: llama.LlamaConfig, params, port: int,
-          ready_event: threading.Event = None) -> ThreadingHTTPServer:
+          ready_event: threading.Event = None,
+          engine_slots: int = None) -> ThreadingHTTPServer:
+    """Start the replica server. ``engine_slots`` > 0 (default: env
+    STPU_ENGINE_SLOTS or 4) serves through the continuous-batching
+    decode engine; 0 keeps the legacy locked fixed-batch path."""
+    if engine_slots is None:
+        engine_slots = ENGINE_SLOTS
     ctx = {"cfg": cfg, "params": params, "lock": threading.Lock(),
-           "ready": ready_event or threading.Event()}
+           "ready": ready_event or threading.Event(), "engine": None}
+    if engine_slots > 0:
+        ctx["engine"] = decode_engine.DecodeEngine(
+            cfg, params, slots=engine_slots,
+            max_seq=MAX_PROMPT_TOKENS + MAX_GEN_TOKENS,
+            prefill_chunk=ENGINE_PREFILL_CHUNK).start()
 
     handler = type("Handler", (_Handler,), {"server_ctx": ctx})
     httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+    httpd.engine = ctx["engine"]  # visible for shutdown/tests
 
     def warmup():
-        buf = jnp.zeros((PROMPT_BUCKET,), jnp.int32)
-        _decode(cfg, params, buf, jnp.int32(8), GEN_BUCKET, 0.0,
-                jnp.uint32(0)).block_until_ready()
+        if ctx["engine"] is not None:
+            ctx["engine"].warmup()
+        else:
+            buf = jnp.zeros((PROMPT_BUCKET,), jnp.int32)
+            _decode_locked(ctx, buf, 8, GEN_BUCKET, 0.0,
+                           0).block_until_ready()
         ctx["ready"].set()
 
     threading.Thread(target=warmup, daemon=True).start()
@@ -252,6 +335,9 @@ def main(argv=None):
                    default="tiny")
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--engine-slots", type=int, default=None,
+                   help="decode-engine slots (0 = legacy locked path; "
+                        "default env STPU_ENGINE_SLOTS or 4)")
     args = p.parse_args(argv)
 
     distributed.initialize_from_env()
@@ -264,8 +350,9 @@ def main(argv=None):
         "gemma-2b": gemma.GemmaConfig.gemma_2b,
         "gemma-7b": gemma.GemmaConfig.gemma_7b,
     }[args.model]()
-    params = _model_api(cfg).init(cfg, jax.random.PRNGKey(args.seed))
-    httpd = serve(cfg, params, args.port)
+    params = model_api(cfg).init(cfg, jax.random.PRNGKey(args.seed))
+    httpd = serve(cfg, params, args.port,
+                  engine_slots=args.engine_slots)
     print(f"serve_llm: listening on :{args.port}", flush=True)
     httpd.serve_forever()
 

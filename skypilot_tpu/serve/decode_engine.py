@@ -1,0 +1,431 @@
+"""Slot-based continuous-batching decode engine.
+
+The throughput lever the fixed-batch serving path cannot reach: under a
+heterogeneous request mix, bucketed batching only ever co-schedules
+same-length prompts and a per-replica model lock serializes everything
+else. This engine holds ONE KV cache of ``slots`` rows and runs one
+jitted decode step over all of them every iteration:
+
+  * requests join MID-FLIGHT into free slots — the prompt is prefilled
+    in fixed-size chunks interleaved with decode steps, so a long
+    arriving prompt never stalls tokens already streaming from other
+    slots for more than one chunk;
+  * every slot sits at its own sequence position — the model's
+    per-slot (B,) ``start_pos``/``valid_len`` contract
+    (models/llama.forward_with_cache) masks each row to its own valid
+    prefix, and split-KV attention reads only up to the longest live
+    frontier;
+  * finished slots free immediately and the next queued request takes
+    the row over — stale K/V left behind is never attendable (masked
+    until overwritten), the invariant the ragged-parity tests pin;
+  * the cache is DONATED through both jitted entry points (prefill
+    chunk and decode step), so the O(layers * slots * max_seq) buffer
+    updates in place instead of double-buffering HBM every token.
+
+Sampling is reproducible per request: the key for the token at
+position p is fold_in(fold_in(root, seed), p), independent of which
+slot the request landed in or what else shared the batch.
+
+Used by recipes/serve_llm.py (replacing its model-lock-per-request
+path) and benchmark/decode_bench.measure_engine_ragged (the
+`engine_ragged_tok_s` bench leg).
+"""
+from __future__ import annotations
+
+import collections
+import functools
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import model_api
+from skypilot_tpu.observability import metrics
+
+# ----------------------------------------------------------------- metrics
+_SLOTS_TOTAL = metrics.gauge(
+    "stpu_engine_slots_total", "Decode-engine slots configured.")
+_SLOTS_OCCUPIED = metrics.gauge(
+    "stpu_engine_slots_occupied", "Decode-engine slots holding a live "
+    "request (prefilling or decoding).")
+_QUEUE_DEPTH = metrics.gauge(
+    "stpu_engine_queue_depth", "Requests admitted but not yet assigned "
+    "a slot.")
+_TOKENS = metrics.counter(
+    "stpu_engine_decode_tokens_total", "Tokens emitted by the engine.")
+_TOK_RATE = metrics.histogram(
+    "stpu_engine_decode_tokens_per_sec",
+    "Per-step decode throughput (live slots / step wall time).",
+    buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384, 65536))
+_TTFT = metrics.histogram(
+    "stpu_engine_ttft_seconds",
+    "Submit-to-first-token latency per request.")
+_REQUESTS = metrics.counter(
+    "stpu_engine_requests_total", "Engine requests by outcome.",
+    ("outcome",))
+
+_DONE = object()          # end-of-stream sentinel on a request's queue
+
+
+class EngineError(RuntimeError):
+    """The engine rejected or failed a request."""
+
+
+class Request:
+    """One in-flight generation; tokens arrive on an internal queue."""
+
+    def __init__(self, prompt: List[int], max_tokens: int,
+                 temperature: float, seed: int):
+        self.prompt = [int(t) for t in prompt]
+        self.max_tokens = int(max_tokens)
+        self.temperature = float(temperature)
+        self.seed = int(seed) & 0xFFFFFFFF
+        self.submitted_at = time.perf_counter()
+        self.first_token_at: Optional[float] = None
+        self.error: Optional[str] = None
+        self.cancelled = False
+        self._out: "queue.Queue[Any]" = queue.Queue()
+
+    def cancel(self) -> None:
+        """Ask the engine to stop decoding this request (the slot frees
+        at the next step). Safe from any thread, e.g. on client
+        disconnect mid-stream."""
+        self.cancelled = True
+
+    def stream(self, timeout: float = 600.0):
+        """Yield token ids as the engine produces them; raises
+        EngineError if the request failed or the engine produced no
+        token within ``timeout`` (a wedged device must surface as a
+        diagnosable error, not a bare queue.Empty)."""
+        while True:
+            try:
+                item = self._out.get(timeout=timeout)
+            except queue.Empty:
+                self.cancel()
+                raise EngineError(
+                    f"no token within {timeout:.0f}s (engine stalled "
+                    f"or overloaded)") from None
+            if item is _DONE:
+                if self.error:
+                    raise EngineError(self.error)
+                return
+            yield item
+
+    def result(self, timeout: float = 600.0) -> List[int]:
+        """Block until the request finishes; returns all tokens."""
+        return list(self.stream(timeout=timeout))
+
+    # engine-side
+    def _emit(self, token: int) -> None:
+        if self.first_token_at is None:
+            self.first_token_at = time.perf_counter()
+            _TTFT.observe(self.first_token_at - self.submitted_at)
+        self._out.put(int(token))
+
+    def _finish(self, error: Optional[str] = None) -> None:
+        self.error = error
+        self._out.put(_DONE)
+
+
+class _Slot:
+    """Host-side state of one cache row."""
+
+    __slots__ = ("request", "pos", "generated", "prefilled", "tok")
+
+    def __init__(self):
+        self.request: Optional[Request] = None
+        self.pos = 0          # valid length of the row (= next write)
+        self.generated = 0
+        self.prefilled = 0    # prompt tokens already prefilled
+        self.tok = 0          # last emitted token (next step's input)
+
+
+# ------------------------------------------------------- jitted entry points
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _prefill_chunk(cfg, params, cache, buf, slot, start, valid):
+    """Prefill ONE chunk of ONE slot's prompt into the shared cache.
+
+    buf: (P,) tokens for positions [start, start+P) of row ``slot``
+    (tail may be padding on the prompt's final chunk). ``valid`` is the
+    absolute count of real tokens after this chunk — padding K/V
+    written past it stays masked until decode steps overwrite it. The
+    cache is donated: the row splice happens in place. Returns
+    (last-real-token logits (vocab,), cache).
+    """
+    api = model_api(cfg)
+    row = {k: jax.lax.dynamic_slice_in_dim(v, slot, 1, axis=1)
+           for k, v in cache.items()}
+    logits, row = api.forward_with_cache(
+        cfg, params, buf[None, :], row, start, valid_len=valid,
+        logits_at=jnp.maximum(valid - start - 1, 0))
+    cache = {k: jax.lax.dynamic_update_slice_in_dim(cache[k], row[k],
+                                                    slot, axis=1)
+             for k in cache}
+    return logits[0, 0], cache
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _engine_step(cfg, params, cache, toks, pos, temps, seeds):
+    """One decode step over ALL slots: write each slot's last token at
+    its own position, attend its own valid prefix, sample its next
+    token. Free slots ride along with pos 0 and are ignored host-side.
+    The cache is donated (in-place update)."""
+    api = model_api(cfg)
+    logits, cache = api.forward_with_cache(
+        cfg, params, toks[:, None], cache, pos)
+    logits = logits[:, -1]
+    nxt = _sample(logits, seeds, pos + 1, temps)
+    return nxt, cache
+
+
+@jax.jit
+def _sample(logits, seeds, positions, temps):
+    """Per-slot sampling, reproducible per request: the key for the
+    token at position p is fold_in(fold_in(root, seed), p) — slot
+    placement and batch composition never change a request's sample
+    stream. temps == 0 is greedy."""
+    root = jax.random.key(0)
+
+    def one(seed, p, row, t):
+        k = jax.random.fold_in(jax.random.fold_in(root, seed), p)
+        return jax.random.categorical(k, row / jnp.maximum(t, 1e-4))
+
+    sampled = jax.vmap(one)(seeds, positions, logits, temps)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+
+
+class DecodeEngine:
+    """Fixed-slot continuous-batching scheduler over one shared cache.
+
+    One background thread owns all device compute: each iteration it
+    (1) admits queued requests into free slots, (2) advances at most
+    one pending prefill by one chunk, (3) runs one batched decode step
+    for every live slot — so prefill of a joining request interleaves
+    with, instead of blocking, in-flight decode.
+    """
+
+    def __init__(self, cfg, params, *, slots: int = 4,
+                 max_seq: int = 1024, prefill_chunk: int = 64,
+                 max_queue: int = 256):
+        if slots < 1:
+            raise ValueError("slots must be >= 1")
+        self._cfg = cfg
+        self._params = params
+        self._api = model_api(cfg)
+        self._slots = [_Slot() for _ in range(slots)]
+        self._max_seq = int(max_seq)
+        # Chunks must tile the cache rows: prefill starts land on chunk
+        # multiples, so chunk | max_seq guarantees every chunk window
+        # fits the row (dynamic_update_slice would otherwise clamp the
+        # start and silently corrupt earlier positions).
+        chunk = max(min(int(prefill_chunk), self._max_seq), 1)
+        while self._max_seq % chunk:
+            chunk //= 2
+        self._chunk = chunk
+        self._max_queue = int(max_queue)
+        self._cache = self._api.init_cache(cfg, slots, max_seq)
+        self._waiting: "collections.deque[Request]" = collections.deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._failed: Optional[str] = None
+        _SLOTS_TOTAL.set(slots)
+
+    # ------------------------------------------------------------- public
+    def start(self) -> "DecodeEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="decode-engine", daemon=True)
+            self._thread.start()
+        return self
+
+    def submit(self, prompt, max_tokens: int, temperature: float = 0.0,
+               seed: int = 0) -> Request:
+        """Enqueue a generation; returns the Request handle (stream()
+        or result()). Raises EngineError on invalid size, full queue,
+        or a dead engine."""
+        req = Request(prompt, max_tokens, temperature, seed)
+        if not req.prompt:
+            raise EngineError("empty prompt")
+        if len(req.prompt) + req.max_tokens > self._max_seq:
+            raise EngineError(
+                f"prompt ({len(req.prompt)}) + max_tokens "
+                f"({req.max_tokens}) exceeds the engine cache "
+                f"(max_seq={self._max_seq})")
+        with self._cond:
+            if self._failed:
+                raise EngineError(f"engine failed: {self._failed}")
+            if self._stop:
+                raise EngineError("engine is shut down")
+            if len(self._waiting) >= self._max_queue:
+                raise EngineError("engine queue full")
+            self._waiting.append(req)
+            _QUEUE_DEPTH.set(len(self._waiting))
+            self._cond.notify()
+        return req
+
+    def warmup(self) -> None:
+        """Compile the prefill-chunk and decode-step programs (one
+        tiny request end to end). max_tokens=2 so the request survives
+        past its prefill-sampled first token and forces one
+        _engine_step — with max_tokens=1 the decode-step program would
+        first compile on the first production request, stalling it for
+        the full XLA compile."""
+        self.start()
+        self.submit([1], max_tokens=2).result(timeout=600.0)
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+
+    # ------------------------------------------------------------ internals
+    def _live(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots) if s.request]
+
+    def _free_slot(self, i: int, error: Optional[str] = None,
+                   outcome: str = "ok") -> None:
+        slot = self._slots[i]
+        if slot.request is not None:
+            slot.request._finish(error)
+            _REQUESTS.labels(outcome=outcome).inc()
+        slot.request = None
+        slot.pos = slot.generated = slot.prefilled = slot.tok = 0
+        # Gauge updated HERE so every free path (finish, cancel during
+        # prefill, cache-full) is reflected even while the loop idles.
+        _SLOTS_OCCUPIED.set(len(self._live()))
+
+    def _admit(self) -> None:
+        with self._cond:
+            for i, slot in enumerate(self._slots):
+                if not self._waiting:
+                    break
+                if slot.request is None:
+                    req = self._waiting.popleft()
+                    if req.cancelled:
+                        req._finish()
+                        _REQUESTS.labels(outcome="cancelled").inc()
+                        continue
+                    slot.request = req
+                    slot.pos = slot.generated = slot.prefilled = 0
+            _QUEUE_DEPTH.set(len(self._waiting))
+        _SLOTS_OCCUPIED.set(len(self._live()))
+
+    def _prefill_one(self) -> bool:
+        """Advance the first slot with un-prefilled prompt by ONE
+        chunk; on the final chunk, sample and emit the first token."""
+        for i, slot in enumerate(self._slots):
+            req = slot.request
+            if req is None or slot.prefilled >= len(req.prompt):
+                continue
+            if req.cancelled:
+                self._free_slot(i, outcome="cancelled")
+                continue
+            start = slot.prefilled
+            piece = req.prompt[start:start + self._chunk]
+            buf = jnp.zeros((self._chunk,), jnp.int32).at[
+                :len(piece)].set(jnp.asarray(piece, jnp.int32))
+            valid = start + len(piece)
+            logits, self._cache = _prefill_chunk(
+                self._cfg, self._params, self._cache, buf,
+                jnp.int32(i), jnp.int32(start), jnp.int32(valid))
+            slot.prefilled = valid
+            slot.pos = valid
+            if slot.prefilled >= len(req.prompt):
+                tok = int(_sample(
+                    logits[None], jnp.asarray([req.seed], jnp.uint32),
+                    jnp.asarray([valid], jnp.int32),
+                    jnp.asarray([req.temperature], jnp.float32))[0])
+                slot.tok = tok
+                slot.generated = 1
+                req._emit(tok)
+                _TOKENS.inc()
+                self._maybe_finish(i)
+            return True
+        return False
+
+    def _maybe_finish(self, i: int) -> None:
+        slot = self._slots[i]
+        req = slot.request
+        if req is None:
+            return
+        if req.cancelled:
+            self._free_slot(i, outcome="cancelled")
+        elif slot.generated >= req.max_tokens:
+            self._free_slot(i, outcome="ok")
+        elif slot.pos + 1 >= self._max_seq:
+            self._free_slot(i, outcome="cache_full")
+
+    def _decode_step(self) -> bool:
+        """One batched step over every slot whose prompt is fully
+        prefilled and which still owes tokens."""
+        live = [i for i in self._live()
+                if self._slots[i].prefilled >=
+                len(self._slots[i].request.prompt)]
+        if not live:
+            return False
+        toks = jnp.asarray([s.tok for s in self._slots], jnp.int32)
+        pos = jnp.asarray([s.pos for s in self._slots], jnp.int32)
+        temps = jnp.asarray(
+            [s.request.temperature if i in live else 0.0
+             for i, s in enumerate(self._slots)], jnp.float32)
+        seeds = jnp.asarray(
+            [s.request.seed if i in live else 0
+             for i, s in enumerate(self._slots)], jnp.uint32)
+        t0 = time.perf_counter()
+        nxt, self._cache = _engine_step(
+            self._cfg, self._params, self._cache, toks, pos, temps,
+            seeds)
+        nxt = jax.device_get(nxt)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        _TOK_RATE.observe(len(live) / dt)
+        for i in live:
+            slot = self._slots[i]
+            slot.pos += 1
+            slot.tok = int(nxt[i])
+            slot.generated += 1
+            slot.request._emit(slot.tok)
+            _TOKENS.inc()
+            self._maybe_finish(i)
+        _SLOTS_OCCUPIED.set(len(self._live()))
+        return True
+
+    def _loop(self) -> None:
+        try:
+            while True:
+                with self._cond:
+                    if self._stop:
+                        break
+                self._admit()
+                did = self._prefill_one()
+                did = self._decode_step() or did
+                if not did:
+                    with self._cond:
+                        if not self._waiting and not self._stop:
+                            self._cond.wait(timeout=0.05)
+        except Exception as e:  # noqa: BLE001 — a dead compute thread
+            # must fail every caller loudly, not hang their queues.
+            msg = f"{type(e).__name__}: {e}"
+            with self._cond:
+                self._failed = msg
+                self._stop = True
+        # Drain: finish anything still attached.
+        err = self._failed or "engine shut down"
+        outcome = "error" if self._failed else "shutdown"
+        for i, slot in enumerate(self._slots):
+            if slot.request is not None:
+                self._free_slot(i, error=err, outcome=outcome)
+        with self._cond:
+            waiting, self._waiting = list(self._waiting), \
+                collections.deque()
+        for req in waiting:
+            req._finish(err)
+            _REQUESTS.labels(outcome=outcome).inc()
+        _SLOTS_OCCUPIED.set(0)
+        _QUEUE_DEPTH.set(0)

@@ -105,18 +105,58 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
 
     def _serve_metrics(self) -> None:
         """GET /metrics: this process's registry merged with the
-        controller's latest snapshot. merge_text drops the snapshot's
-        copies of families this process also registers (the controller
-        imports this module, so zero-valued stpu_lb_* families exist
-        over there too — duplicates would invalidate the scrape).
-        Scrapes are not counted as proxied requests."""
-        body = metrics.merge_text(
-            metrics.render(), self.controller_metrics_text).encode()
+        controller's latest snapshot AND each ready replica's own
+        /metrics (decode-engine slot/queue/token families), so one
+        scrape of the service endpoint covers the whole serving stack.
+        merge_text drops duplicate families (the controller imports
+        this module, so zero-valued stpu_lb_* copies exist over there;
+        with >1 replica the first replica's engine families win — a
+        per-replica label would need a rewriting merge). Scrapes are
+        not counted as proxied requests."""
+        text = metrics.merge_text(metrics.render(),
+                                  self.controller_metrics_text)
+        for doc in self._scrape_replicas():
+            text = metrics.merge_text(text, doc)
+        body = text.encode()
         self.send_response(200)
         self.send_header("Content-Type", metrics.CONTENT_TYPE)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _replica_urls(self) -> List[str]:
+        try:
+            return self.policy.ready_replicas()
+        except NotImplementedError:
+            return []
+
+    def _scrape_replicas(self, timeout: float = 2.0) -> List[str]:
+        """Fetch each ready replica's /metrics CONCURRENTLY, so scrape
+        latency is bounded by one timeout, not timeout x replicas (a
+        wave of mid-restart replicas must not stall Prometheus).
+        Unreachable replicas / missing endpoints are skipped."""
+        urls = self._replica_urls()
+        if not urls:
+            return []
+        docs: Dict[int, str] = {}
+
+        def fetch(i: int, url: str) -> None:
+            try:
+                with urllib.request.urlopen(
+                        url.rstrip("/") + "/metrics",
+                        timeout=timeout) as resp:
+                    docs[i] = resp.read().decode("utf-8", "replace")
+            except Exception:  # noqa: BLE001 — best-effort scrape
+                pass
+
+        threads = [threading.Thread(target=fetch, args=(i, u),
+                                    daemon=True)
+                   for i, u in enumerate(urls)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout + 0.5)
+        return [docs[i] for i in sorted(docs)]
 
     def _proxy(self, method: str) -> None:
         self.recorder.record()

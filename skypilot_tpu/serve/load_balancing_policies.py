@@ -17,6 +17,10 @@ class LoadBalancingPolicy:
     def select_replica(self) -> Optional[str]:
         raise NotImplementedError
 
+    def ready_replicas(self) -> List[str]:
+        """Current ready set (for the LB's /metrics replica scrape)."""
+        raise NotImplementedError
+
 
 class RoundRobinPolicy(LoadBalancingPolicy):
     def __init__(self):
@@ -35,3 +39,7 @@ class RoundRobinPolicy(LoadBalancingPolicy):
             if not self._urls:
                 return None
             return next(self._cycle)
+
+    def ready_replicas(self) -> List[str]:
+        with self._lock:
+            return list(self._urls)

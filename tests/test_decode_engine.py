@@ -1,0 +1,319 @@
+"""Continuous-batching decode engine + ragged KV-cache decode.
+
+The contract under test, from strongest to weakest layer:
+
+  * split-KV (flash-decode-style) attention == dense masked softmax;
+  * batched decode with PER-EXAMPLE prompt lengths matches per-request
+    sequential decode token-for-token (greedy) — batch composition
+    must never change any row's tokens;
+  * the engine (slot scheduling, chunked prefill interleaved with
+    decode, slot reuse) reproduces the same tokens — including that
+    stale K/V left in a reused slot is never attendable;
+  * engine counters land in the observability registry and the replica
+    /metrics surface.
+"""
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import gemma, llama, mixtral
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.serve.decode_engine import DecodeEngine, EngineError
+
+
+def _ragged_prompts(key, lens, vocab):
+    return [jax.random.randint(jax.random.key(key + i), (l,), 1, vocab)
+            for i, l in enumerate(lens)]
+
+
+def _pad(prompts, s_pad):
+    b = len(prompts)
+    out = jnp.zeros((b, s_pad), jnp.int32)
+    for i, p in enumerate(prompts):
+        out = out.at[i, :p.shape[0]].set(p)
+    return out
+
+
+@pytest.mark.parametrize("seq_len,block", [(32, 4), (30, 8)])
+def test_split_kv_matches_dense_reference(seq_len, block):
+    """Blocked online-softmax over the ragged cache == one dense
+    masked softmax, across block boundaries — including a cache length
+    the block does NOT divide (the clamped-overlap tail window)."""
+    B, T, KVH, G, D = 2, 3, 2, 2, 8
+    q = jax.random.normal(jax.random.key(0), (B, T, KVH, G, D))
+    ck = jax.random.normal(jax.random.key(1), (B, seq_len, KVH, D))
+    cv = jax.random.normal(jax.random.key(2), (B, seq_len, KVH, D))
+    positions = jnp.array([[18, 19, 20], [7, 8, 9]])
+    valid = jnp.array([21, 10])
+
+    out = llama._split_kv_attention(q, ck, cv, positions, valid,
+                                    block=block)
+
+    kpos = jnp.arange(seq_len)
+    mask = ((kpos[None, None, :] <= positions[..., None]) &
+            (kpos[None, None, :] < valid[:, None, None]))
+    scores = jnp.einsum("btkgd,bskd->bkgts", q, ck) * (D ** -0.5)
+    scores = jnp.where(mask[:, None, None], scores, -1e30)
+    dense = jnp.einsum("bkgts,bskd->btkgd",
+                       jax.nn.softmax(scores, axis=-1), cv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ragged_batched_decode_matches_sequential():
+    """One batched decode over heterogeneous prompt lengths must equal
+    per-request decode token-for-token — the property the fixed-batch
+    path enforced by REJECTING (B,) lengths."""
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    params = llama.init(cfg, jax.random.key(0))
+    lens, mt, s_pad = [3, 7, 5], 6, 8
+    prompts = _ragged_prompts(1, lens, 128)
+
+    got = llama.decode(cfg, params, _pad(prompts, s_pad),
+                       jnp.asarray(lens), mt, s_pad + mt)
+    for i, p in enumerate(prompts):
+        ref = llama.decode(cfg, params, p[None, :], jnp.int32(lens[i]),
+                           mt, lens[i] + mt)
+        np.testing.assert_array_equal(np.asarray(got[i]),
+                                      np.asarray(ref[0]))
+
+
+@pytest.mark.parametrize("family", ["mixtral", "gemma"])
+def test_ragged_decode_other_families(family):
+    """The (B,) length contract holds through the shared loop for the
+    MoE (dense-routed) and MQA/tied-head families too."""
+    mdl = {"mixtral": mixtral, "gemma": gemma}[family]
+    cfg = mdl.MixtralConfig.tiny() if family == "mixtral" \
+        else mdl.GemmaConfig.tiny(vocab_size=128)
+    vocab = cfg.vocab_size
+    params = mdl.init(cfg, jax.random.key(0))
+    lens, mt, s_pad = [2, 5], 4, 6
+    prompts = _ragged_prompts(3, lens, vocab)
+
+    got = mdl.decode(cfg, params, _pad(prompts, s_pad),
+                     jnp.asarray(lens), mt, s_pad + mt)
+    for i, p in enumerate(prompts):
+        ref = mdl.decode(cfg, params, p[None, :], jnp.int32(lens[i]),
+                         mt, lens[i] + mt)
+        np.testing.assert_array_equal(np.asarray(got[i]),
+                                      np.asarray(ref[0]))
+
+
+def test_decode_rejects_mismatched_length_vector():
+    cfg = llama.LlamaConfig.tiny(vocab_size=64)
+    params = llama.init(cfg, jax.random.key(0))
+    prompt = jnp.ones((2, 4), jnp.int32)
+    with pytest.raises(ValueError, match="scalar or a"):
+        llama.decode(cfg, params, prompt, jnp.asarray([1, 2, 3]), 2, 16)
+
+
+def test_decode_with_donated_preallocated_cache():
+    """The caller-allocated-and-donated cache path (bench + serving)
+    produces the same tokens as the internal-allocation path, and the
+    donation is actually USABLE (return_cache=True puts the cache in
+    the jit output, so XLA can alias the donated input to it)."""
+    import warnings
+    cfg = llama.LlamaConfig.tiny(vocab_size=64)
+    params = llama.init(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 5), 1, 64)
+    ref = llama.decode(cfg, params, prompt, jnp.int32(5), 4, 16)
+
+    decode_jit = jax.jit(
+        lambda p, pr, cache: llama.decode(cfg, p, pr, jnp.int32(5), 4,
+                                          16, cache=cache,
+                                          return_cache=True),
+        donate_argnums=(2,))
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "error", message=".*donated buffers were not usable.*")
+        got, _ = decode_jit(params, prompt, llama.init_cache(cfg, 2, 16))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_engine_matches_decode_across_slot_reuse():
+    """5 ragged greedy requests through 2 slots: every request's
+    stream must equal its own fixed-path decode — requests 3..5 reuse
+    slots whose rows still hold the previous request's K/V, so any
+    leak of stale (masked) cache into attention breaks this."""
+    import random
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    params = llama.init(cfg, jax.random.key(0))
+    engine = DecodeEngine(cfg, params, slots=2, max_seq=64,
+                          prefill_chunk=8).start()
+    try:
+        rng = random.Random(0)
+        specs = [([rng.randint(1, 127)
+                   for _ in range(rng.randint(1, 19))],
+                  rng.randint(1, 8)) for _ in range(5)]
+        reqs = [engine.submit(p, max_tokens=mt) for p, mt in specs]
+        for (p, mt), req in zip(specs, reqs):
+            got = req.result(timeout=300.0)
+            ref = llama.decode(cfg, params,
+                               jnp.asarray([p], jnp.int32),
+                               jnp.int32(len(p)), mt, len(p) + mt)
+            assert got == [int(t) for t in ref[0]], (p, mt)
+    finally:
+        engine.shutdown()
+
+
+def test_engine_chunked_prefill_long_prompt():
+    """A prompt spanning several prefill chunks (chunk 8, prompt 19)
+    must decode identically to the single-pass prefill path."""
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    params = llama.init(cfg, jax.random.key(0))
+    engine = DecodeEngine(cfg, params, slots=2, max_seq=64,
+                          prefill_chunk=8).start()
+    try:
+        prompt = [int(t) for t in jax.random.randint(
+            jax.random.key(7), (19,), 1, 128)]
+        got = engine.submit(prompt, max_tokens=6).result(timeout=300.0)
+        ref = llama.decode(cfg, params, jnp.asarray([prompt]),
+                           jnp.int32(19), 6, 32)
+        assert got == [int(t) for t in ref[0]]
+    finally:
+        engine.shutdown()
+
+
+def test_engine_sampling_reproducible_and_limits():
+    """Seeded sampling is slot- and batch-composition-independent;
+    oversized and empty requests are rejected upfront."""
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    params = llama.init(cfg, jax.random.key(0))
+    engine = DecodeEngine(cfg, params, slots=2, max_seq=32,
+                          prefill_chunk=8).start()
+    try:
+        r1 = engine.submit([5, 6, 7], max_tokens=5, temperature=0.8,
+                           seed=42).result(timeout=300.0)
+        # Second run shares the batch with another live request — the
+        # fold_in(seed, position) keys must not notice.
+        other = engine.submit([9, 9, 9, 9], max_tokens=8)
+        r2 = engine.submit([5, 6, 7], max_tokens=5, temperature=0.8,
+                           seed=42).result(timeout=300.0)
+        other.result(timeout=300.0)
+        assert r1 == r2
+        with pytest.raises(EngineError, match="exceeds"):
+            engine.submit(list(range(1, 30)), max_tokens=16)
+        with pytest.raises(EngineError, match="empty"):
+            engine.submit([], max_tokens=4)
+    finally:
+        engine.shutdown()
+
+
+def test_engine_metrics_in_registry_and_replica_endpoint():
+    """Slot/queue gauges and token/TTFT series reach the process
+    registry, and the replica serves them on GET /metrics."""
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    params = llama.init(cfg, jax.random.key(0))
+    tokens_before = metrics.REGISTRY.counter(
+        "stpu_engine_decode_tokens_total").get()
+
+    from skypilot_tpu.recipes import serve_llm
+    ready = threading.Event()
+    httpd = serve_llm.serve(cfg, params, 0, ready_event=ready,
+                            engine_slots=2)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        assert ready.wait(timeout=300)
+        port = httpd.server_address[1]
+        body = json.dumps({"prompt": [1, 2, 3],
+                           "max_tokens": 4}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert len(json.loads(resp.read())["tokens"]) == 4
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+            text = resp.read().decode()
+        assert "stpu_engine_slots_total 2" in text
+        assert "stpu_engine_queue_depth" in text
+        assert "stpu_engine_ttft_seconds_count" in text
+        assert metrics.REGISTRY.counter(
+            "stpu_engine_decode_tokens_total").get() >= tokens_before + 4
+    finally:
+        httpd.shutdown()
+
+
+def test_lb_metrics_include_replica_engine_families():
+    """The LB /metrics snapshot merges each ready replica's exposition
+    (engine slot/queue/token families) into one scrape."""
+    from skypilot_tpu.recipes import serve_llm
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.serve.load_balancing_policies import \
+        RoundRobinPolicy
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    params = llama.init(cfg, jax.random.key(0))
+    ready = threading.Event()
+    httpd = serve_llm.serve(cfg, params, 0, ready_event=ready,
+                            engine_slots=2)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    lb = None
+    try:
+        assert ready.wait(timeout=300)
+        policy = RoundRobinPolicy()
+        policy.set_ready_replicas(
+            [f"http://127.0.0.1:{httpd.server_address[1]}"])
+        lb = lb_lib.run_load_balancer(0, policy,
+                                      lb_lib.RequestRecorder())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{lb.server_address[1]}/metrics",
+                timeout=30) as resp:
+            text = resp.read().decode()
+        assert "stpu_lb_requests_total" in text       # LB's own
+        assert "stpu_engine_slots_total" in text      # replica's
+    finally:
+        if lb is not None:
+            lb.shutdown()
+        httpd.shutdown()
+
+
+def test_serve_llm_legacy_path_still_serves():
+    """engine_slots=0 keeps the locked fixed-batch path working (the
+    comparability baseline), including its donated-cache _decode."""
+    from skypilot_tpu.recipes import serve_llm
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    params = llama.init(cfg, jax.random.key(0))
+    ready = threading.Event()
+    httpd = serve_llm.serve(cfg, params, 0, ready_event=ready,
+                            engine_slots=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        assert ready.wait(timeout=300)
+        assert httpd.engine is None
+        port = httpd.server_address[1]
+        body = json.dumps({"prompt": [1, 2, 3],
+                           "max_tokens": 6}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            toks = json.loads(resp.read())["tokens"]
+        ref = llama.decode(cfg, params, jnp.asarray([[1, 2, 3]]),
+                           jnp.int32(3), 6, 128)
+        assert toks == [int(t) for t in ref[0]][:6]
+    finally:
+        httpd.shutdown()
+
+
+def test_engine_shutdown_fails_pending_requests():
+    """shutdown() must not strand callers blocked on queues."""
+    cfg = llama.LlamaConfig.tiny(vocab_size=64)
+    params = llama.init(cfg, jax.random.key(0))
+    engine = DecodeEngine(cfg, params, slots=1, max_seq=32,
+                          prefill_chunk=8).start()
+    engine.warmup()
+    reqs = [engine.submit([1, 2], max_tokens=8) for _ in range(3)]
+    engine.shutdown()
+    for req in reqs:
+        try:
+            req.result(timeout=30.0)
+        except EngineError:
+            pass  # "engine shut down" is the expected outcome
+    with pytest.raises(EngineError, match="shut down"):
+        engine.submit([1], max_tokens=1)

@@ -25,12 +25,21 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--family", choices=("mixtral", "llama", "gemma"),
                    default="mixtral")
+    p.add_argument("--mode", choices=("fixed", "engine"),
+                   default="fixed",
+                   help="fixed: bucketed batch decode (r01-r05 "
+                        "comparable); engine: continuous-batching "
+                        "decode engine under ragged arrivals")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--tokens", type=int, default=128)
     p.add_argument("--repeats", type=int, default=3,
                    help="best-of-N timing (the tunneled chip carries "
                         "±5-8%% run-to-run dispatch variance)")
+    p.add_argument("--slots", type=int, default=8,
+                   help="engine mode: concurrent decode slots")
+    p.add_argument("--requests", type=int, default=32,
+                   help="engine mode: ragged requests submitted")
     p.add_argument("--dim", type=int, default=1024)
     p.add_argument("--layers", type=int, default=8)
     p.add_argument("--experts", type=int, default=8)
@@ -61,9 +70,15 @@ def main() -> None:
               file=sys.stderr)
 
     from skypilot_tpu.benchmark import decode_bench
-    print(json.dumps(decode_bench.measure_decode(
-        args.family, batch=args.batch, prompt_len=args.prompt_len,
-        tokens=args.tokens, repeats=args.repeats, **shape_kw)))
+    if args.mode == "engine":
+        result = decode_bench.measure_engine_ragged(
+            args.family, slots=args.slots, n_requests=args.requests,
+            **shape_kw)
+    else:
+        result = decode_bench.measure_decode(
+            args.family, batch=args.batch, prompt_len=args.prompt_len,
+            tokens=args.tokens, repeats=args.repeats, **shape_kw)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
