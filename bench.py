@@ -359,6 +359,27 @@ def _serving_leg() -> dict:
         except Exception as e:  # noqa: BLE001
             out[key] = None
             out[f"{key}_error"] = str(e)[:200]
+        # Shared-prefix serving leg: engine + prefix KV cache under a
+        # shared-system-prompt mix — the hit rate and the warm/cold
+        # TTFT split are the whole point of the cache, so they are
+        # tracked round-over-round alongside the throughput.
+        key = f"{family}_engine_prefix_tok_s"
+        try:
+            r = run_tool(["--family", family, "--mode", "prefix"],
+                         timeout=1200)
+            out[key] = r["engine_prefix_tok_s"]
+            out[f"{family}_prefix_hit_rate"] = r["prefix_hit_rate"]
+            out[f"{family}_prefix_ttft_cold_s"] = r["ttft_cold_s"]
+            out[f"{family}_prefix_ttft_warm_s"] = r["ttft_warm_s"]
+            out[f"{family}_engine_prefix_detail"] = {
+                k: r[k] for k in ("slots", "requests", "shared_prefix",
+                                  "prefill_tokens_saved",
+                                  "steps_to_first_token_cold",
+                                  "steps_to_first_token_warm",
+                                  "generated_tokens", "wall_seconds")}
+        except Exception as e:  # noqa: BLE001
+            out[key] = None
+            out[f"{key}_error"] = str(e)[:200]
     return out
 
 

@@ -35,3 +35,18 @@ def get_cloud(name: str) -> Cloud:
 
 def registered_names() -> List[str]:
     return sorted(CLOUD_REGISTRY)
+
+
+def cloud_manages_ports(resources) -> bool:
+    """Whether ``resources``'s cloud implements OPEN_PORTS — the one
+    capability check both the serve replica launcher (inject the
+    replica's serving port) and the controller bring-up (inject the LB
+    port range) must agree on, so it lives here rather than in either.
+    Unknown clouds answer False: never inject ports a provisioner
+    can't open."""
+    try:
+        cloud = get_cloud(resources.provider_name)
+    except Exception:  # noqa: BLE001 — unknown cloud: don't inject
+        return False
+    return (CloudImplementationFeatures.OPEN_PORTS
+            not in cloud.unsupported_features_for_resources(resources))

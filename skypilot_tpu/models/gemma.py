@@ -98,7 +98,8 @@ class GemmaConfig:
                    self.head_dim +
                    self.n_heads * self.head_dim * self.dim +
                    3 * self.dim * self.mlp_dim)
-        p = self.n_layers * p_layer + self.vocab_size * self.dim  # tied
+        p = self.n_layers * p_layer + self.vocab_size * self.dim * (
+            1 if self.tie_embeddings else 2)
         flops = 6.0 * p
         if seq_len is not None:
             flops += 6.0 * self.n_layers * seq_len * \
@@ -111,14 +112,14 @@ class GemmaConfig:
                    self.n_heads * self.head_dim * self.dim +
                    3 * self.dim * self.mlp_dim + 2 * self.dim)
         return (self.n_layers * p_layer + self.dim +
-                self.vocab_size * self.dim)
+                self.vocab_size * self.dim * (
+                    1 if self.tie_embeddings else 2))
 
 
 def param_specs(cfg: GemmaConfig) -> Params:
-    """Logical-axis names, mirroring init()'s tree (tied head: no
-    lm_head leaf)."""
-    del cfg
-    return {
+    """Logical-axis names, mirroring init()'s tree (the default tied
+    head has no lm_head leaf; ``tie_embeddings=False`` adds one)."""
+    specs = {
         "embed": ("vocab", "embed"),
         "layers": {
             "attn_norm": ("layers", "embed"),
@@ -133,13 +134,19 @@ def param_specs(cfg: GemmaConfig) -> Params:
         },
         "final_norm": ("embed",),
     }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = ("embed", "vocab")
+    return specs
 
 
 def init(cfg: GemmaConfig, key: jax.Array) -> Params:
     """Stacked-layer params. Norm weights are ZEROS (the (1 + w) scale
-    starts at identity — gemma's checkpoint convention); the tied LM
-    head is embed^T (llama.head_weights handles the absent lm_head)."""
-    k = jax.random.split(key, 8)
+    starts at identity — gemma's checkpoint convention); with the
+    default ``tie_embeddings=True`` the LM head is embed^T
+    (llama.head_weights handles the absent lm_head), with it False an
+    untied lm_head is created — config, num_params and flops_per_token
+    all honor the flag."""
+    k = jax.random.split(key, 9)
     d, hd = cfg.dim, cfg.head_dim
     L = cfg.n_layers
     dt = cfg.dtype
@@ -148,7 +155,7 @@ def init(cfg: GemmaConfig, key: jax.Array) -> Params:
         return (jax.random.normal(key, shape, dtype=jnp.float32) *
                 (fan_in ** -0.5)).astype(dt)
 
-    return {
+    params: Params = {
         "embed": dense(k[0], (cfg.vocab_size, d), d),
         "layers": {
             "attn_norm": jnp.zeros((L, d), dtype=dt),
@@ -164,6 +171,9 @@ def init(cfg: GemmaConfig, key: jax.Array) -> Params:
         },
         "final_norm": jnp.zeros((d,), dtype=dt),
     }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(k[8], (d, cfg.vocab_size), d)
+    return params
 
 
 # The forward/decode machinery is llama's, driven by this config's
@@ -189,6 +199,12 @@ def head_weights(params: Params) -> jax.Array:
 
 def init_cache(cfg: GemmaConfig, batch: int, max_seq: int):
     return llama.init_cache(cfg, batch, max_seq)
+
+
+# Shared-prefix KV-cache row copy (decode-engine prefix cache); the
+# cache layout is llama's, so the copy entry points are too.
+gather_cache_rows = llama.gather_cache_rows
+insert_cache_rows = llama.insert_cache_rows
 
 
 def forward_with_cache(cfg: GemmaConfig, params: Params,

@@ -404,6 +404,42 @@ def init_cache(cfg: LlamaConfig, batch: int,
             "v": jnp.zeros(shape, dtype=cfg.dtype)}
 
 
+def gather_cache_rows(cache: Dict[str, jax.Array], slot: jax.Array,
+                      start: jax.Array, length: int
+                      ) -> Dict[str, jax.Array]:
+    """Read cache positions [start, start+length) of row ``slot`` as a
+    standalone {"k","v"} block of shape (layers, length, kv_heads,
+    head_dim) — the extraction half of the shared-prefix KV cache
+    (serve/decode_engine.PrefixCache publishes these blocks to a host
+    pool on slot free). ``length`` must be static (it sizes the output);
+    callers keep it at the engine's prefill-chunk granularity so every
+    gather shares one compile."""
+    def one(c):
+        n_layers, _, _, kvh, hd = c.shape
+        blk = jax.lax.dynamic_slice(c, (0, slot, start, 0, 0),
+                                    (n_layers, 1, length, kvh, hd))
+        return blk[:, 0]
+    return {k: one(v) for k, v in cache.items()}
+
+
+def insert_cache_rows(cache: Dict[str, jax.Array],
+                      kv: Dict[str, jax.Array], slot: jax.Array,
+                      start: jax.Array) -> Dict[str, jax.Array]:
+    """Splice a {"k","v"} block (layers, T, kv_heads, head_dim) into row
+    ``slot`` at position ``start`` — the restore half of the
+    shared-prefix KV cache: on a prefix hit the engine copies cached
+    rows in instead of re-running prefill over them. Pure
+    dynamic_update_slice, so with the cache DONATED through the jit
+    boundary the splice happens in place (no second full-size cache)."""
+    out = {}
+    for name, c in cache.items():
+        blk = kv[name].astype(c.dtype)[:, None]     # (L, 1, T, KVH, HD)
+        out[name] = jax.lax.dynamic_update_slice(
+            c, blk, (jnp.int32(0), slot, start, jnp.int32(0),
+                     jnp.int32(0)))
+    return out
+
+
 def _split_kv_attention(qg: jax.Array, ck: jax.Array, cv: jax.Array,
                         positions: jax.Array, valid_len: jax.Array,
                         block: Optional[int] = None) -> jax.Array:

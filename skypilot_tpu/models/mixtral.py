@@ -251,6 +251,12 @@ def init_cache(cfg: MixtralConfig, batch: int, max_seq: int):
     return llama.init_cache(cfg, batch, max_seq)
 
 
+# Shared-prefix KV-cache row copy (decode-engine prefix cache); the
+# cache layout is llama's, so the copy entry points are too.
+gather_cache_rows = llama.gather_cache_rows
+insert_cache_rows = llama.insert_cache_rows
+
+
 def _moe_block(cfg: MixtralConfig, x: jax.Array, lp: Params) -> jax.Array:
     """Pre-norm dense-routed MoE residual block (inference)."""
     y = llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)

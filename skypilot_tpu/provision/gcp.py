@@ -27,6 +27,7 @@ lazy-adaptor discipline, sky/adaptors/common.py:7).
 from __future__ import annotations
 
 import functools
+import hashlib
 import json
 import subprocess
 import time
@@ -540,7 +541,23 @@ _OP_WAIT_TIMEOUT_SECONDS = 120
 
 def _network_tag(cluster_name: str) -> str:
     """RFC1035-safe network tag for the cluster (lowercase, [a-z0-9-],
-    63 chars)."""
+    63 chars), suffixed with a short hash of the RAW name: the
+    sanitize/truncate steps are lossy (``a.b`` and ``a-b`` sanitize
+    identically; two long names sharing a 57-char prefix truncate
+    identically), and colliding tags would alias two clusters onto ONE
+    firewall rule — tearing down either cluster then deletes the
+    other's ingress (ADVICE round 5). The hash restores injectivity."""
+    digest = hashlib.sha1(cluster_name.encode()).hexdigest()[:6]
+    tag = "".join(c if c.isalnum() or c == "-" else "-"
+                  for c in cluster_name.lower())
+    # Room for "-<digest>" plus the "-ports" suffix rule names append.
+    stem = ("stpu-" + tag.strip("-"))[:63 - 7 - 6].rstrip("-")
+    return f"{stem}-{digest}"
+
+
+def _legacy_network_tag(cluster_name: str) -> str:
+    """Tag format before the hash suffix — kept so cleanup can delete
+    rules created by earlier versions instead of leaking them."""
     tag = "".join(c if c.isalnum() or c == "-" else "-"
                   for c in cluster_name.lower())
     return ("stpu-" + tag.strip("-"))[:63].rstrip("-")
@@ -633,18 +650,23 @@ def cleanup_ports(cluster_name: str, ports: List[str],
                   provider_config: dict) -> None:
     """Delete the cluster's ingress rule (the whole rule — ports is
     advisory, matching the reference's cleanup_ports contract which
-    ignores it, sky/provision/gcp/instance.py:626)."""
+    ignores it, sky/provision/gcp/instance.py:626). Both the current
+    (hash-suffixed) and the legacy rule name are tried: a cluster
+    provisioned before the tag format changed still tears its rule
+    down instead of leaking ingress."""
     del ports
     project = _project_of(provider_config)
-    name = _firewall_rule_name(cluster_name)
-    try:
-        op = compute_rest(
-            "DELETE", f"projects/{project}/global/firewalls/{name}")
-    except GcpApiError as e:
-        if e.status == 404:
-            return  # never created / already gone
-        raise
-    _wait_compute_op(project, op)
+    legacy = _legacy_network_tag(cluster_name) + "-ports"
+    for name in dict.fromkeys([_firewall_rule_name(cluster_name),
+                               legacy[:63]]):
+        try:
+            op = compute_rest(
+                "DELETE", f"projects/{project}/global/firewalls/{name}")
+        except GcpApiError as e:
+            if e.status == 404:
+                continue  # never created / already gone
+            raise
+        _wait_compute_op(project, op)
 
 
 # -------------------------------------------------------------- bootstrap

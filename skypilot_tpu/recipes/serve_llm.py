@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from skypilot_tpu.models import gemma, llama, mixtral, model_api
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve import decode_engine
+from skypilot_tpu.serve import load_balancing_policies
 from skypilot_tpu.train import distributed
 
 
@@ -51,6 +52,11 @@ GEN_BUCKET = 16
 # Engine defaults (overridable per serve() call / env).
 ENGINE_SLOTS = int(os.environ.get("STPU_ENGINE_SLOTS", "4"))
 ENGINE_PREFILL_CHUNK = 64
+# Shared-prefix KV pool budget (MB of host RAM; 0 disables). On by
+# default: shared system prompts are the common production mix, a hit
+# is bit-identical to a cold prefill, and a miss costs one trie walk.
+ENGINE_PREFIX_CACHE_MB = float(
+    os.environ.get("STPU_PREFIX_CACHE_MB", "64"))
 
 
 def _ceil_to(n: int, b: int) -> int:
@@ -296,19 +302,25 @@ class _Handler(BaseHTTPRequestHandler):
 
 def serve(cfg: llama.LlamaConfig, params, port: int,
           ready_event: threading.Event = None,
-          engine_slots: int = None) -> ThreadingHTTPServer:
+          engine_slots: int = None,
+          prefix_cache_mb: float = None) -> ThreadingHTTPServer:
     """Start the replica server. ``engine_slots`` > 0 (default: env
     STPU_ENGINE_SLOTS or 4) serves through the continuous-batching
-    decode engine; 0 keeps the legacy locked fixed-batch path."""
+    decode engine; 0 keeps the legacy locked fixed-batch path.
+    ``prefix_cache_mb`` (default: env STPU_PREFIX_CACHE_MB or 64)
+    bounds the engine's shared-prefix KV pool; 0 disables it."""
     if engine_slots is None:
         engine_slots = ENGINE_SLOTS
+    if prefix_cache_mb is None:
+        prefix_cache_mb = ENGINE_PREFIX_CACHE_MB
     ctx = {"cfg": cfg, "params": params, "lock": threading.Lock(),
            "ready": ready_event or threading.Event(), "engine": None}
     if engine_slots > 0:
         ctx["engine"] = decode_engine.DecodeEngine(
             cfg, params, slots=engine_slots,
             max_seq=MAX_PROMPT_TOKENS + MAX_GEN_TOKENS,
-            prefill_chunk=ENGINE_PREFILL_CHUNK).start()
+            prefill_chunk=ENGINE_PREFILL_CHUNK,
+            prefix_cache_mb=prefix_cache_mb).start()
 
     handler = type("Handler", (_Handler,), {"server_ctx": ctx})
     httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
@@ -338,7 +350,30 @@ def main(argv=None):
     p.add_argument("--engine-slots", type=int, default=None,
                    help="decode-engine slots (0 = legacy locked path; "
                         "default env STPU_ENGINE_SLOTS or 4)")
+    p.add_argument("--prefix-cache-mb", type=float, default=None,
+                   help="shared-prefix KV pool budget in MB (0 "
+                        "disables; default env STPU_PREFIX_CACHE_MB "
+                        "or 64)")
+    p.add_argument("--lb-port", type=int, default=0,
+                   help="also start an in-process load balancer on "
+                        "this port fronting the replica — the "
+                        "single-host dev analog of the `stpu serve` "
+                        "data plane")
+    p.add_argument("--lb-policy",
+                   choices=sorted(
+                       load_balancing_policies.POLICIES),
+                   default=None,
+                   help="routing policy for the --lb-port balancer; "
+                        "prefix_affinity keeps shared-prefix traffic "
+                        "on the replica whose prefix cache is warm. "
+                        "Deployed services set "
+                        "service.load_balancing_policy in the YAML "
+                        "instead.")
     args = p.parse_args(argv)
+    if args.lb_policy and not args.lb_port:
+        p.error("--lb-policy only configures the --lb-port balancer; "
+                "deployed services set service.load_balancing_policy "
+                "in the YAML")
 
     distributed.initialize_from_env()
     cfg = {
@@ -352,7 +387,16 @@ def main(argv=None):
     }[args.model]()
     params = model_api(cfg).init(cfg, jax.random.PRNGKey(args.seed))
     httpd = serve(cfg, params, args.port,
-                  engine_slots=args.engine_slots)
+                  engine_slots=args.engine_slots,
+                  prefix_cache_mb=args.prefix_cache_mb)
+    if args.lb_port:
+        from skypilot_tpu.serve import load_balancer as lb_lib
+        policy = load_balancing_policies.make_policy(args.lb_policy)
+        policy.set_ready_replicas([f"http://127.0.0.1:{args.port}"])
+        lb_lib.run_load_balancer(args.lb_port, policy,
+                                 lb_lib.RequestRecorder())
+        print(f"serve_llm: LB ({args.lb_policy or 'round_robin'}) "
+              f"on :{args.lb_port}", flush=True)
     print(f"serve_llm: listening on :{args.port}", flush=True)
     httpd.serve_forever()
 

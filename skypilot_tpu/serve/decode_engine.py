@@ -26,6 +26,22 @@ Sampling is reproducible per request: the key for the token at
 position p is fold_in(fold_in(root, seed), p), independent of which
 slot the request landed in or what else shared the batch.
 
+Shared-prefix KV caching (``prefix_cache_mb > 0``): production traffic
+shares system prompts / few-shot templates, so identical leading
+tokens produce identical KV rows (causal attention) — recomputing them
+per request burns the prefill FLOPs that dominate TTFT. The engine
+keeps a chunk-granular trie (PrefixCache) over prefilled prompt
+chunks, host-pinned and bounded by a byte budget with LRU + refcount
+eviction: on admission the longest cached prefix is COPIED into the
+slot's cache rows (models/*.insert_cache_rows through a donated jit
+entry point — a memcpy-speed splice instead of a forward pass) and on
+slot free the slot's prompt chunks are published back into the pool
+(models/*.gather_cache_rows). A hit is bit-identical to a cold
+prefill — the copied rows are the ones prefill would recompute — so
+the sampled token stream never changes, only its latency. At least one
+trailing prompt token is always prefilled so the first token is still
+sampled from real logits.
+
 Used by recipes/serve_llm.py (replacing its model-lock-per-request
 path) and benchmark/decode_bench.measure_engine_ragged (the
 `engine_ragged_tok_s` bench leg).
@@ -66,6 +82,26 @@ _TTFT = metrics.histogram(
 _REQUESTS = metrics.counter(
     "stpu_engine_requests_total", "Engine requests by outcome.",
     ("outcome",))
+_PREFIX_HITS = metrics.counter(
+    "stpu_engine_prefix_cache_hits_total",
+    "Admissions that reused >= 1 cached prompt chunk.")
+_PREFIX_MISSES = metrics.counter(
+    "stpu_engine_prefix_cache_misses_total",
+    "Admissions that found no cached prompt chunk.")
+_PREFIX_SAVED = metrics.counter(
+    "stpu_engine_prefill_tokens_saved_total",
+    "Prompt tokens restored from the prefix cache instead of "
+    "prefilled.")
+_PREFIX_BYTES = metrics.gauge(
+    "stpu_engine_prefix_cache_bytes",
+    "Host bytes held by the shared-prefix KV pool.")
+_PREFIX_CHUNKS = metrics.gauge(
+    "stpu_engine_prefix_cache_chunks",
+    "KV chunks resident in the shared-prefix pool.")
+_PREFIX_TTFT = metrics.histogram(
+    "stpu_engine_prefix_ttft_seconds",
+    "Submit-to-first-token latency split by prefix-cache outcome.",
+    ("cache",))
 
 _DONE = object()          # end-of-stream sentinel on a request's queue
 
@@ -87,6 +123,14 @@ class Request:
         self.first_token_at: Optional[float] = None
         self.error: Optional[str] = None
         self.cancelled = False
+        # Prefix-cache accounting, set by the engine: prompt tokens
+        # restored from the pool, and model forward passes (chunk
+        # prefills) actually run before the first token — the
+        # deterministic steps-to-first-token the warm/cold tests and
+        # the bench compare (wall TTFT is noise-prone on tunneled
+        # chips).
+        self.cached_prompt_tokens = 0
+        self.prefill_chunks = 0
         self._out: "queue.Queue[Any]" = queue.Queue()
 
     def cancel(self) -> None:
@@ -133,7 +177,8 @@ class Request:
 class _Slot:
     """Host-side state of one cache row."""
 
-    __slots__ = ("request", "pos", "generated", "prefilled", "tok")
+    __slots__ = ("request", "pos", "generated", "prefilled", "tok",
+                 "held", "cached")
 
     def __init__(self):
         self.request: Optional[Request] = None
@@ -141,6 +186,177 @@ class _Slot:
         self.generated = 0
         self.prefilled = 0    # prompt tokens already prefilled
         self.tok = 0          # last emitted token (next step's input)
+        self.held: List["_ChunkNode"] = []  # pinned prefix-pool nodes
+        self.cached = 0       # prompt tokens to restore from the pool
+
+
+class _ChunkNode:
+    """One prompt chunk in the prefix pool's trie.
+
+    ``kv`` holds the chunk's K/V as host numpy arrays in the model
+    cache dtype, shape (layers, chunk, kv_heads, head_dim) each.
+    ``refs`` counts live slots whose admission matched this node — a
+    referenced node (or any node with children, which a deeper cached
+    prefix depends on) is never evicted."""
+
+    __slots__ = ("key", "parent", "children", "kv", "nbytes", "refs",
+                 "tick")
+
+    def __init__(self, key, parent, kv, nbytes):
+        self.key = key
+        self.parent: Optional["_ChunkNode"] = parent
+        self.children: Dict[tuple, "_ChunkNode"] = {}
+        self.kv = kv
+        self.nbytes = int(nbytes)
+        self.refs = 0
+        self.tick = 0
+
+
+class PrefixCache:
+    """Bounded host pool of prefilled prompt chunks, trie-indexed.
+
+    Chunk-granular (the engine's prefill_chunk alignment): a prompt's
+    leading full chunks are the trie path, so the longest shared prefix
+    between any two prompts is found by a plain dict walk. Eviction is
+    LRU over LEAVES only (an interior node's K/V is a dependency of
+    every deeper cached prefix), and refcounted nodes — chunks a live
+    slot matched at admission — are never evicted even over budget:
+    the pool may transiently exceed ``capacity_bytes`` rather than pull
+    rows out from under an in-flight restore.
+
+    All mutation happens on the engine's compute thread; the lock makes
+    the read-only ``stats()`` safe from tests/handlers.
+    """
+
+    def __init__(self, capacity_bytes: int, chunk: int):
+        self._root = _ChunkNode(None, None, None, 0)
+        self._lock = threading.Lock()
+        self.capacity_bytes = int(capacity_bytes)
+        self.chunk = int(chunk)
+        self._bytes = 0
+        self._chunks = 0
+        self._tick = 0
+        self.hits = 0
+        self.misses = 0
+        self.tokens_saved = 0
+
+    # ------------------------------------------------------------ match
+    def match_and_acquire(self, prompt: List[int]) -> List[_ChunkNode]:
+        """Longest cached prefix of ``prompt``, capped so at least one
+        prompt token is left to prefill (the first output token must be
+        sampled from real logits). Pins every matched node (refcount)
+        until release(); counts the hit/miss."""
+        max_chunks = (len(prompt) - 1) // self.chunk
+        with self._lock:
+            self._tick += 1
+            node, matched = self._root, []
+            for j in range(max_chunks):
+                key = tuple(prompt[j * self.chunk:(j + 1) * self.chunk])
+                child = node.children.get(key)
+                if child is None:
+                    break
+                child.refs += 1
+                child.tick = self._tick
+                matched.append(child)
+                node = child
+            if matched:
+                self.hits += 1
+                self.tokens_saved += len(matched) * self.chunk
+                _PREFIX_HITS.inc()
+                _PREFIX_SAVED.inc(len(matched) * self.chunk)
+            else:
+                self.misses += 1
+                _PREFIX_MISSES.inc()
+        return matched
+
+    def release(self, nodes: List[_ChunkNode]) -> None:
+        with self._lock:
+            for node in nodes:
+                node.refs -= 1
+
+    # ---------------------------------------------------------- publish
+    def missing_chunks(self, prompt: List[int],
+                       valid_tokens: int) -> List[int]:
+        """Chunk indices publish() would have to fetch — lets the
+        caller dispatch every gather up front (async device compute +
+        overlapped host copies) instead of one blocking round-trip per
+        chunk inside publish()."""
+        n_chunks = min(valid_tokens, len(prompt)) // self.chunk
+        with self._lock:
+            node, j = self._root, 0
+            while j < n_chunks:
+                key = tuple(prompt[j * self.chunk:(j + 1) * self.chunk])
+                node = node.children.get(key)
+                if node is None:
+                    break
+                j += 1
+            return list(range(j, n_chunks))
+
+    def publish(self, prompt: List[int], valid_tokens: int,
+                fetch_kv) -> None:
+        """Insert ``prompt``'s leading full chunks (up to
+        ``valid_tokens``, the prefilled frontier — a cancelled slot has
+        valid K/V only that far) into the trie. ``fetch_kv(j)`` is
+        called ONLY for chunks not already cached and must return the
+        chunk's {"k","v"} host arrays. Evicts LRU leaves afterwards if
+        over budget."""
+        n_chunks = min(valid_tokens, len(prompt)) // self.chunk
+        with self._lock:
+            self._tick += 1
+            node = self._root
+            for j in range(n_chunks):
+                key = tuple(prompt[j * self.chunk:(j + 1) * self.chunk])
+                child = node.children.get(key)
+                if child is None:
+                    kv = fetch_kv(j)
+                    nbytes = sum(a.nbytes for a in kv.values())
+                    if nbytes > self.capacity_bytes:
+                        break  # one chunk over budget: don't thrash
+                    child = _ChunkNode(key, node, kv, nbytes)
+                    node.children[key] = child
+                    self._bytes += nbytes
+                    self._chunks += 1
+                child.tick = self._tick
+                node = child
+            self._evict_locked()
+            _PREFIX_BYTES.set(self._bytes)
+            _PREFIX_CHUNKS.set(self._chunks)
+
+    def _evict_locked(self) -> None:
+        """Drop LRU unreferenced leaves until back under budget. A leaf
+        removal can expose its parent as the next candidate, so loop."""
+        while self._bytes > self.capacity_bytes:
+            victim = None
+            stack = list(self._root.children.values())
+            while stack:
+                node = stack.pop()
+                if node.children:
+                    stack.extend(node.children.values())
+                elif node.refs <= 0 and (victim is None
+                                         or node.tick < victim.tick):
+                    victim = node
+            if victim is None:
+                return  # everything left is pinned by live slots
+            del victim.parent.children[victim.key]
+            self._bytes -= victim.nbytes
+            self._chunks -= 1
+
+    # ------------------------------------------------------------ intro
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "tokens_saved": self.tokens_saved,
+                    "bytes": self._bytes, "chunks": self._chunks}
+
+    def nodes(self) -> List[_ChunkNode]:
+        """All resident chunk nodes (tests: refcount/eviction safety)."""
+        with self._lock:
+            out, stack = [], list(self._root.children.values())
+            while stack:
+                node = stack.pop()
+                out.append(node)
+                stack.extend(node.children.values())
+            return out
 
 
 # ------------------------------------------------------- jitted entry points
@@ -165,6 +381,24 @@ def _prefill_chunk(cfg, params, cache, buf, slot, start, valid):
                                                     slot, axis=1)
              for k in cache}
     return logits[0, 0], cache
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _gather_chunk(cfg, length, cache, slot, start):
+    """Read one chunk of one slot's prefilled K/V out of the shared
+    cache (publish path). ``length`` is static — every gather at the
+    engine's chunk granularity shares one compile. The cache is NOT
+    donated: the slot is being freed, but the cache lives on."""
+    return model_api(cfg).gather_cache_rows(cache, slot, start, length)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+def _insert_chunk(cfg, cache, kv, slot, start):
+    """Splice one cached chunk's K/V into row ``slot`` at ``start``
+    (restore path — the prefix-hit replacement for a _prefill_chunk
+    forward pass). The cache is donated: pure dynamic_update_slice, so
+    the splice is in place, memcpy-speed, no model FLOPs."""
+    return model_api(cfg).insert_cache_rows(cache, kv, slot, start)
 
 
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
@@ -210,7 +444,7 @@ class DecodeEngine:
 
     def __init__(self, cfg, params, *, slots: int = 4,
                  max_seq: int = 1024, prefill_chunk: int = 64,
-                 max_queue: int = 256):
+                 max_queue: int = 256, prefix_cache_mb: float = 0.0):
         if slots < 1:
             raise ValueError("slots must be >= 1")
         self._cfg = cfg
@@ -228,6 +462,13 @@ class DecodeEngine:
         self._chunk = chunk
         self._max_queue = int(max_queue)
         self._cache = self._api.init_cache(cfg, slots, max_seq)
+        # Shared-prefix KV pool (module docstring): 0 disables. Chunk
+        # granularity is the (possibly shrunk) prefill chunk, so cached
+        # prefixes splice onto chunk-aligned prefill starts.
+        self.prefix_cache: Optional[PrefixCache] = None
+        if prefix_cache_mb > 0:
+            self.prefix_cache = PrefixCache(
+                int(prefix_cache_mb * 1024 * 1024), self._chunk)
         self._waiting: "collections.deque[Request]" = collections.deque()
         self._cond = threading.Condition()
         self._stop = False
@@ -289,14 +530,50 @@ class DecodeEngine:
     def _live(self) -> List[int]:
         return [i for i, s in enumerate(self._slots) if s.request]
 
+    def _publish_slot_chunks(self, i: int) -> None:
+        """Slot-free half of the prefix cache: gather the slot's
+        prefilled PROMPT chunks off the device and hand them to the
+        pool. Chunks already cached are never gathered, and the ones
+        that are get ALL their gathers dispatched up front with the
+        device→host copies started asynchronously — the engine thread
+        pays roughly one transfer's latency per free, not one blocking
+        round-trip per chunk stacked on top of live decode."""
+        slot = self._slots[i]
+        prompt, valid = slot.request.prompt, slot.prefilled
+        missing = self.prefix_cache.missing_chunks(prompt, valid)
+        gathered = {}
+        for j in missing:
+            kv = _gather_chunk(self._cfg, self._chunk, self._cache,
+                               jnp.int32(i), jnp.int32(j * self._chunk))
+            for arr in kv.values():
+                try:
+                    arr.copy_to_host_async()
+                except AttributeError:  # backend without async D2H
+                    pass
+            gathered[j] = kv
+        if not gathered:
+            return
+        self.prefix_cache.publish(
+            prompt, valid,
+            lambda j: {k: jax.device_get(v)
+                       for k, v in gathered[j].items()})
+
     def _free_slot(self, i: int, error: Optional[str] = None,
                    outcome: str = "ok") -> None:
         slot = self._slots[i]
         if slot.request is not None:
+            if self.prefix_cache is not None and error is None:
+                # Publish before the row is reusable; skipped on engine
+                # failure/shutdown (device state not trustworthy).
+                self._publish_slot_chunks(i)
             slot.request._finish(error)
             _REQUESTS.labels(outcome=outcome).inc()
+        if slot.held:
+            self.prefix_cache.release(slot.held)
+            slot.held = []
         slot.request = None
         slot.pos = slot.generated = slot.prefilled = slot.tok = 0
+        slot.cached = 0
         # Gauge updated HERE so every free path (finish, cancel during
         # prefill, cache-full) is reflected even while the loop idles.
         _SLOTS_OCCUPIED.set(len(self._live()))
@@ -314,6 +591,16 @@ class DecodeEngine:
                         continue
                     slot.request = req
                     slot.pos = slot.generated = slot.prefilled = 0
+                    if self.prefix_cache is not None:
+                        # Trie walk + refcount pin only (host dicts);
+                        # the device-side row restore happens on the
+                        # compute path (_prefill_one), not under the
+                        # submit lock.
+                        slot.held = \
+                            self.prefix_cache.match_and_acquire(
+                                req.prompt)
+                        slot.cached = len(slot.held) * self._chunk
+                        req.cached_prompt_tokens = slot.cached
             _QUEUE_DEPTH.set(len(self._waiting))
         _SLOTS_OCCUPIED.set(len(self._live()))
 
@@ -327,6 +614,18 @@ class DecodeEngine:
             if req.cancelled:
                 self._free_slot(i, outcome="cancelled")
                 continue
+            if slot.prefilled == 0 and slot.cached:
+                # Prefix hit: splice the matched chunks' K/V into the
+                # row instead of prefilling them — chunk by chunk, so
+                # every restore shares the one compiled splice program
+                # regardless of how many chunks matched.
+                for j, node in enumerate(slot.held):
+                    kv = {k: jnp.asarray(v)
+                          for k, v in node.kv.items()}
+                    self._cache = _insert_chunk(
+                        self._cfg, self._cache, kv, jnp.int32(i),
+                        jnp.int32(j * self._chunk))
+                slot.prefilled = slot.pos = slot.cached
             start = slot.prefilled
             piece = req.prompt[start:start + self._chunk]
             buf = jnp.zeros((self._chunk,), jnp.int32).at[
@@ -335,6 +634,7 @@ class DecodeEngine:
             logits, self._cache = _prefill_chunk(
                 self._cfg, self._params, self._cache, buf,
                 jnp.int32(i), jnp.int32(start), jnp.int32(valid))
+            req.prefill_chunks += 1
             slot.prefilled = valid
             slot.pos = valid
             if slot.prefilled >= len(req.prompt):
@@ -346,6 +646,10 @@ class DecodeEngine:
                 slot.generated = 1
                 req._emit(tok)
                 _TOKENS.inc()
+                if self.prefix_cache is not None:
+                    _PREFIX_TTFT.labels(
+                        cache="hit" if slot.cached else "miss").observe(
+                        req.first_token_at - req.submitted_at)
                 self._maybe_finish(i)
             return True
         return False

@@ -176,18 +176,31 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
             _STREAMED.observe(stats["bytes"])
 
     def _proxy_inner(self, method: str, stats: Dict[str, int]) -> None:
-        target = self.policy.select_replica()
+        # Body read BEFORE replica selection: content-aware policies
+        # (prefix affinity) route on the request payload.
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else None
+        target = self.policy.select_replica(
+            {"path": self.path, "body": body})
         if target is None:
             self.send_response(503)
             stats["code"] = 503
-            body = b"No ready replicas.\n"
-            self.send_header("Content-Length", str(len(body)))
+            payload = b"No ready replicas.\n"
+            self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
-            self.wfile.write(body)
+            self.wfile.write(payload)
             return
+        try:
+            self._proxy_to(target, method, body, stats)
+        finally:
+            # Return the in-flight slot on every exit path (clean,
+            # HTTP error, aborted stream) — least-loaded accounting
+            # must not leak slots or a replica reads as busy forever.
+            self.policy.report_done(target)
+
+    def _proxy_to(self, target: str, method: str,
+                  body: Optional[bytes], stats: Dict[str, int]) -> None:
         url = target.rstrip("/") + self.path
-        length = int(self.headers.get("Content-Length") or 0)
-        body = self.rfile.read(length) if length else None
         headers = {k: v for k, v in self.headers.items()
                    if k.lower() not in _HOP_HEADERS}
         req = urllib.request.Request(url, data=body, headers=headers,
@@ -299,7 +312,8 @@ def run_load_balancer(port: int, policy: LoadBalancingPolicy,
 
 # ---------------------------------------------------------- LB as a process
 def run_lb_process(port: int, controller_url: str,
-                   sync_interval: float) -> None:
+                   sync_interval: float,
+                   policy_name: Optional[str] = None) -> None:
     """Standalone LB process (reference: run_load_balancer,
     sky/serve/load_balancer.py:226 — a separate process from the
     controller, syncing over HTTP).
@@ -310,13 +324,19 @@ def run_lb_process(port: int, controller_url: str,
     serving its last-known ready set — the data plane survives a
     control-plane crash (the blast-radius isolation the single-process
     design lacked).
+
+    ``policy_name`` selects the routing policy
+    (load_balancing_policies.POLICIES; service.py passes the service
+    YAML's ``load_balancing_policy``); default env STPU_LB_POLICY or
+    round_robin.
     """
     import json
+    import os
     import urllib.request
 
-    from skypilot_tpu.serve.load_balancing_policies import \
-        RoundRobinPolicy
-    policy = RoundRobinPolicy()
+    from skypilot_tpu.serve.load_balancing_policies import make_policy
+    policy = make_policy(policy_name
+                         or os.environ.get("STPU_LB_POLICY"))
     recorder = RequestRecorder()
     handler_cls = type("Handler", (_ProxyHandler,),
                        {"policy": policy, "recorder": recorder})
@@ -353,11 +373,19 @@ def run_lb_process(port: int, controller_url: str,
 def main() -> None:
     import argparse
     parser = argparse.ArgumentParser()
+    from skypilot_tpu.serve import load_balancing_policies
     parser.add_argument("--port", type=int, required=True)
     parser.add_argument("--controller-url", required=True)
     parser.add_argument("--sync-interval", type=float, default=2.0)
+    parser.add_argument(
+        "--lb-policy",
+        choices=sorted(load_balancing_policies.POLICIES), default=None,
+        help="replica routing policy (default env STPU_LB_POLICY or "
+             "round_robin; prefix_affinity pins shared-prefix traffic "
+             "to the replica whose KV prefix cache is warm)")
     args = parser.parse_args()
-    run_lb_process(args.port, args.controller_url, args.sync_interval)
+    run_lb_process(args.port, args.controller_url, args.sync_interval,
+                   policy_name=args.lb_policy)
 
 
 if __name__ == "__main__":

@@ -236,13 +236,11 @@ class SkyPilotReplicaManager:
 
     @staticmethod
     def _cloud_manages_ports(res) -> bool:
+        # Shared with controller_utils.controller_resources (LB port
+        # range injection) — the two paths must agree on which clouds
+        # can open ports, so the check lives in the clouds registry.
         from skypilot_tpu import clouds as clouds_lib
-        try:
-            cloud = clouds_lib.get_cloud(res.provider_name)
-        except Exception:  # noqa: BLE001 — unknown cloud: don't inject
-            return False
-        return (clouds_lib.CloudImplementationFeatures.OPEN_PORTS
-                not in cloud.unsupported_features_for_resources(res))
+        return clouds_lib.cloud_manages_ports(res)
 
     def _launch_replica(self, info: ReplicaInfo) -> None:
         info.status = ReplicaStatus.PROVISIONING

@@ -50,13 +50,14 @@ class _LbSupervisor:
     """Spawn + babysit the LB process; respawn with backoff on exit."""
 
     def __init__(self, service_name: str, lb_port: int, sync_port: int,
-                 log_f):
+                 log_f, lb_policy: str = "round_robin"):
         self.service_name = service_name
         self.argv = [
             sys.executable, "-m", "skypilot_tpu.serve.load_balancer",
             "--port", str(lb_port),
             "--controller-url", f"http://127.0.0.1:{sync_port}",
-            "--sync-interval", str(_lb_sync_seconds())]
+            "--sync-interval", str(_lb_sync_seconds()),
+            "--lb-policy", lb_policy]
         self.log_f = log_f
         self.proc: subprocess.Popen = None
         self._stop = False
@@ -136,7 +137,8 @@ def run_service(service_name: str, task_yaml: str, lb_port: int) -> None:
                 os.kill(row["lb_pid"], signal.SIGTERM)
             except OSError:
                 pass
-    supervisor = _LbSupervisor(service_name, lb_port, sync_port, log_f)
+    supervisor = _LbSupervisor(service_name, lb_port, sync_port, log_f,
+                               lb_policy=spec.load_balancing_policy)
     supervisor.spawn()
     threading.Thread(target=supervisor.watch, daemon=True).start()
 

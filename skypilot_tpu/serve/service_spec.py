@@ -38,6 +38,11 @@ class SkyServiceSpec:
     # generate can legitimately take minutes to its first byte (VERDICT
     # r3 weak #4 — a hardcoded 120s 502'd such replicas mid-fleet).
     upstream_timeout_seconds: int = DEFAULT_UPSTREAM_TIMEOUT_SECONDS
+    # LB replica-routing policy (load_balancing_policies.POLICIES).
+    # Per-service because it is workload-shaped: prefix_affinity pays
+    # off exactly when replicas run the decode engine's shared-prefix
+    # KV cache under shared-system-prompt traffic.
+    load_balancing_policy: str = "round_robin"
 
     @property
     def autoscaling_enabled(self) -> bool:
@@ -75,7 +80,9 @@ class SkyServiceSpec:
             readiness_post_data=post,
             upstream_timeout_seconds=config.get(
                 "upstream_timeout_seconds",
-                DEFAULT_UPSTREAM_TIMEOUT_SECONDS))
+                DEFAULT_UPSTREAM_TIMEOUT_SECONDS),
+            load_balancing_policy=config.get(
+                "load_balancing_policy", "round_robin"))
         if policy is not None:
             kwargs.update(
                 min_replicas=policy.get("min_replicas", 1),
@@ -108,6 +115,8 @@ class SkyServiceSpec:
         if (self.upstream_timeout_seconds !=
                 DEFAULT_UPSTREAM_TIMEOUT_SECONDS):
             out["upstream_timeout_seconds"] = self.upstream_timeout_seconds
+        if self.load_balancing_policy != "round_robin":
+            out["load_balancing_policy"] = self.load_balancing_policy
         if (self.autoscaling_enabled or self.max_replicas is not None
                 or self.use_ondemand_fallback):
             policy: Dict[str, Any] = {"min_replicas": self.min_replicas}

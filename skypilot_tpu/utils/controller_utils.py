@@ -55,16 +55,21 @@ def controller_mode(kind: Controllers) -> str:
 
 
 def controller_resources(kind: Controllers) -> Resources:
+    from skypilot_tpu import clouds as clouds_lib
     spec = config_lib.get_nested(
         (kind.config_key, "controller", "resources"), None)
     res = (Resources.from_yaml_config(dict(spec)) if spec
            else Resources(cloud="local"))
-    if kind.config_key == "serve":
+    if kind.config_key == "serve" and \
+            clouds_lib.cloud_manages_ports(res):
         # The serve controller hosts every service's LB: open the whole
         # LB port range at controller bring-up so each `serve up`
         # endpoint is reachable without a per-service firewall
         # round-trip (reference: serve controllers open
-        # LB_PORT_RANGE the same way).
+        # LB_PORT_RANGE the same way). Gated on the cloud actually
+        # implementing OPEN_PORTS: on docker (ports published out of
+        # band) the injected range would make the optimizer reject the
+        # controller resources outright.
         from skypilot_tpu.serve.core import LB_PORT_RANGE_SPEC
         if LB_PORT_RANGE_SPEC not in res.ports:
             res = res.copy(ports=tuple(res.ports) + (LB_PORT_RANGE_SPEC,))

@@ -82,6 +82,12 @@ _SERVICE_SCHEMA = {
         },
         "replicas": {"type": "integer"},
         "upstream_timeout_seconds": {"type": "integer"},
+        # Keep in sync with serve.load_balancing_policies.POLICIES (the
+        # schema layer must not import the serve/jax stack).
+        "load_balancing_policy": {
+            "type": "string",
+            "enum": ["round_robin", "prefix_affinity"],
+        },
         "replica_policy": {
             "type": "object",
             "additionalProperties": False,

@@ -1,0 +1,113 @@
+"""tools/bench_compare.py: the CI gate over the bench trajectory.
+
+The tool must fail (exit 1) on >threshold% regressions in the named
+serving/training metrics, tolerate null legs (failed benches record
+null) without crashing, and treat a silently dropped exact-named
+headline as a regression.
+"""
+import importlib.util
+import json
+import pathlib
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_compare",
+    pathlib.Path(__file__).parent.parent / "tools" / "bench_compare.py")
+bench_compare = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(bench_compare)
+
+
+def _doc(mfu, llama_b8, prefix_tok=900.0, hit=0.95):
+    return {
+        "metric": "llama_train_mfu_1chip", "value": mfu, "unit": "%MFU",
+        "vs_baseline": round(mfu / 40.0, 3),
+        "detail": {
+            "tokens_per_sec_per_chip": mfu * 200.0,
+            "long_context": {"tokens_per_sec_per_chip": 14000.0,
+                             "mfu_pct": 48.0},
+            "eight_b_shape": {"tokens_per_sec_per_chip": 10000.0},
+            "serving": {
+                "llama_decode_tok_s_b8": llama_b8,
+                "llama_engine_ragged_tok_s": 800.0,
+                "llama_engine_prefix_tok_s": prefix_tok,
+                "llama_prefix_hit_rate": hit,
+            },
+        },
+    }
+
+
+def _write(tmp_path, name, doc):
+    path = tmp_path / name
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+def test_no_regression_exits_zero(tmp_path, capsys):
+    old = _write(tmp_path, "old.json", _doc(50.0, 1700.0))
+    # +3% everywhere and a small dip well inside the 5% budget.
+    new = _write(tmp_path, "new.json", _doc(51.5, 1650.0))
+    assert bench_compare.main([old, new]) == 0
+    out = capsys.readouterr().out
+    assert "no regression" in out
+    assert "llama_decode_tok_s_b8" in out
+
+
+def test_regression_exits_one_and_names_the_metric(tmp_path, capsys):
+    old = _write(tmp_path, "old.json", _doc(50.0, 1700.0))
+    new = _write(tmp_path, "new.json", _doc(50.0, 1400.0))  # -17.6%
+    assert bench_compare.main([old, new]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out
+    assert "llama_decode_tok_s_b8" in out
+
+
+def test_threshold_is_respected(tmp_path):
+    old = _write(tmp_path, "old.json", _doc(50.0, 1700.0))
+    new = _write(tmp_path, "new.json", _doc(46.0, 1700.0))  # -8% MFU
+    assert bench_compare.main([old, new]) == 1
+    assert bench_compare.main([old, new, "--threshold", "10"]) == 0
+
+
+def test_dropped_exact_metric_fails_null_glob_skipped(tmp_path, capsys):
+    old_doc = _doc(50.0, 1700.0)
+    new_doc = _doc(50.0, 1700.0)
+    # A failed serving leg records null — glob-selected, so skipped
+    # with a note, not a crash.
+    new_doc["detail"]["serving"]["llama_engine_prefix_tok_s"] = None
+    assert bench_compare.main([_write(tmp_path, "a.json", old_doc),
+                               _write(tmp_path, "b.json", new_doc)]) == 0
+    assert "gone in new; skipped" in capsys.readouterr().out
+
+    # The exact-named headline disappearing IS a failure.
+    del new_doc["value"]
+    assert bench_compare.main([_write(tmp_path, "c.json", old_doc),
+                               _write(tmp_path, "d.json", new_doc)]) == 1
+
+
+def test_unwraps_driver_tracked_shape(tmp_path):
+    """BENCH_r*.json wraps the bench doc under "parsed"."""
+    old = _write(tmp_path, "old.json",
+                 {"n": 5, "rc": 0, "parsed": _doc(50.0, 1700.0)})
+    new = _write(tmp_path, "new.json", _doc(50.0, 300.0))
+    assert bench_compare.main([old, new]) == 1
+
+
+def test_custom_metric_selection(tmp_path):
+    old = _write(tmp_path, "old.json", _doc(50.0, 1700.0, hit=0.9))
+    new = _write(tmp_path, "new.json", _doc(10.0, 1700.0, hit=0.89))
+    # Only watching the hit rate: the MFU collapse is out of scope.
+    assert bench_compare.main(
+        [old, new, "--metrics", "detail.serving.*_prefix_hit_rate"]) == 0
+    assert bench_compare.main(
+        [old, new, "--metrics", "value"]) == 1
+
+
+def test_compare_is_pure_and_orders_patterns_once():
+    """compare() never double-counts a path matched by two patterns."""
+    old = _doc(50.0, 1700.0)
+    report, regressions = bench_compare.compare(
+        old, _doc(50.0, 1700.0),
+        ["value", "value", "detail.serving.*"], 5.0)
+    assert not regressions
+    assert len([l for l in report if " value:" in l]) == 1

@@ -301,6 +301,336 @@ def test_serve_llm_legacy_path_still_serves():
         httpd.shutdown()
 
 
+# ------------------------------------------------- shared-prefix KV cache
+def _tiny_cfg(family):
+    if family == "mixtral":
+        return mixtral, mixtral.MixtralConfig.tiny()
+    if family == "gemma":
+        return gemma, gemma.GemmaConfig.tiny(vocab_size=128)
+    return llama, llama.LlamaConfig.tiny(vocab_size=128)
+
+
+@pytest.mark.parametrize("family", ["llama", "mixtral", "gemma"])
+def test_prefix_hit_token_identical_and_fewer_steps(family):
+    """A prefix-cache hit must change ONLY latency: the warm stream is
+    token-identical to the fixed-path (cold) decode, prefill tokens
+    are actually saved, and steps-to-first-token (chunk prefills, the
+    deterministic TTFT) is STRICTLY lower than the cold run's."""
+    mdl, cfg = _tiny_cfg(family)
+    vocab = cfg.vocab_size
+    params = mdl.init(cfg, jax.random.key(0))
+    engine = DecodeEngine(cfg, params, slots=2, max_seq=64,
+                          prefill_chunk=8, prefix_cache_mb=8.0).start()
+    try:
+        shared = [int(t) for t in jax.random.randint(
+            jax.random.key(11), (17,), 1, vocab)]  # 2 full 8-chunks
+        cold = engine.submit(shared + [5, 6], max_tokens=4)
+        cold_toks = cold.result(timeout=300.0)
+        warm = engine.submit(shared + [7, 8, 9], max_tokens=4)
+        warm_toks = warm.result(timeout=300.0)
+
+        for prompt, got in ((shared + [5, 6], cold_toks),
+                            (shared + [7, 8, 9], warm_toks)):
+            ref = mdl.decode(cfg, params, jnp.asarray([prompt]),
+                             jnp.int32(len(prompt)), 4, len(prompt) + 4)
+            assert got == [int(t) for t in ref[0]]
+        assert cold.cached_prompt_tokens == 0
+        assert warm.cached_prompt_tokens == 16
+        assert warm.prefill_chunks < cold.prefill_chunks
+        assert engine.prefix_cache.stats()["tokens_saved"] >= 16
+    finally:
+        engine.shutdown()
+
+
+def test_prefix_hit_seeded_sampling_parity():
+    """A temperature>0 stream is bit-identical warm vs cold: the hit
+    restores the exact KV rows prefill would recompute, and the
+    fold_in(seed, position) keys never see the cache."""
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    params = llama.init(cfg, jax.random.key(0))
+    prompt = [int(t) for t in jax.random.randint(
+        jax.random.key(3), (21,), 1, 128)]
+
+    def run(prefix_mb):
+        engine = DecodeEngine(cfg, params, slots=2, max_seq=64,
+                              prefill_chunk=8,
+                              prefix_cache_mb=prefix_mb).start()
+        try:
+            # Sequential on purpose: the second submission must see the
+            # first's published chunks (cache-hit path).
+            first = engine.submit(prompt, max_tokens=6,
+                                  temperature=0.9, seed=17)
+            first_toks = first.result(timeout=300.0)
+            second = engine.submit(prompt, max_tokens=6,
+                                   temperature=0.9, seed=17)
+            return first_toks, second.result(timeout=300.0), second
+        finally:
+            engine.shutdown()
+
+    cold1, cold2, _ = run(prefix_mb=0.0)
+    warm1, warm2, warm_req = run(prefix_mb=8.0)
+    assert cold1 == cold2 == warm1 == warm2
+    assert warm_req.cached_prompt_tokens > 0  # the hit really happened
+
+
+def test_prefix_pool_lru_refcount_and_interior_protection():
+    """Pool-level eviction contract: LRU leaves go first, nodes pinned
+    by a live match are NEVER evicted even over budget, and an interior
+    chunk (a cached deeper prefix depends on it) outlives fresher
+    leaves."""
+    import numpy as np
+    from skypilot_tpu.serve.decode_engine import PrefixCache
+
+    chunk = 4
+    kv_bytes = 2 * 64                    # two 64-byte arrays per chunk
+    pool = PrefixCache(capacity_bytes=3 * kv_bytes, chunk=chunk)
+
+    def fake_kv(_j):
+        return {"k": np.zeros(64, np.uint8), "v": np.zeros(64, np.uint8)}
+
+    a = list(range(10, 14))
+    b = list(range(20, 24))
+    pool.publish(a + b + [1], valid_tokens=9, fetch_kv=fake_kv)  # a->b
+    pool.publish(list(range(30, 34)) + [1], 5, fake_kv)          # c
+    assert pool.stats()["chunks"] == 3
+
+    # Pin the a->b path like an admitted slot would.
+    held = pool.match_and_acquire(a + b + [1])
+    assert len(held) == 2 and all(n.refs == 1 for n in held)
+
+    # Over-budget publish: the unpinned LRU leaf (c) must go; the
+    # pinned chain must survive; interior node a is not a leaf.
+    pool.publish(list(range(40, 44)) + [1], 5, fake_kv)          # d
+    keys = {n.key for n in pool.nodes()}
+    assert tuple(a) in keys and tuple(b) in keys
+    assert tuple(range(30, 34)) not in keys
+
+    # Even a pool FORCED over budget (everything pinned) refuses to
+    # touch pinned chunks: shrink capacity to one chunk and publish.
+    pool.capacity_bytes = kv_bytes
+    pool.publish(list(range(50, 54)) + [1], 5, fake_kv)          # e
+    keys = {n.key for n in pool.nodes()}
+    assert tuple(a) in keys and tuple(b) in keys  # pinned: untouched
+
+    # Release: the chain becomes evictable again, leaf-first (b before
+    # its parent a).
+    pool.release(held)
+    pool.publish(list(range(60, 64)) + [1], 5, fake_kv)
+    assert pool.stats()["bytes"] <= pool.capacity_bytes
+    assert all(n.refs == 0 for n in pool.nodes())
+
+
+def test_engine_slot_churn_respects_pool_budget_and_parity():
+    """Slot churn through a ONE-chunk pool: every stream stays
+    token-identical to the fixed path while eviction constantly
+    replaces the resident chunk (LRU + refcount safety under churn,
+    the acceptance-criteria wording)."""
+    import random
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    params = llama.init(cfg, jax.random.key(0))
+    # Capacity = one chunk of this config's KV (L*chunk*KVH*HD * 2
+    # tensors * 2 bytes bf16).
+    one_chunk = cfg.n_layers * 8 * cfg.n_kv_heads * cfg.head_dim * 2 * 2
+    engine = DecodeEngine(cfg, params, slots=2, max_seq=64,
+                          prefill_chunk=8,
+                          prefix_cache_mb=one_chunk / (1024 * 1024)
+                          ).start()
+    try:
+        rng = random.Random(2)
+        for _ in range(6):
+            prompt = [rng.randint(1, 127)
+                      for _ in range(rng.randint(9, 20))]
+            got = engine.submit(prompt, max_tokens=3).result(
+                timeout=300.0)
+            ref = llama.decode(cfg, params, jnp.asarray([prompt]),
+                               jnp.int32(len(prompt)), 3,
+                               len(prompt) + 3)
+            assert got == [int(t) for t in ref[0]]
+            stats = engine.prefix_cache.stats()
+            assert stats["bytes"] <= engine.prefix_cache.capacity_bytes
+    finally:
+        engine.shutdown()
+
+
+def test_cancel_mid_prefill_releases_chunk_refcounts():
+    """A request cancelled between admission and prefill completion
+    must release every pinned pool node (engine driven step-by-step on
+    this thread — no scheduler races)."""
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    params = llama.init(cfg, jax.random.key(0))
+    engine = DecodeEngine(cfg, params, slots=1, max_seq=64,
+                          prefill_chunk=8, prefix_cache_mb=8.0)
+    # NOT started: drive _admit/_prefill_one/_decode_step directly.
+    shared = [int(t) for t in jax.random.randint(
+        jax.random.key(5), (18,), 1, 128)]
+    first = engine.submit(shared, max_tokens=1)
+    engine._admit()
+    for _ in range(8):
+        if not engine._prefill_one():
+            break
+        engine._decode_step()
+    assert first.result(timeout=5.0)          # finished + published
+    assert engine.prefix_cache.stats()["chunks"] == 2
+
+    second = engine.submit(shared + [3, 4, 5, 6, 7, 8, 9, 10, 11],
+                           max_tokens=4)
+    engine._admit()
+    pinned = [n for n in engine.prefix_cache.nodes() if n.refs > 0]
+    assert len(pinned) == 2                   # admission pinned the hit
+    second.cancel()
+    engine._prefill_one()                     # cancel path frees slot
+    assert all(n.refs == 0 for n in engine.prefix_cache.nodes())
+    assert second.result(timeout=5.0) == []   # clean cancelled stream
+
+
+def test_prefix_metrics_reach_replica_endpoint():
+    """Hit/miss/tokens-saved counters, the occupancy gauge and the
+    split TTFT histogram are part of the replica's /metrics surface
+    (and therefore of the LB's merged scrape)."""
+    from skypilot_tpu.observability import metrics as metrics_lib
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    params = llama.init(cfg, jax.random.key(0))
+    saved_before = metrics_lib.REGISTRY.counter(
+        "stpu_engine_prefill_tokens_saved_total").get()
+    engine = DecodeEngine(cfg, params, slots=2, max_seq=64,
+                          prefill_chunk=8, prefix_cache_mb=8.0).start()
+    try:
+        shared = list(range(1, 18))
+        engine.submit(shared, max_tokens=2).result(timeout=300.0)
+        engine.submit(shared + [19], max_tokens=2).result(timeout=300.0)
+    finally:
+        engine.shutdown()
+    assert metrics_lib.REGISTRY.counter(
+        "stpu_engine_prefill_tokens_saved_total").get() >= \
+        saved_before + 16
+    text = metrics_lib.render()
+    assert "stpu_engine_prefix_cache_hits_total" in text
+    assert "stpu_engine_prefix_cache_bytes" in text
+    assert 'stpu_engine_prefix_ttft_seconds_count{cache="hit"}' in text
+
+
+# ------------------------------------------------- prefix-affinity LB
+def test_prefix_affinity_routes_equal_prefixes_together():
+    """Equal-prefix requests land on ONE replica; when that replica
+    disappears they remap consistently to a surviving replica; traffic
+    without a prompt falls back to least-loaded."""
+    from skypilot_tpu.serve.load_balancing_policies import \
+        PrefixAffinityPolicy
+
+    policy = PrefixAffinityPolicy()
+    urls = [f"http://replica-{i}" for i in range(4)]
+    policy.set_ready_replicas(urls)
+    body = json.dumps({"prompt": list(range(100)),
+                       "max_tokens": 4}).encode()
+    req = {"path": "/generate", "body": body}
+
+    def pick():
+        url = policy.select_replica(req)
+        policy.report_done(url)   # request completes -> load returns
+        return url
+
+    picks = {pick() for _ in range(8)}
+    assert len(picks) == 1
+    target = picks.pop()
+
+    # Replica vanishes: every equal-prefix request remaps to the SAME
+    # survivor (consistent hashing), never bounces.
+    policy.set_ready_replicas([u for u in urls if u != target])
+    remapped = {pick() for _ in range(8)}
+    assert len(remapped) == 1 and target not in remapped
+
+    # It comes back: affinity returns to the original owner.
+    policy.set_ready_replicas(urls)
+    assert pick() == target
+
+    # DIFFERENT prefixes spread: with vnodes, 20 distinct prefixes on
+    # 4 replicas never all hash to one arc.
+    spread = {policy.select_replica({"path": "/generate",
+                                     "body": json.dumps(
+                                         {"prompt": [i] * 70}).encode()})
+              for i in range(20)}
+    assert len(spread) > 1
+
+
+def test_prefix_affinity_bounded_load_spills_deterministically():
+    """One dominant prefix must NOT pin the whole fleet's traffic on
+    its owner: once the owner's in-flight count crosses the bounded-
+    load threshold, requests spill to the ring successor (which then
+    warms too) — and the spill target is deterministic, not random."""
+    from skypilot_tpu.serve.load_balancing_policies import \
+        PrefixAffinityPolicy
+
+    policy = PrefixAffinityPolicy()
+    policy.set_ready_replicas([f"http://replica-{i}" for i in range(4)])
+    req = {"path": "/generate",
+           "body": json.dumps({"prompt": list(range(100))}).encode()}
+    # No report_done: every request stays in flight (slow decodes).
+    picks = [policy.select_replica(req) for _ in range(8)]
+    owner = picks[0]
+    assert picks[1] == owner              # under the bound: affinity
+    spilled = [u for u in picks if u != owner]
+    assert spilled                        # over the bound: spill
+    assert len(set(spilled)) == 1         # ... to ONE successor
+    # Owner still carries the larger share (affinity preserved).
+    assert picks.count(owner) >= len(spilled)
+
+
+def test_prefix_affinity_fallback_least_loaded_and_report_done():
+    from skypilot_tpu.serve.load_balancing_policies import \
+        PrefixAffinityPolicy
+
+    policy = PrefixAffinityPolicy()
+    policy.set_ready_replicas(["http://a", "http://b"])
+    body = json.dumps({"prompt": list(range(80))}).encode()
+    busy = policy.select_replica({"path": "/generate", "body": body})
+    other = "http://a" if busy == "http://b" else "http://b"
+    # No prompt -> least loaded, i.e. NOT the replica holding the
+    # in-flight generate.
+    assert policy.select_replica({"path": "/health",
+                                  "body": None}) == other
+    policy.report_done(busy)
+    policy.report_done(other)
+    # Unknown url must not crash the accounting.
+    policy.report_done("http://gone")
+
+
+def test_lb_proxies_through_prefix_affinity_policy():
+    """End to end through the real LB: the proxy hands the request body
+    to the policy (content-aware selection) and returns the in-flight
+    slot when the response completes."""
+    from skypilot_tpu.recipes import serve_llm
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.serve.load_balancing_policies import \
+        PrefixAffinityPolicy
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=128)
+    params = llama.init(cfg, jax.random.key(0))
+    ready = threading.Event()
+    httpd = serve_llm.serve(cfg, params, 0, ready_event=ready,
+                            engine_slots=2)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    lb = None
+    try:
+        assert ready.wait(timeout=300)
+        policy = PrefixAffinityPolicy()
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        policy.set_ready_replicas([url])
+        lb = lb_lib.run_load_balancer(0, policy,
+                                      lb_lib.RequestRecorder())
+        body = json.dumps({"prompt": [1, 2, 3],
+                           "max_tokens": 3}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{lb.server_address[1]}/generate",
+            data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert len(json.loads(resp.read())["tokens"]) == 3
+        assert policy._inflight[url] == 0    # slot returned
+    finally:
+        if lb is not None:
+            lb.shutdown()
+        httpd.shutdown()
+
+
 def test_engine_shutdown_fails_pending_requests():
     """shutdown() must not strand callers blocked on queues."""
     cfg = llama.LlamaConfig.tiny(vocab_size=64)

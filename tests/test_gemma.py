@@ -59,6 +59,43 @@ def test_architecture_deltas_active():
     assert not np.allclose(np.asarray(changed), np.asarray(logits))
 
 
+def test_untied_head_honored():
+    """tie_embeddings=False is a real knob, not a dead config field:
+    init creates an lm_head, param_specs names it, num_params counts
+    it, flops_per_token doubles the vocab-projection term, and the
+    forward actually USES the untied weights."""
+    cfg = gemma.GemmaConfig.tiny(vocab_size=64)
+    untied_cfg = dataclasses.replace(cfg, tie_embeddings=False)
+    tied = gemma.init(cfg, jax.random.key(0))
+    untied = gemma.init(untied_cfg, jax.random.key(0))
+
+    assert "lm_head" in untied
+    assert untied["lm_head"].shape == (cfg.dim, cfg.vocab_size)
+    assert "lm_head" in gemma.param_specs(untied_cfg)
+    assert "lm_head" not in gemma.param_specs(cfg)
+
+    # Config accounting and the real tree agree, for BOTH settings —
+    # the drift this knob used to hide.
+    for c, p in ((cfg, tied), (untied_cfg, untied)):
+        actual = sum(int(x.size) for x in jax.tree.leaves(p))
+        assert c.num_params() == actual, (c.tie_embeddings, actual)
+    extra = cfg.vocab_size * cfg.dim
+    assert untied_cfg.num_params() - cfg.num_params() == extra
+    assert untied_cfg.flops_per_token() - cfg.flops_per_token() == \
+        6.0 * extra
+
+    # The untied head is live in the forward: swapping it changes
+    # logits; head_weights returns it (not embed^T).
+    np.testing.assert_array_equal(
+        np.asarray(gemma.head_weights(untied)),
+        np.asarray(untied["lm_head"]))
+    tokens = jax.random.randint(jax.random.key(1), (1, 6), 0, 64)
+    base = gemma.forward(untied_cfg, untied, tokens)
+    swapped = dict(untied, lm_head=untied["lm_head"] * 2.0)
+    changed = gemma.forward(untied_cfg, swapped, tokens)
+    assert not np.allclose(np.asarray(base), np.asarray(changed))
+
+
 def test_gemma_train_loss_decreases():
     cfg = gemma.GemmaConfig.tiny(vocab_size=128)
     mesh = mesh_lib.make_mesh({"dp": 1}, devices=[jax.devices()[0]])

@@ -418,6 +418,39 @@ def test_node_body_carries_network_tag(fake):
                                            gcp._COMMON_TAG]
 
 
+def test_cleanup_ports_also_deletes_legacy_rule_name(fake_compute):
+    """A cluster provisioned before the hash-suffixed tag format still
+    tears down its (legacy-named) ingress rule — cleanup must not leak
+    open firewall rules across the format change."""
+    legacy = gcp._legacy_network_tag("old.cluster") + "-ports"
+    fake_compute.firewalls[legacy] = {"name": legacy}
+    gcp.cleanup_ports("old.cluster", ["8080"], _config())
+    assert legacy not in fake_compute.firewalls
+    # Both names absent: still a clean no-op.
+    gcp.cleanup_ports("old.cluster", ["8080"], _config())
+
+
+def test_network_tag_collision_resistant():
+    """Sanitize/truncate is lossy: names that sanitize ('a.b' vs 'a-b')
+    or truncate (long shared prefixes) identically must still get
+    DISTINCT tags, or two clusters alias one firewall rule and tearing
+    down either deletes the other's ingress (ADVICE round 5). The raw-
+    name hash suffix restores injectivity, within RFC1035 limits."""
+    import re
+    assert gcp._network_tag("a.b") != gcp._network_tag("a-b")
+    long_a = "cluster-" + "x" * 80 + "-a"
+    long_b = "cluster-" + "x" * 80 + "-b"
+    assert gcp._network_tag(long_a) != gcp._network_tag(long_b)
+    # Case is folded by sanitization, so it too needs the hash.
+    assert gcp._network_tag("Train") != gcp._network_tag("train")
+    for name in ("a.b", "a-b", long_a, "Train", "c1"):
+        tag = gcp._network_tag(name)
+        assert re.fullmatch(r"[a-z][a-z0-9-]*[a-z0-9]", tag)
+        assert len(tag) <= 63
+        assert len(gcp._firewall_rule_name(name)) <= 63
+        assert gcp._network_tag(name) == tag  # deterministic
+
+
 def test_invalid_port_spec_rejected(fake_compute):
     with pytest.raises(exceptions.ProvisionError):
         gcp.open_ports("c1", ["not-a-port"], _config())

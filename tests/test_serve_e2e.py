@@ -674,6 +674,28 @@ def test_serve_controller_resources_carry_lb_range(tmp_state_dir,
             controller_utils.Controllers.JOBS).ports
 
 
+def test_serve_controller_lb_range_gated_on_port_support(tmp_state_dir,
+                                                         monkeypatch):
+    """Clouds without OPEN_PORTS (docker publishes ports out of band)
+    must NOT get the LB range injected — the optimizer would reject the
+    controller resources outright, bricking `serve up` on a docker
+    controller (mirrors replica_managers._cloud_manages_ports)."""
+    from skypilot_tpu import config as config_lib
+    from skypilot_tpu.utils import controller_utils
+
+    monkeypatch.setattr(
+        config_lib, "get_nested",
+        lambda keys, default=None:
+        {"cloud": "docker"}
+        if keys == ("serve", "controller", "resources") else default)
+    res = controller_utils.controller_resources(
+        controller_utils.Controllers.SERVE)
+    assert res.cloud == "docker"
+    assert serve_core.LB_PORT_RANGE_SPEC not in res.ports
+    # Explicit user-specified ports pass through untouched.
+    assert res.ports == ()
+
+
 def test_replica_launch_injects_serving_port(tmp_state_dir, monkeypatch):
     """Replica clusters' resources carry the serving port, so the
     provision path opens it for LB probes/proxying from the controller

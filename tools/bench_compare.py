@@ -1,0 +1,143 @@
+"""Diff two bench JSON files; fail on metric regressions.
+
+    python tools/bench_compare.py BENCH_old.json BENCH_new.json \
+        [--threshold 5] [--metrics glob,glob,...]
+
+Guards the bench trajectory in CI the way tier-1 tests guard
+correctness: exit 1 when any NAMED serving/training metric regresses
+by more than ``--threshold`` percent (default 5), so a PR that tanks
+decode throughput or MFU fails the pipeline instead of quietly
+shipping a slower round. Metrics are addressed by dotted path into the
+bench JSON (bench.py's single-line document) and selected by glob
+patterns; all named metrics are higher-is-better (tok/s, MFU, hit
+rate). A metric named by an EXACT (non-glob) pattern that disappears
+from the new file also fails — a silently dropped headline is a
+regression in disguise. Null values (failed legs record null + an
+_error key) are skipped with a warning line.
+"""
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import sys
+from typing import Dict, List, Tuple
+
+# Higher-is-better metrics tracked round-over-round. Keep in sync with
+# bench.py's output shape (tests/test_bench_compare.py pins a fixture).
+DEFAULT_METRICS = (
+    "value",                                        # headline MFU
+    "detail.tokens_per_sec_per_chip",
+    "detail.long_context.tokens_per_sec_per_chip",
+    "detail.long_context.mfu_pct",
+    "detail.eight_b_shape.tokens_per_sec_per_chip",
+    "detail.serving.*_decode_tok_s_b*",
+    "detail.serving.*_engine_ragged_tok_s",
+    "detail.serving.*_engine_prefix_tok_s",
+    "detail.serving.*_prefix_hit_rate",
+)
+
+
+def unwrap(doc: dict) -> dict:
+    """Accept both bench.py's bare document and the driver-tracked
+    BENCH_r*.json wrapper ({"n": ..., "rc": ..., "parsed": {...}})."""
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and "value" in parsed:
+        return parsed
+    return doc
+
+
+def flatten(doc, prefix: str = "") -> Dict[str, float]:
+    """Numeric leaves of a nested JSON document by dotted path."""
+    out: Dict[str, float] = {}
+    if isinstance(doc, dict):
+        for key, val in doc.items():
+            path = f"{prefix}.{key}" if prefix else str(key)
+            out.update(flatten(val, path))
+    elif isinstance(doc, (int, float)) and not isinstance(doc, bool):
+        out[prefix] = float(doc)
+    return out
+
+
+def compare(old: dict, new: dict, patterns: List[str],
+            threshold_pct: float) -> Tuple[List[str], List[str]]:
+    """(report lines, regression lines). A regression is a selected
+    metric whose new value is more than threshold_pct below old, or an
+    exact-named metric missing from the new document."""
+    old_flat, new_flat = flatten(unwrap(old)), flatten(unwrap(new))
+    report: List[str] = []
+    regressions: List[str] = []
+    seen = set()
+    for pattern in patterns:
+        is_glob = any(c in pattern for c in "*?[")
+        matched = sorted(p for p in old_flat
+                         if fnmatch.fnmatchcase(p, pattern))
+        if not matched and not is_glob:
+            report.append(f"-- {pattern}: absent in old file; skipped")
+            continue
+        for path in matched:
+            if path in seen:
+                continue
+            seen.add(path)
+            old_v = old_flat[path]
+            if path not in new_flat:
+                # Null in new (failed leg) or dropped key.
+                line = (f"!! {path}: {old_v:g} -> missing/null in new")
+                if is_glob:
+                    report.append(f"-- {path}: gone in new; skipped")
+                else:
+                    report.append(line)
+                    regressions.append(line)
+                continue
+            new_v = new_flat[path]
+            if old_v <= 0:
+                report.append(f"-- {path}: non-positive baseline "
+                              f"{old_v:g}; skipped")
+                continue
+            change = (new_v - old_v) / old_v * 100.0
+            marker = "ok"
+            if change < -threshold_pct:
+                marker = "REGRESSION"
+            line = (f"{marker:>10}  {path}: {old_v:g} -> {new_v:g} "
+                    f"({change:+.1f}%)")
+            report.append(line)
+            if marker == "REGRESSION":
+                regressions.append(line)
+    return report, regressions
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Fail (exit 1) on >threshold%% regressions "
+                    "between two bench JSON files.")
+    parser.add_argument("old", help="baseline BENCH_*.json")
+    parser.add_argument("new", help="candidate BENCH_*.json")
+    parser.add_argument("--threshold", type=float, default=5.0,
+                        help="allowed drop in percent (default 5)")
+    parser.add_argument("--metrics", default=None,
+                        help="comma-separated dotted-path globs "
+                             "(default: the tracked serving/training "
+                             "set)")
+    args = parser.parse_args(argv)
+
+    with open(args.old) as f:
+        old = json.load(f)
+    with open(args.new) as f:
+        new = json.load(f)
+    patterns = (args.metrics.split(",") if args.metrics
+                else list(DEFAULT_METRICS))
+    report, regressions = compare(old, new, patterns, args.threshold)
+    for line in report:
+        print(line)
+    if regressions:
+        print(f"\nbench_compare: {len(regressions)} metric(s) "
+              f"regressed more than {args.threshold:g}%",
+              file=sys.stderr)
+        return 1
+    print(f"\nbench_compare: no regression beyond "
+          f"{args.threshold:g}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

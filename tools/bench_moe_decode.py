@@ -25,11 +25,14 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--family", choices=("mixtral", "llama", "gemma"),
                    default="mixtral")
-    p.add_argument("--mode", choices=("fixed", "engine"),
+    p.add_argument("--mode", choices=("fixed", "engine", "prefix"),
                    default="fixed",
                    help="fixed: bucketed batch decode (r01-r05 "
                         "comparable); engine: continuous-batching "
-                        "decode engine under ragged arrivals")
+                        "decode engine under ragged arrivals; prefix: "
+                        "engine under shared-prefix traffic with the "
+                        "shared-prefix KV cache on (warm/cold TTFT "
+                        "split + hit rate)")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--tokens", type=int, default=128)
@@ -40,6 +43,10 @@ def main() -> None:
                    help="engine mode: concurrent decode slots")
     p.add_argument("--requests", type=int, default=32,
                    help="engine mode: ragged requests submitted")
+    p.add_argument("--shared-prefix", type=int, default=256,
+                   help="prefix mode: shared system-prompt tokens")
+    p.add_argument("--prefix-cache-mb", type=float, default=256.0,
+                   help="prefix mode: shared-prefix KV pool budget")
     p.add_argument("--dim", type=int, default=1024)
     p.add_argument("--layers", type=int, default=8)
     p.add_argument("--experts", type=int, default=8)
@@ -74,6 +81,11 @@ def main() -> None:
         result = decode_bench.measure_engine_ragged(
             args.family, slots=args.slots, n_requests=args.requests,
             **shape_kw)
+    elif args.mode == "prefix":
+        result = decode_bench.measure_engine_prefix(
+            args.family, slots=args.slots,
+            shared_prefix=args.shared_prefix,
+            prefix_cache_mb=args.prefix_cache_mb, **shape_kw)
     else:
         result = decode_bench.measure_decode(
             args.family, batch=args.batch, prompt_len=args.prompt_len,
