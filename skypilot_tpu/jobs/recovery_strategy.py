@@ -146,7 +146,7 @@ class FailoverStrategyExecutor(StrategyExecutor, name="FAILOVER"):
                 job_id = self._launch(raise_on_failure=False, max_retry=1)
                 if job_id is not None:
                     return job_id
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: stpu-except — same-placement retry is opportunistic; step 2 relaunches anywhere
                 pass
             finally:
                 self.task.resources = original

@@ -57,6 +57,19 @@ ENGINE_PREFILL_CHUNK = 64
 # is bit-identical to a cold prefill, and a miss costs one trie walk.
 ENGINE_PREFIX_CACHE_MB = float(
     os.environ.get("STPU_PREFIX_CACHE_MB", "64"))
+# Per-token stream timeout: how long a client handler waits for the
+# NEXT token before declaring the engine wedged (surfaced as a clean
+# EngineError, not a hang). Operator-tunable — the right bound is how
+# fast wedged-device detection should be vs. the slowest honest step.
+STREAM_TIMEOUT_SECONDS = float(
+    os.environ.get("STPU_STREAM_TIMEOUT", "600"))
+# Engine supervision (decode_engine.EngineSupervisor): restart a
+# crashed engine loop this many times (capped exponential backoff
+# starting at BACKOFF seconds) before declaring the replica dead.
+ENGINE_MAX_RESTARTS = int(os.environ.get("STPU_ENGINE_MAX_RESTARTS",
+                                         "3"))
+ENGINE_RESTART_BACKOFF = float(
+    os.environ.get("STPU_ENGINE_RESTART_BACKOFF", "1.0"))
 
 
 def _ceil_to(n: int, b: int) -> int:
@@ -150,9 +163,21 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path in ("/", "/health"):
-            ready = self.server_ctx["ready"].is_set()
-            self._json(200 if ready else 503,
-                       {"status": "ok" if ready else "warming"})
+            ctx = self.server_ctx
+            ready = ctx["ready"].is_set()
+            engine = ctx.get("engine")
+            if not ready:
+                self._json(503, {"status": "warming"})
+            elif engine is not None and not engine.healthy():
+                # The readiness probe must tell the truth about the
+                # ENGINE, not just the HTTP process: a dead/restarting
+                # engine behind a 200 probe is a zombie replica that
+                # blackholes its share of traffic.
+                self._json(503, {"status": "engine_down"})
+            else:
+                self._json(200, {"status": "ok"})
+        elif self.path == "/drain":
+            self._json(200, self._drain_payload())
         elif self.path == "/metrics":
             # Replica-local registry (engine slot/queue/token families);
             # the LB pulls this into its merged /metrics snapshot.
@@ -165,13 +190,55 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._json(404, {"error": "not found"})
 
+    # ----------------------------------------------------------- drain
+    def _drain_payload(self) -> dict:
+        ctx = self.server_ctx
+        with ctx["inflight_lock"]:
+            handler_inflight = ctx["inflight"][0]
+        engine = ctx.get("engine")
+        if engine is not None:
+            # The engine's slot count hits zero while a handler thread
+            # may still be FLUSHING queued tokens to a slow client —
+            # the handler count covers that tail, so report the max of
+            # the two views or a drain could truncate a live stream.
+            return {"draining": engine.draining(),
+                    "in_flight": max(engine.in_flight(),
+                                     handler_inflight)}
+        return {"draining": ctx["draining"].is_set(),
+                "in_flight": handler_inflight}
+
+    def _start_drain(self) -> None:
+        """POST /drain: stop admitting new generations, report what is
+        still in flight. The replica manager polls GET /drain until
+        in_flight hits 0 (or its deadline) before terminating, so live
+        token streams finish instead of truncating mid-rollout."""
+        ctx = self.server_ctx
+        ctx["draining"].set()
+        engine = ctx.get("engine")
+        if engine is not None:
+            engine.drain()
+        self._json(200, self._drain_payload())
+
     def do_POST(self):
+        # Body consumed up front on EVERY path: an early error response
+        # that leaves unread body bytes on an HTTP/1.1 keep-alive
+        # connection corrupts the next request parsed off it.
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length) if length else b""
+        if self.path == "/drain":
+            self._start_drain()
+            return
         if self.path != "/generate":
             self._json(404, {"error": "not found"})
             return
-        length = int(self.headers.get("Content-Length", 0))
+        if self.server_ctx["draining"].is_set():
+            # Engine-path submits would raise EngineError anyway; this
+            # also covers the legacy path and keeps the refusal shape
+            # uniform (503 → the LB retries on a non-draining peer).
+            self._json(503, {"error": "replica draining"})
+            return
         try:
-            req = json.loads(self.rfile.read(length) or b"{}")
+            req = json.loads(raw or b"{}")
             prompt = [int(t) for t in req["prompt"]]
             if not 1 <= len(prompt) <= MAX_PROMPT_TOKENS:
                 raise ValueError(
@@ -191,6 +258,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(400, {"error": str(e)})
             return
         engine = ctx.get("engine")
+        # Legacy-path in-flight accounting (the engine tracks its own):
+        # GET /drain must see requests this handler is still streaming.
+        with ctx["inflight_lock"]:
+            ctx["inflight"][0] += 1
         try:
             if engine is not None:
                 self._engine_generate(engine, prompt, mt, temperature,
@@ -208,16 +279,20 @@ class _Handler(BaseHTTPRequestHandler):
             # has already swallowed the exception and dropped the
             # connection, so this catch never corrupts a stream.
             self._json(500, {"error": f"{type(e).__name__}: {e}"})
+        finally:
+            with ctx["inflight_lock"]:
+                ctx["inflight"][0] -= 1
 
     # ----------------------------------------------------- engine path
     def _engine_generate(self, engine, prompt, mt, temperature, seed,
                          stream) -> None:
         req = engine.submit(prompt, max_tokens=mt,
                             temperature=temperature, seed=seed)
+        timeout = self.server_ctx["stream_timeout"]
         if not stream:
-            self._json(200, {"tokens": req.result()})
+            self._json(200, {"tokens": req.result(timeout=timeout)})
             return
-        it = req.stream()
+        it = req.stream(timeout=timeout)
         try:
             # First token BEFORE the headers go out: a prefill/compile
             # error must still be reportable as a clean JSON error, not
@@ -303,24 +378,47 @@ class _Handler(BaseHTTPRequestHandler):
 def serve(cfg: llama.LlamaConfig, params, port: int,
           ready_event: threading.Event = None,
           engine_slots: int = None,
-          prefix_cache_mb: float = None) -> ThreadingHTTPServer:
+          prefix_cache_mb: float = None,
+          stream_timeout: float = None,
+          engine_max_restarts: int = None,
+          engine_restart_backoff: float = None) -> ThreadingHTTPServer:
     """Start the replica server. ``engine_slots`` > 0 (default: env
     STPU_ENGINE_SLOTS or 4) serves through the continuous-batching
     decode engine; 0 keeps the legacy locked fixed-batch path.
     ``prefix_cache_mb`` (default: env STPU_PREFIX_CACHE_MB or 64)
-    bounds the engine's shared-prefix KV pool; 0 disables it."""
+    bounds the engine's shared-prefix KV pool; 0 disables it.
+    ``stream_timeout`` (default: env STPU_STREAM_TIMEOUT or 600) is the
+    per-token wait before a wedged engine surfaces as a clean error.
+    The engine runs under an EngineSupervisor: a crashed compute loop
+    flips /health to 503 and is restarted with fresh state (capped
+    backoff, ``engine_max_restarts`` consecutive fast failures →
+    permanently down so the replica manager replaces the replica)."""
     if engine_slots is None:
         engine_slots = ENGINE_SLOTS
     if prefix_cache_mb is None:
         prefix_cache_mb = ENGINE_PREFIX_CACHE_MB
+    if stream_timeout is None:
+        stream_timeout = STREAM_TIMEOUT_SECONDS
+    if engine_max_restarts is None:
+        engine_max_restarts = ENGINE_MAX_RESTARTS
+    if engine_restart_backoff is None:
+        engine_restart_backoff = ENGINE_RESTART_BACKOFF
     ctx = {"cfg": cfg, "params": params, "lock": threading.Lock(),
-           "ready": ready_event or threading.Event(), "engine": None}
+           "ready": ready_event or threading.Event(), "engine": None,
+           "stream_timeout": float(stream_timeout),
+           "draining": threading.Event(),
+           "inflight": [0], "inflight_lock": threading.Lock()}
     if engine_slots > 0:
-        ctx["engine"] = decode_engine.DecodeEngine(
-            cfg, params, slots=engine_slots,
-            max_seq=MAX_PROMPT_TOKENS + MAX_GEN_TOKENS,
-            prefill_chunk=ENGINE_PREFILL_CHUNK,
-            prefix_cache_mb=prefix_cache_mb).start()
+        def _engine_factory():
+            return decode_engine.DecodeEngine(
+                cfg, params, slots=engine_slots,
+                max_seq=MAX_PROMPT_TOKENS + MAX_GEN_TOKENS,
+                prefill_chunk=ENGINE_PREFILL_CHUNK,
+                prefix_cache_mb=prefix_cache_mb)
+
+        ctx["engine"] = decode_engine.EngineSupervisor(
+            _engine_factory, max_restarts=engine_max_restarts,
+            backoff_base=engine_restart_backoff).start()
 
     handler = type("Handler", (_Handler,), {"server_ctx": ctx})
     httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
@@ -354,6 +452,16 @@ def main(argv=None):
                    help="shared-prefix KV pool budget in MB (0 "
                         "disables; default env STPU_PREFIX_CACHE_MB "
                         "or 64)")
+    p.add_argument("--stream-timeout", type=float, default=None,
+                   help="seconds to wait for the NEXT token before "
+                        "failing the request as engine-stalled "
+                        "(default env STPU_STREAM_TIMEOUT or 600); "
+                        "lower = faster wedged-device detection, "
+                        "higher = tolerate slower models")
+    p.add_argument("--engine-max-restarts", type=int, default=None,
+                   help="consecutive fast engine-crash restarts before "
+                        "the replica reports permanently unhealthy "
+                        "(default env STPU_ENGINE_MAX_RESTARTS or 3)")
     p.add_argument("--lb-port", type=int, default=0,
                    help="also start an in-process load balancer on "
                         "this port fronting the replica — the "
@@ -388,7 +496,9 @@ def main(argv=None):
     params = model_api(cfg).init(cfg, jax.random.PRNGKey(args.seed))
     httpd = serve(cfg, params, args.port,
                   engine_slots=args.engine_slots,
-                  prefix_cache_mb=args.prefix_cache_mb)
+                  prefix_cache_mb=args.prefix_cache_mb,
+                  stream_timeout=args.stream_timeout,
+                  engine_max_restarts=args.engine_max_restarts)
     if args.lb_port:
         from skypilot_tpu.serve import load_balancer as lb_lib
         policy = load_balancing_policies.make_policy(args.lb_policy)

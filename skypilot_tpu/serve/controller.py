@@ -190,6 +190,8 @@ class SkyServeController:
         # replicas keep serving as surge), then roll outdated replicas
         # out in two phases — pulled from the LB one tick, terminated the
         # next — so availability never dips and in-flight requests drain.
+        ready_ids = set(rm.ready_ids())
+        trim_pull = set()
         for pool_spot, pool_target in ((True, plan.target_spot),
                                        (False, plan.target_ondemand)):
             alive = rm.alive_current_count(spot=pool_spot)
@@ -198,29 +200,39 @@ class SkyServeController:
             elif alive > pool_target:
                 for rid in rm.scale_down_candidates(spot=pool_spot)[
                         :alive - pool_target]:
-                    rm.scale_down(rid)
+                    if rid in ready_ids:
+                        # Two-phase trim (mirror of the rollover):
+                        # pull the replica from the LB this tick,
+                        # terminate (with an engine-level drain) next
+                        # tick once the LB has synced — killing a
+                        # replica the LB still routes to turns a
+                        # scale-down into client-visible 502s.
+                        trim_pull.add(rid)
+                    else:
+                        rm.scale_down(rid)
+        # Rollover pulls stay gated on current-version capacity being
+        # at target (old replicas keep serving as surge until then);
+        # the gate releasing them re-admits still-READY old replicas.
         outdated = set(rm.outdated_alive_ids())
+        pull = set(trim_pull)
         if rm.ready_current_count() >= target:
-            # Terminate a draining replica only once the LB has SYNCED
-            # since the pull (its rotation no longer holds the url) —
-            # one tick of wall time is not proof the LB observed it.
-            # Fallback: after 10 ticks, terminate anyway so a dead LB
-            # cannot pin outdated replicas forever.
-            lb_caught_up = (self._last_sync_at >= self._draining_since or
-                            time.monotonic() - self._draining_since >
-                            10 * _tick_seconds())
-            terminated = ((outdated & self._draining) if lb_caught_up
-                          else set())
-            for rid in terminated:
-                rm.scale_down(rid)
-            # Next tick terminates only the NEWLY draining replicas —
-            # the ones just terminated must not be scaled down twice.
-            new_draining = outdated - terminated
-            newly_pulled = bool(new_draining - self._draining)
-            self._draining = new_draining
-        else:
-            newly_pulled = False
-            self._draining = set()
+            pull |= outdated
+        # Terminate a pulled replica only once the LB has SYNCED since
+        # the pull (its rotation no longer holds the url) — one tick of
+        # wall time is not proof the LB observed it. Fallback: after 10
+        # ticks, terminate anyway so a dead LB cannot pin outgoing
+        # replicas forever.
+        lb_caught_up = (self._last_sync_at >= self._draining_since or
+                        time.monotonic() - self._draining_since >
+                        10 * _tick_seconds())
+        terminated = (pull & self._draining) if lb_caught_up else set()
+        for rid in terminated:
+            rm.scale_down(rid)
+        # Next tick terminates only the NEWLY pulled replicas — the
+        # ones just terminated must not be scaled down twice.
+        new_draining = pull - terminated
+        newly_pulled = bool(new_draining - self._draining)
+        self._draining = new_draining
         ready = rm.ready_urls(exclude_ids=self._draining)
         was_empty = not self._ready_urls
         self._ready_urls = list(ready)  # served to the LB via /sync

@@ -272,7 +272,7 @@ def _update_local(task: Task, service_name: str) -> int:
             try:
                 controller_utils.cleanup_translated_buckets(
                     Task.from_yaml(str(old)))
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: stpu-except — best-effort bucket GC; the yaml may already be gone
                 pass
             try:
                 old.unlink()
@@ -342,7 +342,7 @@ def _down_local(service_names: Optional[List[str]], all_services: bool,
             try:
                 controller_utils.cleanup_translated_buckets(
                     Task.from_yaml(yaml_path))
-            except Exception:  # noqa: BLE001 — best-effort cleanup
+            except Exception:  # noqa: stpu-except — best-effort bucket cleanup on service down
                 pass
         done.append(name)
     return done

@@ -53,13 +53,15 @@ import functools
 import queue
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 
 from skypilot_tpu.models import model_api
+from skypilot_tpu.observability import events
 from skypilot_tpu.observability import metrics
+from skypilot_tpu.utils import fault_injection
 
 # ----------------------------------------------------------------- metrics
 _SLOTS_TOTAL = metrics.gauge(
@@ -102,6 +104,13 @@ _PREFIX_TTFT = metrics.histogram(
     "stpu_engine_prefix_ttft_seconds",
     "Submit-to-first-token latency split by prefix-cache outcome.",
     ("cache",))
+_RESTARTS = metrics.counter(
+    "stpu_engine_restarts_total",
+    "Engine restarts by the supervisor after a compute-loop crash.")
+_ENGINE_UP = metrics.gauge(
+    "stpu_engine_up",
+    "1 while the decode engine accepts work; 0 while it is failed, "
+    "restarting, or permanently down.")
 
 _DONE = object()          # end-of-stream sentinel on a request's queue
 
@@ -472,6 +481,7 @@ class DecodeEngine:
         self._waiting: "collections.deque[Request]" = collections.deque()
         self._cond = threading.Condition()
         self._stop = False
+        self._draining = False
         self._thread: Optional[threading.Thread] = None
         self._failed: Optional[str] = None
         _SLOTS_TOTAL.set(slots)
@@ -502,6 +512,9 @@ class DecodeEngine:
                 raise EngineError(f"engine failed: {self._failed}")
             if self._stop:
                 raise EngineError("engine is shut down")
+            if self._draining:
+                raise EngineError(
+                    "engine draining (replica shutting down)")
             if len(self._waiting) >= self._max_queue:
                 raise EngineError("engine queue full")
             self._waiting.append(req)
@@ -518,6 +531,27 @@ class DecodeEngine:
         the full XLA compile."""
         self.start()
         self.submit([1], max_tokens=2).result(timeout=600.0)
+
+    def drain(self) -> None:
+        """Stop admitting new requests (submit raises EngineError);
+        live slots keep decoding to completion. The graceful half of a
+        replica scale-down: the manager polls in_flight() and tears the
+        replica down once it hits zero."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify()
+
+    def draining(self) -> bool:
+        return self._draining
+
+    def in_flight(self) -> int:
+        """Requests admitted or queued and not yet finished."""
+        with self._cond:
+            return len(self._waiting) + len(self._live())
+
+    def failed(self) -> Optional[str]:
+        """The error that killed the compute loop, if it died."""
+        return self._failed
 
     def shutdown(self) -> None:
         with self._cond:
@@ -631,6 +665,9 @@ class DecodeEngine:
             buf = jnp.zeros((self._chunk,), jnp.int32).at[
                 :len(piece)].set(jnp.asarray(piece, jnp.int32))
             valid = start + len(piece)
+            if fault_injection.ENABLED:
+                fault_injection.fire("engine.prefill", slot=i,
+                                     start=start)
             logits, self._cache = _prefill_chunk(
                 self._cfg, self._params, self._cache, buf,
                 jnp.int32(i), jnp.int32(start), jnp.int32(valid))
@@ -683,6 +720,8 @@ class DecodeEngine:
             [s.request.seed if i in live else 0
              for i, s in enumerate(self._slots)], jnp.uint32)
         t0 = time.perf_counter()
+        if fault_injection.ENABLED:
+            fault_injection.fire("engine.step", live=len(live))
         nxt, self._cache = _engine_step(
             self._cfg, self._params, self._cache, toks, pos, temps,
             seeds)
@@ -733,3 +772,198 @@ class DecodeEngine:
             _REQUESTS.labels(outcome=outcome).inc()
         _SLOTS_OCCUPIED.set(0)
         _QUEUE_DEPTH.set(0)
+
+
+class EngineSupervisor:
+    """Babysit a DecodeEngine; restart it when the compute loop dies.
+
+    Without supervision a dead engine loop is the worst failure mode in
+    the stack: the HTTP process keeps answering the readiness probe, so
+    the controller keeps the replica READY and the LB keeps routing to
+    it — a zombie that blackholes its share of traffic until a human
+    notices. The supervisor closes that hole from both sides:
+
+      * ``healthy()`` is False the moment the loop dies (and stays
+        False through the restart backoff) — the replica's /health
+        endpoint returns 503, probes fail, and the controller pulls the
+        replica until the engine is back;
+      * the engine is rebuilt from scratch (``factory`` returns a fresh
+        DecodeEngine: new KV cache, empty slots — device state after an
+        arbitrary crash is not trustworthy) under capped exponential
+        backoff; jitted programs are process-cached, so a restart does
+        not re-pay XLA compiles;
+      * ``max_restarts`` consecutive FAST failures (death within
+        ``fast_failure_seconds`` of start — the deterministic-crash
+        signature) leave the engine down for good: /health stays 503,
+        probes keep failing, and the replica manager's
+        user-code-failure path tears the replica down.
+
+    Requests never hang across any of this: the dying engine drains its
+    queue with EngineErrors, and submits during a restart hit the dead
+    engine's (or the permanent-down) clean EngineError.
+
+    API-compatible with DecodeEngine where serve handlers touch it
+    (submit/warmup/drain/in_flight/shutdown), so recipes/serve_llm.py
+    swaps it in transparently.
+    """
+
+    def __init__(self, factory: Callable[[], "DecodeEngine"], *,
+                 max_restarts: int = 3, backoff_base: float = 1.0,
+                 backoff_cap: float = 30.0,
+                 fast_failure_seconds: float = 30.0,
+                 poll_interval: float = 0.1):
+        self._factory = factory
+        self.max_restarts = int(max_restarts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self.fast_failure_seconds = float(fast_failure_seconds)
+        self._poll = float(poll_interval)
+        self._lock = threading.Lock()
+        self._engine: Optional[DecodeEngine] = None
+        self._stop = False
+        self._draining = False
+        self.permanently_down = False
+        self.restarts = 0            # lifetime restarts (tests)
+        self._consecutive = 0        # consecutive fast failures
+        self._started_at = 0.0
+        self._watch_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- public
+    def start(self) -> "EngineSupervisor":
+        if self._watch_thread is None:
+            self._engine = self._factory().start()
+            self._started_at = time.monotonic()
+            _ENGINE_UP.set(1)
+            self._watch_thread = threading.Thread(
+                target=self._watch, name="engine-supervisor",
+                daemon=True)
+            self._watch_thread.start()
+        return self
+
+    @property
+    def engine(self) -> Optional["DecodeEngine"]:
+        """The live engine (swapped on restart) — for tests and
+        introspection (prefix_cache etc.)."""
+        return self._engine
+
+    @property
+    def prefix_cache(self):
+        engine = self._engine
+        return engine.prefix_cache if engine is not None else None
+
+    def healthy(self) -> bool:
+        """True iff the engine accepts work RIGHT NOW. Wired to the
+        replica /health endpoint: 503 while failed/restarting/down."""
+        if self.permanently_down or self._stop:
+            return False
+        engine = self._engine
+        return engine is not None and engine._failed is None
+
+    def submit(self, prompt, max_tokens: int, temperature: float = 0.0,
+               seed: int = 0) -> Request:
+        if self.permanently_down:
+            raise EngineError(
+                f"engine permanently down after {self.max_restarts} "
+                "consecutive fast failures")
+        engine = self._engine
+        if engine is None:
+            raise EngineError("engine not started")
+        # A dead/restarting engine raises its own clean EngineError.
+        return engine.submit(prompt, max_tokens=max_tokens,
+                             temperature=temperature, seed=seed)
+
+    def warmup(self) -> None:
+        engine = self._engine
+        if engine is not None:
+            engine.warmup()
+
+    def drain(self) -> None:
+        self._draining = True
+        engine = self._engine
+        if engine is not None:
+            engine.drain()
+
+    def draining(self) -> bool:
+        return self._draining
+
+    def in_flight(self) -> int:
+        engine = self._engine
+        return engine.in_flight() if engine is not None else 0
+
+    def shutdown(self) -> None:
+        self._stop = True
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=10.0)
+        engine = self._engine
+        if engine is not None:
+            engine.shutdown()
+
+    # ------------------------------------------------------------ internal
+    def _sleep(self, seconds: float) -> bool:
+        """Interruptible sleep; False if shutdown/drain cut it short."""
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            if self._stop or self._draining:
+                return False
+            time.sleep(min(self._poll, 0.05))
+        return True
+
+    def _watch(self) -> None:
+        while not self._stop:
+            time.sleep(self._poll)
+            engine = self._engine
+            if engine is None or engine._failed is None:
+                continue
+            # Gauge flips BEFORE the going-down check: a crash during
+            # a drain must not leave stpu_engine_up stuck at 1 while
+            # /health reports 503.
+            _ENGINE_UP.set(0)
+            if self._draining or self._stop:
+                return      # going down anyway: don't resurrect
+            error = engine._failed
+            fast = (time.monotonic() - self._started_at <
+                    self.fast_failure_seconds)
+            self._consecutive = self._consecutive + 1 if fast else 1
+            events.emit("engine", "decode-engine", "engine_failed",
+                        error=error, consecutive=self._consecutive)
+            if self._consecutive > self.max_restarts:
+                # Deterministic crash loop: stop burning device time.
+                # /health stays 503; the replica manager's probe path
+                # declares the replica FAILED and tears it down.
+                self.permanently_down = True
+                events.emit("engine", "decode-engine", "engine_down",
+                            restarts=self.restarts)
+                return
+            delay = min(self.backoff_base * 2 ** (self._consecutive - 1),
+                        self.backoff_cap)
+            if not self._sleep(delay):
+                return
+            try:
+                new_engine = self._factory().start()
+            except Exception as e:  # noqa: BLE001 — a failing factory
+                # (OOM on cache alloc, device gone) counts as another
+                # fast failure next iteration, not a supervisor crash.
+                events.emit("engine", "decode-engine",
+                            "engine_restart_failed", error=repr(e))
+                self._started_at = time.monotonic()
+                continue
+            with self._lock:
+                # shutdown()/drain() may have landed while the factory
+                # ran (fresh cache alloc can outlast shutdown's join
+                # timeout) — swapping in the new engine then would
+                # leak its loop thread and KV cache on a replica being
+                # torn down, with /health flipping healthy again.
+                if self._stop or self._draining:
+                    abandon = True
+                else:
+                    self._engine = new_engine
+                    abandon = False
+            if abandon:
+                new_engine.shutdown()
+                return
+            self._started_at = time.monotonic()
+            self.restarts += 1
+            _RESTARTS.inc()
+            _ENGINE_UP.set(1)
+            events.emit("engine", "decode-engine", "engine_restarted",
+                        attempt=self._consecutive)

@@ -14,16 +14,20 @@ SSE) is re-chunked to the client with a flush per chunk.
 """
 from __future__ import annotations
 
+import http.client
 import http.server
+import os
+import random
 import socketserver
 import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Set
 
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
+from skypilot_tpu.utils import fault_injection
 
 _HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding",
                 "te", "trailer", "upgrade", "proxy-authorization",
@@ -45,6 +49,139 @@ _STREAMED = metrics.histogram(
     "Response bytes streamed to the client per request.",
     buckets=(256, 1024, 4096, 16384, 65536, 262144, 1048576,
              4194304, 16777216))
+_RETRIES = metrics.counter(
+    "stpu_lb_upstream_retries_total",
+    "Upstream attempts re-routed to another replica after a "
+    "pre-first-byte failure.")
+_BREAKER_STATE = metrics.gauge(
+    "stpu_lb_breaker_state",
+    "Per-replica circuit-breaker state: 0=closed 1=open 2=half-open.",
+    ("replica",))
+_BREAKER_EJECTIONS = metrics.counter(
+    "stpu_lb_breaker_ejections_total",
+    "Replica ejections by the circuit breaker (closed -> open "
+    "transitions).", ("replica",))
+
+# Bounded retry for PRE-first-byte upstream failures (a mid-stream
+# abort is never retried: the status line already went out). Default 2
+# extra attempts, each on a different replica.
+DEFAULT_MAX_RETRIES = int(os.environ.get("STPU_LB_RETRIES", "2"))
+# Reject request bodies above this before buffering them (413): the LB
+# reads the whole body for content-aware routing, so a hostile/buggy
+# client must not be able to OOM the proxy.
+DEFAULT_MAX_BODY_BYTES = int(os.environ.get(
+    "STPU_LB_MAX_BODY_BYTES", str(10 * 1024 * 1024)))
+
+
+class CircuitBreaker:
+    """Per-replica connect-failure ejection, ahead of the controller.
+
+    The controller's probe/sync cycle eventually removes a dead replica
+    from the ready set, but that takes a probe-failure streak plus a
+    sync interval — seconds during which every Nth request eats a
+    connect timeout. The breaker reacts at REQUEST granularity:
+    ``threshold`` consecutive connect-level failures open the circuit
+    (the replica is excluded from selection immediately); after a
+    backoff the circuit turns half-open, letting live traffic probe it
+    — one success closes it, one failure re-opens it with the backoff
+    doubled (capped, jittered so a fleet of LBs doesn't re-probe in
+    lockstep). If EVERY candidate is open, selection fails open and
+    routes anyway: a likely-dead replica beats a guaranteed 502.
+
+    State transitions mirror onto the ``stpu_lb_breaker_state`` gauge
+    (0=closed 1=open 2=half-open) and closed->open edges count into
+    ``stpu_lb_breaker_ejections_total``.
+    """
+
+    def __init__(self, threshold: Optional[int] = None,
+                 backoff_base: Optional[float] = None,
+                 backoff_cap: Optional[float] = None,
+                 jitter: float = 0.25,
+                 seed: Optional[int] = None):
+        self.threshold = threshold if threshold is not None else int(
+            os.environ.get("STPU_LB_BREAKER_THRESHOLD", "3"))
+        self.backoff_base = backoff_base if backoff_base is not None \
+            else float(os.environ.get("STPU_LB_BREAKER_BACKOFF", "2"))
+        self.backoff_cap = backoff_cap if backoff_cap is not None \
+            else float(os.environ.get("STPU_LB_BREAKER_BACKOFF_CAP",
+                                      "60"))
+        self.jitter = float(jitter)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        # url -> [state, consecutive_failures, open_until, backoff]
+        self._replicas: Dict[str, list] = {}
+
+    _CLOSED, _OPEN, _HALF_OPEN = "closed", "open", "half_open"
+    _STATE_VALUE = {_CLOSED: 0, _OPEN: 1, _HALF_OPEN: 2}
+
+    def _set_state(self, url: str, entry: list, state: str) -> None:
+        entry[0] = state
+        _BREAKER_STATE.labels(replica=url).set(self._STATE_VALUE[state])
+
+    def blocked(self, urls: List[str]) -> Set[str]:
+        """The subset of ``urls`` currently ejected. An open circuit
+        whose backoff has expired flips to half-open here (and is NOT
+        blocked): the next request through it is the probe."""
+        now = time.monotonic()
+        out: Set[str] = set()
+        with self._lock:
+            for url in urls:
+                entry = self._replicas.get(url)
+                if entry is None or entry[0] == self._CLOSED:
+                    continue
+                if entry[0] == self._OPEN:
+                    if now < entry[2]:
+                        out.add(url)
+                    else:
+                        self._set_state(url, entry, self._HALF_OPEN)
+        return out
+
+    def record_failure(self, url: str) -> None:
+        """A connect-level failure against ``url``."""
+        with self._lock:
+            entry = self._replicas.setdefault(
+                url, [self._CLOSED, 0, 0.0, self.backoff_base])
+            if entry[0] == self._HALF_OPEN:
+                # Failed probe: re-open with the backoff doubled.
+                entry[3] = min(entry[3] * 2, self.backoff_cap)
+                self._open(url, entry)
+                return
+            entry[1] += 1
+            if entry[0] == self._CLOSED and entry[1] >= self.threshold:
+                _BREAKER_EJECTIONS.labels(replica=url).inc()
+                self._open(url, entry)
+
+    def _open(self, url: str, entry: list) -> None:
+        delay = entry[3] * (1.0 + self.jitter * self._rng.random())
+        entry[2] = time.monotonic() + delay
+        self._set_state(url, entry, self._OPEN)
+
+    def record_success(self, url: str) -> None:
+        """``url`` answered (any HTTP status): close its circuit."""
+        with self._lock:
+            entry = self._replicas.get(url)
+            if entry is None:
+                return
+            entry[1] = 0
+            entry[3] = self.backoff_base
+            if entry[0] != self._CLOSED:
+                self._set_state(url, entry, self._CLOSED)
+
+    def state(self, url: str) -> str:
+        with self._lock:
+            entry = self._replicas.get(url)
+            return entry[0] if entry is not None else self._CLOSED
+
+    def prune(self, urls: List[str]) -> None:
+        """Forget replicas no longer in the ready set (their gauge
+        series reads closed so a torn-down replica doesn't linger as
+        'open' on dashboards forever)."""
+        keep = set(urls)
+        with self._lock:
+            for url in list(self._replicas):
+                if url not in keep:
+                    del self._replicas[url]
+                    _BREAKER_STATE.labels(replica=url).set(0)
 
 
 def write_chunk(wfile, data: bytes) -> None:
@@ -60,6 +197,29 @@ def end_chunks(wfile) -> None:
     """Chunked-transfer terminator."""
     wfile.write(b"0\r\n\r\n")
     wfile.flush()
+
+
+def _is_timeout(exc: BaseException) -> bool:
+    """True if ``exc`` is a timeout, however urllib wrapped it (the
+    exception itself, its URLError .reason, or its __cause__ chain).
+    Shared by every breaker-charging branch so mid-stream and
+    pre-first-byte failures can never diverge on what 'slow' means."""
+    seen = 0
+    while exc is not None and seen < 4:
+        if isinstance(exc, TimeoutError):
+            return True
+        exc = getattr(exc, "reason", None) or exc.__cause__
+        seen += 1
+    return False
+
+
+class _UpstreamAborted(Exception):
+    """Mid-stream failure attributable to the REPLICA (the upstream
+    read died), as opposed to the client hanging up (a write-side
+    error). The distinction matters to the circuit breaker: a replica
+    that accepts connections and dies mid-generation must accumulate
+    failures, while a client closing its SSE tab must not be charged
+    to the replica."""
 
 
 class RequestRecorder:
@@ -90,6 +250,12 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     policy: LoadBalancingPolicy = None  # set by make_handler
     recorder: RequestRecorder = None
+    # Per-replica circuit breaker (None disables: a bare handler
+    # subclass behaves as before). Shared at class level — one breaker
+    # per LB server, like the policy.
+    breaker: Optional[CircuitBreaker] = None
+    max_retries: int = DEFAULT_MAX_RETRIES
+    max_body_bytes: int = DEFAULT_MAX_BODY_BYTES
     # Per-service upstream (replica) timeout; the sync loop overwrites
     # this from the controller's spec (service_spec.py
     # upstream_timeout_seconds) so slow-first-byte services (cold model
@@ -146,7 +312,7 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                         url.rstrip("/") + "/metrics",
                         timeout=timeout) as resp:
                     docs[i] = resp.read().decode("utf-8", "replace")
-            except Exception:  # noqa: BLE001 — best-effort scrape
+            except Exception:  # noqa: stpu-except — best-effort scrape; an unreachable replica just contributes no doc
                 pass
 
         threads = [threading.Thread(target=fetch, args=(i, u),
@@ -175,31 +341,83 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                 time.perf_counter() - t0)
             _STREAMED.observe(stats["bytes"])
 
+    def _send_plain(self, code: int, payload: bytes,
+                    stats: Dict[str, int]) -> None:
+        self.send_response(code)
+        stats["code"] = code
+        if self.close_connection:
+            # Tell the client too (413 leaves the body unread, so the
+            # connection cannot be reused) — not just the server loop.
+            self.send_header("Connection", "close")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+        stats["bytes"] += len(payload)
+
+    def _pick_replica(self, request: dict,
+                      tried: Set[str]) -> Optional[str]:
+        """Policy selection with breaker-ejected replicas excluded.
+        Fails OPEN when every untried replica is ejected: routing to a
+        likely-dead replica beats a guaranteed 502."""
+        if self.breaker is None:
+            return self.policy.select_replica(request, exclude=tried)
+        blocked = self.breaker.blocked(self._replica_urls())
+        target = self.policy.select_replica(request,
+                                            exclude=tried | blocked)
+        if target is None and blocked - tried:
+            target = self.policy.select_replica(request, exclude=tried)
+        return target
+
     def _proxy_inner(self, method: str, stats: Dict[str, int]) -> None:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length > self.max_body_bytes:
+            # Refuse BEFORE buffering: the content-aware-routing body
+            # read below would otherwise hold the whole payload in LB
+            # memory per in-flight request. The unread body makes the
+            # connection unusable for keep-alive — drop it.
+            self.close_connection = True
+            self._send_plain(413, b"Request body too large.\n", stats)
+            return
         # Body read BEFORE replica selection: content-aware policies
         # (prefix affinity) route on the request payload.
-        length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else None
-        target = self.policy.select_replica(
-            {"path": self.path, "body": body})
-        if target is None:
-            self.send_response(503)
-            stats["code"] = 503
-            payload = b"No ready replicas.\n"
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
-            return
-        try:
-            self._proxy_to(target, method, body, stats)
-        finally:
-            # Return the in-flight slot on every exit path (clean,
-            # HTTP error, aborted stream) — least-loaded accounting
-            # must not leak slots or a replica reads as busy forever.
-            self.policy.report_done(target)
+        request = {"path": self.path, "body": body}
+        tried: Set[str] = set()
+        attempts = 1 + max(self.max_retries, 0)
+        for attempt in range(attempts):
+            target = self._pick_replica(request, tried)
+            if target is None:
+                break
+            if attempt:
+                _RETRIES.inc()
+            tried.add(target)
+            # A retry only helps if another replica is left to try.
+            can_retry = (attempt < attempts - 1 and
+                         any(u not in tried
+                             for u in self._replica_urls()))
+            try:
+                retry = self._proxy_to(target, method, body, stats,
+                                       can_retry)
+            finally:
+                # Return the in-flight slot on every exit path (clean,
+                # HTTP error, aborted stream) — least-loaded accounting
+                # must not leak slots or a replica reads as busy
+                # forever.
+                self.policy.report_done(target)
+            if not retry:
+                return
+        if tried:
+            self._send_plain(502, b"Replica unreachable.\n", stats)
+        else:
+            self._send_plain(503, b"No ready replicas.\n", stats)
 
     def _proxy_to(self, target: str, method: str,
-                  body: Optional[bytes], stats: Dict[str, int]) -> None:
+                  body: Optional[bytes], stats: Dict[str, int],
+                  can_retry: bool = False) -> bool:
+        """One upstream attempt. Returns True iff the attempt failed
+        BEFORE the first response byte reached the client and the
+        caller should retry on another replica; in every other case the
+        response (success or error) has been sent."""
         url = target.rstrip("/") + self.path
         headers = {k: v for k, v in self.headers.items()
                    if k.lower() not in _HOP_HEADERS}
@@ -207,35 +425,86 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                                      method=method)
         started: List[bool] = []
         try:
+            if fault_injection.ENABLED:
+                fault_injection.fire("lb.upstream", url=url)
             with urllib.request.urlopen(
                     req, timeout=self.upstream_timeout) as resp:
                 stats["code"] = resp.status
                 self._stream_response(resp, started, stats)
+            # Success recorded only after the WHOLE stream proxied:
+            # recording at first byte would reset the consecutive count
+            # right before a mid-stream failure increments it, so an
+            # accept-then-die replica could never trip the breaker.
+            if self.breaker is not None:
+                self.breaker.record_success(target)
+            return False
         except urllib.error.HTTPError as e:
             payload = e.read()
+            # The replica ANSWERED — connect-wise it is healthy.
+            if self.breaker is not None:
+                self.breaker.record_success(target)
+            if e.code == 503 and can_retry:
+                # 503 is the one status that means "this replica can't
+                # take the request right now" (draining engine, warming
+                # model) while a peer can — and nothing was processed,
+                # so re-routing is safe. Other statuses pass through.
+                return True
             self.send_response(e.code)
             stats["code"] = e.code
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)
             stats["bytes"] += len(payload)
+            return False
+        except _UpstreamAborted as e:
+            # The REPLICA died mid-stream (upstream read failed —
+            # http.client.IncompleteRead on a truncated body, reset,
+            # etc). The response line already went out: a second
+            # response would corrupt the byte stream, so drop the
+            # connection — the truncated body is the one honest signal
+            # left — and charge the replica's breaker (unless it was a
+            # read timeout: slow ≠ dead, see below).
+            stats["aborted"] = True
+            self.close_connection = True
+            if self.breaker is not None and not _is_timeout(e):
+                self.breaker.record_failure(target)
+            return False
         except (urllib.error.URLError, ConnectionError, OSError,
-                TimeoutError):
+                TimeoutError, http.client.HTTPException) as e:
             if started:
-                # The response line/body already went out: a second
-                # response here would corrupt the byte stream. Drop the
-                # connection — the client sees a truncated body, the
-                # one honest signal left.
+                # Upstream reads are wrapped in _UpstreamAborted, so a
+                # raw failure after `started` is the CLIENT side dying
+                # (BrokenPipe on our wfile). Abort the proxying but do
+                # NOT charge the replica — a closed SSE tab is not a
+                # replica failure.
                 stats["aborted"] = True
                 self.close_connection = True
-                return
-            self.send_response(502)
-            stats["code"] = 502
-            payload = b"Replica unreachable.\n"
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
-            stats["bytes"] += len(payload)
+                return False
+            # Pre-first-byte failure. Timeouts feed the RETRY but not
+            # the BREAKER: a replica whose first byte is slow under
+            # load is very likely alive (cold compile, long prompt) —
+            # three-striking it would eject healthy-slow replicas and
+            # concentrate load on the rest, the breaker-cascade
+            # failure mode. Dead replicas still eject via connect
+            # refused/reset, and truly wedged ones fall to the
+            # controller's probe path.
+            if self.breaker is not None and not _is_timeout(e):
+                self.breaker.record_failure(target)
+            if can_retry:
+                return True
+            self._send_plain(502, b"Replica unreachable.\n", stats)
+            return False
+
+    @staticmethod
+    def _read1(resp) -> bytes:
+        """Upstream read, with failures re-raised as _UpstreamAborted
+        so the caller can tell a dying REPLICA (this) from a dying
+        CLIENT (raw write-side errors)."""
+        try:
+            return resp.read1(65536)
+        except (urllib.error.URLError, ConnectionError, OSError,
+                TimeoutError, http.client.HTTPException) as e:
+            raise _UpstreamAborted() from e
 
     def _stream_response(self, resp, started: List[bool],
                          stats: Dict[str, int]) -> None:
@@ -253,7 +522,7 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
             self.send_header("Content-Length", clen)
             self.end_headers()
             while True:
-                chunk = resp.read1(65536)
+                chunk = self._read1(resp)
                 if not chunk:
                     break
                 self.wfile.write(chunk)
@@ -265,7 +534,7 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
             while True:
-                chunk = resp.read1(65536)
+                chunk = self._read1(resp)
                 if not chunk:
                     break
                 write_chunk(self.wfile, chunk)
@@ -301,8 +570,10 @@ def run_load_balancer(port: int, policy: LoadBalancingPolicy,
     """Start the LB server on a daemon thread; returns the server (call
     .shutdown() to stop)."""
     handler = type("Handler", (_ProxyHandler,),
-                   {"policy": policy, "recorder": recorder})
+                   {"policy": policy, "recorder": recorder,
+                    "breaker": CircuitBreaker()})
     server = _ThreadingHTTPServer(("0.0.0.0", port), handler)
+    server.breaker = handler.breaker  # visible for tests/introspection
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     if ready_event is not None:
@@ -331,15 +602,15 @@ def run_lb_process(port: int, controller_url: str,
     round_robin.
     """
     import json
-    import os
-    import urllib.request
 
     from skypilot_tpu.serve.load_balancing_policies import make_policy
     policy = make_policy(policy_name
                          or os.environ.get("STPU_LB_POLICY"))
     recorder = RequestRecorder()
+    breaker = CircuitBreaker()
     handler_cls = type("Handler", (_ProxyHandler,),
-                       {"policy": policy, "recorder": recorder})
+                       {"policy": policy, "recorder": recorder,
+                        "breaker": breaker})
     server = _ThreadingHTTPServer(("0.0.0.0", port), handler_cls)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     while True:
@@ -347,6 +618,9 @@ def run_lb_process(port: int, controller_url: str,
         # controller has one, not one interval late.
         drained = recorder.drain()
         try:
+            if fault_injection.ENABLED:
+                fault_injection.fire("controller.sync",
+                                     controller=controller_url)
             req = urllib.request.Request(
                 controller_url.rstrip("/") + "/sync",
                 data=json.dumps(
@@ -355,7 +629,11 @@ def run_lb_process(port: int, controller_url: str,
                 method="POST")
             with urllib.request.urlopen(req, timeout=5) as resp:
                 payload = json.loads(resp.read())
-            policy.set_ready_replicas(payload.get("ready_urls", []))
+            ready_urls = payload.get("ready_urls", [])
+            policy.set_ready_replicas(ready_urls)
+            # A replica the controller removed must not linger in the
+            # breaker as a stuck-open series.
+            breaker.prune(ready_urls)
             handler_cls.upstream_timeout = float(
                 payload.get("upstream_timeout", 120.0))
             # Controller-process metrics snapshot (autoscaler decisions,

@@ -9,8 +9,12 @@ property the replica cannot provide for itself.
 
 ``select_replica`` takes an optional request dict ({"path", "body"})
 so content-aware policies can route on the payload; stateless policies
-ignore it. ``report_done`` lets the LB return the in-flight slot after
-the response completes (least-loaded accounting).
+ignore it. ``exclude`` names replicas the caller has ruled out for
+this attempt (already failed this request, or breaker-ejected) — the
+LB's retry path re-invokes the policy with the failed target excluded
+so the second attempt lands elsewhere. ``report_done`` lets the LB
+return the in-flight slot after the response completes (least-loaded
+accounting).
 """
 from __future__ import annotations
 
@@ -19,14 +23,15 @@ import hashlib
 import itertools
 import json
 import threading
-from typing import Dict, List, Optional
+from typing import Collection, Dict, List, Optional
 
 
 class LoadBalancingPolicy:
     def set_ready_replicas(self, urls: List[str]) -> None:
         raise NotImplementedError
 
-    def select_replica(self, request: Optional[dict] = None
+    def select_replica(self, request: Optional[dict] = None,
+                       exclude: Optional[Collection[str]] = None
                        ) -> Optional[str]:
         raise NotImplementedError
 
@@ -51,13 +56,20 @@ class RoundRobinPolicy(LoadBalancingPolicy):
                 self._urls = list(urls)
                 self._cycle = itertools.cycle(self._urls)
 
-    def select_replica(self, request: Optional[dict] = None
+    def select_replica(self, request: Optional[dict] = None,
+                       exclude: Optional[Collection[str]] = None
                        ) -> Optional[str]:
         del request
+        excl = exclude or ()
         with self._lock:
             if not self._urls:
                 return None
-            return next(self._cycle)
+            # One full rotation at most: everything excluded -> None.
+            for _ in range(len(self._urls)):
+                url = next(self._cycle)
+                if url not in excl:
+                    return url
+            return None
 
     def ready_replicas(self) -> List[str]:
         with self._lock:
@@ -132,41 +144,48 @@ class PrefixAffinityPolicy(LoadBalancingPolicy):
             return None
         return json.dumps(head).encode()
 
-    def select_replica(self, request: Optional[dict] = None
+    def select_replica(self, request: Optional[dict] = None,
+                       exclude: Optional[Collection[str]] = None
                        ) -> Optional[str]:
         key = self._affinity_key(request)
+        excl = frozenset(exclude or ())
         with self._lock:
-            if not self._urls:
+            candidates = [u for u in self._urls if u not in excl]
+            if not candidates:
                 return None
             if key is None:
-                url = min(self._urls,
+                url = min(candidates,
                           key=lambda u: self._inflight.get(u, 0))
             else:
-                url = self._bounded_ring_walk(key)
+                url = self._bounded_ring_walk(key, excl)
             self._inflight[url] = self._inflight.get(url, 0) + 1
             return url
 
-    def _bounded_ring_walk(self, key: bytes) -> str:
+    def _bounded_ring_walk(self, key: bytes,
+                           excl: frozenset = frozenset()) -> str:
         """Ring owner for ``key``, spilling to successors while the
-        candidate is over the bounded-load threshold. Deterministic:
-        the same key under the same load always spills to the same
-        successor, so the spill target warms too."""
+        candidate is over the bounded-load threshold (or excluded by
+        the caller — a failed/ejected owner spills exactly like a
+        saturated one, so retries keep deterministic affinity).
+        Deterministic: the same key under the same load always spills
+        to the same successor, so the spill target warms too."""
+        live = sum(1 for u in self._urls if u not in excl)
         bound = max(2.0, self.LOAD_FACTOR *
                     (sum(self._inflight.values()) + 1) /
-                    len(self._urls))
+                    max(live, 1))
         idx = bisect.bisect_left(self._ring, (self._hash(key), ""))
         seen = set()
         fallback = None
         for step in range(len(self._ring)):
             url = self._ring[(idx + step) % len(self._ring)][1]
-            if url in seen:
+            if url in excl or url in seen:
                 continue
             if fallback is None:
                 fallback = url                 # the true owner
             if self._inflight.get(url, 0) < bound:
                 return url
             seen.add(url)
-            if len(seen) == len(self._urls):
+            if len(seen) == live:
                 break
         return fallback                        # everyone saturated
 

@@ -26,6 +26,7 @@ from skypilot_tpu.observability import metrics
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
+from skypilot_tpu.utils import fault_injection
 
 _LAUNCHES = metrics.counter(
     "stpu_serve_replica_launches_total",
@@ -33,11 +34,21 @@ _LAUNCHES = metrics.counter(
 _PREEMPTIONS = metrics.counter(
     "stpu_serve_preemptions_total",
     "Replicas lost to provider preemption.", ("service",))
+_DRAINS = metrics.counter(
+    "stpu_serve_replica_drains_total",
+    "Replica drains by outcome "
+    "(complete/timeout/unsupported/aborted).",
+    ("service", "outcome"))
 
 PROBE_TIMEOUT_SECONDS = 4
 # Probe failures tolerated after a replica has been READY before it is
 # declared NOT_READY / checked for preemption.
 _MAX_CONSECUTIVE_FAILURES = 3
+# Consecutive probe SUCCESSES required to re-admit a replica that has
+# failed a probe (NOT_READY -> READY). Mirror of the failure threshold:
+# one lucky probe must not bounce an oscillating replica back into the
+# LB rotation only to eject it again two ticks later (anti-flap).
+_READMIT_SUCCESSES = 2
 
 # Env var handed to every replica so its server knows which port to bind.
 REPLICA_PORT_ENV = "SKYPILOT_SERVE_REPLICA_PORT"
@@ -70,6 +81,8 @@ class ReplicaInfo:
         self.launched_at = time.time()
         self.first_ready_at: Optional[float] = None
         self.consecutive_failures = 0
+        # Probe successes since the last failure (anti-flap gate).
+        self.consecutive_successes = 0
         # Last status written to the lifecycle event log (so _persist
         # emits one event per TRANSITION, not one per probe tick).
         self.last_event_status: Optional[ReplicaStatus] = None
@@ -92,6 +105,9 @@ class SkyPilotReplicaManager:
         # deterministically-broken task can't launch clusters forever.
         self.consecutive_failure_count = 0
         self._threads: List[threading.Thread] = []
+        # Set by shutdown_all: in-progress drains cut short — `serve
+        # down` must not wait out per-replica drain deadlines.
+        self._shutting_down = False
         self.backend = slice_backend.SliceBackend()
         self._recover_replicas()
 
@@ -151,6 +167,7 @@ class SkyPilotReplicaManager:
             # fresh initial-delay grace.
             info.launched_at = row["launched_at"]
             if url and status not in (ReplicaStatus.SHUTTING_DOWN,
+                                      ReplicaStatus.DRAINING,
                                       ReplicaStatus.PREEMPTED):
                 # Live (or at least probe-able) replica: adopt as
                 # STARTING — the probe loop promotes it back to READY
@@ -161,12 +178,18 @@ class SkyPilotReplicaManager:
                 self._persist(info)
             else:
                 # Died mid-launch, or mid-teardown (SHUTTING_DOWN /
-                # PREEMPTED husk the crash interrupted): finish the job
-                # through the normal teardown path — just deleting the
-                # row would leak a half-dead, still-billing cluster.
+                # DRAINING / PREEMPTED husk the crash interrupted):
+                # finish the job through the normal teardown path —
+                # just deleting the row would leak a half-dead,
+                # still-billing cluster. Re-adopting a DRAINING row as
+                # STARTING would be worse: its server's drain flag is
+                # irreversible, so it would probe READY while refusing
+                # every request. Resume its drain wait instead.
                 with self._lock:
                     self.replicas[info.replica_id] = info
-                self.scale_down(info.replica_id)
+                self.scale_down(
+                    info.replica_id,
+                    drain=(status == ReplicaStatus.DRAINING))
 
     # ------------------------------------------------------------ scaling
     def scale_up(self, n: int = 1,
@@ -201,31 +224,54 @@ class SkyPilotReplicaManager:
             self._threads.append(t)
 
     def scale_down(self, replica_id: int, sync: bool = False,
-                   keep_record: bool = False) -> None:
+                   keep_record: bool = False,
+                   drain: Optional[bool] = None) -> None:
         """Terminate a replica's cluster. ``keep_record`` leaves its row
-        (with its terminal status) in serve state for debuggability."""
+        (with its terminal status) in serve state for debuggability.
+
+        ``drain`` (default: auto) waits for the replica's in-flight
+        requests before teardown: the replica goes DRAINING (pulled
+        from the LB ready set on the next publish), its server's
+        /drain endpoint stops new admissions, and termination waits
+        until in-flight hits zero or ``spec.drain_timeout_seconds``
+        passes. Auto-drains only replicas that were READY (serving
+        traffic) — failed/preempted husks have nothing to drain."""
         with self._lock:
             info = self.replicas.get(replica_id)
             if info is None:
                 return
             terminal = info.status in (ReplicaStatus.FAILED,
                                        ReplicaStatus.PREEMPTED)
+            if drain is None:
+                spec = info.spec or self.spec
+                drain = (info.status == ReplicaStatus.READY
+                         and bool(info.url)
+                         and getattr(spec, "drain_timeout_seconds",
+                                     0) > 0)
+            drain = bool(drain) and not terminal and bool(info.url)
             if not (keep_record and terminal):
-                info.status = ReplicaStatus.SHUTTING_DOWN
+                info.status = (ReplicaStatus.DRAINING if drain
+                               else ReplicaStatus.SHUTTING_DOWN)
         self._persist(info)
         t = threading.Thread(target=self._terminate_replica,
-                             args=(info, keep_record), daemon=True)
+                             args=(info, keep_record, drain),
+                             daemon=True)
         t.start()
         self._threads.append(t)
         if sync:
             t.join()
 
     def shutdown_all(self) -> None:
+        # Cut in-progress drains short FIRST: full-service teardown is
+        # an operator action; waiting out N drain deadlines serially
+        # would turn `serve down` into minutes.
+        self._shutting_down = True
         with self._lock:
             ids = [rid for rid, info in self.replicas.items()
-                   if info.status != ReplicaStatus.SHUTTING_DOWN]
+                   if info.status not in (ReplicaStatus.SHUTTING_DOWN,
+                                          ReplicaStatus.DRAINING)]
         for rid in ids:
-            self.scale_down(rid)
+            self.scale_down(rid, drain=False)
         for t in list(self._threads):
             t.join(timeout=60)
 
@@ -290,7 +336,8 @@ class SkyPilotReplicaManager:
         self._persist(info)
 
     def _terminate_replica(self, info: ReplicaInfo,
-                           keep_record: bool = False) -> None:
+                           keep_record: bool = False,
+                           drain: bool = False) -> None:
         # Never tear down under a replica whose launch is still in flight:
         # execution.launch would finish re-creating the cluster after our
         # teardown and leak it (the replica is popped below, so nothing
@@ -299,6 +346,11 @@ class SkyPilotReplicaManager:
         lt = info.launch_thread
         if lt is not None and lt is not threading.current_thread():
             lt.join()
+        if drain and not self._shutting_down:
+            self._drain_replica(info)
+            if info.status == ReplicaStatus.DRAINING:
+                info.status = ReplicaStatus.SHUTTING_DOWN
+                self._persist(info)
         record = global_user_state.get_cluster_from_name(info.cluster_name)
         if record is not None and record["handle"] is not None:
             try:
@@ -311,6 +363,63 @@ class SkyPilotReplicaManager:
             self.replicas.pop(info.replica_id, None)
         if not keep_record:
             serve_state.remove_replica(self.service_name, info.replica_id)
+
+    def _drain_replica(self, info: ReplicaInfo) -> None:
+        """Ask ``info``'s server to stop admitting (POST /drain) and
+        wait for its in-flight count to reach zero, up to the spec's
+        drain deadline. A server without /drain (plain HTTP servers,
+        pre-drain replicas) fails the initial POST and is terminated
+        immediately — exactly the old behavior, so drains degrade to
+        kills instead of stalls."""
+        spec = info.spec or self.spec
+        timeout = float(getattr(spec, "drain_timeout_seconds", 0) or 0)
+        name = f"{self.service_name}/{info.replica_id}"
+        url = (info.url or "").rstrip("/") + "/drain"
+        events.emit("replica", name, "drain_start",
+                    service=self.service_name,
+                    timeout_seconds=timeout)
+        try:
+            req = urllib.request.Request(
+                url, data=b"{}",
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(
+                    req, timeout=PROBE_TIMEOUT_SECONDS) as resp:
+                in_flight = int(json.loads(
+                    resp.read() or b"{}").get("in_flight", 0))
+        except (urllib.error.URLError, ConnectionError, OSError,
+                TimeoutError, ValueError):
+            # 404/501 (no /drain), dead server, or junk reply: nothing
+            # to wait for.
+            _DRAINS.labels(service=self.service_name,
+                           outcome="unsupported").inc()
+            events.emit("replica", name, "drain_unsupported",
+                        service=self.service_name)
+            return
+        deadline = time.monotonic() + timeout
+        while (in_flight > 0 and time.monotonic() < deadline
+               and not self._shutting_down):
+            time.sleep(0.25)
+            try:
+                with urllib.request.urlopen(
+                        url, timeout=PROBE_TIMEOUT_SECONDS) as resp:
+                    in_flight = int(json.loads(
+                        resp.read() or b"{}").get("in_flight", 0))
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    TimeoutError, ValueError):
+                break   # server died mid-drain; the teardown proceeds
+        if in_flight <= 0:
+            outcome = "complete"
+        elif self._shutting_down:
+            # Deliberately cut short by `serve down`, NOT a deadline
+            # miss — counting it as "timeout" would tell operators to
+            # raise drain_timeout_seconds over a teardown.
+            outcome = "aborted"
+        else:
+            outcome = "timeout"
+        _DRAINS.labels(service=self.service_name, outcome=outcome).inc()
+        events.emit("replica", name, f"drain_{outcome}",
+                    service=self.service_name, in_flight=in_flight)
 
     # ------------------------------------------------------------ probing
     def probe_all(self) -> None:
@@ -334,12 +443,22 @@ class SkyPilotReplicaManager:
         if ok:
             info.consecutive_failures = 0
             self.consecutive_failure_count = 0
+            info.consecutive_successes += 1
             if info.first_ready_at is None:
                 info.first_ready_at = time.time()
-            if info.status != ReplicaStatus.SHUTTING_DOWN:
+            if (info.status == ReplicaStatus.NOT_READY and
+                    info.consecutive_successes < _READMIT_SUCCESSES):
+                # Anti-flap: a replica that FAILED a probe needs a
+                # success streak before re-admission — one good probe
+                # from a server oscillating under load must not bounce
+                # it back into the LB rotation.
+                return
+            if info.status not in (ReplicaStatus.SHUTTING_DOWN,
+                                   ReplicaStatus.DRAINING):
                 info.status = ReplicaStatus.READY
             self._persist(info)
             return
+        info.consecutive_successes = 0
         # Not answering. Within the initial grace window this is normal.
         if (info.first_ready_at is None and
                 time.time() - info.launched_at <
@@ -374,6 +493,8 @@ class SkyPilotReplicaManager:
             return False
         full = url.rstrip("/") + spec.readiness_path
         try:
+            if fault_injection.ENABLED:
+                fault_injection.fire("replica.probe", url=full)
             if spec.readiness_post_data is not None:
                 data = json.dumps(spec.readiness_post_data).encode()
                 req = urllib.request.Request(
@@ -412,6 +533,13 @@ class SkyPilotReplicaManager:
     def status_snapshot(self) -> List[ReplicaStatus]:
         with self._lock:
             return [info.status for info in self.replicas.values()]
+
+    def ready_ids(self) -> List[int]:
+        """Replica ids currently READY (the controller's two-phase trim
+        pulls these from the LB one tick before terminating them)."""
+        with self._lock:
+            return [info.replica_id for info in self.replicas.values()
+                    if info.status == ReplicaStatus.READY]
 
     def scale_down_candidates(
             self, spot: Optional[bool] = None) -> List[int]:

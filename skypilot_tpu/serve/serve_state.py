@@ -33,6 +33,11 @@ class ReplicaStatus(enum.Enum):
     STARTING = "STARTING"           # provisioned, not yet probe-ready
     READY = "READY"
     NOT_READY = "NOT_READY"         # probe failing, within grace
+    # On its way out, finishing in-flight requests: excluded from the
+    # LB ready set and from capacity accounting (not alive — the
+    # autoscaler must not count outgoing capacity), terminated once its
+    # server reports zero in-flight or the drain deadline passes.
+    DRAINING = "DRAINING"
     SHUTTING_DOWN = "SHUTTING_DOWN"
     PREEMPTED = "PREEMPTED"
     FAILED = "FAILED"

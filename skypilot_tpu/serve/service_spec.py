@@ -18,6 +18,7 @@ DEFAULT_QPS_WINDOW_SECONDS = 60
 DEFAULT_UPSTREAM_TIMEOUT_SECONDS = 120
 DEFAULT_UPSCALE_DELAY_SECONDS = 300
 DEFAULT_DOWNSCALE_DELAY_SECONDS = 1200
+DEFAULT_DRAIN_TIMEOUT_SECONDS = 120
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,6 +44,12 @@ class SkyServiceSpec:
     # off exactly when replicas run the decode engine's shared-prefix
     # KV cache under shared-system-prompt traffic.
     load_balancing_policy: str = "round_robin"
+    # How long a scale-down/rollover waits for a replica's in-flight
+    # requests to finish (its server's /drain endpoint reporting zero)
+    # before hard-killing it. 0 disables draining (old kill-immediately
+    # behavior). Per-service: the right bound is one worst-case
+    # generation, which is workload-shaped.
+    drain_timeout_seconds: int = DEFAULT_DRAIN_TIMEOUT_SECONDS
 
     @property
     def autoscaling_enabled(self) -> bool:
@@ -82,7 +89,10 @@ class SkyServiceSpec:
                 "upstream_timeout_seconds",
                 DEFAULT_UPSTREAM_TIMEOUT_SECONDS),
             load_balancing_policy=config.get(
-                "load_balancing_policy", "round_robin"))
+                "load_balancing_policy", "round_robin"),
+            drain_timeout_seconds=config.get(
+                "drain_timeout_seconds",
+                DEFAULT_DRAIN_TIMEOUT_SECONDS))
         if policy is not None:
             kwargs.update(
                 min_replicas=policy.get("min_replicas", 1),
@@ -117,6 +127,8 @@ class SkyServiceSpec:
             out["upstream_timeout_seconds"] = self.upstream_timeout_seconds
         if self.load_balancing_policy != "round_robin":
             out["load_balancing_policy"] = self.load_balancing_policy
+        if self.drain_timeout_seconds != DEFAULT_DRAIN_TIMEOUT_SECONDS:
+            out["drain_timeout_seconds"] = self.drain_timeout_seconds
         if (self.autoscaling_enabled or self.max_replicas is not None
                 or self.use_ondemand_fallback):
             policy: Dict[str, Any] = {"min_replicas": self.min_replicas}

@@ -82,6 +82,7 @@ _SERVICE_SCHEMA = {
         },
         "replicas": {"type": "integer"},
         "upstream_timeout_seconds": {"type": "integer"},
+        "drain_timeout_seconds": {"type": "integer"},
         # Keep in sync with serve.load_balancing_policies.POLICIES (the
         # schema layer must not import the serve/jax stack).
         "load_balancing_policy": {
