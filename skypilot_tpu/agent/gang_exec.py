@@ -32,6 +32,7 @@ from skypilot_tpu.agent import constants
 from skypilot_tpu.agent import job_lib
 from skypilot_tpu.observability import events as events_lib
 from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import tracing
 
 GANG_FAILED_RC = constants.GANG_FAILED_RC
 
@@ -67,6 +68,10 @@ def _build_env(spec: Dict, rank: int) -> Dict[str, str]:
         # Simulated slice hosts have no /dev/accel*; the TPU health gate
         # (host_wrapper) only makes sense on real TPU VMs.
         env["STPU_SKIP_HEALTH_PROBE"] = "1"
+    # Traced launch: hand every host the gang span's context (plus the
+    # arming flag) so job-side spans nest under this driver's —
+    # host-to-host propagation through env, like STPU_RUN_ID above.
+    env.update(tracing.child_env())
     env.update(spec.get("envs", {}))
     return env
 
@@ -245,12 +250,23 @@ def run_gang(spec: Dict) -> int:
     # (and its children's, via env inheritance) correlate end to end.
     if spec.get("run_id"):
         os.environ[events_lib.RUN_ID_ENV] = str(spec["run_id"])
+    # Adopt the submitting client's trace context (stamped into the
+    # spec by slice_backend when the client traced the launch): arms
+    # tracing here and parents this driver's span on the client's.
+    tracing.adopt_ctx(spec.get("trace_ctx"))
     job_lib.set_pid(job_id, os.getpid(), home)
     job_lib.set_status(job_id, job_lib.JobStatus.RUNNING, home)
     task_id = spec.get("task_id", str(job_id))
     events_lib.emit("gang", task_id, "start", job_id=job_id,
                     num_hosts=len(spec["hosts"]),
                     cluster=spec.get("cluster_name"))
+    span = tracing.start_span(
+        "gang.run", kind="gang", parent=tracing.from_env(),
+        attrs={"job_id": job_id, "hosts": len(spec["hosts"]),
+               "cluster": spec.get("cluster_name")})
+    # Hosts nest under THIS span (not the client's): _build_env reads
+    # the env context when stamping each host's environment.
+    tracing.set_env_context(span.context())
 
     def abort(detail: str) -> None:
         """A raise-path exit still gets a terminal event + counter —
@@ -260,6 +276,7 @@ def run_gang(spec: Dict) -> int:
         _GANG_RUNS.labels(outcome="error").inc()
         events_lib.emit("gang", task_id, "error", job_id=job_id,
                         detail=detail)
+        span.end(status="error", error=detail)
         metrics.dump_to_file(log_dir / "metrics.prom")
 
     # Gang coordinator (native host-agent core): every host's wrapper
@@ -380,6 +397,10 @@ def run_gang(spec: Dict) -> int:
         _GANG_RUNS.labels(outcome=outcome).inc()
         events_lib.emit("gang", task_id, outcome, job_id=job_id,
                         **fields)
+        # Status stays in the ok/error vocabulary list_traces ranks
+        # by; the gang outcome rides as an attribute.
+        span.end(status="ok" if outcome == "succeeded" else "error",
+                 outcome=outcome, rc=rc, **fields)
         # The driver exits right after this: the .prom dump in the
         # job's log dir is its exposition path (same textfile pattern
         # as the daemon; sync_down/logs pick it up with node logs).

@@ -39,6 +39,7 @@ from skypilot_tpu import global_user_state
 from skypilot_tpu import optimizer as optimizer_lib
 from skypilot_tpu import provision as provision_api
 from skypilot_tpu.observability import events as observability_events
+from skypilot_tpu.observability import tracing as observability_tracing
 from skypilot_tpu.agent import constants as agent_constants
 from skypilot_tpu.agent import job_lib
 from skypilot_tpu.backends import backend as backend_lib
@@ -648,6 +649,12 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
             # re-exports it to every host (STPU_RUN_ID) so job-side
             # events/logs correlate with this CLI call end to end.
             "run_id": observability_events.run_id(),
+            # Trace context of a traced launch (None when tracing is
+            # off): the gang driver adopts it (tracing.adopt_ctx) so
+            # its gang.run span — and every host's env — nests under
+            # the submitting span (e.g. the jobs controller's
+            # jobs.launch). Same host-to-host carrier as run_id.
+            "trace_ctx": observability_tracing.env_context(),
         }
 
     def _execute(self, handle: SliceHandle, task, detach_run,

@@ -180,7 +180,15 @@ def measure_engine_ragged(family: str, slots: int = 8,
     heterogeneous traffic mix actually observes, which per-bucket
     fixed-batch serving cannot reach because it only co-schedules
     same-length prompts.
+
+    With tracing armed (STPU_TRACE=1 / tracing.arm()) every request
+    carries a trace context, so the run measures the engine's ARMED
+    overhead (per-request queue/prefill/decode span records, not
+    per-token work) — comparing the armed and unarmed tok/s is the
+    tracing-overhead acceptance check; unarmed, the tracing cost is
+    one module-flag check per seam.
     """
+    from skypilot_tpu.observability import tracing
     from skypilot_tpu.serve.decode_engine import DecodeEngine
 
     mdl, cfg = build(family, **shape_kw)
@@ -196,12 +204,17 @@ def measure_engine_ragged(family: str, slots: int = 8,
                 for _ in range(rng.randint(8, max_prompt))],
                rng.randint(8, max_tokens))
              for _ in range(n_requests)]
+    span = tracing.start_span("bench.engine_ragged", kind="bench",
+                              attrs={"requests": n_requests})
+    trace_ctx = span.context()  # None unless tracing is armed
     try:
         t0 = time.perf_counter()
-        reqs = [engine.submit(p, max_tokens=mt) for p, mt in specs]
+        reqs = [engine.submit(p, max_tokens=mt, trace=trace_ctx)
+                for p, mt in specs]
         total = sum(len(r.result(timeout=1800.0)) for r in reqs)
         dt = time.perf_counter() - t0
     finally:
+        span.end()
         engine.shutdown()
     return {
         "model": _model_info(family, cfg, params),
@@ -209,6 +222,7 @@ def measure_engine_ragged(family: str, slots: int = 8,
         "requests": n_requests,
         "max_prompt": max_prompt,
         "max_tokens": max_tokens,
+        "traced": trace_ctx is not None,
         "generated_tokens": total,
         "wall_seconds": round(dt, 3),
         "engine_ragged_tok_s": round(total / dt, 1),

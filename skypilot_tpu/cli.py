@@ -215,18 +215,32 @@ def _print_events(events, header: bool = True) -> None:
                    "service transitions) from the observability log.")
 @click.option("--limit", "-n", type=int, default=20,
               help="Max events with --events.")
-def status(clusters, refresh, endpoints, show_events, limit):
+@click.option("--since", default=None,
+              help="With --events: only events newer than a duration "
+                   "ago (30s/5m/2h/1d), a unix timestamp, or a local "
+                   "YYYY-MM-DD[ HH:MM[:SS]] timestamp.")
+def status(clusters, refresh, endpoints, show_events, limit, since):
     """List clusters (with launch age, head IP, and $/hr — reference:
     `sky status` table, sky/cli.py:1571)."""
     from skypilot_tpu import core
+    if since and not show_events:
+        raise click.UsageError("--since requires --events.")
     if show_events:
         if refresh or endpoints:
             raise click.UsageError(
                 "--events cannot be combined with "
                 "--refresh/--endpoints.")
+        since_ts = None
+        if since:
+            from skypilot_tpu.observability import events as events_lib
+            try:
+                since_ts = events_lib.parse_since(since)
+            except ValueError as e:
+                raise click.UsageError(str(e)) from e
         # Filter BEFORE limiting: a busy neighbor's events at the tail
         # of the log must not evict the requested cluster's older ones.
-        recs = core.recent_events(limit=None if clusters else limit)
+        recs = core.recent_events(limit=None if clusters else limit,
+                                  since=since_ts)
         if clusters:
             # Honor the positional filter: keep events whose subject
             # or recorded cluster/service matches a requested name.
@@ -531,6 +545,134 @@ def metrics_cmd(url, service, watch):
         click.clear()
         render_once()
         time_lib.sleep(2.0)
+
+
+@cli.group(name="trace")
+def trace():
+    """Distributed request/launch traces (arm with STPU_TRACE=1).
+
+    Spans are recorded to ~/.stpu/logs/traces.jsonl by every traced
+    process on this host: the serve LB's per-request root span, the
+    replica/decode-engine children it propagates to via X-STPU-Trace,
+    and jobs-controller/gang-driver launch spans."""
+
+
+def _fmt_dur(seconds: float) -> str:
+    if seconds >= 1.0:
+        return f"{seconds:.3f}s"
+    return f"{seconds * 1000:.1f}ms"
+
+
+def _resolve_trace_id(trace_id):
+    """Resolve a (possibly abbreviated) trace id; default newest."""
+    from skypilot_tpu.observability import tracing
+    rows = tracing.list_traces(limit=0)
+    if not rows:
+        raise click.ClickException(
+            "No recorded traces (arm tracing with STPU_TRACE=1).")
+    if trace_id is None:
+        return rows[-1]["trace_id"]
+    matches = [r["trace_id"] for r in rows
+               if r["trace_id"].startswith(trace_id)]
+    if not matches:
+        raise click.ClickException(f"No trace matches {trace_id!r}.")
+    if len(matches) > 1:
+        raise click.ClickException(
+            f"{trace_id!r} is ambiguous ({len(matches)} traces); "
+            "give more characters.")
+    return matches[0]
+
+
+@trace.command(name="list")
+@click.option("--limit", "-n", type=int, default=20,
+              help="Max traces shown (newest last).")
+def trace_list(limit):
+    """List recorded traces, oldest first."""
+    from skypilot_tpu.observability import tracing
+    rows = tracing.list_traces(limit=limit)
+    if not rows:
+        click.echo("No recorded traces (arm tracing with "
+                   "STPU_TRACE=1).")
+        return
+    import time as time_lib
+    fmt = "{:<34} {:<20} {:<20} {:>6} {:>10} {:<6}"
+    click.echo(fmt.format("TRACE_ID", "ROOT", "STARTED", "SPANS",
+                          "DURATION", "STATUS"))
+    for r in rows:
+        stamp = time_lib.strftime("%Y-%m-%d %H:%M:%S",
+                                  time_lib.localtime(r["ts"]))
+        click.echo(fmt.format(r["trace_id"], r["name"][:20], stamp,
+                              r["spans"], _fmt_dur(r["dur"]),
+                              r["status"]))
+
+
+@trace.command(name="show")
+@click.argument("trace_id", required=False)
+@click.option("--events", "show_span_events", is_flag=True,
+              help="Also print span annotations (retries, breaker "
+                   "ejections, policy decisions).")
+def trace_show(trace_id, show_span_events):
+    """Print one trace as an indented span tree with critical-path
+    markers (* = the chain of spans bounding end-to-end latency).
+    TRACE_ID may be abbreviated; defaults to the newest trace."""
+    from skypilot_tpu.observability import tracing
+    tid = _resolve_trace_id(trace_id)
+    roots = tracing.assemble(tid)
+    if not roots:
+        raise click.ClickException(f"Trace {tid} has no spans.")
+    n_spans = sum(1 for _ in _walk_spans(roots))
+    click.echo(f"trace {tid} ({len(roots)} root(s), {n_spans} spans)")
+    for root in roots:
+        critical = set(tracing.critical_path(root))
+        _print_span_tree(root, "", critical, show_span_events)
+
+
+def _walk_spans(nodes):
+    for node in nodes:
+        yield node
+        yield from _walk_spans(node["children"])
+
+
+def _print_span_tree(node, indent, critical, show_span_events):
+    span = node["span"]
+    mark = " *" if span["span_id"] in critical else ""
+    status = span.get("status", "ok")
+    extra = "" if status == "ok" else f" [{status}]"
+    click.echo(f"{indent}{span.get('name', '?'):<28} "
+               f"{_fmt_dur(span.get('dur', 0)):>10}{extra}{mark}")
+    if show_span_events:
+        for ev in span.get("events") or []:
+            detail = " ".join(f"{k}={v}" for k, v in sorted(ev.items())
+                              if k not in ("name", "at"))
+            click.echo(f"{indent}  · {ev.get('name', '?')} "
+                       f"@{_fmt_dur(ev.get('at', 0))} {detail}")
+    for child in node["children"]:
+        _print_span_tree(child, indent + "  ", critical,
+                         show_span_events)
+
+
+@trace.command(name="export")
+@click.argument("trace_id", required=False)
+@click.option("--perfetto", is_flag=True, required=True,
+              help="Chrome trace-event JSON, loadable in "
+                   "ui.perfetto.dev / chrome://tracing.")
+@click.option("--output", "-o", default="-",
+              help="Output file (default stdout).")
+def trace_export(trace_id, perfetto, output):
+    """Export one trace (abbreviated TRACE_ID ok; default newest)."""
+    del perfetto  # the only format; the flag documents the contract
+    from skypilot_tpu.observability import tracing
+    import json as json_lib
+    tid = _resolve_trace_id(trace_id)
+    doc = tracing.to_perfetto(tracing.read(trace_id=tid))
+    text = json_lib.dumps(doc, indent=1, default=str)
+    if output == "-":
+        click.echo(text)
+    else:
+        with open(output, "w") as f:
+            f.write(text)
+        click.echo(f"Wrote {len(doc['traceEvents'])} events to "
+                   f"{output}.")
 
 
 @cli.group()

@@ -218,11 +218,15 @@ def cost_report() -> List[Dict[str, Any]]:
 
 def recent_events(kind: Optional[str] = None,
                   name: Optional[str] = None,
-                  limit: int = 50) -> List[Dict[str, Any]]:
+                  limit: int = 50,
+                  since: Optional[float] = None
+                  ) -> List[Dict[str, Any]]:
     """Recent lifecycle events from the local observability log
-    (cluster/job/replica/service transitions; `stpu status --events`)."""
+    (cluster/job/replica/service transitions; `stpu status --events`).
+    ``since`` is a wall-clock threshold in unix seconds
+    (events.parse_since turns `--since 5m`-style CLI input into one)."""
     from skypilot_tpu.observability import events
-    return events.read(kind=kind, name=name, limit=limit)
+    return events.read(kind=kind, name=name, limit=limit, since=since)
 
 
 def metrics_snapshot(url: Optional[str] = None) -> str:

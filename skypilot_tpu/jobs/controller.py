@@ -30,6 +30,7 @@ from skypilot_tpu.jobs import recovery_strategy
 from skypilot_tpu.jobs import state as jobs_state
 from skypilot_tpu.jobs.state import ManagedJobStatus
 from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.utils import dag_utils
 
 _RECOVERIES = metrics.counter(
@@ -145,19 +146,43 @@ class JobsController:
         strategy = recovery_strategy.StrategyExecutor.make(
             cluster_name, task, retry_gap_seconds=min(
                 _poll_seconds(), recovery_strategy.RETRY_INIT_GAP_SECONDS))
+        # Launch-side trace: one span per managed-job task, parented on
+        # whatever the submitting environment carried (STPU_TRACE_CTX —
+        # the STPU_RUN_ID pattern); exported to the env so the gang
+        # driver (and every host it spawns) nests under it. The
+        # submitter's context is RESTORED afterwards: pipeline tasks in
+        # this one controller process must parent as siblings on the
+        # submitter, not chain-nest under each other's ended spans.
+        prev_ctx = os.environ.get(tracing.ENV_CTX)
+        span = tracing.start_span(
+            "jobs.task", kind="jobs",
+            parent=tracing.parse_ctx(prev_ctx),
+            attrs={"job_id": self.job_id, "task_index": task_index,
+                   "cluster": cluster_name})
+        tracing.set_env_context(span.context())
+        status = "error"
         try:
             jobs_state.set_status(self.job_id, ManagedJobStatus.STARTING)
-            cluster_job_id = strategy.launch()
+            with tracing.start_span("jobs.launch", kind="jobs",
+                                    parent=span,
+                                    attrs={"cluster": cluster_name}):
+                cluster_job_id = strategy.launch()
             jobs_state.set_status(self.job_id, ManagedJobStatus.RUNNING)
-            self._watch(strategy, cluster_name, cluster_job_id)
+            self._watch(strategy, cluster_name, cluster_job_id, span)
+            status = "ok"
         finally:
+            span.end(status=status)
+            if prev_ctx is None:
+                os.environ.pop(tracing.ENV_CTX, None)
+            else:
+                os.environ[tracing.ENV_CTX] = prev_ctx
             # Task done (or cancelled/failed/launch half-succeeded): the
             # task cluster must not outlive its managed job (reference:
             # controller.py cleanup).
             self._teardown_cluster(cluster_name)
 
     def _watch(self, strategy, cluster_name: str,
-               cluster_job_id: Optional[int]) -> None:
+               cluster_job_id: Optional[int], span=None) -> None:
         """Poll until SUCCEEDED; recover on preemption; raise on failure."""
         missing_count = 0
         while True:
@@ -197,7 +222,11 @@ class JobsController:
             if not healthy:
                 _PREEMPTIONS.inc()
             t0 = time.perf_counter()
-            cluster_job_id = strategy.recover()
+            with tracing.start_span(
+                    "jobs.recover", kind="jobs", parent=span,
+                    attrs={"cluster": cluster_name,
+                           "preempted": not healthy}):
+                cluster_job_id = strategy.recover()
             _RECOVERY_SECONDS.observe(time.perf_counter() - t0)
             jobs_state.set_status(self.job_id, ManagedJobStatus.RUNNING)
             missing_count = 0

@@ -9,12 +9,18 @@ Two stdlib-only primitives every long-running stpu process shares:
 * ``events`` — an append-only JSONL lifecycle log (cluster/job/replica
   state transitions) stamped with wall + monotonic time and a run ID
   that propagates CLI -> gang driver -> job environment.
+* ``tracing`` — per-request/per-launch distributed spans (trace_id /
+  span_id / parent) reassembled into causal trees by ``stpu trace``;
+  context propagates LB -> replica via the ``X-STPU-Trace`` header and
+  host-to-host via ``STPU_TRACE_CTX`` (the run-ID pattern). Off by
+  default; hot paths guard on ``tracing.ENABLED``.
 
-Neither may ever break the instrumented call: all I/O failures are
+None may ever break the instrumented call: all I/O failures are
 swallowed, and recording is lock-free on hot paths except for the
 single child-update lock held for the increment itself.
 """
 from skypilot_tpu.observability import events
 from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import tracing
 
-__all__ = ["events", "metrics"]
+__all__ = ["events", "metrics", "tracing"]

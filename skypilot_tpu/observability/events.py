@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 import uuid
@@ -60,15 +61,6 @@ def log_path() -> "os.PathLike[str]":
     return paths.logs_dir() / "events.jsonl"
 
 
-def _rotate_if_needed(path) -> None:
-    try:
-        if path.stat().st_size < _MAX_BYTES:
-            return
-        os.replace(path, str(path) + ".1")
-    except OSError:
-        pass
-
-
 def emit(kind: str, name: str, event: str, **fields: Any) -> None:
     """Append one lifecycle record. Never raises."""
     if not _enabled():
@@ -86,26 +78,56 @@ def emit(kind: str, name: str, event: str, **fields: Any) -> None:
         line = json.dumps(record, default=str)
     except (TypeError, ValueError):
         return
+    from skypilot_tpu.observability import jsonl_log
     try:
         path = log_path()
-        with _lock:
-            _rotate_if_needed(path)
-            with open(path, "a") as f:
-                f.write(line + "\n")
     except OSError:
+        return
+    jsonl_log.append_line(path, line, _MAX_BYTES, _lock)
+
+
+_SINCE_RE = re.compile(r"(\d+(?:\.\d+)?)([smhd])")
+
+
+def parse_since(value: str) -> float:
+    """Parse a ``--since`` window into a wall-clock threshold (unix
+    seconds). Accepts a relative duration (``30s``/``5m``/``2h``/
+    ``1d`` ago), raw unix seconds, or a local timestamp
+    (``YYYY-MM-DD[ HH:MM[:SS]]``, ``T`` separator accepted)."""
+    value = str(value).strip()
+    m = _SINCE_RE.fullmatch(value)
+    if m:
+        mult = {"s": 1, "m": 60, "h": 3600, "d": 86400}[m.group(2)]
+        # Threshold compared against persisted wall stamps.
+        return time.time() - float(m.group(1)) * mult  # wallclock: intentional
+    try:
+        return float(value)
+    except ValueError:
         pass
+    for fmt in ("%Y-%m-%d %H:%M:%S", "%Y-%m-%dT%H:%M:%S",
+                "%Y-%m-%d %H:%M", "%Y-%m-%dT%H:%M", "%Y-%m-%d"):
+        try:
+            return time.mktime(time.strptime(value, fmt))
+        except ValueError:
+            continue
+    raise ValueError(
+        f"unparseable --since value {value!r}: want a duration "
+        "(30s/5m/2h/1d), unix seconds, or YYYY-MM-DD[ HH:MM[:SS]]")
 
 
 def read(kind: Optional[str] = None, name: Optional[str] = None,
          limit: Optional[int] = 50,
          path: Optional[str] = None,
-         max_bytes: Optional[int] = None) -> List[Dict[str, Any]]:
+         max_bytes: Optional[int] = None,
+         since: Optional[float] = None) -> List[Dict[str, Any]]:
     """Most-recent-last matching records (garbage lines skipped — a
     crash mid-append leaves at most one truncated line).
 
     ``max_bytes`` tails only the newest that many bytes of the current
     generation (skipping the rotated one) — for hot callers that only
-    want recent records and must not pay a full multi-MB parse."""
+    want recent records and must not pay a full multi-MB parse.
+    ``since`` keeps only records whose wall stamp is at or after that
+    unix-seconds threshold (see parse_since for the CLI grammar)."""
     target = path or log_path()
     out: List[Dict[str, Any]] = []
     # Include the rotated generation so a read right after rotation
@@ -136,6 +158,8 @@ def read(kind: Optional[str] = None, name: Optional[str] = None,
             if kind is not None and rec.get("kind") != kind:
                 continue
             if name is not None and rec.get("name") != name:
+                continue
+            if since is not None and rec.get("ts", 0) < since:
                 continue
             out.append(rec)
     if limit is not None:

@@ -29,6 +29,7 @@ import functools
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import jax
@@ -36,6 +37,7 @@ import jax.numpy as jnp
 
 from skypilot_tpu.models import gemma, llama, mixtral, model_api
 from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import decode_engine
 from skypilot_tpu.serve import load_balancing_policies
 from skypilot_tpu.train import distributed
@@ -258,18 +260,34 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(400, {"error": str(e)})
             return
         engine = ctx.get("engine")
+        # Replica hop of the request's trace, continued from the LB's
+        # X-STPU-Trace header (tracing.ENABLED guard = zero tracing
+        # cost unarmed); the engine parents its queue/prefill/decode
+        # spans under this one via the submit trace context.
+        span = None
+        if tracing.ENABLED:
+            span = tracing.start_span(
+                "replica.generate", kind="replica",
+                parent=tracing.extract(self.headers),
+                attrs={"prompt_tokens": len(prompt), "max_tokens": mt,
+                       "stream": stream,
+                       "engine": engine is not None})
         # Legacy-path in-flight accounting (the engine tracks its own):
         # GET /drain must see requests this handler is still streaming.
         with ctx["inflight_lock"]:
             ctx["inflight"][0] += 1
+        status = "error"
         try:
             if engine is not None:
                 self._engine_generate(engine, prompt, mt, temperature,
-                                      seed, stream)
+                                      seed, stream, span)
             else:
                 self._legacy_generate(ctx, prompt, mt, temperature,
-                                      seed, stream)
+                                      seed, stream, span)
+            status = "ok"
         except decode_engine.EngineError as e:
+            if span is not None:
+                span.event("engine_error", error=str(e))
             self._json(503, {"error": str(e)})
         except (KeyError, ValueError, TypeError) as e:
             self._json(400, {"error": str(e)})
@@ -282,12 +300,15 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             with ctx["inflight_lock"]:
                 ctx["inflight"][0] -= 1
+            if span is not None:
+                span.end(status=status)
 
     # ----------------------------------------------------- engine path
     def _engine_generate(self, engine, prompt, mt, temperature, seed,
-                         stream) -> None:
-        req = engine.submit(prompt, max_tokens=mt,
-                            temperature=temperature, seed=seed)
+                         stream, span=None) -> None:
+        req = engine.submit(
+            prompt, max_tokens=mt, temperature=temperature, seed=seed,
+            trace=span.context() if span is not None else None)
         timeout = self.server_ctx["stream_timeout"]
         if not stream:
             self._json(200, {"tokens": req.result(timeout=timeout)})
@@ -299,16 +320,22 @@ class _Handler(BaseHTTPRequestHandler):
             # a corrupted half-stream.
             first = next(it)
         except decode_engine.EngineError as e:
+            if span is not None:
+                # end() here (idempotent — do_POST's finally no-ops)
+                # so a 503'd stream records error like the non-stream
+                # path, not a healthy-looking hop.
+                span.event("engine_error", error=str(e))
+                span.end(status="error")
             self._json(503, {"error": str(e)})
             return
         except StopIteration:
             self._json(200, {"tokens": []})
             return
-        self._sse(req, [first], it)
+        self._sse(req, [first], it, span)
 
     # ----------------------------------------------------- legacy path
     def _legacy_generate(self, ctx, prompt, mt, temperature, seed,
-                         stream) -> None:
+                         stream, span=None) -> None:
         s = len(prompt)
         s_pad = _ceil_to(s, PROMPT_BUCKET)
         mt_pad = _ceil_to(mt, GEN_BUCKET)
@@ -342,10 +369,10 @@ class _Handler(BaseHTTPRequestHandler):
                     tok.block_until_ready()
                 yield int(tok[0])
 
-        self._sse(None, [int(tok[0])], tokens())
+        self._sse(None, [int(tok[0])], tokens(), span)
 
     # ------------------------------------------------------------- SSE
-    def _sse(self, req, first_tokens, rest_iter) -> None:
+    def _sse(self, req, first_tokens, rest_iter, span=None) -> None:
         """SSE token stream: one `data: {"token": N}` event per decoded
         token, flushed as produced (chunked transfer), then
         `data: [DONE]` — the OpenAI-style contract LLM clients expect.
@@ -362,17 +389,32 @@ class _Handler(BaseHTTPRequestHandler):
         def emit(payload: str) -> None:
             write_chunk(self.wfile, f"data: {payload}\n\n".encode())
 
+        t0 = time.perf_counter() if span is not None else 0.0
+        sent = 0
         try:
             for tok in first_tokens:
                 emit(json.dumps({"token": int(tok)}))
+                sent += 1
             for tok in rest_iter:
                 emit(json.dumps({"token": int(tok)}))
+                sent += 1
             emit("[DONE]")
             end_chunks(self.wfile)
+            if span is not None:
+                # Stream-delivery child span: first flush → [DONE].
+                tracing.record_span("replica.stream", "replica",
+                                    span.context(), start_mono=t0,
+                                    attrs={"tokens": sent})
         except Exception:  # noqa: BLE001 — client gone / engine died
             if req is not None:
                 req.cancel()  # free the slot; don't decode into a void
             self.close_connection = True
+            if span is not None:
+                tracing.record_span("replica.stream", "replica",
+                                    span.context(), start_mono=t0,
+                                    status="error",
+                                    attrs={"tokens": sent,
+                                           "aborted": True})
 
 
 def serve(cfg: llama.LlamaConfig, params, port: int,

@@ -61,6 +61,7 @@ import jax.numpy as jnp
 from skypilot_tpu.models import model_api
 from skypilot_tpu.observability import events
 from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.utils import fault_injection
 
 # ----------------------------------------------------------------- metrics
@@ -123,7 +124,7 @@ class Request:
     """One in-flight generation; tokens arrive on an internal queue."""
 
     def __init__(self, prompt: List[int], max_tokens: int,
-                 temperature: float, seed: int):
+                 temperature: float, seed: int, trace=None):
         self.prompt = [int(t) for t in prompt]
         self.max_tokens = int(max_tokens)
         self.temperature = float(temperature)
@@ -132,6 +133,14 @@ class Request:
         self.first_token_at: Optional[float] = None
         self.error: Optional[str] = None
         self.cancelled = False
+        # Distributed-tracing parent context (tracing.SpanContext from
+        # the replica handler's span, or None): the engine emits
+        # queue/prefix/prefill/decode child spans under it. Always
+        # None while tracing is unarmed — the hot-path guards below
+        # short-circuit on tracing.ENABLED first.
+        self.trace = trace
+        self.admitted_at: Optional[float] = None
+        self.prefill_start: Optional[float] = None
         # Prefix-cache accounting, set by the engine: prompt tokens
         # restored from the pool, and model forward passes (chunk
         # prefills) actually run before the first token — the
@@ -495,11 +504,13 @@ class DecodeEngine:
         return self
 
     def submit(self, prompt, max_tokens: int, temperature: float = 0.0,
-               seed: int = 0) -> Request:
+               seed: int = 0, trace=None) -> Request:
         """Enqueue a generation; returns the Request handle (stream()
         or result()). Raises EngineError on invalid size, full queue,
-        or a dead engine."""
-        req = Request(prompt, max_tokens, temperature, seed)
+        or a dead engine. ``trace`` is an optional tracing.SpanContext
+        to parent the engine's per-phase spans under."""
+        req = Request(prompt, max_tokens, temperature, seed,
+                      trace=trace)
         if not req.prompt:
             raise EngineError("empty prompt")
         if len(req.prompt) + req.max_tokens > self._max_seq:
@@ -600,6 +611,19 @@ class DecodeEngine:
                 # Publish before the row is reusable; skipped on engine
                 # failure/shutdown (device state not trustworthy).
                 self._publish_slot_chunks(i)
+            req = slot.request
+            if tracing.ENABLED and req.trace is not None \
+                    and req.trace.sampled:
+                # Decode child span: first token → slot free. A request
+                # that died before its first token anchors at submit so
+                # the failure still shows on the timeline.
+                tracing.record_span(
+                    "engine.decode", "engine", req.trace,
+                    start_mono=(req.first_token_at
+                                or req.submitted_at),
+                    status="error" if error else "ok",
+                    attrs={"tokens": slot.generated,
+                           "outcome": outcome})
             slot.request._finish(error)
             _REQUESTS.labels(outcome=outcome).inc()
         if slot.held:
@@ -613,6 +637,10 @@ class DecodeEngine:
         _SLOTS_OCCUPIED.set(len(self._live()))
 
     def _admit(self) -> None:
+        # Traced-phase stamps taken under the lock, RECORDED after it:
+        # record_span does file I/O, and a slow disk under the
+        # admission condition would stall every concurrent submit().
+        emits: List[tuple] = []
         with self._cond:
             for i, slot in enumerate(self._slots):
                 if not self._waiting:
@@ -625,18 +653,39 @@ class DecodeEngine:
                         continue
                     slot.request = req
                     slot.pos = slot.generated = slot.prefilled = 0
+                    traced = (tracing.ENABLED and req.trace is not None
+                              and req.trace.sampled)
+                    if traced:
+                        req.admitted_at = time.perf_counter()
+                        # Queue-wait child span, retroactive from the
+                        # submit/admission monotonic stamps.
+                        emits.append((
+                            "engine.queue", req.trace,
+                            req.submitted_at, req.admitted_at,
+                            {"slot": i}))
                     if self.prefix_cache is not None:
                         # Trie walk + refcount pin only (host dicts);
                         # the device-side row restore happens on the
                         # compute path (_prefill_one), not under the
                         # submit lock.
+                        t0 = time.perf_counter() if traced else 0.0
                         slot.held = \
                             self.prefix_cache.match_and_acquire(
                                 req.prompt)
                         slot.cached = len(slot.held) * self._chunk
                         req.cached_prompt_tokens = slot.cached
+                        if traced:
+                            emits.append((
+                                "engine.prefix_lookup", req.trace,
+                                t0, time.perf_counter(),
+                                {"hit": bool(slot.held),
+                                 "cached_tokens": slot.cached}))
             _QUEUE_DEPTH.set(len(self._waiting))
         _SLOTS_OCCUPIED.set(len(self._live()))
+        for name, trace, t0, t1, attrs in emits:
+            tracing.record_span(name, "engine", trace,
+                                start_mono=t0, end_mono=t1,
+                                attrs=attrs)
 
     def _prefill_one(self) -> bool:
         """Advance the first slot with un-prefilled prompt by ONE
@@ -648,6 +697,9 @@ class DecodeEngine:
             if req.cancelled:
                 self._free_slot(i, outcome="cancelled")
                 continue
+            if tracing.ENABLED and req.trace is not None \
+                    and req.trace.sampled and req.prefill_start is None:
+                req.prefill_start = time.perf_counter()
             if slot.prefilled == 0 and slot.cached:
                 # Prefix hit: splice the matched chunks' K/V into the
                 # row instead of prefilling them — chunk by chunk, so
@@ -687,6 +739,21 @@ class DecodeEngine:
                     _PREFIX_TTFT.labels(
                         cache="hit" if slot.cached else "miss").observe(
                         req.first_token_at - req.submitted_at)
+                if tracing.ENABLED and req.trace is not None \
+                        and req.trace.sampled:
+                    # Chunked-prefill child span, closing at the first
+                    # token: steps_to_first_token is the chunk-prefill
+                    # count (the first token is sampled from the final
+                    # chunk's logits in this engine).
+                    tracing.record_span(
+                        "engine.prefill", "engine", req.trace,
+                        start_mono=(req.prefill_start
+                                    or req.submitted_at),
+                        attrs={"prompt_tokens": len(req.prompt),
+                               "cached_tokens":
+                                   req.cached_prompt_tokens,
+                               "steps_to_first_token":
+                                   req.prefill_chunks})
                 self._maybe_finish(i)
             return True
         return False
@@ -860,7 +927,7 @@ class EngineSupervisor:
         return engine is not None and engine._failed is None
 
     def submit(self, prompt, max_tokens: int, temperature: float = 0.0,
-               seed: int = 0) -> Request:
+               seed: int = 0, trace=None) -> Request:
         if self.permanently_down:
             raise EngineError(
                 f"engine permanently down after {self.max_restarts} "
@@ -870,7 +937,8 @@ class EngineSupervisor:
             raise EngineError("engine not started")
         # A dead/restarting engine raises its own clean EngineError.
         return engine.submit(prompt, max_tokens=max_tokens,
-                             temperature=temperature, seed=seed)
+                             temperature=temperature, seed=seed,
+                             trace=trace)
 
     def warmup(self) -> None:
         engine = self._engine

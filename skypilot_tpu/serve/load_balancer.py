@@ -26,6 +26,7 @@ import urllib.request
 from typing import Callable, Dict, List, Optional, Set
 
 from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
 from skypilot_tpu.utils import fault_injection
 
@@ -328,8 +329,18 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
         self.recorder.record()
         t0 = time.perf_counter()
         stats = {"code": 0, "bytes": 0}
+        # Root span of the request's trace (tracing.ENABLED guard =
+        # zero tracing cost unarmed). A client that is itself traced
+        # (e.g. a traced launch curling the endpoint) parents us via
+        # the X-STPU-Trace header; otherwise the LB is the root.
+        span = None
+        if tracing.ENABLED:
+            span = tracing.start_span(
+                "lb.request", kind="lb",
+                parent=tracing.extract(self.headers),
+                attrs={"method": method, "path": self.path})
         try:
-            self._proxy_inner(method, stats)
+            self._proxy_inner(method, stats, span)
         finally:
             # A replica dying mid-stream already sent the upstream's
             # 2xx status line — record it as "aborted", not a clean
@@ -340,6 +351,10 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
             _LATENCY.labels(code=code).observe(
                 time.perf_counter() - t0)
             _STREAMED.observe(stats["bytes"])
+            if span is not None:
+                span.end(status=("error" if stats.get("aborted")
+                                 else "ok"),
+                         code=code, bytes=stats["bytes"])
 
     def _send_plain(self, code: int, payload: bytes,
                     stats: Dict[str, int]) -> None:
@@ -354,21 +369,26 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
         self.wfile.write(payload)
         stats["bytes"] += len(payload)
 
-    def _pick_replica(self, request: dict,
-                      tried: Set[str]) -> Optional[str]:
+    def _pick_replica(self, request: dict, tried: Set[str],
+                      span=None) -> Optional[str]:
         """Policy selection with breaker-ejected replicas excluded.
         Fails OPEN when every untried replica is ejected: routing to a
         likely-dead replica beats a guaranteed 502."""
         if self.breaker is None:
             return self.policy.select_replica(request, exclude=tried)
         blocked = self.breaker.blocked(self._replica_urls())
+        if span is not None and blocked:
+            span.event("breaker_ejected", replicas=sorted(blocked))
         target = self.policy.select_replica(request,
                                             exclude=tried | blocked)
         if target is None and blocked - tried:
+            if span is not None:
+                span.event("breaker_fail_open")
             target = self.policy.select_replica(request, exclude=tried)
         return target
 
-    def _proxy_inner(self, method: str, stats: Dict[str, int]) -> None:
+    def _proxy_inner(self, method: str, stats: Dict[str, int],
+                     span=None) -> None:
         length = int(self.headers.get("Content-Length") or 0)
         if length > self.max_body_bytes:
             # Refuse BEFORE buffering: the content-aware-routing body
@@ -385,11 +405,19 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
         tried: Set[str] = set()
         attempts = 1 + max(self.max_retries, 0)
         for attempt in range(attempts):
-            target = self._pick_replica(request, tried)
+            target = self._pick_replica(request, tried, span)
             if target is None:
                 break
             if attempt:
                 _RETRIES.inc()
+                if span is not None:
+                    span.event("retry", attempt=attempt,
+                               target=target)
+            if span is not None:
+                # The policy decision, annotated on every attempt: who
+                # was picked, by which policy, excluding whom.
+                span.event("select", target=target, attempt=attempt,
+                           policy=type(self.policy).__name__)
             tried.add(target)
             # A retry only helps if another replica is left to try.
             can_retry = (attempt < attempts - 1 and
@@ -397,7 +425,7 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                              for u in self._replica_urls()))
             try:
                 retry = self._proxy_to(target, method, body, stats,
-                                       can_retry)
+                                       can_retry, span)
             finally:
                 # Return the in-flight slot on every exit path (clean,
                 # HTTP error, aborted stream) — least-loaded accounting
@@ -413,7 +441,7 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
 
     def _proxy_to(self, target: str, method: str,
                   body: Optional[bytes], stats: Dict[str, int],
-                  can_retry: bool = False) -> bool:
+                  can_retry: bool = False, span=None) -> bool:
         """One upstream attempt. Returns True iff the attempt failed
         BEFORE the first response byte reached the client and the
         caller should retry on another replica; in every other case the
@@ -421,6 +449,12 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
         url = target.rstrip("/") + self.path
         headers = {k: v for k, v in self.headers.items()
                    if k.lower() not in _HOP_HEADERS}
+        if span is not None:
+            # Context propagation to the replica: the replica's
+            # generate/engine spans attach under this request's trace.
+            ctx = tracing.format_ctx(span.context())
+            if ctx:
+                headers[tracing.HEADER] = ctx
         req = urllib.request.Request(url, data=body, headers=headers,
                                      method=method)
         started: List[bool] = []
@@ -448,6 +482,8 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                 # take the request right now" (draining engine, warming
                 # model) while a peer can — and nothing was processed,
                 # so re-routing is safe. Other statuses pass through.
+                if span is not None:
+                    span.event("reroute_503", target=target)
                 return True
             self.send_response(e.code)
             stats["code"] = e.code
@@ -490,6 +526,9 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
             # controller's probe path.
             if self.breaker is not None and not _is_timeout(e):
                 self.breaker.record_failure(target)
+            if span is not None:
+                span.event("upstream_failed", target=target,
+                           error=type(e).__name__)
             if can_retry:
                 return True
             self._send_plain(502, b"Replica unreachable.\n", stats)

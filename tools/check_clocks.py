@@ -1,5 +1,7 @@
 #!/usr/bin/env python3
-"""Lint: reject ``time.time()`` used in duration arithmetic.
+"""Clock + span discipline lints (tier-1).
+
+Lint 1: reject ``time.time()`` used in duration arithmetic.
 
 ``time.time() - t0`` is wrong for measuring elapsed time: an NTP step
 (or a VM migration's clock slew) mid-interval yields negative or wildly
@@ -14,12 +16,23 @@ against a timestamp persisted by another process/boot, where monotonic
 clocks are meaningless — are either allowlisted below or annotated
 inline with ``# wallclock: intentional``.
 
+Lint 2: reject LEAKED tracing spans. Every
+``tracing.start_span(...)`` call must either be the context expression
+of a ``with`` statement or be assigned to a name on which ``.end()``
+is called somewhere in the same function — an open span that is never
+ended is silently dropped (records are written on end), which is
+precisely the "request disappeared from the trace" bug distributed
+tracing exists to rule out. Phases whose boundaries are only known
+after the fact should use ``tracing.record_span`` (start+end in one
+call), which this lint does not constrain.
+
 Runs as a tier-1 test (tests/test_observability.py) and standalone:
 
     python tools/check_clocks.py        # exit 1 on violations
 """
 from __future__ import annotations
 
+import ast
 import pathlib
 import re
 import sys
@@ -83,6 +96,78 @@ def check(root: pathlib.Path = TARGET_DIR) -> List[str]:
     return violations
 
 
+# --------------------------------------------------- span-leak lint
+def _is_start_span_call(node: "ast.AST") -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    name = (func.attr if isinstance(func, ast.Attribute)
+            else func.id if isinstance(func, ast.Name) else None)
+    return name == "start_span"
+
+
+def _span_closed(call: "ast.Call", parents: dict) -> bool:
+    """True iff the start_span() call cannot leak an open span: it is a
+    with-statement context expression, or its result is assigned to a
+    name with a matching ``<name>.end(...)`` in the enclosing function
+    (nested helpers like a shared finish() closure count)."""
+    stmt = call
+    while not isinstance(stmt, ast.stmt):
+        stmt = parents[stmt]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if call is item.context_expr or any(
+                    n is call for n in ast.walk(item.context_expr)):
+                return True
+        return False
+    target = None
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+            and isinstance(stmt.targets[0], ast.Name):
+        target = stmt.targets[0].id
+    elif isinstance(stmt, ast.AnnAssign) \
+            and isinstance(stmt.target, ast.Name):
+        target = stmt.target.id
+    if target is None:
+        return False  # bare/returned span: nobody owns the .end()
+    scope = stmt
+    while not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Module)):
+        scope = parents[scope]
+    for node in ast.walk(scope):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "end"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == target):
+            return True
+    return False
+
+
+def check_spans(root: pathlib.Path = TARGET_DIR) -> List[str]:
+    """Return span-leak violation strings ('path:lineno: message')."""
+    violations = []
+    for path in sorted(root.rglob("*.py")):
+        rel = str(path.relative_to(REPO_ROOT)) \
+            if REPO_ROOT in path.parents else str(path)
+        try:
+            tree = ast.parse(path.read_text(errors="replace"))
+        except (OSError, SyntaxError):
+            continue
+        parents: dict = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(tree):
+            if _is_start_span_call(node) and \
+                    not _span_closed(node, parents):
+                violations.append(
+                    f"{rel}:{node.lineno}: start_span() result is "
+                    "never ended (use `with`, or assign it and call "
+                    ".end() in the same function; for "
+                    "known-after-the-fact phases use record_span)")
+    return violations
+
+
 def main() -> int:
     violations = check()
     if violations:
@@ -93,7 +178,14 @@ def main() -> int:
         for v in violations:
             print(f"  {v}")
         return 1
-    print("clock discipline OK")
+    span_violations = check_spans()
+    if span_violations:
+        print("leaked tracing spans (records are written on end(); an "
+              "un-ended span silently vanishes from the trace):")
+        for v in span_violations:
+            print(f"  {v}")
+        return 1
+    print("clock + span discipline OK")
     return 0
 
 
