@@ -380,6 +380,21 @@ def _serving_leg() -> dict:
         except Exception as e:  # noqa: BLE001
             out[key] = None
             out[f"{key}_error"] = str(e)[:200]
+        # Checkpoint save/restore latency for the family's full param
+        # set (train/checkpoint.py): bounds the step-path cost of
+        # --ckpt-every and the relaunch stall of a preemption recovery.
+        # LOWER is better — bench_compare gates these via its
+        # lower-is-better metric set.
+        key = f"{family}_ckpt_save_s"
+        try:
+            r = run_tool(["--family", family, "--mode", "ckpt"],
+                         timeout=900)
+            out[key] = r["ckpt_save_s"]
+            out[f"{family}_ckpt_restore_s"] = r["ckpt_restore_s"]
+            out[f"{family}_ckpt_bytes"] = r["ckpt_bytes"]
+        except Exception as e:  # noqa: BLE001
+            out[key] = None
+            out[f"{key}_error"] = str(e)[:200]
     return out
 
 

@@ -24,6 +24,7 @@ import sys
 import threading
 
 from skypilot_tpu.agent import constants
+from skypilot_tpu.utils import fault_injection
 
 GANG_FAILED_RC = constants.GANG_FAILED_RC
 
@@ -86,6 +87,21 @@ def main() -> int:
             print(f"[wrapper rank {rank}] gang barrier failed "
                   f"(rc={rc})", file=sys.stderr, flush=True)
             client.close()
+            return GANG_FAILED_RC
+
+    # Chaos seam: one host of the slice dying right as the gang starts
+    # (or, in ``kill`` mode, with no exit handshake at all) — the gang
+    # driver must cancel every peer with rc 137, exactly like a real
+    # preempted host. Sits AFTER the barrier so all peers are already
+    # committed to the run.
+    if fault_injection.ENABLED:
+        try:
+            fault_injection.fire("gang.host", rank=rank)
+        except fault_injection.InjectedFault as e:
+            print(f"[wrapper rank {rank}] {e}", file=sys.stderr,
+                  flush=True)
+            if client is not None:
+                client.close()
             return GANG_FAILED_RC
 
     proc = subprocess.Popen(["bash", "-c", cmd],

@@ -752,14 +752,51 @@ class SliceBackend(backend_lib.Backend[SliceHandle]):
                           stream_logs=True)
 
     # ------------------------------------------------------------ teardown
+    @staticmethod
+    def _await_job_grace(pids: List[int],
+                         timeout: Optional[float] = None) -> None:
+        """Bounded wait for SIGTERM'd job processes to exit before the
+        host dirs vanish: a training loop that installed the
+        preemption-grace handler (train/checkpoint.GraceHandler) uses
+        this window to flush its final checkpoint. Real spot TPUs give
+        ~30s of notice; the simulated slice gives
+        STPU_TEARDOWN_GRACE_SECONDS (default 5, 0 disables)."""
+        if timeout is None:
+            timeout = float(os.environ.get(
+                "STPU_TEARDOWN_GRACE_SECONDS", "5"))
+
+        # Zombie-aware liveness (proc_utils): an unreaped detached
+        # driver stays kill-0-able forever — waiting on it would burn
+        # the whole grace budget on an already-exited process.
+        from skypilot_tpu.utils import proc_utils
+        deadline = time.monotonic() + timeout
+        for pid in pids:
+            while time.monotonic() < deadline and \
+                    proc_utils.pid_state(pid) == "running":
+                time.sleep(0.1)
+
     def _teardown(self, handle: SliceHandle, terminate: bool,
                   purge: bool = False) -> None:
         with _cluster_lock(handle.cluster_name):
             if terminate and handle.provider_name == "local":
                 # Kill any live gang before the host dirs vanish, so no
-                # orphan process outlives its (simulated) slice.
+                # orphan process outlives its (simulated) slice — but
+                # give SIGTERM'd jobs their preemption-grace window
+                # first (no live jobs = no wait).
+                # Pid snapshot is best-effort and must never block the
+                # kill below: a corrupt jobs DB still gets its gang
+                # cancelled (the no-orphan invariant).
+                live_pids: List[int] = []
+                try:
+                    live_pids = [
+                        j["pid"] for j in job_lib.queue(handle.head_home)
+                        if j.get("pid") and not job_lib.JobStatus(
+                            j["status"]).is_terminal()]
+                except Exception:
+                    pass
                 try:
                     job_lib.cancel_jobs(None, home=handle.head_home)
+                    self._await_job_grace(live_pids)
                 except Exception:
                     pass
                 self._kill_local_daemon(handle.head_home)

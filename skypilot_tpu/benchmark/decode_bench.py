@@ -315,3 +315,55 @@ def measure_engine_prefix(family: str, slots: int = 8,
         "steps_to_first_token_warm": max(r.prefill_chunks
                                          for r in reqs),
     }
+
+
+def measure_ckpt(family: str, repeats: int = 3,
+                 **shape_kw) -> Dict[str, Any]:
+    """Checkpoint save/restore latency for a family's full param set.
+
+    The number that bounds two halves of the preemption story: how much
+    step-path time a --ckpt-every save can cost (save_s, synchronous
+    worst case — the async Checkpointer hides most of it), and how long
+    a recovery relaunch stalls before its first step (restore_s).
+    Measured through the real train/checkpoint.py path — atomic rename,
+    checksummed manifest and all — into a throwaway directory; best of
+    ``repeats`` to shed filesystem-cache noise, same policy as the
+    decode legs.
+    """
+    import shutil
+    import tempfile
+
+    from skypilot_tpu.train import checkpoint as checkpoint_lib
+
+    mdl, cfg = build(family, **shape_kw)
+    params = mdl.init(cfg, jax.random.key(0))
+    jax.block_until_ready(params)
+    tree = {"params": params}
+    ckpt_dir = tempfile.mkdtemp(prefix=f"stpu-ckpt-bench-{family}-")
+    try:
+        save_s = restore_s = float("inf")
+        nbytes = 0
+        for i in range(repeats):
+            t0 = time.perf_counter()
+            checkpoint_lib.save(ckpt_dir, i, tree, keep=1)
+            save_s = min(save_s, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            restored = checkpoint_lib.restore_latest(ckpt_dir,
+                                                     like=tree)
+            restore_s = min(restore_s, time.perf_counter() - t0)
+            assert restored is not None and restored.step == i
+        import json as json_lib
+        import pathlib as pathlib_lib
+        manifest = sorted(
+            pathlib_lib.Path(ckpt_dir).glob("ckpt-*.json"))[-1]
+        nbytes = json_lib.loads(
+            manifest.read_text())["payload_bytes"]
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    return {
+        "ckpt_save_s": round(save_s, 4),
+        "ckpt_restore_s": round(restore_s, 4),
+        "ckpt_bytes": nbytes,
+        "repeats": repeats,
+        "model": _model_info(family, cfg, params),
+    }

@@ -762,16 +762,23 @@ def jobs_launch(entrypoint, name, env, detach_run, yes):
 @jobs.command(name="queue")
 @click.option("--skip-finished", "-s", is_flag=True)
 def jobs_queue(skip_finished):
-    """List managed jobs (reference `sky jobs queue` columns)."""
+    """List managed jobs (reference `sky jobs queue` columns).
+
+    CKPT shows resume progress: the newest durable checkpoint step the
+    controller observed — what a preemption right now would resume
+    from."""
     from skypilot_tpu.jobs import core as jobs_core
-    fmt = "{:<5} {:<20} {:<10} {:<18} {:>9} {:<24}"
+    fmt = "{:<5} {:<20} {:<10} {:<18} {:>9} {:>8} {:<24}"
     click.echo(fmt.format("ID", "NAME", "SUBMITTED", "STATUS",
-                          "#RECOVER", "CLUSTER"))
+                          "#RECOVER", "CKPT", "CLUSTER"))
     for j in jobs_core.queue(skip_finished=skip_finished):
+        step = j.get("last_ckpt_step")
         click.echo(fmt.format(
             j["job_id"], (j["job_name"] or "-")[:20],
             _human_ago(j.get("submitted_at")), j["status"],
-            j["recovery_count"], j["cluster_name"] or "-"))
+            j["recovery_count"],
+            "-" if step is None else f"@{step}",
+            j["cluster_name"] or "-"))
 
 
 @jobs.command(name="cancel")
@@ -791,6 +798,18 @@ def jobs_logs(job_id, no_follow):
     """Stream a managed job's task logs."""
     from skypilot_tpu.jobs import core as jobs_core
     sys.exit(jobs_core.tail_logs(job_id, follow=not no_follow))
+
+
+@jobs.command(name="reconcile")
+def jobs_reconcile():
+    """Adopt orphaned managed jobs (controller process died): resume
+    the watch on live clusters, finish interrupted recoveries."""
+    from skypilot_tpu.jobs import core as jobs_core
+    adopted = jobs_core.reconcile()
+    if adopted:
+        click.echo(f"Adopting managed jobs: {adopted}")
+    else:
+        click.echo("No orphaned managed jobs.")
 
 
 @jobs.command(name="dashboard")

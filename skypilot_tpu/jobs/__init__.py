@@ -7,7 +7,8 @@ from skypilot_tpu.jobs.state import ManagedJobStatus  # noqa: F401
 
 
 def __getattr__(name):
-    if name in ("launch", "queue", "cancel", "tail_logs", "wait"):
+    if name in ("launch", "queue", "cancel", "tail_logs", "wait",
+                "reconcile"):
         from skypilot_tpu.jobs import core
         return getattr(core, name)
     raise AttributeError(f"module 'skypilot_tpu.jobs' has no attribute "

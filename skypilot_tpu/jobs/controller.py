@@ -10,7 +10,23 @@ here it runs as a detached local process per managed job (the client is the
 controller host). The control flow is identical, so moving it onto a
 controller VM is a transport change, not a logic change.
 
-Runnable:  python -m skypilot_tpu.jobs.controller --job-id N dag.yaml
+Checkpoint/resume contract: every task gets a stable per-task checkpoint
+directory stamped into its env as $STPU_JOB_CKPT_DIR (train/checkpoint.py
+format; recipes default --checkpoint-dir to it). Recovery relaunches the
+SAME task with the SAME env, so the relaunched run resumes from the last
+durable checkpoint instead of step 0; the controller polls the directory
+each watch tick and records the newest step (``stpu jobs queue`` shows it
+as resume progress).
+
+Adoption: a controller that dies mid-flight (OOM, host reboot, SIGKILL
+mid-recovery) must not orphan its job. ``--adopt`` re-attaches a fresh
+controller to a non-terminal job whose recorded controller pid is dead:
+a healthy cluster resumes the watch in place; a missing/preempted one
+finishes the interrupted recovery — the same rule PR 4's drain adoption
+follows for serve replicas. ``jobs.core.reconcile()`` scans for such
+orphans and spawns adopters.
+
+Runnable:  python -m skypilot_tpu.jobs.controller --job-id N [--adopt] dag.yaml
 """
 from __future__ import annotations
 
@@ -44,6 +60,17 @@ _RECOVERY_SECONDS = metrics.histogram(
     "stpu_jobs_recovery_duration_seconds",
     "Wall time from loss detection to the job RUNNING again.",
     buckets=(1, 5, 15, 30, 60, 120, 300, 600, 1800, 3600))
+_ADOPTIONS = metrics.counter(
+    "stpu_jobs_adoptions_total",
+    "Jobs adopted by a fresh controller after the previous controller "
+    "process died.", ("mode",))
+_RECOVERED_STEP = metrics.gauge(
+    "stpu_jobs_recovered_step",
+    "Checkpoint step the most recent recovery resumed from (0 = no "
+    "checkpoint existed; the relaunch recomputes from scratch).")
+_LAST_CKPT_STEP = metrics.gauge(
+    "stpu_jobs_last_ckpt_step",
+    "Newest durable checkpoint step observed in the job's ckpt dir.")
 
 # Poll gap between on-cluster job status checks (reference:
 # JOB_STATUS_CHECK_GAP_SECONDS). Overridable for hermetic tests.
@@ -55,12 +82,38 @@ class _Cancelled(Exception):
     pass
 
 
+def _pid_alive(pid: Optional[int]) -> bool:
+    """Is ``pid`` a live controller-ish process? Liveness alone is not
+    enough — a recycled pid belonging to an unrelated daemon would
+    make reconcile skip an orphaned job forever — so when /proc is
+    available the cmdline must look like a controller: the detached
+    module invocation (``jobs.controller``) or any python interpreter
+    (inline ``detach=False`` controllers and reconciler claim tokens
+    live in the SDK caller's process). A pid recycled by another
+    *python* process remains a false-alive tail case; zombies (exited,
+    unreaped) are dead for adoption purposes."""
+    if not pid or pid <= 0:
+        return False
+    from skypilot_tpu.utils import proc_utils
+    if proc_utils.pid_state(pid) != "running":
+        return False
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmdline = f.read().replace(b"\x00", b" ")
+    except OSError:
+        return True  # no /proc (non-linux): liveness is the answer
+    return (b"jobs.controller" in cmdline or b"python" in cmdline)
+
+
 class JobsController:
-    def __init__(self, job_id: int, dag_yaml_path: str):
+    def __init__(self, job_id: int, dag_yaml_path: str,
+                 adopt: bool = False):
         self.job_id = job_id
         self.dag = dag_utils.load_chain_dag_from_yaml(dag_yaml_path)
         self.backend = slice_backend.SliceBackend()
         self._cancel_requested = False
+        self._adopt = adopt
+        self._last_ckpt_reported: Optional[int] = None
 
     # ------------------------------------------------------------------
     def _export_metrics(self) -> None:
@@ -89,10 +142,21 @@ class JobsController:
                 signal.signal(sig, old)
 
     def _run(self) -> None:
+        # Adoption skips tasks the dead controller already completed:
+        # task_index is persisted before each task starts, so resuming
+        # there finishes the interrupted task and continues the chain.
+        start_index = 0
+        if self._adopt:
+            job = jobs_state.get_job(self.job_id)
+            start_index = int(job.get("task_index") or 0) if job else 0
         try:
             for task_index, task in enumerate(self.dag.topo_order()):
+                if task_index < start_index:
+                    continue
                 jobs_state.set_task_index(self.job_id, task_index)
-                self._run_one_task(task_index, task)
+                self._run_one_task(
+                    task_index, task,
+                    adopt=self._adopt and task_index == start_index)
             jobs_state.set_status(self.job_id, ManagedJobStatus.SUCCEEDED)
         except _Cancelled:
             jobs_state.set_status(self.job_id, ManagedJobStatus.CANCELLED)
@@ -140,9 +204,41 @@ class JobsController:
         base = (job["job_name"] or "job").replace("_", "-")[:20]
         return f"stpu-jobs-{base}-{self.job_id}-{task_index}"
 
-    def _run_one_task(self, task_index: int, task) -> None:
+    def _task_ckpt_dir(self, task_index: int) -> str:
+        """Stable per-task checkpoint dir: survives the controller, the
+        task cluster, and every recovery relaunch. Point workloads at a
+        bucket via their own --checkpoint-dir to override."""
+        from skypilot_tpu.utils import paths
+        return str(paths.home() / "job_ckpts" / f"job-{self.job_id}" /
+                   f"task-{task_index}")
+
+    def _poll_ckpt_progress(self, ckpt_dir: str) -> Optional[int]:
+        """Record the newest durable checkpoint step (resume progress
+        for `stpu jobs queue`). Cheap manifest scan; the dir may be a
+        bucket mount that does not exist controller-side — skip then."""
+        from skypilot_tpu.train import checkpoint as checkpoint_lib
+        if not os.path.isdir(ckpt_dir):
+            return None
+        step = checkpoint_lib.latest_step(ckpt_dir)
+        if step is not None and step != self._last_ckpt_reported:
+            # Write-on-change only: re-stamping the same step every
+            # poll tick is pure WAL churn on the shared jobs DB.
+            jobs_state.set_last_ckpt_step(self.job_id, step)
+            _LAST_CKPT_STEP.set(step)
+            self._last_ckpt_reported = step
+        return step
+
+    def _run_one_task(self, task_index: int, task,
+                      adopt: bool = False) -> None:
         cluster_name = self._cluster_name(task_index)
         jobs_state.set_cluster_name(self.job_id, cluster_name)
+        from skypilot_tpu.train import checkpoint as checkpoint_lib
+        ckpt_dir = self._task_ckpt_dir(task_index)
+        jobs_state.set_ckpt_dir(self.job_id, ckpt_dir)
+        # The env rides the task through EVERY launch — initial and
+        # recovery relaunches alike — so a preempted run resumes from
+        # its own checkpoints (resume args point at the job's dir).
+        task.update_envs({checkpoint_lib.CKPT_DIR_ENV: ckpt_dir})
         strategy = recovery_strategy.StrategyExecutor.make(
             cluster_name, task, retry_gap_seconds=min(
                 _poll_seconds(), recovery_strategy.RETRY_INIT_GAP_SECONDS))
@@ -162,13 +258,21 @@ class JobsController:
         tracing.set_env_context(span.context())
         status = "error"
         try:
-            jobs_state.set_status(self.job_id, ManagedJobStatus.STARTING)
-            with tracing.start_span("jobs.launch", kind="jobs",
-                                    parent=span,
-                                    attrs={"cluster": cluster_name}):
-                cluster_job_id = strategy.launch()
+            if adopt:
+                cluster_job_id = self._adopt_task(strategy, cluster_name,
+                                                  ckpt_dir, span)
+            else:
+                jobs_state.set_status(self.job_id,
+                                      ManagedJobStatus.STARTING)
+                with tracing.start_span("jobs.launch", kind="jobs",
+                                        parent=span,
+                                        attrs={"cluster": cluster_name}):
+                    cluster_job_id = strategy.launch()
+                jobs_state.set_cluster_job_id(self.job_id,
+                                              cluster_job_id)
             jobs_state.set_status(self.job_id, ManagedJobStatus.RUNNING)
-            self._watch(strategy, cluster_name, cluster_job_id, span)
+            self._watch(strategy, cluster_name, cluster_job_id, span,
+                        ckpt_dir)
             status = "ok"
         finally:
             span.end(status=status)
@@ -181,8 +285,48 @@ class JobsController:
             # controller.py cleanup).
             self._teardown_cluster(cluster_name)
 
+    def _adopt_task(self, strategy, cluster_name: str, ckpt_dir: str,
+                    span) -> Optional[int]:
+        """Re-attach to the task a dead controller left behind: a
+        healthy cluster keeps running and we just resume the watch; a
+        missing/preempted one gets the interrupted recovery finished
+        (cleanup + relaunch, resuming from the job's checkpoints)."""
+        from skypilot_tpu.observability import events
+        job = jobs_state.get_job(self.job_id) or {}
+        cluster_job_id = job.get("cluster_job_id")
+        if cluster_job_id is not None and \
+                self._cluster_healthy(cluster_name):
+            _ADOPTIONS.labels(mode="watch").inc()
+            events.emit("job", str(self.job_id), "adopted",
+                        mode="watch", cluster=cluster_name)
+            span.event("adopted", mode="watch")
+            return cluster_job_id
+        # Finish the interrupted recovery (the dead controller may have
+        # been killed anywhere between cleanup and relaunch). Adoption
+        # is AT-LEAST-ONCE: a controller that died after the task
+        # finished but before SUCCEEDED was persisted is
+        # indistinguishable from one that died mid-recovery, so the
+        # task re-runs — checkpoint-aware workloads resume at their
+        # final step (near-free); side-effecting run commands must be
+        # idempotent, same as under any preemption recovery.
+        _ADOPTIONS.labels(mode="recover").inc()
+        events.emit("job", str(self.job_id), "adopted",
+                    mode="recover", cluster=cluster_name)
+        span.event("adopted", mode="recover")
+        resumed_step = self._poll_ckpt_progress(ckpt_dir) or 0
+        jobs_state.set_recovering(self.job_id)
+        _RECOVERIES.inc()
+        with tracing.start_span("jobs.recover", kind="jobs", parent=span,
+                                attrs={"cluster": cluster_name,
+                                       "adopted": True}):
+            cluster_job_id = strategy.recover()
+        jobs_state.set_cluster_job_id(self.job_id, cluster_job_id)
+        _RECOVERED_STEP.set(resumed_step)
+        return cluster_job_id
+
     def _watch(self, strategy, cluster_name: str,
-               cluster_job_id: Optional[int], span=None) -> None:
+               cluster_job_id: Optional[int], span=None,
+               ckpt_dir: str = "") -> None:
         """Poll until SUCCEEDED; recover on preemption; raise on failure."""
         missing_count = 0
         while True:
@@ -190,6 +334,8 @@ class JobsController:
             self._export_metrics()
             time.sleep(_poll_seconds())
             self._check_cancelled()
+            if ckpt_dir:
+                self._poll_ckpt_progress(ckpt_dir)
             status = self._job_status(cluster_name, cluster_job_id)
             healthy = self._cluster_healthy(cluster_name)
             if status == job_lib.JobStatus.SUCCEEDED:
@@ -217,6 +363,10 @@ class JobsController:
                 missing_count += 1
                 if missing_count < recovery_strategy.MAX_JOB_CHECKING_RETRY:
                     continue
+            # The step the relaunch will resume from — observed BEFORE
+            # recovery so the gauge reflects what the preemption cost.
+            resumed_step = (self._poll_ckpt_progress(ckpt_dir) or 0
+                            if ckpt_dir else 0)
             jobs_state.set_recovering(self.job_id)
             _RECOVERIES.inc()
             if not healthy:
@@ -225,9 +375,12 @@ class JobsController:
             with tracing.start_span(
                     "jobs.recover", kind="jobs", parent=span,
                     attrs={"cluster": cluster_name,
-                           "preempted": not healthy}):
+                           "preempted": not healthy,
+                           "resumed_step": resumed_step}):
                 cluster_job_id = strategy.recover()
             _RECOVERY_SECONDS.observe(time.perf_counter() - t0)
+            jobs_state.set_cluster_job_id(self.job_id, cluster_job_id)
+            _RECOVERED_STEP.set(resumed_step)
             jobs_state.set_status(self.job_id, ManagedJobStatus.RUNNING)
             missing_count = 0
 
@@ -278,16 +431,34 @@ class _UserFailure(Exception):
         self.status = status
 
 
-def run_controller(job_id: int, dag_yaml_path: str) -> None:
-    JobsController(job_id, dag_yaml_path).run()
+def run_controller(job_id: int, dag_yaml_path: str,
+                   adopt: bool = False) -> None:
+    if adopt:
+        # Two live controllers on one job would double-launch clusters;
+        # adoption is only legal once the recorded owner is dead.
+        job = jobs_state.get_job(job_id)
+        if job is None:
+            raise exceptions.SkyTpuError(
+                f"Managed job {job_id} not found; cannot adopt.")
+        pid = job.get("controller_pid")
+        if _pid_alive(pid) and pid != os.getpid():
+            raise exceptions.SkyTpuError(
+                f"Managed job {job_id} still has a live controller "
+                f"(pid {pid}); refusing to adopt.")
+        if ManagedJobStatus(job["status"]).is_terminal():
+            return  # nothing to adopt
+    JobsController(job_id, dag_yaml_path, adopt=adopt).run()
 
 
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--job-id", type=int, required=True)
+    parser.add_argument("--adopt", action="store_true",
+                        help="re-attach to a job whose previous "
+                             "controller died (refuses if it is alive)")
     parser.add_argument("dag_yaml")
     args = parser.parse_args()
-    run_controller(args.job_id, args.dag_yaml)
+    run_controller(args.job_id, args.dag_yaml, adopt=args.adopt)
 
 
 if __name__ == "__main__":

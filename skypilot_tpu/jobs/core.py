@@ -109,18 +109,30 @@ def _launch_local(dag: dag_lib.Dag, detach: bool) -> int:
     jobs_state.set_status(job_id, ManagedJobStatus.SUBMITTED)
 
     if detach:
-        log_dir = paths.logs_dir() / "managed_jobs"
-        log_dir.mkdir(parents=True, exist_ok=True)
-        with open(log_dir / f"controller-{job_id}.log", "ab") as log_f:
-            subprocess.Popen(
-                [sys.executable, "-m", "skypilot_tpu.jobs.controller",
-                 "--job-id", str(job_id), dag_yaml_path],
-                stdout=log_f, stderr=subprocess.STDOUT,
-                start_new_session=True, env=dict(os.environ))
+        _spawn_controller(job_id, dag_yaml_path)
     else:
         from skypilot_tpu.jobs import controller
         controller.run_controller(job_id, dag_yaml_path)
     return job_id
+
+
+def _spawn_controller(job_id: int, dag_yaml_path: str,
+                      adopt: bool = False) -> int:
+    """Detached controller process for a managed job (appends to the
+    job's controller log, so an adopter continues the same file).
+    Returns the spawned pid."""
+    log_dir = paths.logs_dir() / "managed_jobs"
+    log_dir.mkdir(parents=True, exist_ok=True)
+    argv = [sys.executable, "-m", "skypilot_tpu.jobs.controller",
+            "--job-id", str(job_id)]
+    if adopt:
+        argv.append("--adopt")
+    argv.append(dag_yaml_path)
+    with open(log_dir / f"controller-{job_id}.log", "ab") as log_f:
+        proc = subprocess.Popen(
+            argv, stdout=log_f, stderr=subprocess.STDOUT,
+            start_new_session=True, env=dict(os.environ))
+    return proc.pid
 
 
 # ---------------------------------------------------------------- queries
@@ -224,6 +236,71 @@ def _finalize_dead_controller(job: Dict[str, Any]) -> None:
     jobs_state.finalize_status(job["job_id"], ManagedJobStatus.CANCELLED)
 
 
+def reconcile(detach: bool = True) -> List[int]:
+    """Adopt orphaned managed jobs: every non-terminal job whose
+    recorded controller pid is dead gets a fresh controller with
+    ``--adopt`` (resume the watch on a healthy cluster, or finish the
+    interrupted recovery — mirroring the serve layer's drain-adoption
+    rule). Returns the adopted job ids. ``detach=False`` runs the
+    adopting controllers inline (tests)."""
+    handle = _proxy()
+    if handle is not None:
+        out = controller_utils.run_on_controller(
+            handle, controller_utils.module_command(
+                "skypilot_tpu.jobs.core", "reconcile"))
+        return list(out["adopted"])
+    return _reconcile_local(detach)
+
+
+def _reconcile_local(detach: bool) -> List[int]:
+    from skypilot_tpu.jobs import controller as controller_mod
+    adopted = []
+    for job in jobs_state.queue(skip_finished=True):
+        pid = job.get("controller_pid")
+        status = ManagedJobStatus(job["status"])
+        if status.is_terminal():
+            continue
+        if controller_mod._pid_alive(pid):
+            continue
+        if pid is not None and pid < 0 and \
+                controller_mod._pid_alive(-pid):
+            # Negative pid = another reconciler's in-flight claim (see
+            # below) and that reconciler is still alive (same
+            # recycled-pid-aware liveness as controllers — a stale
+            # claim whose reconciler died must not wedge the job).
+            continue
+        if pid is None and (
+                time.time() - (job.get("submitted_at") or 0) < 60):
+            # Controller may still be starting up (pid not yet
+            # recorded); give it the same minute the cancel path does.
+            continue
+        dag_yaml_path = job.get("dag_yaml_path")
+        if not dag_yaml_path or not os.path.exists(dag_yaml_path):
+            _finalize_dead_controller(job)
+            continue
+        # Atomic claim (CAS on controller_pid): two concurrent
+        # reconcile passes both observe the same dead pid, but only
+        # the CAS winner may spawn — the loser skips. The claim token
+        # is this reconciler's NEGATED pid: distinguishable from a
+        # real controller pid, and a claimer that crashes mid-claim is
+        # itself detectably dead, so the next pass re-claims.
+        if not jobs_state.claim_controller(job["job_id"], pid,
+                                           -os.getpid()):
+            continue
+        if detach:
+            new_pid = _spawn_controller(job["job_id"], dag_yaml_path,
+                                        adopt=True)
+            # Replace the claim with the adopter's real pid NOW, not
+            # when it finishes booting: a reconcile pass inside the
+            # adopter's startup window must see a live controller.
+            jobs_state.set_controller_pid(job["job_id"], new_pid)
+        else:
+            controller_mod.run_controller(job["job_id"], dag_yaml_path,
+                                          adopt=True)
+        adopted.append(job["job_id"])
+    return adopted
+
+
 def tail_logs(job_id: Optional[int] = None, follow: bool = True) -> int:
     """Stream the task logs of a managed job via its current cluster."""
     handle = _proxy()
@@ -304,6 +381,8 @@ def main() -> None:
     p = sub.add_parser("status")
     p.add_argument("--job-id", type=int, required=True)
 
+    sub.add_parser("reconcile")
+
     p = sub.add_parser("tail")
     p.add_argument("--job-id", type=int, default=None)
     p.add_argument("--no-follow", action="store_true")
@@ -325,6 +404,8 @@ def main() -> None:
             {"cancelled": _cancel_local(ids, args.all_jobs)}))
     elif args.cmd == "status":
         print(json.dumps(jobs_state.get_job(args.job_id)))
+    elif args.cmd == "reconcile":
+        print(json.dumps({"adopted": _reconcile_local(detach=True)}))
     elif args.cmd == "tail":
         raise SystemExit(_tail_logs_local(args.job_id,
                                           follow=not args.no_follow))

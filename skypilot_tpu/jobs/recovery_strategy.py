@@ -11,6 +11,7 @@ by force-terminating whatever half-dead slice remains.
 """
 from __future__ import annotations
 
+import random
 import time
 import traceback
 from typing import Dict, Optional, Type
@@ -21,6 +22,7 @@ from skypilot_tpu import global_user_state
 from skypilot_tpu.backends import slice_backend
 from skypilot_tpu.observability import events
 from skypilot_tpu.observability import metrics
+from skypilot_tpu.utils import fault_injection
 
 RECOVERY_REGISTRY: Dict[str, Type["StrategyExecutor"]] = {}
 
@@ -32,6 +34,14 @@ _LAUNCH_ATTEMPTS = metrics.counter(
 DEFAULT_RECOVERY_STRATEGY = "EAGER_NEXT_REGION"
 MAX_JOB_CHECKING_RETRY = 10
 RETRY_INIT_GAP_SECONDS = 60
+# Exponential-backoff ceiling for launch retries: a regional stockout
+# lasts minutes-to-hours; retrying a dead zone every minute forever just
+# burns API quota, but capping keeps the job responsive once capacity
+# returns.
+RETRY_BACKOFF_CAP_SECONDS = 600
+# ±fraction of jitter on every gap so many controllers recovering from
+# the same zone-wide preemption don't relaunch in lockstep.
+RETRY_JITTER_FRACTION = 0.25
 
 
 class StrategyExecutor:
@@ -98,10 +108,23 @@ class StrategyExecutor:
 
     def _launch(self, raise_on_failure: bool = True,
                 max_retry: int = 3) -> Optional[int]:
-        """Launch with retries; returns on-cluster job id or None."""
+        """Launch with retries; returns on-cluster job id or None.
+
+        Backoff is exponential (doubling from ``retry_gap_seconds`` up
+        to ``RETRY_BACKOFF_CAP_SECONDS``) with ±25% jitter, and the
+        final failed attempt returns/raises immediately — no pointless
+        trailing sleep before the caller sees the outcome.
+        """
         backoff = self.retry_gap_seconds
         for attempt in range(max_retry):
             try:
+                # Chaos seam: a launch attempt failing (InjectedFault is
+                # a ConnectionError, so it rides the generic-error retry
+                # path a real provisioning outage would).
+                if fault_injection.ENABLED:
+                    fault_injection.fire("jobs.launch",
+                                         cluster=self.cluster_name,
+                                         attempt=attempt)
                 job_id, handle = execution.launch(
                     self.task, cluster_name=self.cluster_name,
                     detach_run=True, stream_logs=False)
@@ -120,7 +143,12 @@ class StrategyExecutor:
                 if raise_on_failure and attempt == max_retry - 1:
                     raise
                 traceback.print_exc()
-            time.sleep(backoff)
+            if attempt < max_retry - 1:
+                jitter = 1.0 + RETRY_JITTER_FRACTION * (
+                    2.0 * random.random() - 1.0)
+                time.sleep(backoff * jitter)
+                backoff = min(backoff * 2,
+                              RETRY_BACKOFF_CAP_SECONDS)
         return None
 
 

@@ -58,6 +58,11 @@ def _db_path() -> pathlib.Path:
     return p
 
 
+# DB paths whose schema migration already ran in this process (keyed by
+# path, not a bare flag: tests repoint STPU_HOME per test).
+_MIGRATED: set = set()
+
+
 def _conn() -> sqlite3.Connection:
     conn = sqlite3.connect(_db_path(), timeout=10)
     conn.execute("PRAGMA journal_mode=WAL")
@@ -76,7 +81,32 @@ def _conn() -> sqlite3.Connection:
         task_index INTEGER DEFAULT 0,
         num_tasks INTEGER DEFAULT 1,
         controller_pid INTEGER,
-        failure_reason TEXT)""")
+        failure_reason TEXT,
+        last_ckpt_step INTEGER,
+        ckpt_dir TEXT,
+        cluster_job_id INTEGER)""")
+    # Schema migration for DBs created before the checkpoint columns
+    # existed (sqlite has no ADD COLUMN IF NOT EXISTS). Once per
+    # process per DB path: every jobs_state call opens a fresh
+    # connection, and three always-failing DDL statements per watch
+    # tick is pointless overhead.
+    db_key = str(_db_path())
+    if db_key not in _MIGRATED:
+        migrated = True
+        for column, decl in (("last_ckpt_step", "INTEGER"),
+                             ("ckpt_dir", "TEXT"),
+                             ("cluster_job_id", "INTEGER")):
+            try:
+                conn.execute(f"ALTER TABLE managed_jobs "
+                             f"ADD COLUMN {column} {decl}")
+            except sqlite3.OperationalError as e:
+                if "duplicate column" not in str(e).lower():
+                    # Transient failure (locked DB): DON'T pin the
+                    # path — retry on the next connection, or every
+                    # later write to the new columns breaks.
+                    migrated = False
+        if migrated:
+            _MIGRATED.add(db_key)
     conn.commit()
     return conn
 
@@ -84,7 +114,8 @@ def _conn() -> sqlite3.Connection:
 _COLUMNS = ("job_id", "job_name", "dag_yaml_path", "resources_str",
             "cluster_name", "status", "submitted_at", "start_at", "end_at",
             "last_recovered_at", "recovery_count", "task_index",
-            "num_tasks", "controller_pid", "failure_reason")
+            "num_tasks", "controller_pid", "failure_reason",
+            "last_ckpt_step", "ckpt_dir", "cluster_job_id")
 
 
 def add_job(job_name: str, dag_yaml_path: str, resources_str: str,
@@ -209,6 +240,49 @@ def set_task_index(job_id: int, task_index: int) -> None:
         conn.execute(
             "UPDATE managed_jobs SET task_index=? WHERE job_id=?",
             (task_index, job_id))
+
+
+def set_ckpt_dir(job_id: int, ckpt_dir: str) -> None:
+    """Record the job's stable checkpoint directory (stamped into the
+    task env as $STPU_JOB_CKPT_DIR by the controller)."""
+    with _conn() as conn:
+        conn.execute(
+            "UPDATE managed_jobs SET ckpt_dir=? WHERE job_id=?",
+            (ckpt_dir, job_id))
+
+
+def set_last_ckpt_step(job_id: int, step: int) -> None:
+    """Newest durable checkpoint step the controller observed —
+    `stpu jobs queue` surfaces it as resume progress."""
+    with _conn() as conn:
+        conn.execute(
+            "UPDATE managed_jobs SET last_ckpt_step=? WHERE job_id=?",
+            (step, job_id))
+
+
+def claim_controller(job_id: int, expected_pid: Optional[int],
+                     claim_pid: int) -> bool:
+    """Atomically take ownership of a job's controller slot:
+    compare-and-swap controller_pid from the observed (dead) value to
+    ``claim_pid``. Two concurrent reconcile passes both observe the
+    same dead pid; only the CAS winner may spawn an adopter — the
+    loser's rowcount is 0. Returns True iff the claim won."""
+    with _conn() as conn:
+        cur = conn.execute(
+            "UPDATE managed_jobs SET controller_pid=? "
+            "WHERE job_id=? AND controller_pid IS ?",
+            (claim_pid, job_id, expected_pid))
+        return cur.rowcount > 0
+
+
+def set_cluster_job_id(job_id: int, cluster_job_id: Optional[int]) -> None:
+    """On-cluster job id of the current launch/recovery attempt; an
+    adopting controller resumes the watch with it instead of blindly
+    relaunching."""
+    with _conn() as conn:
+        conn.execute(
+            "UPDATE managed_jobs SET cluster_job_id=? WHERE job_id=?",
+            (cluster_job_id, job_id))
 
 
 def get_job(job_id: int) -> Optional[Dict[str, Any]]:

@@ -1,14 +1,25 @@
-"""Llama-3.1 LoRA finetune with bucket checkpointing — the flagship recipe.
+"""Llama-3.1 LoRA finetune with crash-consistent checkpointing — the
+flagship recipe.
 
 Reference analog: llm/llama-3_1-finetuning/lora.yaml (torchtune LoRA with
 checkpoints to a MOUNT-mode bucket, lines 24-30 — the reference's
 checkpoint/resume pattern). Native version: low-rank adapters on the
 attention projections of models/llama.py (applied as y@A@B inside
 `lora_dense`, never materializing the full-rank delta), base weights
-frozen via gradients taken only w.r.t. the adapter subtree, and orbax
-checkpoints written to --checkpoint-dir — point it at a MOUNT-mode storage
-path (examples/llama31_lora.yaml) and a preempted managed job resumes from
-the last step.
+frozen via gradients taken only w.r.t. the adapter subtree, and
+crash-consistent checkpoints (train/checkpoint.py: atomic rename +
+checksummed manifest + async D2H) written to --checkpoint-dir every
+``--ckpt-every`` steps — point it at a MOUNT-mode storage path
+(examples/llama31_lora.yaml), or let a managed job stamp it via
+$STPU_JOB_CKPT_DIR, and a preempted run resumes **bit-identically**:
+the full train state (adapters, optimizer state, step, data position,
+PRNG key) round-trips as raw bytes and the data stream replays from
+the exact saved position.
+
+Preemption grace: the agent layer forwards SIGTERM to this process
+(agent/host_wrapper.py); the loop finishes the in-flight step, saves a
+final checkpoint, and exits with rc 143 so the controller records an
+interrupted (not succeeded) task with a fresh checkpoint to resume.
 
     python -m skypilot_tpu.recipes.llama_lora --model tiny --steps 20 \
         --checkpoint-dir /checkpoints/run1
@@ -18,6 +29,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
 
 import jax
@@ -28,7 +40,9 @@ import optax
 from skypilot_tpu.models import llama
 from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.recipes import synthetic_data
+from skypilot_tpu.train import checkpoint as checkpoint_lib
 from skypilot_tpu.train import distributed, trainer
+from skypilot_tpu.utils import fault_injection
 
 
 def init_lora(cfg: llama.LlamaConfig, rank: int, key: jax.Array,
@@ -73,10 +87,23 @@ def build_arg_parser(model_choices, default_model) -> argparse.ArgumentParser:
     p.add_argument("--lora-rank", type=int, default=8)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--checkpoint-dir", type=str, default=None,
-                   help="orbax checkpoint root; a MOUNT-mode bucket path "
-                        "makes runs resumable across preemptions")
-    p.add_argument("--save-every", type=int, default=10)
+    p.add_argument("--checkpoint-dir", type=str,
+                   default=os.environ.get(checkpoint_lib.CKPT_DIR_ENV),
+                   help="checkpoint root (train/checkpoint.py format); "
+                        "a MOUNT-mode bucket path makes runs resumable "
+                        "across preemptions. Defaults to "
+                        f"${checkpoint_lib.CKPT_DIR_ENV}, which the "
+                        "managed-jobs controller stamps per job.")
+    p.add_argument("--ckpt-every", "--save-every", dest="ckpt_every",
+                   type=int, default=10,
+                   help="save a checkpoint every N steps (a preemption "
+                        "replays at most N-1 steps)")
+    p.add_argument("--ckpt-keep", type=int,
+                   default=checkpoint_lib.DEFAULT_KEEP,
+                   help="retention: newest checkpoints kept on disk")
+    p.add_argument("--ckpt-sync", action="store_true",
+                   help="write checkpoints synchronously on the step "
+                        "path (default: async D2H + background write)")
     return p
 
 
@@ -112,31 +139,46 @@ def run_lora(model_lib, cfg, args, recipe_name: str) -> dict:
     tx = optax.adamw(args.lr)
     opt_state = tx.init(lora)
     start_step = 0
+    data_start = 0
+    # Training PRNG key: carried in the checkpoint (full-TrainState
+    # contract) so any stochastic op added later resumes mid-stream
+    # instead of restarting its randomness.
+    train_rng = np.asarray(jax.random.PRNGKey(args.seed + 2))
 
-    mgr = ocp = None
+    def _state_tree(step: int):
+        return {"lora": lora, "opt_state": opt_state,
+                "step": np.int64(step), "data_pos": np.int64(step),
+                "rng": train_rng}
+
+    saver = None
     if args.checkpoint_dir:
-        import orbax.checkpoint as ocp
-        mgr = ocp.CheckpointManager(
-            os.path.abspath(os.path.expanduser(args.checkpoint_dir)),
-            options=ocp.CheckpointManagerOptions(max_to_keep=3))
-        latest = mgr.latest_step()
-        if latest is not None:
-            restored = mgr.restore(
-                latest, args=ocp.args.StandardRestore(
-                    {"lora": lora, "opt_state": opt_state}))
-            # Restored arrays land on one device; put them back as
+        ckpt_dir = os.path.abspath(
+            os.path.expanduser(args.checkpoint_dir))
+        saver = checkpoint_lib.Checkpointer(
+            ckpt_dir, keep=args.ckpt_keep,
+            async_save=not args.ckpt_sync)
+        restored = checkpoint_lib.restore_latest(ckpt_dir,
+                                                 like=_state_tree(0))
+        if restored is not None:
+            # Restored leaves are host arrays; put them back as
             # replicated (uncommitted-on-one-device clashes with the
-            # mesh-sharded base inside jit).
+            # mesh-sharded base inside jit). Raw-byte round-trip: no
+            # dtype cast, so resume is bit-identical.
             from jax.sharding import NamedSharding, PartitionSpec
             replicated = NamedSharding(mesh, PartitionSpec())
-            def _replicate(ref, x):
-                return jax.device_put(jnp.asarray(x, dtype=ref.dtype),
-                                      replicated)
-            lora = jax.tree.map(_replicate, lora, restored["lora"])
-            opt_state = jax.tree.map(_replicate, opt_state,
-                                     restored["opt_state"])
-            start_step = latest
-            print(f"{recipe_name}: resumed from step {latest}", flush=True)
+            def _replicate(x):
+                return jax.device_put(jnp.asarray(x), replicated)
+            lora = jax.tree.map(_replicate, restored.tree["lora"])
+            opt_state = jax.tree.map(_replicate,
+                                     restored.tree["opt_state"])
+            train_rng = np.asarray(restored.tree["rng"])
+            start_step = int(restored.tree["step"])
+            # Data position is its own leaf (not derived from step):
+            # loops where they diverge — gradient accumulation,
+            # multi-epoch shuffles — resume the stream correctly.
+            data_start = int(restored.tree["data_pos"])
+            print(f"{recipe_name}: resumed from step {start_step}",
+                  flush=True)
 
     def constrain(x, spec):
         return mesh_lib.constrain(x, mesh, rules, spec)
@@ -158,6 +200,9 @@ def run_lora(model_lib, cfg, args, recipe_name: str) -> dict:
 
     data = synthetic_data.lm_tokens(args.seed + ctx.rank, 256,
                                     args.seq_len, cfg.vocab_size)
+    # Preemption grace: the gang layer forwards SIGTERM here; finish
+    # the in-flight step, save, exit 143 (train/checkpoint.py).
+    grace = checkpoint_lib.GraceHandler.install()
     t0 = time.time()
     loss = None
     losses = []
@@ -166,22 +211,45 @@ def run_lora(model_lib, cfg, args, recipe_name: str) -> dict:
     # `with` guarantees the trace is finalized even when a step raises.
     from skypilot_tpu import callbacks
     with callbacks.device_profile():
+        # Data position: skip replays the RNG draws of the completed
+        # steps, so step k's batch is the same whether or not the run
+        # was interrupted (bit-identical resume).
         for i, (tokens,) in enumerate(
                 synthetic_data.batches((data,), args.batch_size,
                                        args.seed,
-                                       args.steps - start_step)):
+                                       args.steps - start_step,
+                                       skip=data_start)):
             step = start_step + i + 1
             lora, opt_state, loss = step_fn(base, lora, opt_state,
                                             jnp.asarray(tokens))
             losses.append(float(loss))
-            if mgr is not None and (step % args.save_every == 0
-                                    or step == args.steps):
-                mgr.save(step, args=ocp.args.StandardSave(
-                    {"lora": lora, "opt_state": opt_state}))
+            # Chaos seam: deterministic mid-epoch crash/preempt
+            # (STPU_FAULTS="train.step:kill:skip=K").
+            if fault_injection.ENABLED:
+                fault_injection.fire("train.step", step=step)
+            # Snapshot ONCE: SIGTERM landing between a save-condition
+            # read and the exit-branch read must not skip the grace
+            # save while still reporting it happened.
+            preempting = grace.triggered
+            if saver is not None and (step % args.ckpt_every == 0
+                                      or step == args.steps
+                                      or preempting):
+                saver.save(step, _state_tree(step))
+            if preempting:
+                if saver is not None:
+                    saver.wait()  # the grace save must be durable
+                print(json.dumps({
+                    "recipe": recipe_name, "preempted": True,
+                    "resumed_from": start_step, "stopped_at": step,
+                    "last_ckpt_step": (saver.last_saved_step
+                                       if saver is not None else None),
+                }), flush=True)
+                raise SystemExit(
+                    checkpoint_lib.GraceHandler.GRACE_EXIT_CODE)
         if loss is not None:
             loss.block_until_ready()
-    if mgr is not None:
-        mgr.wait_until_finished()
+    if saver is not None:
+        saver.wait()
 
     wall = time.time() - t0
     steps_run = max(args.steps - start_step, 0)
@@ -192,6 +260,8 @@ def run_lora(model_lib, cfg, args, recipe_name: str) -> dict:
         "lora_params": num_params(lora),
         "base_params": cfg.num_params(),
         "resumed_from": start_step,
+        "last_ckpt_step": (saver.last_saved_step
+                           if saver is not None else None),
         "steps": args.steps,
         "first_loss": losses[0] if losses else None,
         "final_loss": losses[-1] if losses else None,

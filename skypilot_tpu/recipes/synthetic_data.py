@@ -68,10 +68,19 @@ def lm_tokens(seed: int, n_seqs: int, seq_len: int,
 
 
 def batches(arrays: Tuple[np.ndarray, ...], batch_size: int, seed: int,
-            steps: int) -> Iterator[Tuple[np.ndarray, ...]]:
-    """Infinite shuffled minibatch stream, sliced to `steps`."""
+            steps: int, skip: int = 0) -> Iterator[Tuple[np.ndarray, ...]]:
+    """Infinite shuffled minibatch stream, sliced to `steps`.
+
+    ``skip`` is the data-position half of checkpoint/resume: drawing
+    and discarding the first ``skip`` index batches advances the RNG
+    exactly as the original run did, so a run resumed at step k sees
+    the SAME batch at step k+1 that an uninterrupted run would — the
+    precondition for bit-identical resume (train/checkpoint.py).
+    """
     n = arrays[0].shape[0]
     rng = np.random.RandomState(seed)
+    for _ in range(skip):
+        rng.randint(0, n, size=(batch_size,))
     for _ in range(steps):
         idx = rng.randint(0, n, size=(batch_size,))
         yield tuple(a[idx] for a in arrays)

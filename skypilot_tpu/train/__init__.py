@@ -1,0 +1,4 @@
+"""Native training stack: sharded train step (trainer), multi-host
+bring-up (distributed), and crash-consistent checkpointing
+(checkpoint) — the workload half of the managed-jobs preemption
+contract."""

@@ -18,8 +18,16 @@ Spec grammar (";"-separated rules): ``point:mode[:k=v[,k=v...]]`` with
 
     mode   ``raise`` / ``error``  -> raise InjectedFault at the point
            ``delay``              -> sleep ``s`` seconds at the point
+           ``kill``               -> SIGKILL the *current process* at
+                                     the point (crash chaos: a torn
+                                     checkpoint write, a host dying
+                                     mid-step — no cleanup runs, which
+                                     is the point)
     p      trigger probability in [0, 1] (default 1.0)
     times  stop firing after this many triggers (default unlimited)
+    skip   ignore the first N otherwise-eligible hits (default 0) —
+           lets one rule target "the SECOND launch" or "step K"
+           deterministically
     s      delay seconds (``delay`` mode only, default 0.05)
 
 Probabilistic rules draw from ONE module RNG seeded by
@@ -48,6 +56,19 @@ Known points (callers may add more; names are dotted subsystem.seam):
                       readiness probe
     controller.sync   load_balancer.run_lb_process — the LB's
                       controller sync RPC failing
+    jobs.launch       jobs/recovery_strategy.StrategyExecutor._launch,
+                      before the task-cluster launch — a failed or slow
+                      (re)launch attempt
+    ckpt.write        train/checkpoint._save_locked, between writing
+                      the payload bytes and the atomic rename — a
+                      crash mid-checkpoint (``kill`` mode leaves the
+                      torn .tmp restore_latest must skip)
+    gang.host         agent/host_wrapper.main, after the gang barrier
+                      and before exec'ing the command — one host of a
+                      slice dying at start-of-run
+    train.step        recipes' training loops, after each optimizer
+                      step — preempt/crash a run mid-epoch at a
+                      deterministic step (``skip=K`` + ``kill``)
 """
 from __future__ import annotations
 
@@ -75,14 +96,16 @@ class FaultSpecError(ValueError):
 
 
 class _Rule:
-    __slots__ = ("point", "mode", "p", "times", "delay", "fired")
+    __slots__ = ("point", "mode", "p", "times", "delay", "skip",
+                 "fired", "seen")
 
     def __init__(self, point: str, mode: str = "raise", p: float = 1.0,
-                 times: Optional[int] = None, delay: float = 0.05):
-        if mode not in ("raise", "error", "delay"):
+                 times: Optional[int] = None, delay: float = 0.05,
+                 skip: int = 0):
+        if mode not in ("raise", "error", "delay", "kill"):
             raise FaultSpecError(
                 f"{point}: unknown fault mode {mode!r} "
-                "(expected raise/error/delay)")
+                "(expected raise/error/delay/kill)")
         if not 0.0 <= p <= 1.0:
             raise FaultSpecError(f"{point}: p={p} outside [0, 1]")
         self.point = point
@@ -90,7 +113,9 @@ class _Rule:
         self.p = float(p)
         self.times = None if times is None else int(times)
         self.delay = float(delay)
+        self.skip = int(skip)     # eligible hits ignored before firing
         self.fired = 0            # times this rule actually triggered
+        self.seen = 0             # eligible hits (incl. skipped ones)
 
 
 _lock = threading.Lock()
@@ -126,7 +151,7 @@ def parse_spec(spec: str) -> List[_Rule]:
                         f"fault rule {part!r}: bad param {kv!r}")
                 k, v = kv.split("=", 1)
                 k = k.strip()
-                if k not in ("p", "times", "s"):
+                if k not in ("p", "times", "s", "skip"):
                     raise FaultSpecError(
                         f"fault rule {part!r}: unknown param {k!r}")
                 try:
@@ -138,7 +163,8 @@ def parse_spec(spec: str) -> List[_Rule]:
         rules.append(_Rule(
             point, mode, p=kwargs.get("p", 1.0),
             times=(int(kwargs["times"]) if "times" in kwargs else None),
-            delay=kwargs.get("s", 0.05)))
+            delay=kwargs.get("s", 0.05),
+            skip=int(kwargs.get("skip", 0))))
     return rules
 
 
@@ -157,9 +183,10 @@ def configure(spec: str, seed: Optional[int] = None) -> None:
 
 
 def activate(point: str, mode: str = "raise", p: float = 1.0,
-             times: Optional[int] = None, delay: float = 0.05) -> None:
+             times: Optional[int] = None, delay: float = 0.05,
+             skip: int = 0) -> None:
     """Arm one fault point programmatically (tests)."""
-    rule = _Rule(point, mode, p=p, times=times, delay=delay)
+    rule = _Rule(point, mode, p=p, times=times, delay=delay, skip=skip)
     with _lock:
         _rules[point] = rule
         _refresh_enabled()
@@ -187,10 +214,10 @@ def fires(point: str) -> int:
 
 @contextlib.contextmanager
 def inject(point: str, mode: str = "raise", p: float = 1.0,
-           times: Optional[int] = None,
-           delay: float = 0.05) -> Iterator[None]:
+           times: Optional[int] = None, delay: float = 0.05,
+           skip: int = 0) -> Iterator[None]:
     """Arm ``point`` for the duration of the with-block."""
-    activate(point, mode=mode, p=p, times=times, delay=delay)
+    activate(point, mode=mode, p=p, times=times, delay=delay, skip=skip)
     try:
         yield
     finally:
@@ -211,12 +238,21 @@ def fire(point: str, **context) -> None:
             return
         if rule.p < 1.0 and _rng.random() >= rule.p:
             return
+        rule.seen += 1
+        if rule.seen <= rule.skip:
+            return
         rule.fired += 1
         mode, delay = rule.mode, rule.delay
     if mode == "delay":
         import time
         time.sleep(delay)
         return
+    if mode == "kill":
+        # Crash chaos: die the way a preempted host dies — instantly,
+        # with no chance to flush or clean up.
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
+        return  # unreachable; kill is synchronous on this thread
     detail = "".join(f" {k}={v}" for k, v in sorted(context.items()))
     raise InjectedFault(f"injected fault at {point}{detail}")
 

@@ -26,3 +26,33 @@ def cmdline_matches(pid: int, marker: str) -> bool:
     except OSError:
         return False
     return marker in argv
+
+
+def pid_state(pid: int) -> str:
+    """'dead', 'zombie', or 'running' for ``pid``.
+
+    A zombie (exited, unreaped — detached children whose parent is
+    gone) stays kill-0-able forever, so liveness checks that gate
+    adoption or teardown grace must not treat it as running.
+    PermissionError means the process exists but belongs to someone
+    else — still 'running' (the /proc files below are world-readable
+    on Linux regardless).
+    """
+    import os
+    if not pid or pid <= 0:
+        return "dead"
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return "dead"
+    except PermissionError:
+        pass
+    except OSError:
+        return "dead"
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            if f.read().rsplit(")", 1)[-1].split()[0] == "Z":
+                return "zombie"
+    except (OSError, IndexError):
+        pass  # no /proc (non-linux): kill-0 is the answer
+    return "running"

@@ -111,3 +111,60 @@ def test_compare_is_pure_and_orders_patterns_once():
         ["value", "value", "detail.serving.*"], 5.0)
     assert not regressions
     assert len([l for l in report if " value:" in l]) == 1
+
+
+# ------------------------------------------------ lower-is-better legs
+def _doc_with_ckpt(mfu, save_s, restore_s=0.5):
+    doc = _doc(mfu, 1700.0)
+    doc["detail"]["serving"]["llama_ckpt_save_s"] = save_s
+    doc["detail"]["serving"]["llama_ckpt_restore_s"] = restore_s
+    return doc
+
+
+def test_lower_is_better_regression_on_rise(tmp_path, capsys):
+    """Checkpoint latencies regress when they go UP, not down."""
+    old = _write(tmp_path, "old.json", _doc_with_ckpt(50.0, 1.0))
+    worse = _write(tmp_path, "worse.json", _doc_with_ckpt(50.0, 1.5))
+    assert bench_compare.main([old, worse]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "llama_ckpt_save_s" in out
+    assert "lower is better" in out
+
+    better = _write(tmp_path, "better.json", _doc_with_ckpt(50.0, 0.3))
+    assert bench_compare.main([old, better]) == 0
+
+
+def test_lower_is_better_threshold_and_custom_selection(tmp_path):
+    old = _write(tmp_path, "old.json", _doc_with_ckpt(50.0, 1.0))
+    slightly = _write(tmp_path, "s.json", _doc_with_ckpt(50.0, 1.04))
+    assert bench_compare.main([old, slightly]) == 0  # +4% < 5%
+    worse = _write(tmp_path, "w.json", _doc_with_ckpt(50.0, 2.0))
+    # --metrics-lower narrows the lower-is-better set.
+    assert bench_compare.main(
+        [old, worse, "--metrics-lower",
+         "detail.serving.*_ckpt_restore_s"]) == 0
+    assert bench_compare.main(
+        [old, worse, "--metrics-lower",
+         "detail.serving.*_ckpt_save_s"]) == 1
+
+
+def test_lower_metric_absent_in_old_is_skipped(tmp_path):
+    """Pre-checkpoint BENCH files (r01-r05) have no ckpt legs: the
+    glob matches nothing and the compare must not fail on that."""
+    old = _write(tmp_path, "old.json", _doc(50.0, 1700.0))
+    new = _write(tmp_path, "new.json", _doc_with_ckpt(50.0, 99.0))
+    assert bench_compare.main([old, new]) == 0
+
+
+def test_lower_pattern_wins_polarity_overlap(tmp_path):
+    """A broad higher-is-better glob must not claim latency paths away
+    from the lower-is-better set (polarity inversion)."""
+    old = _write(tmp_path, "old.json", _doc_with_ckpt(50.0, 1.0))
+    worse = _write(tmp_path, "worse.json", _doc_with_ckpt(50.0, 2.0))
+    # detail.serving.* overlaps llama_ckpt_save_s; the rise must still
+    # be a regression (and the symmetric drop must still pass).
+    assert bench_compare.main(
+        [old, worse, "--metrics", "detail.serving.*"]) == 1
+    better = _write(tmp_path, "better.json", _doc_with_ckpt(50.0, 0.4))
+    assert bench_compare.main(
+        [old, better, "--metrics", "detail.serving.*"]) == 0

@@ -1,0 +1,197 @@
+"""Recovery-strategy unit tests: placement handling and launch retry
+behavior, with execution.launch stubbed — no clusters, just the
+strategy's own control flow (ISSUE 6 satellite).
+"""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import fault_injection as fi
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+@pytest.fixture(autouse=True)
+def _no_sleep(monkeypatch):
+    """Record retry gaps instead of sleeping them."""
+    gaps = []
+    monkeypatch.setattr(recovery_strategy.time, "sleep", gaps.append)
+    return gaps
+
+
+def _task(pinned=True):
+    task = Task("rs", run="echo hi")
+    res = Resources(cloud="local")
+    task.set_resources(res)
+    if pinned:
+        task.best_resources = Resources(cloud="local", zone="zone-a")
+    return task
+
+
+class _FakeHandle:
+    pass
+
+
+def _stub_launch(monkeypatch, outcomes):
+    """execution.launch stub consuming ``outcomes``: an exception
+    instance (raised) or an int job id (returned). Records the task's
+    placement pin at each call."""
+    calls = []
+
+    def fake_launch(task, cluster_name, detach_run, stream_logs):
+        outcome = outcomes.pop(0)
+        calls.append({"best_resources": task.best_resources,
+                      "resources": tuple(task.resources)})
+        if isinstance(outcome, BaseException):
+            raise outcome
+        return outcome, _FakeHandle()
+
+    monkeypatch.setattr(recovery_strategy.execution, "launch",
+                        fake_launch)
+    return calls
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_failover_restores_resources_when_retry_raises(monkeypatch):
+    """FAILOVER's same-placement retry failing (even raising out of
+    set_resources) must leave the ORIGINAL resource set on the task
+    before the widened relaunch."""
+    task = _task(pinned=True)
+    original = tuple(task.resources)
+    strategy = recovery_strategy.RECOVERY_REGISTRY["FAILOVER"](
+        "rs-cluster", task, max_restarts_on_errors=0,
+        retry_gap_seconds=0.01)
+    calls = _stub_launch(monkeypatch, [77])
+
+    real_set = task.set_resources
+
+    def exploding_set(res):
+        if res is task.best_resources:
+            raise ValueError("boom mid-retry")
+        return real_set(res)
+
+    monkeypatch.setattr(task, "set_resources", exploding_set)
+    assert strategy.recover() == 77
+    assert tuple(task.resources) == original
+    # The widened relaunch ran with the pin dropped.
+    assert len(calls) == 1
+    assert calls[0]["best_resources"] is None
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_failover_restores_resources_when_retry_fails(monkeypatch):
+    """Same-placement attempt exhausts (swallowed failure) → resources
+    restored, then the anywhere-relaunch succeeds."""
+    task = _task(pinned=True)
+    original = tuple(task.resources)
+    pinned = task.best_resources
+    strategy = recovery_strategy.RECOVERY_REGISTRY["FAILOVER"](
+        "rs-cluster", task, max_restarts_on_errors=0,
+        retry_gap_seconds=0.01)
+    calls = _stub_launch(monkeypatch, [RuntimeError("zone gone"), 42])
+
+    assert strategy.recover() == 42
+    assert tuple(task.resources) == original
+    assert len(calls) == 2
+    # Call 1: pinned placement; call 2: relaxed.
+    assert calls[0]["best_resources"] is pinned
+    assert calls[1]["best_resources"] is None
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_eager_next_region_relaxes_before_relaunch(monkeypatch):
+    """EAGER_NEXT_REGION never retries the preempted placement: the pin
+    is dropped before the first relaunch attempt."""
+    task = _task(pinned=True)
+    strategy = recovery_strategy.RECOVERY_REGISTRY["EAGER_NEXT_REGION"](
+        "rs-cluster", task, max_restarts_on_errors=0,
+        retry_gap_seconds=0.01)
+    calls = _stub_launch(monkeypatch, [7])
+    assert strategy.recover() == 7
+    assert len(calls) == 1
+    assert calls[0]["best_resources"] is None
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_seeded_jobs_launch_fault_retry_then_succeed(monkeypatch):
+    """The jobs.launch chaos seam rides the generic-error retry path:
+    one injected fault → one backoff gap → success."""
+    task = _task(pinned=False)
+    strategy = recovery_strategy.StrategyExecutor.make(
+        "rs-cluster", task, retry_gap_seconds=0.05)
+    calls = _stub_launch(monkeypatch, [5])
+    gaps = _no_sleep_gaps(monkeypatch)
+    with fi.inject("jobs.launch", times=1):
+        assert strategy._launch(raise_on_failure=True) == 5
+        assert fi.fires("jobs.launch") == 1
+    # The fault fired BEFORE execution.launch: only the success called
+    # through.
+    assert len(calls) == 1
+
+
+def _no_sleep_gaps(monkeypatch):
+    gaps = []
+    monkeypatch.setattr(recovery_strategy.time, "sleep", gaps.append)
+    return gaps
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_launch_backoff_exponential_capped_no_trailing_sleep(
+        monkeypatch):
+    """Satellite fix: the gap doubles (with ±25% jitter) up to the cap,
+    and the FINAL failed attempt returns without sleeping."""
+    task = _task(pinned=False)
+    strategy = recovery_strategy.StrategyExecutor.make(
+        "rs-cluster", task, retry_gap_seconds=1.0)
+    _stub_launch(monkeypatch, [RuntimeError("a"), RuntimeError("b"),
+                               RuntimeError("c"), RuntimeError("d")])
+    gaps = _no_sleep_gaps(monkeypatch)
+    assert strategy._launch(raise_on_failure=False, max_retry=4) is None
+    # 4 attempts, 3 gaps — none after the last failure.
+    assert len(gaps) == 3
+    lo = 1 - recovery_strategy.RETRY_JITTER_FRACTION
+    hi = 1 + recovery_strategy.RETRY_JITTER_FRACTION
+    for i, gap in enumerate(gaps):
+        base = min(1.0 * 2 ** i,
+                   recovery_strategy.RETRY_BACKOFF_CAP_SECONDS)
+        assert base * lo <= gap <= base * hi, (i, gap)
+    # Strictly growing despite jitter (1.25 < 2 * 0.75).
+    assert gaps[0] < gaps[1] < gaps[2]
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_launch_backoff_caps(monkeypatch):
+    task = _task(pinned=False)
+    strategy = recovery_strategy.StrategyExecutor.make(
+        "rs-cluster", task,
+        retry_gap_seconds=recovery_strategy.RETRY_BACKOFF_CAP_SECONDS)
+    _stub_launch(monkeypatch, [RuntimeError("a"), RuntimeError("b"),
+                               RuntimeError("c")])
+    gaps = _no_sleep_gaps(monkeypatch)
+    assert strategy._launch(raise_on_failure=False, max_retry=3) is None
+    cap = recovery_strategy.RETRY_BACKOFF_CAP_SECONDS
+    hi = 1 + recovery_strategy.RETRY_JITTER_FRACTION
+    assert all(g <= cap * hi for g in gaps)
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_launch_resources_unavailable_raises_after_exhaustion(
+        monkeypatch):
+    task = _task(pinned=False)
+    strategy = recovery_strategy.StrategyExecutor.make(
+        "rs-cluster", task, retry_gap_seconds=0.01)
+    _stub_launch(monkeypatch, [
+        exceptions.ResourcesUnavailableError("no capacity"),
+        exceptions.ResourcesUnavailableError("still none"),
+        exceptions.ResourcesUnavailableError("nope"),
+    ])
+    with pytest.raises(exceptions.ResourcesUnavailableError,
+                       match="after 3 attempts"):
+        strategy._launch(raise_on_failure=True, max_retry=3)

@@ -9,11 +9,14 @@ by more than ``--threshold`` percent (default 5), so a PR that tanks
 decode throughput or MFU fails the pipeline instead of quietly
 shipping a slower round. Metrics are addressed by dotted path into the
 bench JSON (bench.py's single-line document) and selected by glob
-patterns; all named metrics are higher-is-better (tok/s, MFU, hit
-rate). A metric named by an EXACT (non-glob) pattern that disappears
-from the new file also fails — a silently dropped headline is a
-regression in disguise. Null values (failed legs record null + an
-_error key) are skipped with a warning line.
+patterns. Metrics come in two polarities: the default set is
+higher-is-better (tok/s, MFU, hit rate); DEFAULT_METRICS_LOWER /
+``--metrics-lower`` name lower-is-better latencies (checkpoint
+save/restore seconds), where a regression is the new value RISING by
+more than the threshold. A metric named by an EXACT (non-glob) pattern
+that disappears from the new file also fails — a silently dropped
+headline is a regression in disguise. Null values (failed legs record
+null + an _error key) are skipped with a warning line.
 """
 from __future__ import annotations
 
@@ -21,7 +24,7 @@ import argparse
 import fnmatch
 import json
 import sys
-from typing import Dict, List, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 # Higher-is-better metrics tracked round-over-round. Keep in sync with
 # bench.py's output shape (tests/test_bench_compare.py pins a fixture).
@@ -35,6 +38,13 @@ DEFAULT_METRICS = (
     "detail.serving.*_engine_ragged_tok_s",
     "detail.serving.*_engine_prefix_tok_s",
     "detail.serving.*_prefix_hit_rate",
+)
+
+# Lower-is-better metrics (latencies): a regression is the value going
+# UP by more than the threshold.
+DEFAULT_METRICS_LOWER = (
+    "detail.serving.*_ckpt_save_s",
+    "detail.serving.*_ckpt_restore_s",
 )
 
 
@@ -60,15 +70,23 @@ def flatten(doc, prefix: str = "") -> Dict[str, float]:
 
 
 def compare(old: dict, new: dict, patterns: List[str],
-            threshold_pct: float) -> Tuple[List[str], List[str]]:
+            threshold_pct: float,
+            lower_patterns: Sequence[str] = ()
+            ) -> Tuple[List[str], List[str]]:
     """(report lines, regression lines). A regression is a selected
-    metric whose new value is more than threshold_pct below old, or an
+    higher-is-better metric dropping more than threshold_pct, a
+    lower-is-better metric RISING more than threshold_pct, or an
     exact-named metric missing from the new document."""
     old_flat, new_flat = flatten(unwrap(old)), flatten(unwrap(new))
     report: List[str] = []
     regressions: List[str] = []
     seen = set()
-    for pattern in patterns:
+    # Lower-is-better patterns claim their paths FIRST: a broad
+    # higher-is-better glob (e.g. detail.serving.*) overlapping a
+    # latency metric must not invert its polarity via the seen-dedup.
+    tagged = ([(p, True) for p in lower_patterns] +
+              [(p, False) for p in patterns])
+    for pattern, lower_is_better in tagged:
         is_glob = any(c in pattern for c in "*?[")
         matched = sorted(p for p in old_flat
                          if fnmatch.fnmatchcase(p, pattern))
@@ -96,10 +114,14 @@ def compare(old: dict, new: dict, patterns: List[str],
                 continue
             change = (new_v - old_v) / old_v * 100.0
             marker = "ok"
-            if change < -threshold_pct:
+            if lower_is_better:
+                if change > threshold_pct:
+                    marker = "REGRESSION"
+            elif change < -threshold_pct:
                 marker = "REGRESSION"
             line = (f"{marker:>10}  {path}: {old_v:g} -> {new_v:g} "
-                    f"({change:+.1f}%)")
+                    f"({change:+.1f}%"
+                    f"{', lower is better' if lower_is_better else ''})")
             report.append(line)
             if marker == "REGRESSION":
                 regressions.append(line)
@@ -118,6 +140,10 @@ def main(argv=None) -> int:
                         help="comma-separated dotted-path globs "
                              "(default: the tracked serving/training "
                              "set)")
+    parser.add_argument("--metrics-lower", default=None,
+                        help="comma-separated dotted-path globs of "
+                             "LOWER-is-better metrics (default: the "
+                             "tracked checkpoint-latency set)")
     args = parser.parse_args(argv)
 
     with open(args.old) as f:
@@ -126,7 +152,10 @@ def main(argv=None) -> int:
         new = json.load(f)
     patterns = (args.metrics.split(",") if args.metrics
                 else list(DEFAULT_METRICS))
-    report, regressions = compare(old, new, patterns, args.threshold)
+    lower = (args.metrics_lower.split(",") if args.metrics_lower
+             else list(DEFAULT_METRICS_LOWER))
+    report, regressions = compare(old, new, patterns, args.threshold,
+                                  lower_patterns=lower)
     for line in report:
         print(line)
     if regressions:

@@ -25,14 +25,17 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--family", choices=("mixtral", "llama", "gemma"),
                    default="mixtral")
-    p.add_argument("--mode", choices=("fixed", "engine", "prefix"),
+    p.add_argument("--mode", choices=("fixed", "engine", "prefix",
+                                      "ckpt"),
                    default="fixed",
                    help="fixed: bucketed batch decode (r01-r05 "
                         "comparable); engine: continuous-batching "
                         "decode engine under ragged arrivals; prefix: "
                         "engine under shared-prefix traffic with the "
                         "shared-prefix KV cache on (warm/cold TTFT "
-                        "split + hit rate)")
+                        "split + hit rate); ckpt: crash-consistent "
+                        "checkpoint save/restore latency for the "
+                        "family's full param set (train/checkpoint.py)")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--tokens", type=int, default=128)
@@ -86,6 +89,9 @@ def main() -> None:
             args.family, slots=args.slots,
             shared_prefix=args.shared_prefix,
             prefix_cache_mb=args.prefix_cache_mb, **shape_kw)
+    elif args.mode == "ckpt":
+        result = decode_bench.measure_ckpt(
+            args.family, repeats=args.repeats, **shape_kw)
     else:
         result = decode_bench.measure_decode(
             args.family, batch=args.batch, prompt_len=args.prompt_len,
