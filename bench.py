@@ -380,6 +380,29 @@ def _serving_leg() -> dict:
         except Exception as e:  # noqa: BLE001
             out[key] = None
             out[f"{key}_error"] = str(e)[:200]
+        # SLO-graded serving leg: the family's engine behind a real
+        # serve_llm replica + in-process LB, driven by the open-loop
+        # load generator (benchmark/loadgen.py) under the chat mix —
+        # goodput under TTFT/TPOT SLOs, p99 TTFT, and achieved tok/s
+        # under Poisson load. bench_compare gates goodput/tok_s as
+        # higher-is-better and p99 TTFT as lower-is-better, so LB-
+        # policy/autoscaler/engine regressions that only show under
+        # concurrent load fail the pipeline like MFU regressions do.
+        key = f"{family}_slo_goodput"
+        try:
+            r = run_tool(["--family", family, "--mode", "loadgen"],
+                         timeout=1200)
+            out[key] = r["slo_goodput"]
+            out[f"{family}_p99_ttft_s"] = r["p99_ttft_s"]
+            out[f"{family}_loadgen_tok_s"] = r["loadgen_tok_s"]
+            out[f"{family}_loadgen_detail"] = {
+                k: r[k] for k in ("offered_qps", "achieved_qps",
+                                  "requests", "errors", "slo_ttft_s",
+                                  "slo_tpot_s", "p50_ttft_s",
+                                  "schedule_sha256")}
+        except Exception as e:  # noqa: BLE001
+            out[key] = None
+            out[f"{key}_error"] = str(e)[:200]
         # Checkpoint save/restore latency for the family's full param
         # set (train/checkpoint.py): bounds the step-path cost of
         # --ckpt-every and the relaunch stall of a preemption recovery.

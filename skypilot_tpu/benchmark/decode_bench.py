@@ -26,6 +26,11 @@ Two measurements:
     throughput, hit rate, prefill tokens saved, and the warm/cold
     TTFT split (both wall seconds and deterministic
     steps-to-first-token).
+  * ``measure_engine_slo`` — the whole data plane (serve_llm replica
+    behind an in-process LB) under the open-loop load generator
+    (benchmark/loadgen.py): goodput under declared TTFT/TPOT SLOs,
+    p99 TTFT, and achieved tok/s under Poisson load — the
+    bench_compare-gated serving-SLO leg.
 
 Models are scaled to fit one v5e chip (full 8x7B / 8B need a pod
 slice).
@@ -314,6 +319,119 @@ def measure_engine_prefix(family: str, slots: int = 8,
         "steps_to_first_token_cold": cold.prefill_chunks,
         "steps_to_first_token_warm": max(r.prefill_chunks
                                          for r in reqs),
+    }
+
+
+def measure_engine_slo(family: str, *, slots: int = 8,
+                       qps: float = 6.0, duration_s: float = 8.0,
+                       seed: int = 0, slo_ttft_s: float = 3.0,
+                       slo_tpot_s: float = 0.5,
+                       max_tokens: int = 16,
+                       **shape_kw) -> Dict[str, Any]:
+    """SLO-graded serving leg: the family's engine behind a REAL
+    serve_llm replica and an in-process LB, driven by the open-loop
+    load generator (benchmark/loadgen.py) under the shared-prefix chat
+    mix. Unlike measure_engine_ragged (engine in isolation, submit-all
+    -at-once), this measures what a USER sees through the whole data
+    plane — HTTP parse, LB proxy hop, engine queueing under a Poisson
+    arrival process — and grades it against declared TTFT/TPOT SLOs.
+    The reported ``slo_goodput`` / ``p99_ttft_s`` / ``loadgen_tok_s``
+    are the bench_compare-gated headline: an LB-policy, autoscaler, or
+    engine regression that only shows under concurrent load lands
+    here, where the isolated-engine legs stay green.
+    """
+    import json
+    import tempfile
+    import threading
+    import urllib.request
+
+    from skypilot_tpu.benchmark import loadgen
+    from skypilot_tpu.recipes import serve_llm
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.serve.load_balancing_policies import (
+        PrefixAffinityPolicy)
+    from skypilot_tpu.serve.replica_managers import _free_port
+
+    mdl, cfg = build(family, **shape_kw)
+    params = mdl.init(cfg, jax.random.key(0))
+    port, lb_port = _free_port(), _free_port()
+    httpd = serve_llm.serve(cfg, params, port, engine_slots=slots)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    replica_url = f"http://127.0.0.1:{port}"
+    deadline = time.time() + 600
+    while time.time() < deadline:          # warmup = first compile
+        try:
+            with urllib.request.urlopen(replica_url + "/health",
+                                        timeout=2) as resp:
+                if resp.status == 200:
+                    break
+        except Exception:  # noqa: stpu-except — warming; poll again
+            pass
+        time.sleep(0.2)
+    else:
+        raise RuntimeError("replica never became healthy")
+
+    spec = loadgen.LoadSpec(
+        mix="chat", arrival="poisson", qps=qps, duration_s=duration_s,
+        seed=seed, max_tokens=max_tokens,
+        vocab=min(cfg.vocab_size, 32000))
+    # Warm the FULL serving path before the clock starts: beyond
+    # engine.warmup()'s prefill/decode programs, the first
+    # shared-prefix traffic compiles the prefix-cache gather (slot
+    # free publishes chunks) and insert (hit restores them) splices —
+    # 30-60s each on a tunneled chip. A cold trace would measure the
+    # XLA compiler, not the serving stack: the first requests eat the
+    # compiles and everything queued behind them times out at the LB.
+    # Two sequential requests sharing the TRACE's own first prefix
+    # force every program exactly once.
+    warm_prefix = loadgen._prefixes(spec)[0]
+    for i in range(2):
+        body = json.dumps({"prompt": warm_prefix + [17 + i],
+                           "max_tokens": 2}).encode()
+        warm_req = urllib.request.Request(
+            replica_url + "/generate", data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(warm_req, timeout=600) as resp:
+            resp.read()
+
+    policy = PrefixAffinityPolicy()
+    policy.set_ready_replicas([replica_url])
+    lb = lb_lib.run_load_balancer(lb_port, policy,
+                                  lb_lib.RequestRecorder())
+    # Tail requests queue behind slot contention under load; the LB's
+    # default 120s first-byte timeout would convert a saturated-but-
+    # alive engine into 502s mid-leg.
+    lb.RequestHandlerClass.upstream_timeout = 300.0
+    try:
+        report = loadgen.run(
+            f"http://127.0.0.1:{lb_port}", spec,
+            slo_ttft_s=slo_ttft_s, slo_tpot_s=slo_tpot_s,
+            scrape_interval=1.0,
+            out_dir=tempfile.mkdtemp(
+                prefix=f"stpu-loadgen-bench-{family}-"),
+            request_timeout=300.0)
+    finally:
+        lb.shutdown()
+        if httpd.engine is not None:
+            httpd.engine.shutdown()
+        httpd.shutdown()
+    ttft = report["latency_s"]["ttft"] or {}
+    return {
+        "model": _model_info(family, cfg, params),
+        "slots": slots,
+        "offered_qps": report["qps"]["offered"],
+        "achieved_qps": report["qps"]["achieved"],
+        "requests": report["requests"]["scheduled"],
+        "errors": report["requests"]["error"],
+        "slo_ttft_s": slo_ttft_s,
+        "slo_tpot_s": slo_tpot_s,
+        "slo_goodput": report["goodput"]["fraction"],
+        "p99_ttft_s": ttft.get("p99"),
+        "p50_ttft_s": ttft.get("p50"),
+        "loadgen_tok_s": report["tokens"]["tok_s"],
+        "schedule_sha256": report["schedule_sha256"],
+        "report_dir": report["out_dir"],
     }
 
 

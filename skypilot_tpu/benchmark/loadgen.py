@@ -1,0 +1,766 @@
+"""Open-loop, trace-driven load generator with SLO reports.
+
+Reference analogs: the LoadGen harness of MLPerf Inference (open-loop
+Poisson "server" scenario — the arrival process never waits for the
+system under test, so a slow stack accumulates queue instead of
+silently throttling the offered load) and vLLM's benchmark_serving.py
+(client-side TTFT/TPOT from the streamed tokens). bench.py measures
+engines in ISOLATION and the chaos tests inject single faults; this is
+the missing piece that drives the full LB -> replica -> engine stack
+the way a million users would, and turns the histograms the stack
+already exports into SLO VERDICTS.
+
+Three layers, each usable alone:
+
+* **Schedule** — ``build_schedule(spec)`` expands a ``LoadSpec`` into a
+  concrete trace: per-request arrival offset, prompt token ids, token
+  budget, sampling seed. Everything is derived from ONE seeded RNG, so
+  the same spec + seed replays bit-identically (``schedule_digest``
+  pins it) — a latency regression seen in production traffic shapes
+  can be handed to a teammate as ``--mix chat --qps 50 --seed 7``.
+  Mixes: ``chat`` (a few shared system prompts + unique tails — the
+  prefix-cache/affinity shape), ``long_context`` (long prompts, short
+  outputs — prefill-dominated), ``bursty`` (chat content under a
+  diurnal rate wave). Arrivals: ``poisson`` (memoryless, the
+  open-loop default), ``ramp`` (rate climbs linearly across the run —
+  finds the knee), ``uniform`` (fixed spacing — isolates queueing from
+  arrival variance).
+
+* **Driver** — ``run(target, spec)`` fires the schedule at a live
+  stack over HTTP (POST /generate, SSE streaming), OPEN LOOP: requests
+  launch at their scheduled instant no matter how many are still in
+  flight. Client-observed TTFT / TPOT / end-to-end latency per request;
+  meanwhile a run-scoped scraper thread snapshots the target's
+  ``/metrics`` every ``scrape_interval`` seconds into a JSONL time
+  series (``metrics.jsonl`` in the run dir, same append path as the
+  events/traces sinks) parsed via ``observability/promtext.py``.
+  ``faults=...`` arms the deterministic chaos seams
+  (utils/fault_injection.py) ``faults_at`` seconds INTO the run — an
+  in-process stack (tests, serve_llm --lb-port, the bench leg)
+  degrades mid-run and the report shows it; remote stacks arm via
+  STPU_FAULTS in their own environment instead.
+
+* **Report** — client percentiles + achieved-vs-offered QPS +
+  goodput-under-SLO (the fraction of ALL scheduled requests that
+  completed AND met ``--slo-ttft``/``--slo-tpot``; errors and drops
+  count against it), cross-checked with SERVER-side percentiles
+  interpolated from the first/last Prometheus histogram snapshots
+  (engine TTFT, LB latency) and LB retry/breaker/status counters over
+  the run window. Written as ``report.json`` for machines
+  (bench_compare gates ``{family}_slo_goodput`` / ``{family}_p99_ttft_s``
+  on it) and rendered by ``stpu loadgen report`` for humans. With
+  tracing armed (STPU_TRACE=1) every request the LB handles carries a
+  span tree, so a slow p99 in the report links to concrete
+  ``stpu trace show`` timelines from the same window.
+
+Stdlib-only; no jax import — the generator must run from a laptop
+against a remote endpoint.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from random import Random
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu.observability import events
+from skypilot_tpu.observability import jsonl_log
+from skypilot_tpu.observability import promtext
+from skypilot_tpu.utils import fault_injection
+
+MIXES = ("chat", "long_context", "bursty")
+ARRIVALS = ("poisson", "ramp", "uniform")
+
+# Rotation cap for the per-run metrics time series (jsonl_log): a
+# pathological day-long scrape must not fill the disk.
+_SERIES_MAX_BYTES = 64 * 1024 * 1024
+
+# Server-side histogram families the report interpolates percentiles
+# from (engine TTFT rides the LB /metrics via the replica scrape).
+_TTFT_FAMILY = "stpu_engine_ttft_seconds"
+_LB_LATENCY_FAMILY = "stpu_lb_request_duration_seconds"
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadSpec:
+    """A replayable workload: (spec, seed) fully determines the trace."""
+    mix: str = "chat"
+    arrival: str = "poisson"
+    qps: float = 8.0                 # base offered arrival rate
+    duration_s: float = 10.0
+    seed: int = 0
+    # chat / bursty mixes: shared system prompts.
+    shared_prefix: int = 64          # tokens per shared prefix (one
+    #                                  engine prefill chunk = cacheable)
+    n_prefixes: int = 4              # distinct system prompts in play
+    prompt_tokens: int = 96          # mean TOTAL chat prompt length
+    # long_context mix: prefill-heavy prompts.
+    long_prompt_tokens: int = 640    # mean long-context prompt length
+    max_prompt_tokens: int = 960     # hard cap (serve_llm caps at 1024)
+    max_tokens: int = 32             # per-request decode budget cap
+    temperature: float = 0.0
+    vocab: int = 32000
+    # bursty mix: diurnal wave on top of the arrival process.
+    burst_factor: float = 4.0        # peak rate = burst_factor x qps
+    burst_period_s: float = 4.0      # one trough->peak->trough cycle
+
+    def validate(self) -> "LoadSpec":
+        if self.mix not in MIXES:
+            raise ValueError(f"unknown mix {self.mix!r}; one of {MIXES}")
+        if self.arrival not in ARRIVALS:
+            raise ValueError(
+                f"unknown arrival {self.arrival!r}; one of {ARRIVALS}")
+        if self.qps <= 0 or self.duration_s <= 0:
+            raise ValueError("qps and duration_s must be positive")
+        return self
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduledRequest:
+    index: int
+    at: float                        # seconds after run start
+    prompt: Tuple[int, ...]
+    max_tokens: int
+    temperature: float
+    seed: int                        # per-request sampling seed
+
+
+def _rate_at(spec: LoadSpec, t: float) -> float:
+    """Instantaneous arrival rate. The bursty mix modulates the base
+    rate with a raised-cosine diurnal wave (troughs at cycle edges,
+    ``burst_factor`` x qps at the crest); ramp climbs linearly from
+    25% to 175% of qps across the run so one trace sweeps the knee."""
+    rate = spec.qps
+    if spec.arrival == "ramp":
+        rate = spec.qps * (0.25 + 1.5 * min(t / spec.duration_s, 1.0))
+    if spec.mix == "bursty":
+        phase = 0.5 * (1.0 - math.cos(
+            2.0 * math.pi * t / max(spec.burst_period_s, 1e-6)))
+        rate *= 1.0 + (spec.burst_factor - 1.0) * phase
+    return max(rate, 1e-6)
+
+
+def _prefixes(spec: LoadSpec) -> List[List[int]]:
+    """The mix's shared system prompts — derived from the seed alone
+    (NOT the arrival RNG), so two specs differing only in qps/duration
+    still share prompt identity and a replica cache warmed by one trace
+    is warm for the other."""
+    out = []
+    for i in range(max(spec.n_prefixes, 1)):
+        rng = Random(f"{spec.seed}/prefix/{i}")
+        out.append([rng.randrange(1, spec.vocab)
+                    for _ in range(spec.shared_prefix)])
+    return out
+
+
+def build_schedule(spec: LoadSpec) -> List[ScheduledRequest]:
+    """Expand the spec into a concrete, replayable trace. One RNG,
+    seeded by ``spec.seed``, drives arrivals AND content in a fixed
+    draw order — the bit-identical-replay contract the smoke test
+    pins."""
+    spec.validate()
+    rng = Random(f"{spec.seed}/schedule")
+    prefixes = _prefixes(spec)
+    out: List[ScheduledRequest] = []
+    t = 0.0
+    index = 0
+    while True:
+        rate = _rate_at(spec, t)
+        if spec.arrival == "uniform":
+            gap = 1.0 / rate
+        else:
+            gap = rng.expovariate(rate)
+        t += gap
+        if t >= spec.duration_s:
+            break
+        if spec.mix == "long_context":
+            lo = max(spec.long_prompt_tokens // 2, 16)
+            hi = min(spec.long_prompt_tokens * 3 // 2,
+                     spec.max_prompt_tokens)
+            prompt = [rng.randrange(1, spec.vocab)
+                      for _ in range(rng.randint(lo, max(hi, lo)))]
+            max_tokens = rng.randint(1, max(spec.max_tokens // 4, 1))
+        else:
+            prefix = prefixes[rng.randrange(len(prefixes))]
+            tail_budget = max(spec.prompt_tokens - len(prefix), 8)
+            tail = [rng.randrange(1, spec.vocab)
+                    for _ in range(rng.randint(4, tail_budget))]
+            prompt = (prefix + tail)[:spec.max_prompt_tokens]
+            max_tokens = rng.randint(max(spec.max_tokens // 4, 1),
+                                     max(spec.max_tokens, 1))
+        out.append(ScheduledRequest(
+            index=index, at=t, prompt=tuple(prompt),
+            max_tokens=max_tokens, temperature=spec.temperature,
+            seed=rng.getrandbits(32)))
+        index += 1
+    return out
+
+
+def schedule_digest(schedule: List[ScheduledRequest]) -> str:
+    """sha256 over the full schedule content (arrival offsets at full
+    float precision, prompts, budgets, seeds) — equal digests mean
+    bit-identical traces."""
+    doc = [[r.index, repr(r.at), list(r.prompt), r.max_tokens,
+            repr(r.temperature), r.seed] for r in schedule]
+    return hashlib.sha256(
+        json.dumps(doc, separators=(",", ":")).encode()).hexdigest()
+
+
+# ------------------------------------------------------------- scraper
+class MetricsScraper:
+    """Run-scoped /metrics snapshotter: every ``interval`` seconds the
+    target's exposition is fetched, parsed (promtext), and appended as
+    one JSONL record to ``series_path`` — a metric time series scoped
+    to THIS run, beside the events/traces sinks. The first and last
+    successful snapshots are kept in memory for the report's
+    histogram-delta percentiles."""
+
+    def __init__(self, target: str, interval: float, series_path):
+        import pathlib
+        self._url = target.rstrip("/") + "/metrics"
+        self.interval = float(interval)
+        self.series_path = pathlib.Path(series_path)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = 0.0
+        self.first: Optional[Dict[str, promtext.Family]] = None
+        self.last: Optional[Dict[str, promtext.Family]] = None
+        self.snapshots = 0
+        self.failures = 0
+
+    def scrape_once(self) -> Optional[Dict[str, promtext.Family]]:
+        now = time.time()
+        offset = round(time.perf_counter() - self._t0, 3) \
+            if self._t0 else 0.0
+        try:
+            with urllib.request.urlopen(self._url, timeout=5) as resp:
+                text = resp.read().decode("utf-8", "replace")
+            families = promtext.parse(text)
+        except Exception as e:  # noqa: BLE001 — a scrape failure is a
+            # data point (the stack was unreachable), never a crash.
+            self.failures += 1
+            record = {"ts": now, "offset": offset,
+                      "error": f"{type(e).__name__}: {e}"}
+            jsonl_log.append_line(self.series_path, json.dumps(record),
+                                  _SERIES_MAX_BYTES, self._lock)
+            return None
+        if self.first is None:
+            self.first = families
+        self.last = families
+        self.snapshots += 1
+        record = {
+            "ts": now, "offset": offset,
+            "families": {
+                name: {"kind": fam.kind,
+                       "samples": [[s.name, dict(s.labels), s.value]
+                                   for s in fam.samples]}
+                for name, fam in families.items()},
+        }
+        jsonl_log.append_line(self.series_path, json.dumps(record),
+                              _SERIES_MAX_BYTES, self._lock)
+        return families
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+        self.scrape_once()               # baseline snapshot at t=0
+        self._thread = threading.Thread(target=self._loop,
+                                        name="loadgen-scraper",
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.scrape_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval + 5)
+        self.scrape_once()               # closing snapshot
+
+    # ----------------------------------------------------- report side
+    def histogram_delta(self, name: str
+                        ) -> Optional[promtext.HistogramSnapshot]:
+        if self.first is None or self.last is None:
+            return None
+        end = promtext.histogram(self.last, name)
+        if end is None:
+            return None
+        begin = promtext.histogram(self.first, name)
+        if begin is None:
+            return end
+        try:
+            return end.delta(begin)
+        except ValueError:
+            return end                   # process restarted mid-run
+
+    def counter_delta(self, name: str, **labels) -> float:
+        if self.last is None:
+            return 0.0
+        end = promtext.counter_total(self.last, name, **labels)
+        begin = promtext.counter_total(self.first or {}, name, **labels)
+        return max(end - begin, 0.0)
+
+    def counter_by_label(self, name: str, key: str) -> Dict[str, float]:
+        """Per-label-value counter deltas, e.g. LB requests by code."""
+        if self.last is None:
+            return {}
+        out: Dict[str, float] = {}
+        fam = self.last.get(name)
+        if fam is None:
+            return {}
+        for s in fam.samples:
+            val = s.label(key)
+            if not val:
+                continue
+            out[val] = out.get(val, 0.0) + s.value
+        if self.first is not None:
+            prev = self.first.get(name)
+            if prev is not None:
+                for s in prev.samples:
+                    val = s.label(key)
+                    if val in out:
+                        out[val] -= s.value
+        return {k: v for k, v in out.items() if v > 0}
+
+
+# -------------------------------------------------------------- driver
+def _percentile(values: List[float], q: float) -> Optional[float]:
+    """Linear-interpolation percentile of raw client samples."""
+    if not values:
+        return None
+    vals = sorted(values)
+    if len(vals) == 1:
+        return vals[0]
+    pos = q * (len(vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(vals) - 1)
+    return vals[lo] + (vals[hi] - vals[lo]) * (pos - lo)
+
+
+def _pctiles(values: List[float]) -> Optional[Dict[str, float]]:
+    if not values:
+        return None
+    return {f"p{int(q * 100)}": round(_percentile(values, q), 6)
+            for q in (0.5, 0.9, 0.95, 0.99)}
+
+
+class _RequestWorker(threading.Thread):
+    """One scheduled request: POST /generate with stream=true, stamp
+    the first/last token arrival off the SSE events."""
+
+    def __init__(self, target: str, req: ScheduledRequest, t0: float,
+                 timeout: float, sink: List[dict], lock):
+        super().__init__(daemon=True, name=f"loadgen-{req.index}")
+        self._target = target
+        self._req = req
+        self._t0 = t0
+        self._timeout = timeout
+        self._sink = sink
+        self._lock = lock
+
+    def run(self) -> None:
+        req = self._req
+        record: Dict[str, Any] = {
+            "index": req.index,
+            "scheduled_at": round(req.at, 6),
+            "prompt_tokens": len(req.prompt),
+            "max_tokens": req.max_tokens,
+            "ok": False, "code": 0, "tokens": 0,
+            "ttft_s": None, "tpot_s": None, "e2e_s": None,
+            "error": None,
+        }
+        body = json.dumps({
+            "prompt": list(req.prompt), "max_tokens": req.max_tokens,
+            "temperature": req.temperature, "seed": req.seed,
+            "stream": True,
+        }).encode()
+        http_req = urllib.request.Request(
+            self._target.rstrip("/") + "/generate", data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        sent = time.perf_counter()
+        record["sent_offset"] = round(sent - self._t0, 6)
+        record["dispatch_lag_s"] = round(sent - self._t0 - req.at, 6)
+        first_at = last_at = None
+        tokens = 0
+        done = False
+        try:
+            with urllib.request.urlopen(
+                    http_req, timeout=self._timeout) as resp:
+                record["code"] = resp.status
+                buf = b""
+                while True:
+                    chunk = resp.read1(65536)
+                    now = time.perf_counter()
+                    if not chunk:
+                        break
+                    buf += chunk
+                    while b"\n\n" in buf:
+                        event, buf = buf.split(b"\n\n", 1)
+                        for line in event.splitlines():
+                            if not line.startswith(b"data: "):
+                                continue
+                            payload = line[len(b"data: "):]
+                            if payload.strip() == b"[DONE]":
+                                done = True
+                                continue
+                            try:
+                                json.loads(payload)
+                            except ValueError:
+                                continue
+                            tokens += 1
+                            last_at = now
+                            if first_at is None:
+                                first_at = now
+        except urllib.error.HTTPError as e:
+            record["code"] = e.code
+            record["error"] = f"http_{e.code}"
+            try:
+                e.read()
+            except OSError:
+                pass
+        except Exception as e:  # noqa: BLE001 — connect refused, reset,
+            # timeout, truncated stream: all are load-test outcomes.
+            record["error"] = type(e).__name__
+        finish = time.perf_counter()
+        record["tokens"] = tokens
+        if first_at is not None:
+            record["ttft_s"] = round(first_at - sent, 6)
+            if tokens > 1:
+                record["tpot_s"] = round(
+                    (last_at - first_at) / (tokens - 1), 6)
+        record["e2e_s"] = round(finish - sent, 6)
+        # ok = the stream COMPLETED ([DONE] seen): a truncated stream
+        # or transport error is not a served request, whatever the
+        # status line said.
+        record["ok"] = bool(done) and record["error"] is None \
+            and record["code"] == 200
+        if record["error"] is None and not done:
+            record["error"] = "truncated_stream"
+        with self._lock:
+            self._sink.append(record)
+
+
+def run(target: str, spec: LoadSpec, *,
+        slo_ttft_s: Optional[float] = None,
+        slo_tpot_s: Optional[float] = None,
+        scrape_interval: float = 1.0,
+        out_dir: Optional[str] = None,
+        faults: Optional[str] = None,
+        faults_at: float = 0.0,
+        request_timeout: float = 120.0) -> Dict[str, Any]:
+    """Fire ``spec``'s schedule at ``target`` (the LB endpoint) and
+    return the SLO report (also persisted to ``<out_dir>/report.json``
+    next to ``schedule.json`` and the scraped ``metrics.jsonl``)."""
+    spec.validate()
+    if faults:
+        # Fail fast on a malformed spec — not mid-run with the scraper
+        # already started and partial artifacts on disk.
+        fault_injection.parse_spec(faults)
+    schedule = build_schedule(spec)
+    digest = schedule_digest(schedule)
+    run_dir = _resolve_out_dir(out_dir, spec)
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, "schedule.json"), "w") as f:
+        json.dump({
+            "spec": dataclasses.asdict(spec),
+            "digest": digest,
+            "requests": [
+                {"index": r.index, "at": r.at,
+                 "prompt": list(r.prompt), "max_tokens": r.max_tokens,
+                 "temperature": r.temperature, "seed": r.seed}
+                for r in schedule],
+        }, f)
+
+    scraper = MetricsScraper(target, scrape_interval,
+                             os.path.join(run_dir, "metrics.jsonl"))
+    events.emit("loadgen", os.path.basename(run_dir), "run_start",
+                target=target, mix=spec.mix, arrival=spec.arrival,
+                qps=spec.qps, duration_s=spec.duration_s,
+                seed=spec.seed, requests=len(schedule), digest=digest)
+
+    results: List[dict] = []
+    results_lock = threading.Lock()
+    workers: List[_RequestWorker] = []
+    fault_timer: Optional[threading.Timer] = None
+    armed_faults = False
+    if faults:
+        def _arm():
+            fault_injection.configure(faults)
+        fault_timer = threading.Timer(max(faults_at, 0.0), _arm)
+        fault_timer.daemon = True
+        armed_faults = True
+
+    scraper.start()
+    t0 = time.perf_counter()
+    if fault_timer is not None:
+        if faults_at <= 0:
+            fault_injection.configure(faults)
+            fault_timer = None
+        else:
+            fault_timer.start()
+    try:
+        # Open-loop dispatch: each request fires at its scheduled
+        # instant, never gated on completions — a saturated stack sees
+        # the queue it would see in production, not a self-throttling
+        # closed loop.
+        for req in schedule:
+            delay = t0 + req.at - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            worker = _RequestWorker(target, req, t0, request_timeout,
+                                    results, results_lock)
+            worker.start()
+            workers.append(worker)
+        dispatch_window = time.perf_counter() - t0
+        deadline = time.perf_counter() + request_timeout + 5.0
+        for worker in workers:
+            worker.join(timeout=max(deadline - time.perf_counter(),
+                                    0.1))
+    finally:
+        if fault_timer is not None:
+            fault_timer.cancel()
+        wall = time.perf_counter() - t0
+        scraper.stop()
+        if armed_faults:
+            # The run armed this process's seams; a later run (or the
+            # host process) must not inherit them.
+            fault_injection.clear()
+
+    with results_lock:
+        # Snapshot: a straggler worker past its join deadline may still
+        # append while the report is being assembled.
+        results_snapshot = list(results)
+    report = _build_report(spec, schedule, digest, results_snapshot,
+                           wall, scraper, target,
+                           dispatch_window=dispatch_window,
+                           slo_ttft_s=slo_ttft_s,
+                           slo_tpot_s=slo_tpot_s,
+                           faults=faults, faults_at=faults_at)
+    report["out_dir"] = run_dir
+    with open(os.path.join(run_dir, "report.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    events.emit("loadgen", os.path.basename(run_dir), "run_complete",
+                goodput=report["goodput"]["fraction"],
+                achieved_qps=report["qps"]["achieved"],
+                errors=report["requests"]["error"])
+    return report
+
+
+def _resolve_out_dir(out_dir: Optional[str], spec: LoadSpec) -> str:
+    if out_dir:
+        return str(out_dir)
+    from skypilot_tpu.utils import paths
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    return str(paths.logs_dir() / "loadgen"
+               / f"{stamp}-{spec.mix}-seed{spec.seed}")
+
+
+def runs_root() -> str:
+    from skypilot_tpu.utils import paths
+    return str(paths.logs_dir() / "loadgen")
+
+
+def latest_run_dir() -> Optional[str]:
+    """Newest run dir holding a report.json (for `stpu loadgen
+    report` with no argument)."""
+    root = runs_root()
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return None
+    for name in reversed(names):
+        candidate = os.path.join(root, name)
+        if os.path.exists(os.path.join(candidate, "report.json")):
+            return candidate
+    return None
+
+
+def _build_report(spec, schedule, digest, results, wall, scraper,
+                  target, *, dispatch_window, slo_ttft_s, slo_tpot_s,
+                  faults, faults_at) -> Dict[str, Any]:
+    results = sorted(results, key=lambda r: r["index"])
+    ok = [r for r in results if r["ok"]]
+    ttfts = [r["ttft_s"] for r in ok if r["ttft_s"] is not None]
+    tpots = [r["tpot_s"] for r in ok if r["tpot_s"] is not None]
+    e2es = [r["e2e_s"] for r in ok if r["e2e_s"] is not None]
+    total_tokens = sum(r["tokens"] for r in results)
+    # Throughput window: run start -> last SERVED completion. The raw
+    # wall is join-bounded, so one wedged stream waiting out its socket
+    # timeout would deflate achieved QPS / tok_s by an order of
+    # magnitude (and trip bench_compare) even with goodput untouched.
+    done_at = [r["sent_offset"] + r["e2e_s"]
+               for r in (ok or results) if r.get("e2e_s") is not None]
+    window = max(done_at) if done_at else wall
+
+    def meets_slo(r) -> bool:
+        if not r["ok"]:
+            return False
+        if slo_ttft_s is not None and (r["ttft_s"] is None
+                                       or r["ttft_s"] > slo_ttft_s):
+            return False
+        if slo_tpot_s is not None and r["tpot_s"] is not None \
+                and r["tpot_s"] > slo_tpot_s:
+            return False
+        return True
+
+    # Goodput over SCHEDULED requests: a request that never completed
+    # (still hung at join deadline) counts against goodput exactly like
+    # an error — the user it represents was not served.
+    good = sum(1 for r in results if meets_slo(r))
+    n_sched = len(schedule)
+    error_count = sum(1 for r in results if not r["ok"])
+    errors_by_kind: Dict[str, int] = {}
+    for r in results:
+        if r["ok"]:
+            continue
+        kind = r["error"] or f"http_{r['code']}"
+        errors_by_kind[kind] = errors_by_kind.get(kind, 0) + 1
+
+    server: Dict[str, Any] = {"scrapes": scraper.snapshots,
+                              "scrape_failures": scraper.failures}
+    ttft_hist = scraper.histogram_delta(_TTFT_FAMILY)
+    if ttft_hist is not None and ttft_hist.count > 0:
+        server["engine_ttft"] = {
+            "count": ttft_hist.count,
+            "p50": round(ttft_hist.quantile(0.50), 6),
+            "p90": round(ttft_hist.quantile(0.90), 6),
+            "p99": round(ttft_hist.quantile(0.99), 6),
+        }
+    lb_hist = scraper.histogram_delta(_LB_LATENCY_FAMILY)
+    if lb_hist is not None and lb_hist.count > 0:
+        server["lb_latency"] = {
+            "count": lb_hist.count,
+            "p50": round(lb_hist.quantile(0.50), 6),
+            "p99": round(lb_hist.quantile(0.99), 6),
+        }
+    server["lb_retries"] = scraper.counter_delta(
+        "stpu_lb_upstream_retries_total")
+    server["lb_breaker_ejections"] = scraper.counter_delta(
+        "stpu_lb_breaker_ejections_total")
+    by_code = scraper.counter_by_label("stpu_lb_requests_total", "code")
+    if by_code:
+        server["lb_requests_by_code"] = by_code
+
+    offered = n_sched / spec.duration_s
+    return {
+        "version": 1,
+        "target": target,
+        "spec": dataclasses.asdict(spec),
+        "schedule_sha256": digest,
+        "wall_seconds": round(wall, 3),
+        "serving_window_seconds": round(window, 3),
+        "faults": faults, "faults_at_s": faults_at if faults else None,
+        "qps": {
+            "offered": round(offered, 3),
+            # Every scheduled request IS dispatched (open loop); the
+            # sent rate differs from offered only by dispatch lag —
+            # dividing by completion wall time would silently turn
+            # 'sent' into a completion rate under saturation.
+            "sent": round(n_sched / max(dispatch_window, 1e-9), 3),
+            "achieved": round(len(ok) / max(window, 1e-9), 3),
+        },
+        "requests": {
+            "scheduled": n_sched,
+            "completed": len(results),
+            "ok": len(ok),
+            "error": error_count,
+            "unfinished": n_sched - len(results),
+            "errors_by_kind": errors_by_kind,
+        },
+        "latency_s": {
+            "ttft": _pctiles(ttfts),
+            "tpot": _pctiles(tpots),
+            "e2e": _pctiles(e2es),
+        },
+        "goodput": {
+            "slo_ttft_s": slo_ttft_s,
+            "slo_tpot_s": slo_tpot_s,
+            "good": good,
+            "fraction": round(good / max(n_sched, 1), 4),
+        },
+        "tokens": {
+            "generated": total_tokens,
+            "tok_s": round(total_tokens / max(window, 1e-9), 1),
+        },
+        "server": server,
+        "per_request": results,
+    }
+
+
+# ------------------------------------------------------------ renderer
+def format_report(report: Dict[str, Any]) -> str:
+    """Human rendering of a report dict (`stpu loadgen` / `stpu
+    loadgen report`)."""
+    spec = report.get("spec", {})
+    qps = report.get("qps", {})
+    reqs = report.get("requests", {})
+    good = report.get("goodput", {})
+    lat = report.get("latency_s", {})
+    server = report.get("server", {})
+    lines = [
+        f"run        {report.get('out_dir', '-')}",
+        f"target     {report.get('target', '-')}",
+        f"workload   mix={spec.get('mix')} arrival={spec.get('arrival')}"
+        f" qps={spec.get('qps')} duration={spec.get('duration_s')}s"
+        f" seed={spec.get('seed')}",
+        f"schedule   {reqs.get('scheduled')} requests"
+        f" sha256={str(report.get('schedule_sha256', ''))[:12]}…",
+        f"qps        offered {qps.get('offered')}  sent {qps.get('sent')}"
+        f"  achieved {qps.get('achieved')}",
+        f"requests   ok {reqs.get('ok')}  error {reqs.get('error')}"
+        f"  unfinished {reqs.get('unfinished')}"
+        + (f"  ({', '.join(f'{k}={v}' for k, v in sorted(reqs.get('errors_by_kind', {}).items()))})"
+           if reqs.get("errors_by_kind") else ""),
+        f"tokens     {report.get('tokens', {}).get('generated')} generated"
+        f" ({report.get('tokens', {}).get('tok_s')} tok/s)",
+    ]
+    if report.get("faults"):
+        lines.append(f"faults     {report['faults']} "
+                     f"(armed at t+{report.get('faults_at_s')}s)")
+
+    def fmt_p(name: str, p: Optional[Dict[str, float]]) -> str:
+        if not p:
+            return f"{name:<10} (no samples)"
+        body = "  ".join(f"{k} {v * 1000:.1f}ms"
+                         for k, v in sorted(p.items(),
+                                            key=lambda kv: int(kv[0][1:])))
+        return f"{name:<10} {body}"
+
+    lines.append("client-side latency:")
+    for key in ("ttft", "tpot", "e2e"):
+        lines.append("  " + fmt_p(key, lat.get(key)))
+    if server.get("engine_ttft"):
+        s = server["engine_ttft"]
+        lines.append(
+            f"server ttft (engine histogram, n={s['count']:g}): "
+            f"p50 {s['p50'] * 1000:.1f}ms  p90 {s['p90'] * 1000:.1f}ms"
+            f"  p99 {s['p99'] * 1000:.1f}ms")
+    if server.get("lb_latency"):
+        s = server["lb_latency"]
+        lines.append(
+            f"server e2e (LB histogram, n={s['count']:g}): "
+            f"p50 {s['p50'] * 1000:.1f}ms  p99 {s['p99'] * 1000:.1f}ms")
+    lines.append(
+        f"lb         retries {server.get('lb_retries', 0):g}  breaker "
+        f"ejections {server.get('lb_breaker_ejections', 0):g}  scrapes "
+        f"{server.get('scrapes', 0)}")
+    slo_bits = []
+    if good.get("slo_ttft_s") is not None:
+        slo_bits.append(f"ttft<={good['slo_ttft_s']}s")
+    if good.get("slo_tpot_s") is not None:
+        slo_bits.append(f"tpot<={good['slo_tpot_s']}s")
+    slo = " and ".join(slo_bits) if slo_bits else "completion only"
+    lines.append(
+        f"goodput    {good.get('good')}/{reqs.get('scheduled')} = "
+        f"{good.get('fraction', 0) * 100:.1f}% under SLO ({slo})")
+    return "\n".join(lines)

@@ -547,6 +547,131 @@ def metrics_cmd(url, service, watch):
         time_lib.sleep(2.0)
 
 
+@cli.group(name="loadgen", invoke_without_command=True)
+@click.option("--target", default=None,
+              help="Endpoint to drive (a serve LB / serve_llm "
+                   "--lb-port URL). Required unless a subcommand is "
+                   "given.")
+@click.option("--mix", type=click.Choice(["chat", "long_context",
+                                          "bursty"]),
+              default="chat", show_default=True,
+              help="Workload shape: chat = shared system prompts + "
+                   "unique tails; long_context = prefill-heavy; "
+                   "bursty = chat under a diurnal rate wave.")
+@click.option("--arrival", type=click.Choice(["poisson", "ramp",
+                                              "uniform"]),
+              default="poisson", show_default=True,
+              help="Arrival process (open loop: requests fire on "
+                   "schedule regardless of completions).")
+@click.option("--qps", type=float, default=8.0, show_default=True,
+              help="Base offered arrival rate.")
+@click.option("--duration", type=float, default=10.0,
+              show_default=True, help="Trace length in seconds.")
+@click.option("--seed", type=int, default=0, show_default=True,
+              help="Schedule seed: the same seed replays the trace "
+                   "bit-identically (arrivals, prompts, budgets).")
+@click.option("--max-tokens", type=int, default=32, show_default=True)
+@click.option("--prompt-tokens", type=int, default=96,
+              show_default=True,
+              help="Mean total chat prompt length.")
+@click.option("--shared-prefix", type=int, default=64,
+              show_default=True,
+              help="Tokens per shared system prompt (chat/bursty).")
+@click.option("--slo-ttft", type=float, default=None,
+              help="TTFT SLO in seconds; requests above it do not "
+                   "count toward goodput.")
+@click.option("--slo-tpot", type=float, default=None,
+              help="Per-output-token latency SLO in seconds.")
+@click.option("--scrape-interval", type=float, default=1.0,
+              show_default=True,
+              help="Seconds between /metrics snapshots into the "
+                   "run's metrics.jsonl time series.")
+@click.option("--faults", default=None,
+              help="STPU_FAULTS-grammar chaos spec armed mid-run in "
+                   "THIS process (in-process stacks; remote stacks "
+                   "export STPU_FAULTS themselves), e.g. "
+                   "'lb.upstream:delay:s=0.5'.")
+@click.option("--faults-at", type=float, default=0.0,
+              show_default=True,
+              help="Seconds into the run to arm --faults.")
+@click.option("--out", default=None,
+              help="Run directory (default "
+                   "~/.stpu/logs/loadgen/<stamp>-<mix>-seed<seed>).")
+@click.option("--json", "as_json", is_flag=True,
+              help="Print the raw report JSON instead of the "
+                   "rendered summary.")
+@click.pass_context
+def loadgen(ctx, target, mix, arrival, qps, duration, seed, max_tokens,
+            prompt_tokens, shared_prefix, slo_ttft, slo_tpot,
+            scrape_interval, faults, faults_at, out, as_json):
+    """Trace-driven open-loop load harness with SLO reports.
+
+    Fires a seeded, replayable request schedule at a live serving
+    endpoint while snapshotting its /metrics into a run-scoped JSONL
+    time series, then reports TTFT/TPOT/e2e percentiles (client-side
+    AND interpolated from the server's histograms), achieved vs
+    offered QPS, error/retry/breaker counts, and goodput under the
+    declared SLOs. See docs/observability.md."""
+    if ctx.invoked_subcommand is not None:
+        return
+    if not target:
+        raise click.UsageError(
+            "--target is required (or use `stpu loadgen report`).")
+    import json as json_lib
+
+    from skypilot_tpu.benchmark import loadgen as loadgen_lib
+    try:
+        spec = loadgen_lib.LoadSpec(
+            mix=mix, arrival=arrival, qps=qps, duration_s=duration,
+            seed=seed, max_tokens=max_tokens,
+            prompt_tokens=prompt_tokens, shared_prefix=shared_prefix)
+        report = loadgen_lib.run(
+            target, spec, slo_ttft_s=slo_ttft, slo_tpot_s=slo_tpot,
+            scrape_interval=scrape_interval, out_dir=out,
+            faults=faults, faults_at=faults_at)
+    except (ValueError, OSError) as e:
+        raise click.ClickException(str(e)) from e
+    if as_json:
+        click.echo(json_lib.dumps(report, indent=1))
+    else:
+        click.echo(loadgen_lib.format_report(report))
+
+
+@loadgen.command(name="report")
+@click.argument("run", required=False)
+@click.option("--json", "as_json", is_flag=True,
+              help="Print the raw report JSON.")
+def loadgen_report(run, as_json):
+    """Render a recorded run's SLO report. RUN is a run directory or a
+    name under ~/.stpu/logs/loadgen/; defaults to the newest run."""
+    import json as json_lib
+    import os as os_lib
+
+    from skypilot_tpu.benchmark import loadgen as loadgen_lib
+    if run is None:
+        run_dir = loadgen_lib.latest_run_dir()
+        if run_dir is None:
+            raise click.ClickException(
+                "No recorded loadgen runs (run `stpu loadgen "
+                "--target ...` first).")
+    elif os_lib.path.isdir(run):
+        run_dir = run
+    else:
+        run_dir = os_lib.path.join(loadgen_lib.runs_root(), run)
+    report_path = os_lib.path.join(run_dir, "report.json")
+    try:
+        with open(report_path) as f:
+            report = json_lib.load(f)
+    except (OSError, ValueError) as e:
+        raise click.ClickException(
+            f"cannot read {report_path}: {e}") from e
+    report.setdefault("out_dir", run_dir)
+    if as_json:
+        click.echo(json_lib.dumps(report, indent=1))
+    else:
+        click.echo(loadgen_lib.format_report(report))
+
+
 @cli.group(name="trace")
 def trace():
     """Distributed request/launch traces (arm with STPU_TRACE=1).

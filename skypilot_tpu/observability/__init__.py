@@ -14,6 +14,9 @@ Two stdlib-only primitives every long-running stpu process shares:
   context propagates LB -> replica via the ``X-STPU-Trace`` header and
   host-to-host via ``STPU_TRACE_CTX`` (the run-ID pattern). Off by
   default; hot paths guard on ``tracing.ENABLED``.
+* ``promtext`` — the exposition PARSER dual to ``metrics.render()``,
+  shared by the loadgen scraper, bench gates, and tests so ad-hoc
+  string matching over scraped documents never reappears.
 
 None may ever break the instrumented call: all I/O failures are
 swallowed, and recording is lock-free on hot paths except for the
@@ -21,6 +24,7 @@ single child-update lock held for the increment itself.
 """
 from skypilot_tpu.observability import events
 from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import promtext
 from skypilot_tpu.observability import tracing
 
-__all__ = ["events", "metrics", "tracing"]
+__all__ = ["events", "metrics", "promtext", "tracing"]

@@ -33,6 +33,47 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                    5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
 
+# Tail-latency-SLO bucket set for TTFT-class histograms. DEFAULT_BUCKETS
+# jumps 1.0 -> 2.5 -> 5 -> 10 -> 30: a p99 TTFT anywhere past ~1s lands
+# in a bucket 2.5-20s wide and interpolated quantiles are mush — useless
+# for a "p99 TTFT < 2s" SLO verdict. This set keeps ~1.5x spacing
+# through the 0.1s-20s band where serving TTFT tails actually live,
+# while still covering cold-compile outliers at the top.
+LATENCY_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.15, 0.25, 0.4, 0.6, 0.9,
+                   1.3, 2.0, 3.0, 4.5, 6.5, 10.0, 15.0, 22.5, 35.0,
+                   50.0, 75.0, 120.0, 300.0)
+
+
+def quantile_from_cumulative(bounds: Sequence[float],
+                             cumulative: Sequence[float],
+                             q: float) -> float:
+    """Quantile ``q`` in [0, 1] from cumulative bucket counts, linearly
+    interpolated within the winning bucket (PromQL histogram_quantile
+    semantics): the first bucket interpolates from 0, and a quantile
+    landing in the +Inf bucket returns the highest finite bound — the
+    histogram cannot resolve beyond it. ``cumulative`` has one more
+    entry than ``bounds`` (the +Inf bucket). NaN when empty.
+
+    Shared by Histogram.quantile (live registry) and
+    promtext.HistogramSnapshot.quantile (scraped exposition), so the
+    two can never diverge on what a percentile means."""
+    if not cumulative:
+        return math.nan
+    total = cumulative[-1]
+    if total <= 0:
+        return math.nan
+    q = min(max(float(q), 0.0), 1.0)
+    rank = q * total
+    for i, bound in enumerate(bounds):
+        if cumulative[i] >= rank:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            below = cumulative[i - 1] if i > 0 else 0.0
+            in_bucket = cumulative[i] - below
+            if in_bucket <= 0:
+                return bound
+            return lo + (bound - lo) * (rank - below) / in_bucket
+    return bounds[-1] if bounds else math.nan
+
 
 def _format_value(value: float) -> str:
     if value == math.inf:
@@ -219,6 +260,13 @@ class Histogram(_MetricFamily):
 
     def observe(self, value: float) -> None:
         self.labels().observe(value)
+
+    def quantile(self, q: float, **labelkw) -> float:
+        """Interpolated quantile of one series' observations so far
+        (label-less family by default). NaN while empty. SLO-grade
+        accuracy depends on the bucket layout — see LATENCY_BUCKETS."""
+        cumulative, _, _ = self.labels(**labelkw).snapshot()
+        return quantile_from_cumulative(self.buckets, cumulative, q)
 
     def _samples(self) -> Iterable[str]:
         with self._lock:

@@ -57,6 +57,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from skypilot_tpu.models import model_api
 from skypilot_tpu.observability import events
@@ -81,7 +82,8 @@ _TOK_RATE = metrics.histogram(
     buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384, 65536))
 _TTFT = metrics.histogram(
     "stpu_engine_ttft_seconds",
-    "Submit-to-first-token latency per request.")
+    "Submit-to-first-token latency per request.",
+    buckets=metrics.LATENCY_BUCKETS)
 _REQUESTS = metrics.counter(
     "stpu_engine_requests_total", "Engine requests by outcome.",
     ("outcome",))
@@ -104,7 +106,7 @@ _PREFIX_CHUNKS = metrics.gauge(
 _PREFIX_TTFT = metrics.histogram(
     "stpu_engine_prefix_ttft_seconds",
     "Submit-to-first-token latency split by prefix-cache outcome.",
-    ("cache",))
+    ("cache",), buckets=metrics.LATENCY_BUCKETS)
 _RESTARTS = metrics.counter(
     "stpu_engine_restarts_total",
     "Engine restarts by the supervisor after a compute-loop crash.")
@@ -714,8 +716,14 @@ class DecodeEngine:
                 slot.prefilled = slot.pos = slot.cached
             start = slot.prefilled
             piece = req.prompt[start:start + self._chunk]
-            buf = jnp.zeros((self._chunk,), jnp.int32).at[
-                :len(piece)].set(jnp.asarray(piece, jnp.int32))
+            # Pad host-side (numpy), NOT with a jnp zeros/at/set: the
+            # eager at/set compiles one XLA pad program PER DISTINCT
+            # final-chunk length, so a live traffic mix steadily grows
+            # the jit cache and pays compile jitter on the prefill hot
+            # path. A plain host-array upload needs no program at all.
+            buf_np = np.zeros((self._chunk,), np.int32)
+            buf_np[:len(piece)] = piece
+            buf = jnp.asarray(buf_np)
             valid = start + len(piece)
             if fault_injection.ENABLED:
                 fault_injection.fire("engine.prefill", slot=i,

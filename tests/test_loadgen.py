@@ -1,0 +1,587 @@
+"""`stpu loadgen`: trace-driven load harness + SLO reports (ISSUE 7).
+
+The stories pinned here:
+  * the same (spec, seed) expands to a BIT-identical request schedule
+    — and a full run against a stub LB stack replays it (equal
+    schedule digests), while the SLO report carries TTFT/TPOT/e2e
+    percentiles, achieved-vs-offered QPS, and goodput-under-SLO;
+  * the run-scoped scraper snapshots /metrics into a JSONL time
+    series beside the report, parseable back through promtext;
+  * an injected engine slowdown (fault-injection delay mode) degrades
+    the reported goodput and is flagged by bench_compare on the new
+    serving-leg metrics with the right polarity;
+plus the satellites: the promtext render→parse→render golden
+round-trip, Histogram.quantile interpolation, the latency-tuned TTFT
+buckets, and LB inflight-accounting / PrefixAffinity bounded-load
+spill under a seeded loadgen burst.
+"""
+import importlib.util
+import json
+import math
+import pathlib
+import socket
+import threading
+import time
+import urllib.request
+import http.server
+import socketserver
+
+import pytest
+
+from skypilot_tpu.benchmark import loadgen
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import promtext
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve.load_balancing_policies import (
+    PrefixAffinityPolicy, RoundRobinPolicy)
+from skypilot_tpu.utils import fault_injection as fi
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_compare",
+    pathlib.Path(__file__).parent.parent / "tools" / "bench_compare.py")
+bench_compare = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(bench_compare)
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+# ============================================================ schedule
+def test_schedule_bit_identical_same_seed():
+    spec = loadgen.LoadSpec(mix="chat", arrival="poisson", qps=25,
+                            duration_s=2.0, seed=7)
+    s1, s2 = loadgen.build_schedule(spec), loadgen.build_schedule(spec)
+    assert s1 == s2
+    assert loadgen.schedule_digest(s1) == loadgen.schedule_digest(s2)
+    other = loadgen.build_schedule(
+        loadgen.LoadSpec(mix="chat", qps=25, duration_s=2.0, seed=8))
+    assert loadgen.schedule_digest(other) != loadgen.schedule_digest(s1)
+
+
+@pytest.mark.parametrize("mix", loadgen.MIXES)
+@pytest.mark.parametrize("arrival", loadgen.ARRIVALS)
+def test_schedule_shapes(mix, arrival):
+    spec = loadgen.LoadSpec(mix=mix, arrival=arrival, qps=15,
+                            duration_s=2.0, seed=3)
+    sched = loadgen.build_schedule(spec)
+    assert sched, f"{mix}/{arrival} produced an empty schedule"
+    ats = [r.at for r in sched]
+    assert ats == sorted(ats)
+    assert all(0 < at < spec.duration_s for at in ats)
+    assert all(1 <= len(r.prompt) <= spec.max_prompt_tokens
+               for r in sched)
+    assert all(1 <= r.max_tokens <= spec.max_tokens for r in sched)
+
+
+def test_chat_mix_shares_prefixes_across_requests_and_seeds():
+    spec = loadgen.LoadSpec(mix="chat", qps=30, duration_s=2.0, seed=1)
+    sched = loadgen.build_schedule(spec)
+    heads = {r.prompt[:spec.shared_prefix] for r in sched}
+    assert 1 < len(heads) <= spec.n_prefixes
+    # Prefix identity depends on the seed only, not qps/duration: a
+    # cache warmed by one trace shape is warm for another.
+    other = loadgen.build_schedule(loadgen.LoadSpec(
+        mix="chat", qps=5, duration_s=1.0, seed=1))
+    assert {r.prompt[:spec.shared_prefix] for r in other} <= set(
+        tuple(p) for p in map(tuple, heads)) | heads
+
+
+def test_long_context_mix_is_prefill_heavy():
+    chat = loadgen.build_schedule(
+        loadgen.LoadSpec(mix="chat", qps=20, duration_s=2.0, seed=2))
+    lctx = loadgen.build_schedule(loadgen.LoadSpec(
+        mix="long_context", qps=20, duration_s=2.0, seed=2))
+    avg = lambda s: sum(len(r.prompt) for r in s) / len(s)  # noqa: E731
+    assert avg(lctx) > 3 * avg(chat)
+
+
+def test_bursty_mix_modulates_rate():
+    spec = loadgen.LoadSpec(mix="bursty", arrival="uniform", qps=10,
+                            duration_s=4.0, seed=0, burst_factor=6.0,
+                            burst_period_s=4.0)
+    sched = loadgen.build_schedule(spec)
+    # Crest of the wave (mid-period) must be denser than the troughs.
+    mid = sum(1 for r in sched if 1.0 <= r.at < 3.0)
+    edges = len(sched) - mid
+    assert mid > 2 * edges
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        loadgen.LoadSpec(mix="nope").validate()
+    with pytest.raises(ValueError):
+        loadgen.LoadSpec(arrival="nope").validate()
+    with pytest.raises(ValueError):
+        loadgen.LoadSpec(qps=0).validate()
+
+
+# ============================================================ promtext
+def test_promtext_roundtrip_golden():
+    """render → parse → render recovers the exact document, and the
+    parsed samples carry the exact values (the shared parser the
+    loadgen scraper, bench gates, and `stpu metrics` consumers rely
+    on)."""
+    reg = metrics.Registry()
+    c = reg.counter("rt_total", "Req.", ("method", "code"))
+    c.labels(method="GET", code="200").inc(3)
+    c.labels(method="POST", code="502").inc()
+    g = reg.gauge("rt_gauge", "G.", ("k",))
+    g.labels(k='a"b\\c\nd').set(-math.inf)
+    g.labels(k="frac").set(0.125)
+    h = reg.histogram("rt_seconds", "L.", ("svc",), buckets=(0.1, 1.0))
+    h.labels(svc="x").observe(0.05)
+    h.labels(svc="x").observe(7.0)
+    text = reg.render()
+    fams = promtext.parse(text)
+    assert promtext.render_families(fams) == text
+    assert fams["rt_total"].kind == "counter"
+    assert fams["rt_seconds"].kind == "histogram"
+    assert promtext.value(fams, "rt_total", method="GET",
+                          code="200") == 3
+    assert promtext.value(fams, "rt_gauge", k='a"b\\c\nd') == -math.inf
+    assert promtext.value(fams, "rt_gauge", k="frac") == 0.125
+    assert promtext.counter_total(fams, "rt_total") == 4
+    # Parse is the exact inverse on a second round trip too.
+    assert promtext.render_families(
+        promtext.parse(promtext.render_families(fams))) == text
+
+
+def test_promtext_histogram_snapshot_delta_and_quantile():
+    reg = metrics.Registry()
+    h = reg.histogram("d_seconds", "D.", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 1.5, 3.0):
+        h.observe(v)
+    first = promtext.histogram(promtext.parse(reg.render()),
+                               "d_seconds")
+    for v in (3.0, 3.0, 5.0):
+        h.observe(v)
+    last = promtext.histogram(promtext.parse(reg.render()),
+                              "d_seconds")
+    assert last.count == 7
+    run_window = last.delta(first)
+    assert run_window.count == 3
+    assert run_window.cumulative == [0.0, 0.0, 2.0, 3.0]
+    # Scraped quantile == live-registry quantile (shared math).
+    assert last.quantile(0.5) == pytest.approx(h.quantile(0.5))
+    # A quantile landing in +Inf returns the top finite bound.
+    assert run_window.quantile(0.99) == 4.0
+
+
+def test_promtext_parse_errors_and_labeled_aggregation():
+    with pytest.raises(promtext.ParseError):
+        promtext.parse("bad line without value\n# TYPE x counter")
+    reg = metrics.Registry()
+    h = reg.histogram("agg_seconds", "A.", ("code",), buckets=(1.0,))
+    h.labels(code="200").observe(0.5)
+    h.labels(code="502").observe(2.0)
+    fams = promtext.parse(reg.render())
+    merged = promtext.histogram(fams, "agg_seconds")
+    assert merged.count == 2 and merged.cumulative == [1.0, 2.0]
+    only_200 = promtext.histogram(fams, "agg_seconds", code="200")
+    assert only_200.count == 1 and only_200.sum == 0.5
+
+
+# ============================================================ quantile
+def test_histogram_quantile_interpolation():
+    reg = metrics.Registry()
+    h = reg.histogram("q_seconds", "Q.", buckets=(1.0, 2.0, 4.0))
+    assert math.isnan(h.quantile(0.5))          # empty
+    for v in (0.5, 1.5, 1.5, 3.0):
+        h.observe(v)
+    # rank 2 of 4 is halfway through the (1, 2] bucket's 2 counts.
+    assert h.quantile(0.5) == pytest.approx(1.5)
+    # First bucket interpolates from 0.
+    assert 0.0 < h.quantile(0.1) < 1.0
+    assert h.quantile(1.0) == pytest.approx(4.0)
+
+
+def test_quantile_from_cumulative_inf_bucket():
+    # Observation beyond the top bound: quantile saturates at the
+    # highest finite bound rather than inventing a number.
+    val = metrics.quantile_from_cumulative(
+        [1.0, 2.0], [0, 0, 5], 0.99)
+    assert val == 2.0
+    assert math.isnan(metrics.quantile_from_cumulative([1.0], [0, 0],
+                                                       0.5))
+
+
+def test_engine_ttft_buckets_latency_tuned():
+    """Satellite: the engine TTFT histograms use the SLO-grade bucket
+    set (DEFAULT_BUCKETS collapses 1-30s tails into 2.5-20s-wide
+    buckets), and the exposition stays backward-compatible: same
+    family names, same _bucket/_sum/_count sample shape."""
+    from skypilot_tpu.serve import decode_engine
+    assert decode_engine._TTFT.buckets == metrics.LATENCY_BUCKETS
+    assert decode_engine._PREFIX_TTFT.buckets == metrics.LATENCY_BUCKETS
+    # Tail band resolution: at least 8 bounds between 0.1s and 20s.
+    in_band = [b for b in metrics.LATENCY_BUCKETS if 0.1 <= b <= 20.0]
+    assert len(in_band) >= 8
+    # Delta against the current state: the process-wide registry may
+    # already hold TTFT observations from other suites in a full run.
+    before = promtext.histogram(promtext.parse(metrics.render()),
+                                "stpu_engine_ttft_seconds")
+    decode_engine._TTFT.observe(0.45)
+    text = metrics.render()
+    assert "# TYPE stpu_engine_ttft_seconds histogram" in text
+    snap = promtext.histogram(promtext.parse(text),
+                              "stpu_engine_ttft_seconds")
+    assert snap is not None and snap.count >= 1
+    assert snap.bounds == list(metrics.LATENCY_BUCKETS)
+    window = snap.delta(before) if before is not None else snap
+    assert window.count == 1
+    # 0.45 lands in the (0.4, 0.6] bucket — resolvable to that band.
+    assert 0.4 <= window.quantile(0.5) <= 0.6
+
+
+# ====================================================== stub LB stack
+class _Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def handle_error(self, request, client_address):
+        pass
+
+
+class _SSEHandler(http.server.BaseHTTPRequestHandler):
+    """Stub replica: streams min(max_tokens, cap) SSE token events
+    with a per-token delay, then [DONE] — the serve_llm contract the
+    loadgen client parses. ``hits``/``delay``/``abort_after`` are
+    class attributes set per test. Observes into the engine TTFT
+    histogram so the LB scrape path carries real server-side data."""
+    protocol_version = "HTTP/1.1"
+    hits = None
+    delay = 0.002
+    token_cap = 6
+    abort_after = None        # tokens, then drop the connection
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if self.path == "/metrics":
+            body = metrics.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", metrics.CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", "2")
+        self.end_headers()
+        self.wfile.write(b"{}")
+
+    def do_POST(self):
+        from skypilot_tpu.serve import decode_engine
+        length = int(self.headers.get("Content-Length") or 0)
+        req = json.loads(self.rfile.read(length) or b"{}")
+        if self.hits is not None:
+            self.hits.append(self.server.server_address[1])
+        t0 = time.perf_counter()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        n = min(int(req.get("max_tokens", 4)), self.token_cap)
+        for i in range(n):
+            time.sleep(self.delay)
+            if i == 0:
+                decode_engine._TTFT.observe(time.perf_counter() - t0)
+            if self.abort_after is not None and i >= self.abort_after:
+                # Mid-stream death: no [DONE], no terminator.
+                self.wfile.flush()
+                self.connection.close()
+                return
+            lb_lib.write_chunk(
+                self.wfile, f'data: {{"token": {i}}}\n\n'.encode())
+        lb_lib.write_chunk(self.wfile, b"data: [DONE]\n\n")
+        lb_lib.end_chunks(self.wfile)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _start_replica(handler_cls):
+    server = _Server(("127.0.0.1", 0), handler_cls)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+def _start_lb(policy, **handler_attrs):
+    port = _free_port()
+    handler = type("Handler", (lb_lib._ProxyHandler,), {
+        "policy": policy, "recorder": lb_lib.RequestRecorder(),
+        "breaker": lb_lib.CircuitBreaker(), **handler_attrs})
+    server = lb_lib._ThreadingHTTPServer(("127.0.0.1", port), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://127.0.0.1:{port}"
+
+
+# ================================================== e2e smoke (tier-1)
+def test_loadgen_e2e_bit_identical_and_slo_report(tmp_state_dir,
+                                                  tmp_path):
+    """Acceptance: two runs with the same seed against the same stub
+    stack produce a bit-identical schedule, and the report carries
+    percentiles, achieved-vs-offered QPS, goodput, and the scraped
+    server-side series."""
+    replica, _ = _start_replica(
+        type("Ok", (_SSEHandler,), {"delay": 0.002}))
+    policy = RoundRobinPolicy()
+    policy.set_ready_replicas(
+        [f"http://127.0.0.1:{replica.server_address[1]}"])
+    lb, target = _start_lb(policy)
+    spec = loadgen.LoadSpec(mix="chat", qps=20, duration_s=1.5, seed=11,
+                            max_tokens=6)
+    try:
+        rep1 = loadgen.run(target, spec, slo_ttft_s=1.0, slo_tpot_s=0.5,
+                           scrape_interval=0.25,
+                           out_dir=str(tmp_path / "run1"))
+        rep2 = loadgen.run(target, spec, slo_ttft_s=1.0, slo_tpot_s=0.5,
+                           scrape_interval=0.25,
+                           out_dir=str(tmp_path / "run2"))
+    finally:
+        lb.shutdown()
+        replica.shutdown()
+    assert rep1["schedule_sha256"] == rep2["schedule_sha256"]
+    assert rep1["requests"]["scheduled"] == rep2["requests"]["scheduled"]
+    assert rep1["requests"]["ok"] == rep1["requests"]["scheduled"]
+    assert rep1["goodput"]["fraction"] == 1.0
+    for key in ("ttft", "tpot", "e2e"):
+        assert rep1["latency_s"][key]["p99"] is not None
+    assert rep1["qps"]["offered"] > 0
+    assert 0 < rep1["qps"]["achieved"] <= rep1["qps"]["offered"] * 2
+    assert rep1["tokens"]["generated"] > 0
+    # Server-side: the engine TTFT histogram (scraped via the LB merge)
+    # yields interpolated percentiles for the run window.
+    assert rep1["server"]["scrapes"] >= 2
+    assert rep1["server"]["engine_ttft"]["p99"] > 0
+    # Artifacts: schedule + report + the JSONL metric time series.
+    run_dir = pathlib.Path(rep1["out_dir"])
+    sched_doc = json.loads((run_dir / "schedule.json").read_text())
+    assert sched_doc["digest"] == rep1["schedule_sha256"]
+    report_doc = json.loads((run_dir / "report.json").read_text())
+    assert report_doc["goodput"]["fraction"] == 1.0
+    series = [json.loads(line) for line in
+              (run_dir / "metrics.jsonl").read_text().splitlines()]
+    assert len(series) >= 2
+    assert any("families" in rec and
+               "stpu_lb_requests_total" in rec["families"]
+               for rec in series)
+    # The rendered report mentions the headline numbers.
+    text = loadgen.format_report(rep1)
+    assert "goodput" in text and "achieved" in text
+
+
+def test_loadgen_fault_delay_degrades_goodput_and_gates(tmp_state_dir,
+                                                        tmp_path):
+    """Acceptance: an injected upstream slowdown (fault-injection
+    delay mode at the lb.upstream seam) measurably degrades goodput
+    and p99 TTFT, and bench_compare flags BOTH new serving-leg metrics
+    with the right polarity."""
+    replica, _ = _start_replica(
+        type("Ok2", (_SSEHandler,), {"delay": 0.002}))
+    policy = RoundRobinPolicy()
+    policy.set_ready_replicas(
+        [f"http://127.0.0.1:{replica.server_address[1]}"])
+    lb, target = _start_lb(policy)
+    spec = loadgen.LoadSpec(mix="chat", qps=15, duration_s=1.2, seed=4,
+                            max_tokens=4)
+    try:
+        base = loadgen.run(target, spec, slo_ttft_s=0.3,
+                           scrape_interval=0.3,
+                           out_dir=str(tmp_path / "base"))
+        slow = loadgen.run(target, spec, slo_ttft_s=0.3,
+                           scrape_interval=0.3,
+                           out_dir=str(tmp_path / "slow"),
+                           faults="lb.upstream:delay:s=0.8",
+                           faults_at=0.0)
+    finally:
+        lb.shutdown()
+        replica.shutdown()
+    assert not fi.ENABLED        # the run cleared its own arming
+    assert base["goodput"]["fraction"] == 1.0
+    assert slow["goodput"]["fraction"] < 0.5
+    assert slow["latency_s"]["ttft"]["p99"] > \
+        base["latency_s"]["ttft"]["p99"] + 0.5
+
+    def bench_doc(report):
+        return {"value": 50.0, "detail": {"serving": {
+            "llama_slo_goodput": report["goodput"]["fraction"],
+            "llama_p99_ttft_s": report["latency_s"]["ttft"]["p99"],
+            "llama_loadgen_tok_s": report["tokens"]["tok_s"],
+        }}}
+
+    _, regressions = bench_compare.compare(
+        bench_doc(base), bench_doc(slow),
+        list(bench_compare.DEFAULT_METRICS), 5.0,
+        lower_patterns=list(bench_compare.DEFAULT_METRICS_LOWER))
+    joined = "\n".join(regressions)
+    assert "llama_slo_goodput" in joined          # dropped: regression
+    assert "llama_p99_ttft_s" in joined           # rose: regression
+    # And the polarity is honest: the un-regressed direction passes.
+    _, none = bench_compare.compare(
+        bench_doc(slow), bench_doc(base),
+        list(bench_compare.DEFAULT_METRICS), 5.0,
+        lower_patterns=list(bench_compare.DEFAULT_METRICS_LOWER))
+    assert not [r for r in none if "p99_ttft" in r
+                or "slo_goodput" in r]
+
+
+# ==================================== LB accounting under burst (sat.)
+def test_lb_inflight_returns_slots_under_burst_failures(tmp_state_dir,
+                                                        tmp_path):
+    """Satellite: report_done returns the in-flight slot on EVERY exit
+    path — clean streams, retried dead-replica attempts, mid-stream
+    aborts, and 413 rejections — under a seeded open-loop burst, so
+    least-loaded accounting can never leak a slot."""
+    good, good_url = _start_replica(
+        type("Good", (_SSEHandler,), {"delay": 0.002}))
+    flaky, flaky_url = _start_replica(
+        type("Flaky", (_SSEHandler,), {"delay": 0.002,
+                                       "abort_after": 1}))
+    dead_url = f"http://127.0.0.1:{_free_port()}"
+    policy = PrefixAffinityPolicy()
+    policy.set_ready_replicas([good_url, flaky_url, dead_url])
+    lb, target = _start_lb(policy, max_body_bytes=64 * 1024)
+    spec = loadgen.LoadSpec(mix="chat", arrival="uniform", qps=40,
+                            duration_s=1.0, seed=9, max_tokens=4)
+    try:
+        report = loadgen.run(target, spec, scrape_interval=0.5,
+                             out_dir=str(tmp_path / "burst"))
+        # An oversized body is refused with 413 before buffering; its
+        # slot (never selected) must not corrupt the accounting.
+        big = json.dumps({"prompt": [1] * 40000,
+                          "max_tokens": 1}).encode()
+        req = urllib.request.Request(target + "/generate", data=big,
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 413
+        exc.value.read()
+    finally:
+        lb.shutdown()
+        good.shutdown()
+        flaky.shutdown()
+    # Burst saw real failures (aborts from the flaky replica, retries
+    # off the dead one) AND real successes.
+    assert report["requests"]["ok"] > 0
+    assert report["requests"]["error"] > 0
+    # Mid-stream aborts surface as a truncated stream, however the
+    # client's HTTP layer chose to report it.
+    assert any(k in ("truncated_stream", "IncompleteRead")
+               for k in report["requests"]["errors_by_kind"])
+    # The whole point: every in-flight slot came back.
+    with policy._lock:
+        assert all(v == 0 for v in policy._inflight.values()), \
+            policy._inflight
+
+
+def test_prefix_affinity_bounded_load_spills_under_burst(
+        tmp_state_dir, tmp_path):
+    """Satellite: one dominant system prompt under an open-loop burst
+    spills deterministically off its saturated ring owner instead of
+    pinning the fleet's traffic on one replica — and the inflight
+    counters still drain to zero."""
+    hits = []
+    handlers = [type(f"Slow{i}", (_SSEHandler,),
+                     {"delay": 0.03, "hits": hits, "token_cap": 4})
+                for i in range(3)]
+    servers = [_start_replica(h) for h in handlers]
+    urls = [url for _, url in servers]
+    policy = PrefixAffinityPolicy()
+    policy.set_ready_replicas(urls)
+    lb, target = _start_lb(policy)
+    # n_prefixes=1: every request hashes to the same ring owner.
+    spec = loadgen.LoadSpec(mix="chat", arrival="uniform", qps=50,
+                            duration_s=1.0, seed=6, n_prefixes=1,
+                            max_tokens=4)
+    try:
+        report = loadgen.run(target, spec, scrape_interval=0.5,
+                             out_dir=str(tmp_path / "spill"))
+    finally:
+        lb.shutdown()
+        for server, _ in servers:
+            server.shutdown()
+    assert report["requests"]["ok"] == report["requests"]["scheduled"]
+    # Bounded load: the owner took traffic, but so did >= 1 successor.
+    assert len(set(hits)) >= 2, f"no spill: all hits on {set(hits)}"
+    with policy._lock:
+        assert all(v == 0 for v in policy._inflight.values())
+
+
+# ================================================================ CLI
+def test_cli_loadgen_run_and_report(tmp_state_dir):
+    from click.testing import CliRunner
+
+    from skypilot_tpu.cli import cli
+    replica, url = _start_replica(
+        type("Cli", (_SSEHandler,), {"delay": 0.001}))
+    policy = RoundRobinPolicy()
+    policy.set_ready_replicas([url])
+    lb, target = _start_lb(policy)
+    runner = CliRunner()
+    try:
+        res = runner.invoke(cli, [
+            "loadgen", "--target", target, "--qps", "10",
+            "--duration", "1.0", "--seed", "5", "--slo-ttft", "1.0"])
+        assert res.exit_code == 0, res.output
+        assert "goodput" in res.output
+        assert "sha256=" in res.output
+        # report with no args renders the newest run.
+        res2 = runner.invoke(cli, ["loadgen", "report"])
+        assert res2.exit_code == 0, res2.output
+        assert "goodput" in res2.output
+        res3 = runner.invoke(cli, ["loadgen", "report", "--json"])
+        assert res3.exit_code == 0
+        assert json.loads(res3.output)["schedule_sha256"]
+    finally:
+        lb.shutdown()
+        replica.shutdown()
+
+
+def test_cli_loadgen_requires_target(tmp_state_dir):
+    from click.testing import CliRunner
+
+    from skypilot_tpu.cli import cli
+    res = CliRunner().invoke(cli, ["loadgen"])
+    assert res.exit_code != 0
+    assert "--target" in res.output
+
+
+def test_cli_loadgen_report_without_runs(tmp_state_dir):
+    from click.testing import CliRunner
+
+    from skypilot_tpu.cli import cli
+    res = CliRunner().invoke(cli, ["loadgen", "report"])
+    assert res.exit_code != 0
+    assert "No recorded loadgen runs" in res.output
+
+
+# =========================================== bench leg (real engine)
+def test_measure_engine_slo_tiny_end_to_end(tmp_state_dir, monkeypatch):
+    """The bench serving leg end to end on a tiny model: serve_llm
+    replica + in-process LB + loadgen, returning the gated keys."""
+    from skypilot_tpu.benchmark import decode_bench
+    from skypilot_tpu.models import llama
+
+    def tiny_build(family, **kw):
+        return llama, llama.LlamaConfig.tiny(vocab_size=512)
+
+    monkeypatch.setattr(decode_bench, "build", tiny_build)
+    result = decode_bench.measure_engine_slo(
+        "llama", slots=2, qps=4.0, duration_s=1.5, slo_ttft_s=30.0,
+        slo_tpot_s=30.0, max_tokens=4)
+    assert set(result) >= {"slo_goodput", "p99_ttft_s",
+                           "loadgen_tok_s", "achieved_qps",
+                           "offered_qps", "schedule_sha256"}
+    assert result["errors"] == 0
+    assert result["slo_goodput"] == 1.0
+    assert result["p99_ttft_s"] > 0
+    assert result["loadgen_tok_s"] > 0

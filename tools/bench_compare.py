@@ -38,6 +38,8 @@ DEFAULT_METRICS = (
     "detail.serving.*_engine_ragged_tok_s",
     "detail.serving.*_engine_prefix_tok_s",
     "detail.serving.*_prefix_hit_rate",
+    "detail.serving.*_slo_goodput",
+    "detail.serving.*_loadgen_tok_s",
 )
 
 # Lower-is-better metrics (latencies): a regression is the value going
@@ -45,6 +47,7 @@ DEFAULT_METRICS = (
 DEFAULT_METRICS_LOWER = (
     "detail.serving.*_ckpt_save_s",
     "detail.serving.*_ckpt_restore_s",
+    "detail.serving.*_p99_ttft_s",
 )
 
 

@@ -26,7 +26,7 @@ def main() -> None:
     p.add_argument("--family", choices=("mixtral", "llama", "gemma"),
                    default="mixtral")
     p.add_argument("--mode", choices=("fixed", "engine", "prefix",
-                                      "ckpt"),
+                                      "ckpt", "loadgen"),
                    default="fixed",
                    help="fixed: bucketed batch decode (r01-r05 "
                         "comparable); engine: continuous-batching "
@@ -35,7 +35,10 @@ def main() -> None:
                         "shared-prefix KV cache on (warm/cold TTFT "
                         "split + hit rate); ckpt: crash-consistent "
                         "checkpoint save/restore latency for the "
-                        "family's full param set (train/checkpoint.py)")
+                        "family's full param set (train/checkpoint.py); "
+                        "loadgen: the full serve_llm+LB data plane "
+                        "under the open-loop load generator, graded "
+                        "against TTFT/TPOT SLOs (goodput, p99 TTFT)")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--tokens", type=int, default=128)
@@ -48,6 +51,14 @@ def main() -> None:
                    help="engine mode: ragged requests submitted")
     p.add_argument("--shared-prefix", type=int, default=256,
                    help="prefix mode: shared system-prompt tokens")
+    p.add_argument("--qps", type=float, default=6.0,
+                   help="loadgen mode: offered Poisson arrival rate")
+    p.add_argument("--duration", type=float, default=8.0,
+                   help="loadgen mode: trace length in seconds")
+    p.add_argument("--slo-ttft", type=float, default=3.0,
+                   help="loadgen mode: TTFT SLO in seconds")
+    p.add_argument("--slo-tpot", type=float, default=0.5,
+                   help="loadgen mode: per-output-token SLO in seconds")
     p.add_argument("--prefix-cache-mb", type=float, default=256.0,
                    help="prefix mode: shared-prefix KV pool budget")
     p.add_argument("--dim", type=int, default=1024)
@@ -92,6 +103,11 @@ def main() -> None:
     elif args.mode == "ckpt":
         result = decode_bench.measure_ckpt(
             args.family, repeats=args.repeats, **shape_kw)
+    elif args.mode == "loadgen":
+        result = decode_bench.measure_engine_slo(
+            args.family, slots=args.slots, qps=args.qps,
+            duration_s=args.duration, slo_ttft_s=args.slo_ttft,
+            slo_tpot_s=args.slo_tpot, **shape_kw)
     else:
         result = decode_bench.measure_decode(
             args.family, batch=args.batch, prompt_len=args.prompt_len,
