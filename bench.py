@@ -322,10 +322,10 @@ def _serving_leg() -> dict:
     tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "tools", "bench_moe_decode.py")
 
-    def run_tool(extra_args, timeout=900):
+    def run_tool(extra_args, timeout=900, env=None):
         proc = subprocess.run(
             [sys.executable, tool] + extra_args,
-            capture_output=True, text=True, timeout=timeout)
+            capture_output=True, text=True, timeout=timeout, env=env)
         if proc.returncode != 0:
             raise RuntimeError(
                 proc.stderr.strip().splitlines()[-1]
@@ -400,6 +400,29 @@ def _serving_leg() -> dict:
                                   "requests", "errors", "slo_ttft_s",
                                   "slo_tpot_s", "p50_ttft_s",
                                   "schedule_sha256")}
+        except Exception as e:  # noqa: BLE001
+            out[key] = None
+            out[f"{key}_error"] = str(e)[:200]
+        # Tensor-parallel engine leg (serve/gang_replica.py): the
+        # sharded-replica code path — params by param_specs, KV cache
+        # by cache_specs over a tp=2 mesh — under the same ragged mix
+        # as engine_ragged. Runs on a FORCED multi-device CPU mesh
+        # (the tunnel exposes one chip; the leg tracks the sharded
+        # path's dispatch/partition overhead round-over-round, not raw
+        # chip speed — correctness is owned by the bit-parity tests).
+        key = f"{family}_engine_tp_tok_s"
+        try:
+            tp_env = dict(os.environ)
+            tp_env["JAX_PLATFORMS"] = "cpu"
+            tp_env["XLA_FLAGS"] = (
+                "--xla_force_host_platform_device_count=2")
+            r = run_tool(["--family", family, "--mode", "tp",
+                          "--tp", "2"], timeout=1200, env=tp_env)
+            out[key] = r["engine_tp_tok_s"]
+            out[f"{family}_engine_tp_detail"] = {
+                k: r[k] for k in ("tp", "topology", "slots",
+                                  "requests", "generated_tokens",
+                                  "wall_seconds")}
         except Exception as e:  # noqa: BLE001
             out[key] = None
             out[f"{key}_error"] = str(e)[:200]

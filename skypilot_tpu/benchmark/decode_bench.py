@@ -234,6 +234,64 @@ def measure_engine_ragged(family: str, slots: int = 8,
     }
 
 
+def measure_engine_tp(family: str, tp: int = 2, slots: int = 8,
+                      n_requests: int = 24, max_prompt: int = 192,
+                      max_tokens: int = 64,
+                      **shape_kw) -> Dict[str, Any]:
+    """Tensor-parallel engine throughput under the ragged mix.
+
+    The sharded-replica serving path (serve/gang_replica.py): params
+    sharded by param_specs, the KV cache by cache_specs, over a
+    ``tp``-wide mesh — on real hardware the replica's ICI domain, in
+    this bench a multi-device CPU mesh forced with
+    ``XLA_FLAGS=--xla_force_host_platform_device_count`` (bench.py's
+    serving leg sets it). The figure tracks the sharded code path's
+    overhead round over round, not raw chip speed; the bit-parity
+    tests own correctness.
+    """
+    import jax as jax_lib
+    from skypilot_tpu.serve import gang_replica
+    from skypilot_tpu.serve.decode_engine import DecodeEngine
+
+    if len(jax_lib.devices()) < tp:
+        raise RuntimeError(
+            f"engine_tp needs {tp} devices; run under "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={tp}")
+    mdl, cfg = build(family, **shape_kw)
+    params = mdl.init(cfg, jax.random.key(0))
+    topology = gang_replica.ReplicaTopology(hosts=1,
+                                            ici_axes={"tp": tp})
+    mesh, rules = gang_replica.build_mesh(topology)
+    params = gang_replica.shard_params(cfg, params, mesh, rules)
+    engine = DecodeEngine(cfg, params, slots=slots,
+                          max_seq=max_prompt + max_tokens,
+                          prefill_chunk=64, mesh=mesh, rules=rules)
+    engine.start()
+    engine.warmup()
+    rng = random.Random(0)
+    specs = [([rng.randint(1, cfg.vocab_size - 1)
+               for _ in range(rng.randint(8, max_prompt))],
+              rng.randint(8, max_tokens))
+             for _ in range(n_requests)]
+    try:
+        t0 = time.perf_counter()
+        reqs = [engine.submit(p, max_tokens=mt) for p, mt in specs]
+        total = sum(len(r.result(timeout=1800.0)) for r in reqs)
+        dt = time.perf_counter() - t0
+    finally:
+        engine.shutdown()
+    return {
+        "model": _model_info(family, cfg, params),
+        "slots": slots,
+        "requests": n_requests,
+        "tp": tp,
+        "topology": topology.label(),
+        "generated_tokens": total,
+        "wall_seconds": round(dt, 3),
+        "engine_tp_tok_s": round(total / dt, 1),
+    }
+
+
 def measure_engine_prefix(family: str, slots: int = 8,
                           n_requests: int = 24,
                           shared_prefix: int = 256,

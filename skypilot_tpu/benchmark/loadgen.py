@@ -331,6 +331,24 @@ class MetricsScraper:
                         out[val] -= s.value
         return {k: v for k, v in out.items() if v > 0}
 
+    def label_sets(self, name: str) -> List[Dict[str, str]]:
+        """Distinct label sets of an info-style family in the LAST
+        snapshot (e.g. stpu_replica_topology_info: one entry per
+        replica topology serving behind the target)."""
+        if self.last is None:
+            return []
+        fam = self.last.get(name)
+        if fam is None:
+            return []
+        seen, out = set(), []
+        for s in fam.samples:
+            key = tuple(s.labels)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(dict(s.labels))
+        return out
+
 
 # -------------------------------------------------------------- driver
 def _percentile(values: List[float], q: float) -> Optional[float]:
@@ -643,6 +661,16 @@ def _build_report(spec, schedule, digest, results, wall, scraper,
             "p50": round(lb_hist.quantile(0.50), 6),
             "p99": round(lb_hist.quantile(0.99), 6),
         }
+    # Replica topology tags (hosts x tp, from each replica's
+    # stpu_replica_topology_info riding the LB's merged /metrics): an
+    # SLO regression between two runs that ALSO differ here is
+    # attributable to the replica_topology change, not the engine.
+    topo = scraper.label_sets("stpu_replica_topology_info")
+    if topo:
+        server["replica_topology"] = [
+            {"hosts": t.get("hosts", "1"), "tp": t.get("tp", "1"),
+             "label": f"{t.get('hosts', '1')}x{t.get('tp', '1')}"}
+            for t in topo]
     server["lb_retries"] = scraper.counter_delta(
         "stpu_lb_upstream_retries_total")
     server["lb_breaker_ejections"] = scraper.counter_delta(
@@ -754,6 +782,10 @@ def format_report(report: Dict[str, Any]) -> str:
         f"lb         retries {server.get('lb_retries', 0):g}  breaker "
         f"ejections {server.get('lb_breaker_ejections', 0):g}  scrapes "
         f"{server.get('scrapes', 0)}")
+    if server.get("replica_topology"):
+        labels = ", ".join(t["label"]
+                           for t in server["replica_topology"])
+        lines.append(f"topology   replicas (hosts x tp): {labels}")
     slo_bits = []
     if good.get("slo_ttft_s") is not None:
         slo_bits.append(f"ttft<={good['slo_ttft_s']}s")

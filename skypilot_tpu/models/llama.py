@@ -404,6 +404,20 @@ def init_cache(cfg: LlamaConfig, batch: int,
             "v": jnp.zeros(shape, dtype=cfg.dtype)}
 
 
+def cache_specs(cfg: LlamaConfig) -> Dict[str, tuple]:
+    """Logical-axis names for the KV cache, mirroring init_cache()'s
+    (layers, batch, max_seq, kv_heads, head_dim) layout — the serving
+    analog of param_specs. Under a TP mesh the kv_heads axis shards
+    over ICI neighbors (each chip holds its heads' rows); batch and
+    seq stay replicated because every decode step touches all slots.
+    Callers that build concrete shardings must re-point the rule at
+    the trailing head_dim axis when tp does not divide n_kv_heads
+    (gemma's single KV head) — serve/gang_replica.cache_shardings is
+    the one place that check lives."""
+    spec = ("layers", None, None, "kv_heads", None)
+    return {"k": spec, "v": spec}
+
+
 def gather_cache_rows(cache: Dict[str, jax.Array], slot: jax.Array,
                       start: jax.Array, length: int
                       ) -> Dict[str, jax.Array]:

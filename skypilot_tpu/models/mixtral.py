@@ -255,6 +255,7 @@ def init_cache(cfg: MixtralConfig, batch: int, max_seq: int):
 # cache layout is llama's, so the copy entry points are too.
 gather_cache_rows = llama.gather_cache_rows
 insert_cache_rows = llama.insert_cache_rows
+cache_specs = llama.cache_specs
 
 
 def _moe_block(cfg: MixtralConfig, x: jax.Array, lp: Params) -> jax.Array:

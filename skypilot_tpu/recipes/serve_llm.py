@@ -25,9 +25,13 @@ cache through the jit boundary).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import functools
 import json
 import os
+import signal
+import subprocess
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -35,10 +39,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import jax
 import jax.numpy as jnp
 
+from skypilot_tpu.agent import constants as agent_constants
 from skypilot_tpu.models import gemma, llama, mixtral, model_api
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import decode_engine
+from skypilot_tpu.serve import gang_replica
 from skypilot_tpu.serve import load_balancing_policies
 from skypilot_tpu.train import distributed
 
@@ -72,6 +78,15 @@ ENGINE_MAX_RESTARTS = int(os.environ.get("STPU_ENGINE_MAX_RESTARTS",
                                          "3"))
 ENGINE_RESTART_BACKOFF = float(
     os.environ.get("STPU_ENGINE_RESTART_BACKOFF", "1.0"))
+
+# Topology tag for this replica (hosts x tp), exported so the LB's
+# merged /metrics and loadgen reports can attribute SLO shifts to a
+# replica_topology change. Info-style gauge: value is always 1, the
+# labels carry the fact.
+_TOPOLOGY_INFO = metrics.gauge(
+    "stpu_replica_topology_info",
+    "Replica serving topology (hosts x tensor-parallel degree); "
+    "value is constant 1.", ("hosts", "tp"))
 
 
 def _ceil_to(n: int, b: int) -> int:
@@ -168,8 +183,15 @@ class _Handler(BaseHTTPRequestHandler):
             ctx = self.server_ctx
             ready = ctx["ready"].is_set()
             engine = ctx.get("engine")
+            gang = ctx.get("gang")
             if not ready:
                 self._json(503, {"status": "warming"})
+            elif gang is not None and not gang.healthy():
+                # Gang replicas probe as ONE unit: host 0's /health
+                # speaks for every host (the leader's membership
+                # monitor), so a dead follower can never hide behind a
+                # READY replica serving partial-gang garbage.
+                self._json(503, {"status": "gang_degraded"})
             elif engine is not None and not engine.healthy():
                 # The readiness probe must tell the truth about the
                 # ENGINE, not just the HTTP process: a dead/restarting
@@ -180,6 +202,17 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, {"status": "ok"})
         elif self.path == "/drain":
             self._json(200, self._drain_payload())
+        elif self.path == "/gang":
+            gang = self.server_ctx.get("gang")
+            if gang is None:
+                self._json(404, {"error": "not a gang replica"})
+            else:
+                self._json(200, {
+                    "topology": gang.topology.to_config(),
+                    "label": gang.topology.label(),
+                    "healthy": gang.healthy(),
+                    "restarts": gang.restarts,
+                    "members": gang.members_info()})
         elif self.path == "/metrics":
             # Replica-local registry (engine slot/queue/token families);
             # the LB pulls this into its merged /metrics snapshot.
@@ -219,6 +252,11 @@ class _Handler(BaseHTTPRequestHandler):
         engine = ctx.get("engine")
         if engine is not None:
             engine.drain()
+        gang = ctx.get("gang")
+        if gang is not None:
+            # Drain is gang-wide: follower engines stop admitting too,
+            # so scale-down leaves no host mid-lockstep.
+            gang.drain()
         self._json(200, self._drain_payload())
 
     def do_POST(self):
@@ -306,9 +344,28 @@ class _Handler(BaseHTTPRequestHandler):
     # ----------------------------------------------------- engine path
     def _engine_generate(self, engine, prompt, mt, temperature, seed,
                          stream, span=None) -> None:
-        req = engine.submit(
-            prompt, max_tokens=mt, temperature=temperature, seed=seed,
-            trace=span.context() if span is not None else None)
+        gang = self.server_ctx.get("gang")
+        trace = span.context() if span is not None else None
+        if gang is not None:
+            # Mirror the admission (prompt + sampling seed) to every
+            # follower host BEFORE the local submit, so all hosts see
+            # the same request order and execute identical jitted
+            # submissions (the lockstep half of the gang contract).
+            # Broadcast + local submit are ONE critical section:
+            # concurrent handler threads interleaving them would admit
+            # (A,B) on followers but (B,A) on host 0 — divergent slot
+            # state, and on a real ICI-federated slice a mismatched
+            # SPMD program.
+            with self.server_ctx["gang_admit_lock"]:
+                gang.broadcast_generate(prompt, mt, temperature, seed,
+                                        trace=trace)
+                req = engine.submit(prompt, max_tokens=mt,
+                                    temperature=temperature, seed=seed,
+                                    trace=trace)
+        else:
+            req = engine.submit(prompt, max_tokens=mt,
+                                temperature=temperature, seed=seed,
+                                trace=trace)
         timeout = self.server_ctx["stream_timeout"]
         if not stream:
             self._json(200, {"tokens": req.result(timeout=timeout)})
@@ -423,7 +480,11 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
           prefix_cache_mb: float = None,
           stream_timeout: float = None,
           engine_max_restarts: int = None,
-          engine_restart_backoff: float = None) -> ThreadingHTTPServer:
+          engine_restart_backoff: float = None,
+          topology: "gang_replica.ReplicaTopology" = None,
+          mesh=None, rules=None,
+          gang: "gang_replica.GangLeader" = None
+          ) -> ThreadingHTTPServer:
     """Start the replica server. ``engine_slots`` > 0 (default: env
     STPU_ENGINE_SLOTS or 4) serves through the continuous-batching
     decode engine; 0 keeps the legacy locked fixed-batch path.
@@ -434,7 +495,13 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
     The engine runs under an EngineSupervisor: a crashed compute loop
     flips /health to 503 and is restarted with fresh state (capped
     backoff, ``engine_max_restarts`` consecutive fast failures →
-    permanently down so the replica manager replaces the replica)."""
+    permanently down so the replica manager replaces the replica).
+
+    Sharded replicas (gang_replica.py): ``mesh``/``rules`` make the
+    engine tensor-parallel (params must arrive pre-sharded), and
+    ``gang`` is host 0's GangLeader — admitted requests broadcast to
+    followers, /health covers gang membership, drain propagates, and
+    an engine crash-restart restarts every host's engine."""
     if engine_slots is None:
         engine_slots = ENGINE_SLOTS
     if prefix_cache_mb is None:
@@ -448,15 +515,27 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
     ctx = {"cfg": cfg, "params": params, "lock": threading.Lock(),
            "ready": ready_event or threading.Event(), "engine": None,
            "stream_timeout": float(stream_timeout),
-           "draining": threading.Event(),
+           "draining": threading.Event(), "gang": gang,
+           "gang_admit_lock": threading.Lock(),
            "inflight": [0], "inflight_lock": threading.Lock()}
+    _TOPOLOGY_INFO.labels(
+        hosts=str(topology.hosts if topology else 1),
+        tp=str(topology.tp if topology else 1)).set(1)
     if engine_slots > 0:
+        first_build = [True]
+
         def _engine_factory():
+            if gang is not None and not first_build[0]:
+                # Supervisor crash-restart: followers rebuild in
+                # lockstep or the gang serves from desynced caches.
+                gang.broadcast_restart()
+            first_build[0] = False
             return decode_engine.DecodeEngine(
                 cfg, params, slots=engine_slots,
                 max_seq=MAX_PROMPT_TOKENS + MAX_GEN_TOKENS,
                 prefill_chunk=ENGINE_PREFILL_CHUNK,
-                prefix_cache_mb=prefix_cache_mb)
+                prefix_cache_mb=prefix_cache_mb,
+                mesh=mesh, rules=rules)
 
         ctx["engine"] = decode_engine.EngineSupervisor(
             _engine_factory, max_restarts=engine_max_restarts,
@@ -465,8 +544,13 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
     handler = type("Handler", (_Handler,), {"server_ctx": ctx})
     httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
     httpd.engine = ctx["engine"]  # visible for shutdown/tests
+    httpd.gang = gang
 
     def warmup():
+        if gang is not None and not gang.wait_ready():
+            # Probes keep seeing "warming" → the replica manager's
+            # initial-delay deadline replaces the half-formed gang.
+            return
         if ctx["engine"] is not None:
             ctx["engine"].warmup()
         else:
@@ -479,6 +563,60 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
     return httpd
 
 
+def _resolve_topology(args) -> "gang_replica.ReplicaTopology":
+    """CLI flags > STPU_REPLICA_TOPOLOGY env (stamped by the replica
+    manager) > unsharded default."""
+    if args.replica_hosts or args.tp:
+        hosts = int(args.replica_hosts or 1)
+        tp = int(args.tp or 1)
+        return gang_replica.ReplicaTopology(
+            hosts=hosts, ici_axes={"tp": tp} if tp > 1 else {})
+    return (gang_replica.ReplicaTopology.from_env()
+            or gang_replica.ReplicaTopology())
+
+
+def _build_model(args):
+    cfg = {
+        "tiny": llama.LlamaConfig.tiny,
+        "8b": llama.LlamaConfig.llama3_8b,
+        "mixtral-tiny": mixtral.MixtralConfig.tiny,
+        "mixtral-8x7b": mixtral.MixtralConfig.mixtral_8x7b,
+        "gemma-tiny": gemma.GemmaConfig.tiny,
+        "gemma-2b": gemma.GemmaConfig.gemma_2b,
+        "gemma-7b": gemma.GemmaConfig.gemma_7b,
+    }[args.model]()
+    if args.dtype:
+        cfg = dataclasses.replace(
+            cfg, dtype={"bfloat16": jnp.bfloat16,
+                        "float32": jnp.float32}[args.dtype])
+    params = model_api(cfg).init(cfg, jax.random.PRNGKey(args.seed))
+    return cfg, params
+
+
+def _spawn_follower_cmd(args, rank: int, topology, leader_port: int):
+    """Self-spawn dev gang (`--replica-hosts N` outside a gang launch):
+    follower processes on THIS machine, carrying the same rank/env
+    contract a gang-launched host would see (SKYPILOT_NODE_RANK +
+    STPU_TRACE_CTX propagation)."""
+    env = dict(os.environ)
+    env[agent_constants.NODE_RANK] = str(rank)
+    env[agent_constants.NUM_NODES] = str(topology.hosts)
+    env[gang_replica.GANG_ADDR_ENV] = f"127.0.0.1:{leader_port}"
+    env.update(tracing.child_env())
+    argv = [sys.executable, "-m", "skypilot_tpu.recipes.serve_llm",
+            "--model", args.model, "--seed", str(args.seed),
+            "--port", str(args.port),
+            "--replica-hosts", str(topology.hosts),
+            "--tp", str(topology.tp)]
+    if args.dtype:
+        argv += ["--dtype", args.dtype]
+    if args.engine_slots is not None:
+        argv += ["--engine-slots", str(args.engine_slots)]
+    if args.prefix_cache_mb is not None:
+        argv += ["--prefix-cache-mb", str(args.prefix_cache_mb)]
+    return subprocess.Popen(argv, env=env, start_new_session=True)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--model",
@@ -487,6 +625,22 @@ def main(argv=None):
                    default="tiny")
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--replica-hosts", type=int, default=None,
+                   help="hosts in this replica's serving gang (default "
+                        "env STPU_REPLICA_TOPOLOGY or 1). Outside a "
+                        "gang launch, host 0 self-spawns the follower "
+                        "processes — the single-machine dev analog of "
+                        "a gang-scheduled slice")
+    p.add_argument("--tp", type=int, default=None,
+                   help="tensor-parallel degree over the replica's "
+                        "devices (params + KV cache sharded via "
+                        "parallel/mesh.py ShardingRules)")
+    p.add_argument("--dtype", choices=["bfloat16", "float32"],
+                   default=None,
+                   help="override the model compute dtype (float32 "
+                        "makes TP output bit-identical to the "
+                        "unsharded engine; bfloat16 matches only to "
+                        "bf16 rounding, like any resharding)")
     p.add_argument("--engine-slots", type=int, default=None,
                    help="decode-engine slots (0 = legacy locked path; "
                         "default env STPU_ENGINE_SLOTS or 4)")
@@ -525,22 +679,77 @@ def main(argv=None):
                 "deployed services set service.load_balancing_policy "
                 "in the YAML")
 
+    topology = _resolve_topology(args)
+    rank = int(os.environ.get(agent_constants.NODE_RANK, "0"))
+    # Bring up jax.distributed from the gang env contract (federates
+    # every host's chips on a real slice; non-fatal no-op elsewhere).
     distributed.initialize_from_env()
-    cfg = {
-        "tiny": llama.LlamaConfig.tiny,
-        "8b": llama.LlamaConfig.llama3_8b,
-        "mixtral-tiny": mixtral.MixtralConfig.tiny,
-        "mixtral-8x7b": mixtral.MixtralConfig.mixtral_8x7b,
-        "gemma-tiny": gemma.GemmaConfig.tiny,
-        "gemma-2b": gemma.GemmaConfig.gemma_2b,
-        "gemma-7b": gemma.GemmaConfig.gemma_7b,
-    }[args.model]()
-    params = model_api(cfg).init(cfg, jax.random.PRNGKey(args.seed))
+    cfg, params = _build_model(args)
+    mesh, rules = gang_replica.build_mesh(topology)
+    if mesh is not None:
+        params = gang_replica.shard_params(cfg, params, mesh, rules)
+
+    if topology.hosts > 1 and rank > 0:
+        # Non-zero hosts never front HTTP: they run the lockstep
+        # follower loop against the leader's gang channel, mirroring
+        # every submission into the same sharded engine.
+        def _follower_engine():
+            return decode_engine.DecodeEngine(
+                cfg, params,
+                slots=(args.engine_slots
+                       if args.engine_slots else ENGINE_SLOTS),
+                max_seq=MAX_PROMPT_TOKENS + MAX_GEN_TOKENS,
+                prefill_chunk=ENGINE_PREFILL_CHUNK,
+                prefix_cache_mb=(args.prefix_cache_mb
+                                 if args.prefix_cache_mb is not None
+                                 else ENGINE_PREFIX_CACHE_MB),
+                mesh=mesh, rules=rules)
+
+        sys.exit(gang_replica.follower_serve(
+            _follower_engine, topology,
+            gang_replica.follower_addr(args.port), rank))
+
+    gang = None
+    if topology.hosts > 1:
+        gang_launched = int(os.environ.get(
+            agent_constants.NUM_NODES, "1")) > 1 and \
+            not os.environ.get(gang_replica.GANG_ADDR_ENV)
+        if gang_launched:
+            # Followers derive the channel address from the env
+            # contract (head ip + serving port + offset), so the bind
+            # port is fixed.
+            gang = gang_replica.GangLeader(
+                topology,
+                port=args.port + gang_replica.GANG_PORT_OFFSET)
+        else:
+            # Self-spawn dev gang: OS-assigned channel port, followers
+            # on this machine with the address stamped explicitly
+            # (the lambda reads gang.port after construction binds it).
+            gang = gang_replica.GangLeader(
+                topology, spawn=lambda r: _spawn_follower_cmd(
+                    args, r, topology, gang.port))
+            gang.start_followers()
+
     httpd = serve(cfg, params, args.port,
                   engine_slots=args.engine_slots,
                   prefix_cache_mb=args.prefix_cache_mb,
                   stream_timeout=args.stream_timeout,
-                  engine_max_restarts=args.engine_max_restarts)
+                  engine_max_restarts=args.engine_max_restarts,
+                  topology=topology, mesh=mesh, rules=rules,
+                  gang=gang)
+    if gang is not None:
+        if httpd.engine is not None:
+            # Whole-gang restart rebuilds host 0's engine too.
+            gang.set_engine_reset(httpd.engine.restart_now)
+
+        def _term(signum, frame):
+            del signum, frame
+            # SIGTERM (teardown / scale-down) propagates to every
+            # host: followers get an explicit shutdown, self-spawned
+            # ones are reaped — no orphan processes.
+            gang.shutdown()
+            os._exit(143)
+        signal.signal(signal.SIGTERM, _term)
     if args.lb_port:
         from skypilot_tpu.serve import load_balancer as lb_lib
         policy = load_balancing_policies.make_policy(args.lb_policy)

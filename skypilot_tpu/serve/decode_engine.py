@@ -464,7 +464,8 @@ class DecodeEngine:
 
     def __init__(self, cfg, params, *, slots: int = 4,
                  max_seq: int = 1024, prefill_chunk: int = 64,
-                 max_queue: int = 256, prefix_cache_mb: float = 0.0):
+                 max_queue: int = 256, prefix_cache_mb: float = 0.0,
+                 mesh=None, rules=None):
         if slots < 1:
             raise ValueError("slots must be >= 1")
         self._cfg = cfg
@@ -472,6 +473,14 @@ class DecodeEngine:
         self._api = model_api(cfg)
         self._slots = [_Slot() for _ in range(slots)]
         self._max_seq = int(max_seq)
+        # Tensor-parallel serving (serve/gang_replica.py): with a mesh,
+        # params arrive pre-sharded (ShardingRules over param_specs)
+        # and the KV cache is placed by cache_specs — the jitted entry
+        # points are unchanged, GSPMD partitions them from the operand
+        # shardings and donation still aliases in place (pinned by
+        # tests/test_sharded_replica.py).
+        self._mesh = mesh
+        self._rules = rules
         # Chunks must tile the cache rows: prefill starts land on chunk
         # multiples, so chunk | max_seq guarantees every chunk window
         # fits the row (dynamic_update_slice would otherwise clamp the
@@ -482,6 +491,11 @@ class DecodeEngine:
         self._chunk = chunk
         self._max_queue = int(max_queue)
         self._cache = self._api.init_cache(cfg, slots, max_seq)
+        if mesh is not None:
+            from skypilot_tpu.serve import gang_replica
+            self._cache = jax.device_put(
+                self._cache,
+                gang_replica.cache_shardings(cfg, mesh, rules))
         # Shared-prefix KV pool (module docstring): 0 disables. Chunk
         # granularity is the (possibly shrunk) prefill chunk, so cached
         # prefixes splice onto chunk-aligned prefill starts.
@@ -965,6 +979,39 @@ class EngineSupervisor:
     def in_flight(self) -> int:
         engine = self._engine
         return engine.in_flight() if engine is not None else 0
+
+    def restart_now(self) -> None:
+        """Tear down the live engine and build a fresh one immediately
+        (the whole-gang restart path: losing a gang member invalidates
+        lockstep state on EVERY host, so host 0's engine restarts with
+        the gang even though its own loop never crashed). In-flight
+        requests fail with the shutdown EngineError — their stream died
+        with the gang. Not a crash: the consecutive-fast-failure ladder
+        is untouched."""
+        new_engine = self._factory().start()
+        with self._lock:
+            # Capture the outgoing engine under the SAME lock as the
+            # swap: the _watch crash-restart path swaps concurrently
+            # (a slice-wide fault can kill a follower AND crash host
+            # 0's loop), and a stale read here would orphan _watch's
+            # fresh engine with a live loop thread and a full KV cache.
+            if self._stop or self._draining:
+                abandon, old = True, None
+            else:
+                old = self._engine
+                self._engine = new_engine
+                self._started_at = time.monotonic()
+                abandon = False
+        if abandon:
+            new_engine.shutdown()
+            return
+        if old is not None:
+            old.shutdown()
+        self.restarts += 1
+        _RESTARTS.inc()
+        _ENGINE_UP.set(1)
+        events.emit("engine", "decode-engine", "engine_restarted",
+                    reason="gang")
 
     def shutdown(self) -> None:
         self._stop = True

@@ -294,6 +294,19 @@ class SkyPilotReplicaManager:
         import copy as copy_lib
         task = copy_lib.deepcopy(self.task)
         task.service = None
+        spec = info.spec or self.spec
+        topology = getattr(spec, "replica_topology", None)
+        if topology and int(topology.get("hosts", 1)) > 1:
+            # Gang replica: ALL hosts of the slice launch as ONE
+            # replica through the existing gang driver (rank/env
+            # contract + slice-atomic failure). Host 0 fronts HTTP —
+            # info.url already points at the head instance — and the
+            # topology rides the env next to the serving port so
+            # serve_llm picks its role from SKYPILOT_NODE_RANK.
+            from skypilot_tpu.serve import gang_replica
+            task.num_nodes = int(topology["hosts"])
+            task.update_envs({
+                gang_replica.TOPOLOGY_ENV: json.dumps(topology)})
         if task.resources:
             # Pin the replica's pool regardless of the task default: a
             # fallback replica from a spot task must launch on-demand.
@@ -626,8 +639,17 @@ class SkyPilotReplicaManager:
         if changed:
             # Every replica state TRANSITION lands in the lifecycle log
             # (one hook covers launch, readiness, preemption, teardown).
+            extra = {}
+            topology = getattr(info.spec, "replica_topology", None)
+            if topology:
+                # hosts x tp tag so incident timelines attribute a
+                # replica churn to the topology it ran.
+                from skypilot_tpu.serve import gang_replica
+                extra["topology"] = (gang_replica.ReplicaTopology
+                                     .from_config(topology).label())
             events.emit("replica",
                         f"{self.service_name}/{info.replica_id}",
                         info.status.value, service=self.service_name,
                         cluster=info.cluster_name,
-                        is_spot=info.is_spot, version=info.version)
+                        is_spot=info.is_spot, version=info.version,
+                        **extra)

@@ -50,6 +50,13 @@ class SkyServiceSpec:
     # behavior). Per-service: the right bound is one worst-case
     # generation, which is workload-shaped.
     drain_timeout_seconds: int = DEFAULT_DRAIN_TIMEOUT_SECONDS
+    # Per-replica slice topology ({"hosts": N, "ici_axes": {"tp": K}}):
+    # each replica is gang-launched across `hosts` machines, host 0
+    # fronts HTTP and drives a tensor-parallel engine, and the LB /
+    # controller / autoscaler see exactly ONE replica per gang
+    # (serve/gang_replica.py). Stored as a plain dict so the frozen
+    # spec stays json-round-trippable through serve_state.
+    replica_topology: Optional[Dict[str, Any]] = None
 
     @property
     def autoscaling_enabled(self) -> bool:
@@ -82,6 +89,17 @@ class SkyServiceSpec:
             raise exceptions.InvalidTaskError(
                 "Specify either service.replicas or "
                 "service.replica_policy, not both.")
+        topology = config.get("replica_topology")
+        if topology is not None:
+            # Semantic validation + normalization beyond the schema's
+            # shape check (positive axis sizes, int coercion) — the
+            # topology dataclass is control-plane (no jax import).
+            from skypilot_tpu.serve import gang_replica
+            try:
+                topology = gang_replica.ReplicaTopology.from_config(
+                    topology).to_config()
+            except gang_replica.GangError as e:
+                raise exceptions.InvalidTaskError(str(e)) from e
         kwargs: Dict[str, Any] = dict(
             readiness_path=path, initial_delay_seconds=delay,
             readiness_post_data=post,
@@ -92,7 +110,8 @@ class SkyServiceSpec:
                 "load_balancing_policy", "round_robin"),
             drain_timeout_seconds=config.get(
                 "drain_timeout_seconds",
-                DEFAULT_DRAIN_TIMEOUT_SECONDS))
+                DEFAULT_DRAIN_TIMEOUT_SECONDS),
+            replica_topology=topology)
         if policy is not None:
             kwargs.update(
                 min_replicas=policy.get("min_replicas", 1),
@@ -129,6 +148,8 @@ class SkyServiceSpec:
             out["load_balancing_policy"] = self.load_balancing_policy
         if self.drain_timeout_seconds != DEFAULT_DRAIN_TIMEOUT_SECONDS:
             out["drain_timeout_seconds"] = self.drain_timeout_seconds
+        if self.replica_topology:
+            out["replica_topology"] = dict(self.replica_topology)
         if (self.autoscaling_enabled or self.max_replicas is not None
                 or self.use_ondemand_fallback):
             policy: Dict[str, Any] = {"min_replicas": self.min_replicas}

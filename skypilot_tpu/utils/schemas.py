@@ -89,6 +89,24 @@ _SERVICE_SCHEMA = {
             "type": "string",
             "enum": ["round_robin", "prefix_affinity"],
         },
+        # Per-replica slice topology (serve/gang_replica.py): each
+        # replica is a gang of `hosts` machines whose devices form one
+        # mesh, with `ici_axes` naming the intra-slice parallel axes
+        # (serving uses tp). Kept jax-free here: the schema layer must
+        # not import the serve/compute stack.
+        "replica_topology": {
+            "type": "object",
+            "additionalProperties": False,
+            "required": ["hosts"],
+            "properties": {
+                "hosts": {"type": "integer", "minimum": 1},
+                "ici_axes": {
+                    "type": "object",
+                    "additionalProperties": {"type": "integer",
+                                             "minimum": 1},
+                },
+            },
+        },
         "replica_policy": {
             "type": "object",
             "additionalProperties": False,

@@ -900,3 +900,144 @@ def test_except_lint_catches_and_allows(tmp_path):
     assert files == ["skypilot_tpu/serve/bad.py",
                      "skypilot_tpu/serve/bad.py",
                      "skypilot_tpu/serve/lazy.py"]
+
+
+# ================================================= gang-replica chaos
+def _spawn_gang_replica(port, env_extra=None, hosts=2):
+    """2-process gang replica (serve_llm self-spawn mode), unsharded
+    (tp=1) so the fault-path tests pay no mesh-compile tax."""
+    import pathlib
+    import subprocess
+    import sys
+    env = dict(__import__("os").environ)
+    env["PYTHONPATH"] = str(
+        pathlib.Path(__file__).resolve().parent.parent)
+    env["STPU_GANG_HB_TIMEOUT"] = "2"
+    env.update(env_extra or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "skypilot_tpu.recipes.serve_llm",
+         "--model", "tiny", "--port", str(port),
+         "--replica-hosts", str(hosts)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True)
+
+
+def _wait_code(url, want, timeout=240):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            code = _get_code(url, timeout=5)
+        except (urllib.error.URLError, ConnectionError, OSError):
+            code = None      # not listening yet / mid-restart
+        if code == want:
+            return True
+        time.sleep(0.25)
+    return False
+
+
+def _gang_members(port):
+    return json.loads(
+        _get(f"http://127.0.0.1:{port}/gang")[1])["members"]
+
+
+def _pid_alive(pid):
+    import os
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_gang_drain_and_shutdown_leave_no_orphan_followers():
+    """POST /drain propagates to the follower's engine (gang-wide
+    drain), and SIGTERM teardown reaps every self-spawned follower —
+    scale-down must never orphan a gang member process."""
+    import os
+    import signal as signal_lib
+    import subprocess
+    port = _free_port()
+    proc = _spawn_gang_replica(port)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        assert _wait_code(base + "/health", 200), "gang never ready"
+        follower_pids = [m["pid"] for m in _gang_members(port)
+                         if m["role"] == "follower"]
+        assert follower_pids and all(_pid_alive(p)
+                                     for p in follower_pids)
+        # Drain: replica refuses new work, gang stays up (draining is
+        # not degradation — /gang keeps answering).
+        req = urllib.request.Request(base + "/drain", data=b"{}",
+                                     method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            payload = json.loads(resp.read())
+        assert payload["draining"] is True
+        gen = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"prompt": [1], "max_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(gen, timeout=10)
+            assert False, "draining replica accepted work"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        # SIGTERM: the leader broadcasts shutdown + reaps followers.
+        os.kill(proc.pid, signal_lib.SIGTERM)
+        proc.wait(timeout=30)
+        assert proc.returncode == 143
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and any(
+                _pid_alive(p) for p in follower_pids):
+            time.sleep(0.2)
+        leaked = [p for p in follower_pids if _pid_alive(p)]
+        assert not leaked, f"orphaned follower processes: {leaked}"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_gang_follower_kill_via_chaos_seam_recovers():
+    """A seeded `gang.host` kill fault SIGKILLs the follower at its
+    first mirrored submission (the same seam host_wrapper fires for
+    gang-launched hosts): host 0's /health flips 503, the whole-gang
+    supervisor restart respawns the member, and traffic recovers."""
+    port = _free_port()
+    # The fault spec rides the leader's env into the self-spawned
+    # follower; the leader itself never fires gang.host.
+    proc = _spawn_gang_replica(
+        port, env_extra={"STPU_FAULTS": "gang.host:kill:times=1"})
+    base = f"http://127.0.0.1:{port}"
+    try:
+        assert _wait_code(base + "/health", 200), "gang never ready"
+        before = [m["pid"] for m in _gang_members(port)
+                  if m["role"] == "follower"]
+        gen = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"prompt": [1, 2],
+                             "max_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        # The broadcast of this admission kills the follower; host 0's
+        # own engine still answers the request.
+        with urllib.request.urlopen(gen, timeout=120) as resp:
+            assert resp.status == 200
+        assert _wait_code(base + "/health", 503, timeout=30), \
+            "/health never flipped after the chaos kill"
+        assert _wait_code(base + "/health", 200, timeout=120), \
+            "whole-gang restart never recovered"
+        after = [m["pid"] for m in _gang_members(port)
+                 if m["role"] == "follower"]
+        assert after and after != before
+        with urllib.request.urlopen(gen, timeout=120) as resp:
+            assert resp.status == 200
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except Exception:  # noqa: stpu-except — best-effort teardown of a test subprocess
+                proc.kill()

@@ -36,6 +36,7 @@ DEFAULT_METRICS = (
     "detail.eight_b_shape.tokens_per_sec_per_chip",
     "detail.serving.*_decode_tok_s_b*",
     "detail.serving.*_engine_ragged_tok_s",
+    "detail.serving.*_engine_tp_tok_s",
     "detail.serving.*_engine_prefix_tok_s",
     "detail.serving.*_prefix_hit_rate",
     "detail.serving.*_slo_goodput",

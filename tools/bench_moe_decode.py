@@ -26,7 +26,7 @@ def main() -> None:
     p.add_argument("--family", choices=("mixtral", "llama", "gemma"),
                    default="mixtral")
     p.add_argument("--mode", choices=("fixed", "engine", "prefix",
-                                      "ckpt", "loadgen"),
+                                      "ckpt", "loadgen", "tp"),
                    default="fixed",
                    help="fixed: bucketed batch decode (r01-r05 "
                         "comparable); engine: continuous-batching "
@@ -38,7 +38,12 @@ def main() -> None:
                         "family's full param set (train/checkpoint.py); "
                         "loadgen: the full serve_llm+LB data plane "
                         "under the open-loop load generator, graded "
-                        "against TTFT/TPOT SLOs (goodput, p99 TTFT)")
+                        "against TTFT/TPOT SLOs (goodput, p99 TTFT); "
+                        "tp: the tensor-parallel sharded engine "
+                        "(serve/gang_replica.py) over a --tp-wide "
+                        "mesh — needs that many visible devices "
+                        "(XLA_FLAGS=--xla_force_host_platform_"
+                        "device_count on CPU)")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--tokens", type=int, default=128)
@@ -61,6 +66,8 @@ def main() -> None:
                    help="loadgen mode: per-output-token SLO in seconds")
     p.add_argument("--prefix-cache-mb", type=float, default=256.0,
                    help="prefix mode: shared-prefix KV pool budget")
+    p.add_argument("--tp", type=int, default=2,
+                   help="tp mode: tensor-parallel degree (mesh width)")
     p.add_argument("--dim", type=int, default=1024)
     p.add_argument("--layers", type=int, default=8)
     p.add_argument("--experts", type=int, default=8)
@@ -108,6 +115,10 @@ def main() -> None:
             args.family, slots=args.slots, qps=args.qps,
             duration_s=args.duration, slo_ttft_s=args.slo_ttft,
             slo_tpot_s=args.slo_tpot, **shape_kw)
+    elif args.mode == "tp":
+        result = decode_bench.measure_engine_tp(
+            args.family, tp=args.tp, slots=args.slots,
+            n_requests=args.requests, **shape_kw)
     else:
         result = decode_bench.measure_decode(
             args.family, batch=args.batch, prompt_len=args.prompt_len,
