@@ -172,7 +172,7 @@ class Daemon:
         baseline = max(
             job_lib.last_activity_time(home=str(self.home)),
             float(cfg.get("set_at", self.started_at)))
-        idle_for = time.time() - baseline
+        idle_for = time.time() - baseline  # noqa: stpu-wallclock baseline mixes job-DB wall stamps with autostop set_at written by the remote client
         # Even at -i 0, give an in-flight submission a moment: the
         # client sets autostop at PRE_EXEC and then ships the job spec
         # to this head — terminating inside that window would kill the

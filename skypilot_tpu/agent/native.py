@@ -262,7 +262,7 @@ class _PyCoordinator:
                     return -2 - self._failed_rank
                 if len(self._conns) == self.num_hosts:
                     return 0
-                remaining = deadline - time.time()
+                remaining = deadline - time.time()  # noqa: stpu-wallclock deadlines are exchanged with code stamping wall clock
                 if remaining <= 0:
                     return -1
                 self._cond.wait(remaining)
@@ -421,7 +421,7 @@ class _PyClient:
             raise OSError(f"client rank {rank}: register failed")
         threading.Thread(target=self._reader_loop, daemon=True).start()
         with self._cond:
-            remaining = deadline - time.time()
+            remaining = deadline - time.time()  # noqa: stpu-wallclock deadlines are exchanged with code stamping wall clock
             self._cond.wait_for(lambda: self._registered,
                                 max(remaining, 0.1))
             if not self._registered:
@@ -444,7 +444,7 @@ class _PyClient:
                     return 0
                 if self._failed_rank >= 0:
                     return -2 - self._failed_rank
-                remaining = deadline - time.time()
+                remaining = deadline - time.time()  # noqa: stpu-wallclock deadlines are exchanged with code stamping wall clock
                 if remaining <= 0 or self._sock is None:
                     return -1
                 self._cond.wait(remaining)

@@ -1,0 +1,36 @@
+"""`stpu check` — the unified static-analysis framework.
+
+One AST parse per file feeds every registered rule (no more four
+scripts re-walking the tree), one suppression grammar
+(``# noqa: stpu-<rule> <mandatory reason>``), one report format
+(``file:line:rule-id: message`` or ``--json``).
+
+Rules live in ``rules_*.py`` modules and self-register on import:
+
+  * ``stpu-wallclock``   — time.time() in duration arithmetic
+  * ``stpu-span-leak``   — tracing.start_span() never ended
+  * ``stpu-except``      — except Exception: pass in the control plane
+  * ``stpu-atomic``      — bare durable writes in crash-critical files
+  * ``stpu-collective``  — raw collectives in serve/
+  * ``stpu-donation``    — use-after-donate on jitted entry points
+  * ``stpu-host-sync``   — device syncs on the decode hot path
+  * ``stpu-env``         — STPU_* env reads vs utils/env_contract.py
+
+Entry points: ``stpu check`` (cli.py), ``python tools/check_*.py``
+(thin shims), and ``tests/test_static_analysis.py`` (tier-1).
+See docs/static-analysis.md for the rule catalog and how to add one.
+"""
+from skypilot_tpu.analysis.core import (Finding, Rule, all_rules,
+                                        get_rule, register, run_check)
+
+# Importing the rule modules registers them (order = report order).
+from skypilot_tpu.analysis import rules_clocks  # noqa: F401,E402
+from skypilot_tpu.analysis import rules_excepts  # noqa: F401,E402
+from skypilot_tpu.analysis import rules_atomic  # noqa: F401,E402
+from skypilot_tpu.analysis import rules_collectives  # noqa: F401,E402
+from skypilot_tpu.analysis import rules_donation  # noqa: F401,E402
+from skypilot_tpu.analysis import rules_host_sync  # noqa: F401,E402
+from skypilot_tpu.analysis import rules_env  # noqa: F401,E402
+
+__all__ = ["Finding", "Rule", "all_rules", "get_rule", "register",
+           "run_check"]

@@ -1,0 +1,312 @@
+"""Shared static-analysis core: parse once, run every rule, one
+suppression grammar, one report shape.
+
+The framework owns everything the four pre-existing lint scripts each
+reimplemented:
+
+  * file discovery + a SINGLE ``ast.parse`` per file (a ``FileContext``
+    carries the tree, a parent map, and a flattened node list that all
+    rules share — adding a rule never adds another tree walk);
+  * the unified suppression grammar::
+
+        # noqa: stpu-<rule>[, stpu-<rule>...] <mandatory reason>
+
+    A marker with no (or a too-short) reason does NOT suppress — the
+    reason is the review artifact, exactly the check_excepts contract,
+    now uniform across every rule;
+  * reporting: ``file:line:rule-id: message`` text or a pinned JSON
+    schema (``[{"path", "line", "rule", "message"}]``).
+
+Rules subclass :class:`Rule` and register via :func:`register`. A rule
+only sees files whose repo-relative path it claims via ``targets()``,
+and returns raw findings — suppression is applied centrally.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+DEFAULT_TARGET = REPO_ROOT / "skypilot_tpu"
+
+# The unified suppression marker. The reason is MANDATORY and must be
+# real prose (>= MIN_REASON_CHARS non-space chars): an unexplained
+# exemption is how lint discipline rots.
+NOQA_RE = re.compile(
+    r"#\s*noqa:\s*(?P<rules>stpu-[a-z0-9-]+(?:\s*,\s*stpu-[a-z0-9-]+)*)"
+    r"(?P<reason>[^#]*)")
+MIN_REASON_CHARS = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation: where, which rule, what."""
+    path: str       # relative to the scan root
+    line: int
+    rule: str       # e.g. "stpu-wallclock"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.rule}: {self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        return {"path": self.path, "line": self.line,
+                "rule": self.rule, "message": self.message}
+
+
+class _Noqa:
+    """Per-line suppressions parsed once per file."""
+
+    def __init__(self, lines: Sequence[str]):
+        # line number -> (frozenset of rule ids, reason string)
+        self.by_line: Dict[int, Tuple[frozenset, str]] = {}
+        for lineno, line in enumerate(lines, start=1):
+            m = NOQA_RE.search(line)
+            if not m:
+                continue
+            rules = frozenset(
+                r.strip() for r in m.group("rules").split(","))
+            reason = m.group("reason").strip(" \t-—:")
+            self.by_line[lineno] = (rules, reason)
+
+    def status(self, lineno: int, rule: str) -> str:
+        """'suppressed' | 'no-reason' (marker present, reason missing)
+        | 'none'."""
+        entry = self.by_line.get(lineno)
+        if entry is None or rule not in entry[0]:
+            return "none"
+        if len(entry[1].replace(" ", "")) >= MIN_REASON_CHARS:
+            return "suppressed"
+        return "no-reason"
+
+
+class FileContext:
+    """Everything rules need about one file, computed exactly once."""
+
+    def __init__(self, path: pathlib.Path, rel: str):
+        self.path = path
+        self.rel = rel
+        try:
+            self.text = path.read_text(errors="replace")
+            self.error: Optional[str] = None
+            self.error_line = 1
+        except OSError as e:
+            self.text = ""
+            self.error = f"unreadable: {e}"
+            self.error_line = 1
+        self.lines: List[str] = self.text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        try:
+            self.tree = ast.parse(self.text)
+        except SyntaxError as e:
+            # Rules silently skip a tree-less file, so the failure MUST
+            # surface as a finding — a lint gate that exits 0 on a file
+            # it never inspected is worse than no gate.
+            self.error = f"syntax error: {e.msg}"
+            self.error_line = e.lineno or 1
+        # One walk builds both the flat node list and the parent map
+        # every rule shares.
+        self.nodes: List[ast.AST] = []
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        if self.tree is not None:
+            stack: List[ast.AST] = [self.tree]
+            while stack:
+                node = stack.pop()
+                self.nodes.append(node)
+                for child in ast.iter_child_nodes(node):
+                    self.parents[child] = node
+                    stack.append(child)
+        self.noqa = _Noqa(self.lines)
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def enclosing(self, node: ast.AST, *kinds) -> Optional[ast.AST]:
+        """Nearest ancestor of one of the given AST types."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, kinds):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+
+class Rule:
+    """Base class for one analyzer.
+
+    Subclasses set ``id`` / ``title`` / ``rationale`` (the doc catalog
+    pulls these), claim files via ``targets(rel)``, and yield raw
+    ``Finding``s from ``check(ctx)``. ``prepare(contexts)`` runs once
+    before any ``check`` for rules that need cross-file state (the env
+    rule's constant table, for instance).
+    """
+
+    id: str = ""
+    title: str = ""
+    rationale: str = ""
+
+    def targets(self, rel: str) -> bool:
+        return rel.endswith(".py")
+
+    def prepare(self, contexts: Sequence[FileContext]) -> None:
+        pass
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule_cls):
+    """Class decorator: instantiate + register a rule by its id."""
+    rule = rule_cls()
+    if not rule.id:
+        raise ValueError(f"{rule_cls.__name__} has no id")
+    if rule.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id!r}")
+    _REGISTRY[rule.id] = rule
+    return rule_cls
+
+
+def all_rules() -> List[Rule]:
+    return list(_REGISTRY.values())
+
+
+def get_rule(rule_id: str) -> Rule:
+    if rule_id not in _REGISTRY:
+        known = ", ".join(sorted(_REGISTRY))
+        raise KeyError(f"unknown rule {rule_id!r} (known: {known})")
+    return _REGISTRY[rule_id]
+
+
+def _discover(paths: Sequence[pathlib.Path]) -> List[pathlib.Path]:
+    files: List[pathlib.Path] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    # De-dup while preserving order (overlapping path args).
+    seen, out = set(), []
+    for f in files:
+        if f not in seen:
+            seen.add(f)
+            out.append(f)
+    return out
+
+
+def run_check(paths: Optional[Sequence[pathlib.Path]] = None,
+              rules: Optional[Sequence[str]] = None,
+              root: Optional[pathlib.Path] = None,
+              respect_targets: bool = True) -> List[Finding]:
+    """Run ``rules`` (default: all) over ``paths`` (default: the repo's
+    skypilot_tpu/ tree). Returns suppression-filtered findings sorted
+    by (path, line, rule). ``root`` anchors the relative paths in the
+    report (defaults to the repo root for in-repo scans, else the
+    common parent of ``paths``). ``respect_targets=False`` runs the
+    selected rules on every discovered file regardless of each rule's
+    ``targets()`` claim — the tools/ shims use it to keep the
+    historical lint-exactly-these-paths API."""
+    if paths is None:
+        paths = [DEFAULT_TARGET]
+    paths = [pathlib.Path(p).resolve() for p in paths]
+    if root is None:
+        anchored = all(REPO_ROOT in p.parents or p == REPO_ROOT
+                       for p in paths)
+        root = REPO_ROOT if anchored else _common_root(paths)
+    root = pathlib.Path(root).resolve()
+
+    selected: List[Rule] = ([get_rule(r) for r in rules]
+                            if rules is not None
+                            else all_rules())
+
+    contexts: List[FileContext] = []
+    for f in _discover(paths):
+        try:
+            rel = str(f.resolve().relative_to(root))
+        except ValueError:
+            rel = str(f)
+        # Parsing is the expensive step: skip files no selected rule
+        # claims (e.g. `--rule stpu-atomic` parses 2 files, not ~100).
+        # Untargeted files also skip the stpu-parse gate — a file no
+        # rule would inspect can't mask a finding.
+        if respect_targets and not any(r.targets(rel)
+                                       for r in selected):
+            continue
+        contexts.append(FileContext(f, rel))
+
+    for rule in selected:
+        rule.prepare(contexts)
+
+    findings: List[Finding] = []
+    for ctx in contexts:
+        if ctx.error is not None:
+            # Core-level finding (rule id "stpu-parse"): no rule saw
+            # this file, which must fail the gate, not pass it.
+            findings.append(Finding(
+                ctx.rel, ctx.error_line, "stpu-parse",
+                f"{ctx.error} — no rule inspected this file"))
+            continue
+        for rule in selected:
+            if respect_targets and not rule.targets(ctx.rel):
+                continue
+            for finding in rule.check(ctx):
+                status = ctx.noqa.status(finding.line, finding.rule)
+                if status == "suppressed":
+                    continue
+                if status == "no-reason":
+                    finding = dataclasses.replace(
+                        finding, message=finding.message +
+                        f" (noqa: {finding.rule} present but the "
+                        "reason is missing — reasons are mandatory)")
+                findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def _common_root(paths: Sequence[pathlib.Path]) -> pathlib.Path:
+    parents = [p if p.is_dir() else p.parent for p in paths]
+    common = parents[0]
+    for p in parents[1:]:
+        while common not in (p, *p.parents):
+            common = common.parent
+    return common
+
+
+def render_text(findings: Sequence[Finding]) -> str:
+    return "\n".join(f.render() for f in findings)
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    return json.dumps([f.to_json() for f in findings], indent=2)
+
+
+# --------------------------------------------------------- shared helpers
+def dotted_path(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain of plain names, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Trailing name of the called expression ('psum' for lax.psum)."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None

@@ -1,0 +1,107 @@
+"""``stpu-atomic`` — bare durable writes in crash-consistency-critical
+files (ported from tools/check_atomic_writes.py).
+
+The checkpoint/restore contract (train/checkpoint.py) and the managed-
+jobs state layer (jobs/state.py) are exactly the files whose writes a
+SIGKILL must never tear: a half-written checkpoint manifest or state
+file silently poisons the resume path the whole preemption story rests
+on. Every durable write must go through the atomic temp + fsync +
+rename helper (``checkpoint.atomic_write_bytes``). The helper itself
+(functions named ``atomic_write_bytes``) is exempt — someone has to
+own the raw fd. Everything else annotates
+``# noqa: stpu-atomic <reason>``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.core import FileContext, Finding, Rule
+
+TARGET_FILES = ("skypilot_tpu/train/checkpoint.py",
+                "skypilot_tpu/jobs/state.py")
+
+# Functions that ARE the atomic protocol; their internals are the one
+# sanctioned raw-write site.
+HELPER_FUNCTIONS = {"atomic_write_bytes"}
+
+_WRITE_OS_FLAGS = {"O_WRONLY", "O_RDWR", "O_CREAT", "O_APPEND",
+                   "O_TRUNC"}
+
+
+def _mode_of_open(call: ast.Call) -> str:
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        return call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return "r"
+
+
+def _os_flags(call: ast.Call) -> set:
+    names = set()
+    for node in ast.walk(call):
+        if isinstance(node, ast.Attribute) and node.attr.startswith("O_"):
+            names.add(node.attr)
+        elif isinstance(node, ast.Name) and node.id.startswith("O_"):
+            names.add(node.id)
+    return names
+
+
+def _violation_kind(node: ast.Call) -> str:
+    """'' when fine, else a short description of the raw write."""
+    func = node.func
+    if isinstance(func, ast.Name) and func.id == "open":
+        mode = _mode_of_open(node)
+        if any(c in mode for c in "wax+"):
+            return f"bare open(..., {mode!r})"
+    elif isinstance(func, ast.Attribute):
+        if func.attr == "open" and isinstance(func.value, ast.Name) \
+                and func.value.id == "os":
+            if _os_flags(node) & _WRITE_OS_FLAGS:
+                return "raw os.open() with write flags"
+        elif func.attr in ("write_text", "write_bytes"):
+            return f".{func.attr}() durable write"
+    return ""
+
+
+@core.register
+class AtomicWriteRule(Rule):
+    id = "stpu-atomic"
+    title = "non-atomic durable write in a crash-critical file"
+    rationale = ("A SIGKILL mid-write tears bare open()/write_text() "
+                 "output; durable state must go through "
+                 "checkpoint.atomic_write_bytes (temp+fsync+rename).")
+
+    def targets(self, rel: str) -> bool:
+        # '/'-bounded suffix match: restrain/checkpoint.py must NOT
+        # match train/checkpoint.py.
+        suffixes = [t for full in TARGET_FILES
+                    for t in (full, full.split("/", 1)[-1])]
+        return any(rel == t or rel.endswith("/" + t) for t in suffixes)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _violation_kind(node)
+            if not kind:
+                continue
+            helper = ctx.enclosing(node, ast.FunctionDef,
+                                   ast.AsyncFunctionDef)
+            while helper is not None and \
+                    helper.name not in HELPER_FUNCTIONS:
+                helper = ctx.enclosing(helper, ast.FunctionDef,
+                                       ast.AsyncFunctionDef)
+            if helper is not None:
+                continue
+            yield Finding(
+                ctx.rel, node.lineno, self.id,
+                f"{kind} — durable state writes must go through "
+                "checkpoint.atomic_write_bytes (temp + fsync + "
+                "rename), or carry '# noqa: stpu-atomic <reason>'")

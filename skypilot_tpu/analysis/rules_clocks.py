@@ -1,0 +1,109 @@
+"""Clock + tracing-span discipline (ported from tools/check_clocks.py).
+
+``stpu-wallclock`` — ``time.time()`` in duration arithmetic. An NTP
+step (or a VM migration's clock slew) mid-interval yields negative or
+wildly wrong durations; intervals must come from ``perf_counter`` /
+``monotonic``. Sites where wall clock is genuinely right (arithmetic
+against a timestamp persisted by another process/boot) annotate
+``# noqa: stpu-wallclock <reason>`` — the bespoke ``# wallclock:
+intentional`` marker and the script-resident allowlist are gone.
+
+``stpu-span-leak`` — every ``tracing.start_span()`` is either a
+``with`` context expression or assigned to a name ``.end()``ed in the
+same function. Records are written on end; an open span that is never
+ended silently vanishes from the trace.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.core import FileContext, Finding, Rule
+
+_WALLCLOCK_RE = re.compile(r"time\.time\(\)\s*-|-\s*time\.time\(\)")
+
+
+@core.register
+class WallclockRule(Rule):
+    id = "stpu-wallclock"
+    title = "time.time() in duration arithmetic"
+    rationale = ("Durations measured with time.time() break under NTP "
+                 "steps/clock slew; use time.perf_counter() or "
+                 "time.monotonic(). Wall clock is for stamps.")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for lineno, line in enumerate(ctx.lines, start=1):
+            if line.strip().startswith("#"):
+                continue
+            if _WALLCLOCK_RE.search(line):
+                yield Finding(
+                    ctx.rel, lineno, self.id,
+                    "time.time() used in duration arithmetic — use "
+                    "time.perf_counter()/time.monotonic(), or annotate "
+                    "'# noqa: stpu-wallclock <reason>' if arithmetic "
+                    "against a persisted wall stamp is intentional")
+
+
+def _is_start_span_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and core.call_name(node) == "start_span")
+
+
+def _span_closed(call: ast.Call, ctx: FileContext) -> bool:
+    """True iff the start_span() call cannot leak an open span: it is a
+    with-statement context expression, or its result is assigned to a
+    name with a matching ``<name>.end(...)`` in the enclosing function
+    (nested helpers like a shared finish() closure count)."""
+    stmt = call
+    while not isinstance(stmt, ast.stmt):
+        stmt = ctx.parents[stmt]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if call is item.context_expr or any(
+                    n is call for n in ast.walk(item.context_expr)):
+                return True
+        return False
+    target = None
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+            and isinstance(stmt.targets[0], ast.Name):
+        target = stmt.targets[0].id
+    elif isinstance(stmt, ast.AnnAssign) \
+            and isinstance(stmt.target, ast.Name):
+        target = stmt.target.id
+    if target is None:
+        return False  # bare/returned span: nobody owns the .end()
+    scope = stmt
+    while not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Module)):
+        scope = ctx.parents[scope]
+    for node in ast.walk(scope):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "end"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == target):
+            return True
+    return False
+
+
+@core.register
+class SpanLeakRule(Rule):
+    id = "stpu-span-leak"
+    title = "tracing span opened but never ended"
+    rationale = ("Span records are written on end(); an un-ended "
+                 "start_span() silently drops the hop from the trace. "
+                 "Known-after-the-fact phases use record_span.")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        for node in ctx.nodes:
+            if _is_start_span_call(node) and not _span_closed(node, ctx):
+                yield Finding(
+                    ctx.rel, node.lineno, self.id,
+                    "start_span() result is never ended (use `with`, "
+                    "or assign it and call .end() in the same "
+                    "function; for known-after-the-fact phases use "
+                    "record_span)")

@@ -1,0 +1,72 @@
+"""``stpu-collective`` — no hand-rolled collectives in the serving
+stack (ported from tools/check_collectives.py).
+
+Serving code expresses parallelism through ``parallel/mesh.py``
+(ShardingRules resolving logical axes onto a named mesh; XLA's SPMD
+partitioner inserts the collectives). A raw ``lax.psum`` /
+``all_gather`` / ``ppermute`` in ``skypilot_tpu/serve`` hard-codes a
+mesh axis name into request-path code, breaks the moment the topology
+block changes shape, and silently decouples the engine from the
+single-process path the bit-parity tests compare against. A site that
+genuinely must issue one annotates ``# noqa: stpu-collective
+<reason>``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.core import FileContext, Finding, Rule
+
+COLLECTIVES = frozenset({
+    "psum", "psum_scatter", "pmean", "pmax", "pmin",
+    "all_gather", "all_to_all", "ppermute", "pshuffle",
+    "pbroadcast", "axis_index", "pdot",
+})
+
+
+@core.register
+class CollectiveRule(Rule):
+    id = "stpu-collective"
+    title = "raw collective primitive in serve/"
+    rationale = ("Collectives belong where the mesh is managed "
+                 "(parallel/); in serve/ they hard-code axis names "
+                 "into request-path code and break on topology "
+                 "changes.")
+
+    def targets(self, rel: str) -> bool:
+        return (rel.startswith("skypilot_tpu/serve/")
+                or rel.startswith("serve/"))
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        # A bare Name only counts when it was imported as a collective
+        # (e.g. `from jax.lax import psum`); local variables that
+        # happen to share a name are fine — attribute access (lax.psum)
+        # is always flagged.
+        imported = set()
+        for node in ctx.nodes:
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    name = alias.asname or alias.name
+                    if name in COLLECTIVES:
+                        imported.add(name)
+        for node in ctx.nodes:
+            if isinstance(node, ast.Attribute):
+                name = node.attr
+            elif isinstance(node, ast.Name):
+                name = node.id
+                if name not in imported:
+                    continue
+            else:
+                continue
+            if name not in COLLECTIVES:
+                continue
+            yield Finding(
+                ctx.rel, node.lineno, self.id,
+                f"collective `{name}` in serve/ — express parallelism "
+                "through parallel/mesh.py ShardingRules (XLA inserts "
+                "the collectives); annotate '# noqa: stpu-collective "
+                "<reason>' if a raw collective is truly unavoidable")

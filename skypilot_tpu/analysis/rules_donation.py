@@ -1,0 +1,350 @@
+"""``stpu-donation`` — use-after-donate on jitted entry points.
+
+``donate_argnums``/``donate_argnames`` hands a buffer's storage to
+XLA: after the call the caller's reference points at memory the
+compiled program has already overwritten. On the CPU tier-1 mesh
+donation is a silent no-op (XLA copies), so a use-after-donate passes
+every test here and returns garbage the first time it runs on a real
+TPU — the nastiest possible class of "works on my machine". This rule
+makes the contract static:
+
+  * **Caller side** — at every call to a donating jitted entry point,
+    the donated argument (a name or a dotted path like
+    ``self._cache``) must either be REBOUND from the call's return in
+    the same statement (``logits, cache = step(..., cache)``) or go
+    dead: any later read of the donated path in the enclosing function
+    is use-after-donate. A donating call inside a loop that does not
+    rebind is flagged outright — the next iteration reads the donated
+    buffer.
+  * **Callee side** — a donated parameter must (transitively) flow
+    into the jitted function's return value. XLA only aliases a
+    donated input to an OUTPUT; a donated param that reaches no output
+    is silently un-donated (HBM double-buffers) — the exact trap the
+    decode-cache plumbing documents.
+
+Recognized donation sites: ``@functools.partial(jax.jit,
+donate_argnums=...)`` decorators and ``jax.jit(fn_or_lambda,
+donate_argnums=...)`` calls (including ``name = jax.jit(...)``
+bindings, whose call sites are then tracked by name). Resolution is
+per-module — cross-module donation flows are out of scope.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.core import FileContext, Finding, Rule
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+@dataclasses.dataclass
+class _Donator:
+    """One jitted callable with donated args."""
+    name: Optional[str]          # call-site name, if bound to one
+    params: List[str]            # positional parameter names
+    donated: List[int]           # positional indices into params
+    donated_names: List[str]     # donate_argnames entries
+    fn_node: Optional[ast.AST]   # FunctionDef or Lambda for alias check
+    lineno: int
+
+    def donated_params(self) -> List[str]:
+        out = [self.params[i] for i in self.donated
+               if i < len(self.params)]
+        out.extend(n for n in self.donated_names if n in self.params)
+        return out
+
+
+def _const_indices(node: ast.AST) -> List[int]:
+    """(1, 2) / [1] / 1 -> [1, 2] / [1] / [1]."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, int)]
+    return []
+
+
+def _const_names(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)]
+    return []
+
+
+def _positional_params(args: ast.arguments) -> List[str]:
+    return [a.arg for a in (*args.posonlyargs, *args.args)]
+
+
+def _donate_kwargs(call: ast.Call):
+    nums: List[int] = []
+    names: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums = _const_indices(kw.value)
+        elif kw.arg == "donate_argnames":
+            names = _const_names(kw.value)
+    return nums, names
+
+
+def _collect_donators(ctx: FileContext) -> List[_Donator]:
+    """Every donating jitted callable defined in this module."""
+    # Module functions by name, for `jax.jit(step, ...)` resolution.
+    fn_by_name: Dict[str, ast.AST] = {}
+    for node in ctx.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_by_name.setdefault(node.name, node)
+
+    donators: List[_Donator] = []
+    for node in ctx.nodes:
+        # Decorated defs: @functools.partial(jax.jit, donate_argnums=..)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                dec_name = core.dotted_path(dec.func)
+                if dec_name not in _PARTIAL_NAMES:
+                    continue
+                if not (dec.args and core.dotted_path(dec.args[0])
+                        in _JIT_NAMES):
+                    continue
+                nums, names = _donate_kwargs(dec)
+                if nums or names:
+                    donators.append(_Donator(
+                        node.name, _positional_params(node.args),
+                        nums, names, node, node.lineno))
+        # jax.jit(fn_or_lambda, donate_argnums=...) calls.
+        if isinstance(node, ast.Call) \
+                and core.dotted_path(node.func) in _JIT_NAMES:
+            nums, names = _donate_kwargs(node)
+            if not (nums or names) or not node.args:
+                continue
+            wrapped = node.args[0]
+            fn_node: Optional[ast.AST] = None
+            params: List[str] = []
+            if isinstance(wrapped, ast.Lambda):
+                fn_node = wrapped
+                params = _positional_params(wrapped.args)
+            elif isinstance(wrapped, ast.Name):
+                fn_node = fn_by_name.get(wrapped.id)
+                if fn_node is not None:
+                    params = _positional_params(fn_node.args)
+            # Bind to a call-site name when the jit result is assigned.
+            bound = None
+            stmt = ctx.parents.get(node)
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.value is node:
+                bound = stmt.targets[0].id
+            donators.append(_Donator(bound, params, nums, names,
+                                     fn_node, node.lineno))
+    return donators
+
+
+# ------------------------------------------------------ callee side
+def _aliases_output(fn_node: ast.AST, param: str) -> bool:
+    """Does the donated param (transitively) reach a return value?"""
+    if isinstance(fn_node, ast.Lambda):
+        return any(isinstance(n, ast.Name) and n.id == param
+                   for n in ast.walk(fn_node.body))
+    taint: Set[str] = {param}
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn_node):
+            targets: List[ast.AST] = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.NamedExpr):
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            if not any(isinstance(n, ast.Name) and n.id in taint
+                       for n in ast.walk(value)):
+                continue
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name) and n.id not in taint:
+                        taint.add(n.id)
+                        changed = True
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if any(isinstance(n, ast.Name) and n.id in taint
+                   for n in ast.walk(node.value)):
+                return True
+    return False
+
+
+# ------------------------------------------------------ caller side
+def _stmt_of(node: ast.AST, ctx: FileContext) -> ast.stmt:
+    cur = node
+    while not isinstance(cur, ast.stmt):
+        cur = ctx.parents[cur]
+    return cur
+
+
+def _flatten_targets(stmt: ast.stmt) -> List[ast.AST]:
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        raw = stmt.targets
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        raw = [stmt.target]
+    else:
+        return targets
+    stack = list(raw)
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        else:
+            targets.append(t)
+    return targets
+
+
+def _enclosing_scope(node: ast.AST, ctx: FileContext) -> ast.AST:
+    scope = ctx.enclosing(node, ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.Module)
+    return scope if scope is not None else ctx.tree
+
+
+def _enclosing_loop(node: ast.AST, ctx: FileContext
+                    ) -> Optional[ast.AST]:
+    """Nearest For/While ancestor INSIDE the same function scope."""
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            return cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return None
+        cur = ctx.parents.get(cur)
+    return None
+
+
+def _stored_in_loop_before(loop: ast.AST, stmt: ast.stmt, path: str
+                           ) -> bool:
+    """Is ``path`` freshly stored inside the loop body, textually
+    before the donating statement? Then each iteration donates a new
+    buffer (``cache = init_cache(b); step(b, cache)``) and the
+    back-edge read is of a fresh value, not the donated one."""
+    excluded = set(id(n) for n in ast.walk(stmt))
+    for node in ast.walk(loop):
+        if id(node) in excluded:
+            continue
+        if not isinstance(node, (ast.Name, ast.Attribute)):
+            continue
+        if not isinstance(getattr(node, "ctx", None), ast.Store):
+            continue
+        if core.dotted_path(node) != path:
+            continue
+        if node.lineno < stmt.lineno:
+            return True
+    return False
+
+
+def _first_event_after(scope: ast.AST, stmt: ast.stmt, path: str,
+                       ctx: FileContext):
+    """First (Load|Store) of ``path`` textually after ``stmt`` in
+    ``scope``. Returns (kind, lineno) or None."""
+    excluded = set(id(n) for n in ast.walk(stmt))
+    end = getattr(stmt, "end_lineno", stmt.lineno)
+    events = []
+    for node in ast.walk(scope):
+        if id(node) in excluded:
+            continue
+        if not isinstance(node, (ast.Name, ast.Attribute)):
+            continue
+        if core.dotted_path(node) != path:
+            continue
+        kind = ("store" if isinstance(getattr(node, "ctx", None),
+                                      (ast.Store, ast.Del))
+                else "load")
+        events.append((node.lineno, node.col_offset, kind))
+    events.sort()
+    for lineno, _col, kind in events:
+        if lineno > end:
+            return kind, lineno
+    return None
+
+
+@core.register
+class DonationRule(Rule):
+    id = "stpu-donation"
+    title = "use-after-donate / donated input aliasing no output"
+    rationale = ("Donated buffers are invalid after the call on real "
+                 "TPUs (the CPU tier-1 mesh silently copies); donated "
+                 "args must be rebound from the return or go dead, "
+                 "and donated params must alias an output.")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        donators = _collect_donators(ctx)
+
+        # Callee side: donated param must reach a return.
+        for d in donators:
+            if d.fn_node is None:
+                continue
+            for param in d.donated_params():
+                if not _aliases_output(d.fn_node, param):
+                    label = d.name or "<lambda>"
+                    yield Finding(
+                        ctx.rel, d.lineno, self.id,
+                        f"donated parameter `{param}` of `{label}` "
+                        "aliases no output — XLA only donates an "
+                        "input that aliases an output; return the "
+                        "updated buffer or drop the donation")
+
+        # Caller side: track calls to named donators.
+        by_name = {d.name: d for d in donators if d.name}
+        if not by_name:
+            return
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Name):
+                continue
+            d = by_name.get(node.func.id)
+            if d is None:
+                continue
+            donated_args: List[ast.AST] = [
+                node.args[i] for i in d.donated if i < len(node.args)]
+            for kw in node.keywords:
+                if kw.arg in d.donated_names:
+                    donated_args.append(kw.value)
+            stmt = _stmt_of(node, ctx)
+            target_paths = {core.dotted_path(t)
+                            for t in _flatten_targets(stmt)}
+            for arg in donated_args:
+                path = core.dotted_path(arg)
+                if path is None:
+                    continue  # a temporary: nothing outlives the call
+                if path in target_paths:
+                    continue  # rebound from the return — the contract
+                loop = _enclosing_loop(node, ctx)
+                if loop is not None and not _stored_in_loop_before(
+                        loop, stmt, path):
+                    yield Finding(
+                        ctx.rel, node.lineno, self.id,
+                        f"`{path}` is donated to `{d.name}` inside a "
+                        "loop without being rebound from the return — "
+                        "the next iteration reads a donated buffer")
+                    continue
+                scope = _enclosing_scope(node, ctx)
+                event = _first_event_after(scope, stmt, path, ctx)
+                if event is not None and event[0] == "load":
+                    yield Finding(
+                        ctx.rel, event[1], self.id,
+                        f"`{path}` is read after being donated to "
+                        f"`{d.name}` (line {node.lineno}) — rebind it "
+                        "from the call's return or stop using it; on "
+                        "TPU the buffer is already overwritten")

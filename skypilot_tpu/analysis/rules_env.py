@@ -1,0 +1,153 @@
+"""``stpu-env`` — every STPU_* env read resolves through the contract.
+
+~45 ``STPU_*`` knobs are read across orchestration layers (CLI, LB,
+engine, gang driver, jobs controller, agent daemon). Before the
+registry, nothing related a knob's name, default, and doc — the drift
+failure mode where two call sites parse the same knob with different
+defaults (the class of bug "Adaptive Orchestration" attributes config
+incidents to). This rule makes ``utils/env_contract.py`` load-bearing:
+
+  * an ``os.environ.get``/``os.getenv``/``os.environ[...]`` read of an
+    ``STPU_*`` name that is NOT in the registry is a violation — new
+    knobs must be declared (default + doc) before first read;
+  * a read whose inline default LITERAL disagrees with the registered
+    default is a violation — one knob, one default, everywhere.
+
+Names are resolved statically: string literals, module constants
+(``ENABLE_ENV = "STPU_TRACE"`` — same file first, then a cross-file
+table built in ``prepare()`` for dotted reads like ``tracing.ENV_CTX``;
+ambiguous bare names never resolve cross-file). Dynamic defaults
+(``str(10 * 1024 * 1024)``) can't be compared statically and are
+skipped — the registry still pins the canonical value for the doc
+table. Env WRITES (``os.environ[...] = ...``, ``.pop``) are stamps,
+not config reads, and are out of scope.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional, Sequence, Set, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.core import FileContext, Finding, Rule
+from skypilot_tpu.utils import env_contract
+
+_GET_CALLS = {"os.environ.get", "environ.get", "os.getenv", "getenv"}
+_ENVIRON = {"os.environ", "environ"}
+
+
+def _local_constants(ctx: FileContext) -> Dict[str, str]:
+    """NAME -> 'STPU_*' for constant string assignments in this file."""
+    out: Dict[str, str] = {}
+    for node in ctx.nodes:
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and node.value.value.startswith(env_contract.PREFIX):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = node.value.value
+    return out
+
+
+class _EnvRead:
+    """One detected env read: the name expression + optional default."""
+
+    def __init__(self, node: ast.AST, name_expr: ast.AST,
+                 default: Optional[ast.AST], has_default: bool):
+        self.node = node
+        self.name_expr = name_expr
+        self.default = default
+        self.has_default = has_default
+
+
+def _env_reads(ctx: FileContext) -> Iterable[_EnvRead]:
+    for node in ctx.nodes:
+        if isinstance(node, ast.Call):
+            path = core.dotted_path(node.func)
+            if path in _GET_CALLS and node.args:
+                default = node.args[1] if len(node.args) > 1 else None
+                for kw in node.keywords:
+                    if kw.arg == "default":
+                        default = kw.value
+                yield _EnvRead(node, node.args[0], default,
+                               default is not None)
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(getattr(node, "ctx", None), ast.Load) \
+                and core.dotted_path(node.value) in _ENVIRON:
+            yield _EnvRead(node, node.slice, None, False)
+
+
+@core.register
+class EnvContractRule(Rule):
+    id = "stpu-env"
+    title = "STPU_* env read outside utils/env_contract.py"
+    rationale = ("Unregistered knobs and per-site default literals are "
+                 "how two orchestration layers end up parsing the same "
+                 "env var differently; every STPU_* read must resolve "
+                 "through the central registry's name + default.")
+
+    def __init__(self) -> None:
+        # Cross-file constant table: bare NAME -> set of STPU_* values
+        # it is bound to anywhere in the scanned tree. Only UNAMBIGUOUS
+        # names (one value) resolve for dotted reads.
+        self._cross: Dict[str, Set[str]] = {}
+
+    def prepare(self, contexts: Sequence[FileContext]) -> None:
+        self._cross = {}
+        for ctx in contexts:
+            for name, value in _local_constants(ctx).items():
+                self._cross.setdefault(name, set()).add(value)
+
+    def _resolve(self, expr: ast.AST,
+                 local: Dict[str, str]) -> Optional[str]:
+        if isinstance(expr, ast.Constant) \
+                and isinstance(expr.value, str):
+            return expr.value
+        if isinstance(expr, ast.Name):
+            if expr.id in local:
+                return local[expr.id]
+            values = self._cross.get(expr.id, set())
+            return next(iter(values)) if len(values) == 1 else None
+        if isinstance(expr, ast.Attribute):
+            values = self._cross.get(expr.attr, set())
+            return next(iter(values)) if len(values) == 1 else None
+        return None
+
+    @staticmethod
+    def _default_literal(read: _EnvRead
+                         ) -> Tuple[bool, Optional[str]]:
+        """(comparable, normalized default). Only an INLINE constant
+        default can disagree with the registry: a presence-style read
+        with no default (``if os.environ.get("STPU_X"):``) and a
+        dynamic default expression are both out of scope."""
+        if not read.has_default:
+            return False, None
+        if isinstance(read.default, ast.Constant):
+            value = read.default.value
+            return True, None if value is None else str(value)
+        return False, None
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        local = _local_constants(ctx)
+        for read in _env_reads(ctx):
+            name = self._resolve(read.name_expr, local)
+            if name is None or not name.startswith(env_contract.PREFIX):
+                continue
+            knob = env_contract.REGISTRY.get(name)
+            if knob is None:
+                yield Finding(
+                    ctx.rel, read.node.lineno, self.id,
+                    f"`{name}` is read but not registered in "
+                    "utils/env_contract.py — declare the knob "
+                    "(default + one-line doc) before reading it")
+                continue
+            comparable, default = self._default_literal(read)
+            if comparable and default != knob.default:
+                yield Finding(
+                    ctx.rel, read.node.lineno, self.id,
+                    f"`{name}` read with default {default!r} but "
+                    f"env_contract.py registers {knob.default!r} — "
+                    "one knob, one default (fix the site or the "
+                    "registry)")

@@ -1,0 +1,59 @@
+"""``stpu-except`` — swallowed exceptions in the control plane
+(ported from tools/check_excepts.py).
+
+``except Exception: pass`` in the serving / jobs / agent control
+planes is how zombie states are born: a probe loop that eats its own
+failure keeps a dead replica READY, a teardown that eats its failure
+leaks a billing cluster, and nothing ever surfaces in logs or metrics.
+Narrow catches with a recovery action are fine; catching EVERYTHING
+and doing NOTHING is not. Genuinely-best-effort sites annotate
+``# noqa: stpu-except <reason>``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.core import FileContext, Finding, Rule
+
+TARGET_DIRS = ("skypilot_tpu/serve", "skypilot_tpu/agent",
+               "skypilot_tpu/jobs")
+
+
+def _swallows_everything(handler: ast.ExceptHandler) -> bool:
+    if not (len(handler.body) == 1
+            and isinstance(handler.body[0], ast.Pass)):
+        return False
+    if handler.type is None:
+        return True
+    return (isinstance(handler.type, ast.Name)
+            and handler.type.id in ("Exception", "BaseException"))
+
+
+@core.register
+class ExceptRule(Rule):
+    id = "stpu-except"
+    title = "except [Exception]: pass in the control plane"
+    rationale = ("A handler that catches everything and does nothing "
+                 "turns failures into zombie states (dead-but-READY "
+                 "replicas, leaked clusters) with no log/metric trail.")
+
+    def targets(self, rel: str) -> bool:
+        return any(rel.startswith(d + "/") or rel.startswith(
+            d.split("/", 1)[-1] + "/") for d in TARGET_DIRS)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        for node in ctx.nodes:
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _swallows_everything(node):
+                continue
+            shown = ctx.line(node.lineno).strip() or "except: pass"
+            yield Finding(
+                ctx.rel, node.lineno, self.id,
+                f"swallowed exception `{shown}` — handle it, narrow "
+                "the catch, or annotate '# noqa: stpu-except "
+                "<reason>' if it is genuinely best-effort")

@@ -1,0 +1,295 @@
+"""``stpu-host-sync`` — no implicit device syncs on the decode hot
+path.
+
+Every ``.item()``, ``float(arr)``, ``np.asarray(arr)``, ``print(arr)``
+or ``.block_until_ready()`` on a device array forces a device→host
+round-trip that stalls EVERY slot in the continuous-batching engine,
+not just the request that issued it — the decode loop is one thread
+driving one shared cache, so one stray sync is a whole-replica
+latency cliff. The engine's one sanctioned sync is the explicit
+``jax.device_get`` on the sampled tokens (the tokens must reach the
+host to be emitted); everything else stays on device.
+
+Scope: ``serve/decode_engine.py`` and ``serve/gang_replica.py``.
+
+  * ``.item()`` and ``.block_until_ready()`` are flagged ANYWHERE in
+    those files — they only exist on arrays and are never right on
+    the serving path (benches that want a sync point live elsewhere).
+  * ``float(...)``, ``np.asarray(...)`` / ``np.array(...)``, and
+    ``print(...)`` are flagged inside HOT functions — the transitive
+    same-module callers of the jitted entry points plus the gang
+    mirror loops — and only when the argument is DEVICE-TAINTED: a
+    value (transitively) produced by a jitted entry point or a
+    ``jnp.``/``jax.`` call in the same function. ``jax.device_get``
+    UN-taints (its result is a host array), so post-fetch host math
+    never trips the rule, and neither do host scalars like an HTTP
+    request's ``temperature``.
+
+Annotate a genuinely-required sync with
+``# noqa: stpu-host-sync <reason>``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.core import FileContext, Finding, Rule
+
+TARGET_FILES = ("serve/decode_engine.py", "serve/gang_replica.py")
+
+# Per-token mirror/broadcast loops that never call a jitted name
+# directly (the engine is driven through objects), but sit on the
+# admission path of every gang request.
+EXTRA_HOT_ROOTS = {"follower_serve", "broadcast_generate",
+                   "_serve_member", "_drain_request"}
+
+# Flagged anywhere in the target files.
+_ALWAYS_SYNC_ATTRS = {"item", "block_until_ready"}
+_NP_MODULES = {"np", "numpy", "onp"}
+_NP_FUNCS = {"asarray", "array"}
+_DEVICE_MODULES = ("jnp.", "jax.")
+_UNTAINT_CALLS = {"jax.device_get", "device_get"}
+
+
+def _jitted_names(ctx: FileContext) -> Set[str]:
+    """Module-level names bound to jitted callables."""
+    names: Set[str] = set()
+    for node in ctx.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    dec_name = core.dotted_path(dec.func)
+                    if dec_name in ("functools.partial", "partial") \
+                            and dec.args and core.dotted_path(
+                                dec.args[0]) in ("jax.jit", "jit"):
+                        names.add(node.name)
+                    elif dec_name in ("jax.jit", "jit"):
+                        names.add(node.name)
+                elif core.dotted_path(dec) in ("jax.jit", "jit"):
+                    names.add(node.name)
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call) \
+                and core.dotted_path(node.value.func) in ("jax.jit",
+                                                          "jit"):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _function_index(ctx: FileContext) -> Dict[str, ast.AST]:
+    """name -> def node, for module functions AND methods (methods are
+    keyed by bare name: the call graph treats `self.f()` and `f()`
+    alike, which is exact enough for a two-file rule)."""
+    index: Dict[str, ast.AST] = {}
+    for node in ctx.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            index.setdefault(node.name, node)
+    return index
+
+
+def _callees(fn: ast.AST) -> Set[str]:
+    """Bare names this function calls (f(), self.f(), obj.f())."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = core.call_name(node)
+            if name:
+                out.add(name)
+    return out
+
+
+def _hot_functions(ctx: FileContext) -> Set[str]:
+    """Transitive closure of functions that reach a jitted call, plus
+    the configured mirror-loop roots and everything THEY call."""
+    jitted = _jitted_names(ctx)
+    index = _function_index(ctx)
+    callees = {name: _callees(fn) for name, fn in index.items()}
+
+    # Upward closure: anything that (transitively) calls a jitted name.
+    hot: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, called in callees.items():
+            if name in hot:
+                continue
+            if called & jitted or called & hot:
+                hot.add(name)
+                changed = True
+
+    # Downward closure from the hot set + extra roots: a helper CALLED
+    # from the per-token path stalls it just the same.
+    hot |= EXTRA_HOT_ROOTS & set(index)
+    frontier = list(hot)
+    while frontier:
+        name = frontier.pop()
+        for callee in callees.get(name, ()):
+            if callee in index and callee not in hot:
+                hot.add(callee)
+                frontier.append(callee)
+    return hot
+
+
+def _is_device_producer(call: ast.Call, jitted: Set[str]) -> bool:
+    """Call whose result lives on device: a jitted entry point or a
+    jnp./jax. API call (minus the explicit D2H fetch)."""
+    path = core.dotted_path(call.func)
+    if path is None:
+        return False
+    if path in _UNTAINT_CALLS:
+        return False
+    if path in jitted:
+        return True
+    return path.startswith(_DEVICE_MODULES)
+
+
+def _references_taint(node: ast.AST, taint: Set[str],
+                      jitted: Set[str]) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in taint:
+            return True
+        if isinstance(n, ast.Call) and _is_device_producer(n, jitted):
+            return True
+    return False
+
+
+def _ordered_statements(fn: ast.AST) -> List[ast.stmt]:
+    """All statements under fn in source order (nested defs included —
+    closures run on the same thread)."""
+    out: List[ast.stmt] = []
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                out.append(child)
+            visit(child)
+
+    visit(fn)
+    return out
+
+
+class _FnScan:
+    """One ordered pass over a hot function: track device taint
+    through assignments, collect sync findings."""
+
+    def __init__(self, rule: "HostSyncRule", ctx: FileContext,
+                 fn: ast.AST, jitted: Set[str]):
+        self.rule = rule
+        self.ctx = ctx
+        self.jitted = jitted
+        self.taint: Set[str] = set()
+        self.findings: List[Finding] = []
+        for stmt in _ordered_statements(fn):
+            self._scan_stmt(stmt)
+
+    def _scan_stmt(self, stmt: ast.stmt) -> None:
+        targets: List[ast.AST] = []
+        value: Optional[ast.AST] = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets, value = [stmt.target], stmt.value
+        if value is not None:
+            # device_get at the top of the RHS is the sanctioned
+            # fetch: its result is HOST memory.
+            untaints = (isinstance(value, ast.Call)
+                        and core.dotted_path(value.func)
+                        in _UNTAINT_CALLS)
+            tainted = (not untaints and
+                       _references_taint(value, self.taint,
+                                         self.jitted))
+            for t in targets:
+                stack = [t]
+                while stack:
+                    n = stack.pop()
+                    if isinstance(n, (ast.Tuple, ast.List)):
+                        stack.extend(n.elts)
+                    elif isinstance(n, ast.Name):
+                        if tainted:
+                            self.taint.add(n.id)
+                        else:
+                            self.taint.discard(n.id)
+        # Sync patterns in THIS statement's expressions (nested
+        # statements get their own visit from the ordered walk).
+        stack = [c for c in ast.iter_child_nodes(stmt)
+                 if not isinstance(c, ast.stmt)]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Call):
+                self._scan_call(node)
+            stack.extend(c for c in ast.iter_child_nodes(node)
+                         if not isinstance(c, ast.stmt))
+
+    def _scan_call(self, node: ast.Call) -> None:
+        func_path = core.dotted_path(node.func)
+        hit = None
+        if isinstance(node.func, ast.Name) and node.func.id == "float":
+            hit = ("float(...)", "concretizes its argument (a D2H "
+                   "sync for a device array)")
+        elif func_path is not None and "." in func_path \
+                and func_path.split(".", 1)[0] in _NP_MODULES \
+                and func_path.rsplit(".", 1)[-1] in _NP_FUNCS:
+            hit = (f"{func_path}(...)", "copies device memory to host")
+        elif isinstance(node.func, ast.Name) \
+                and node.func.id == "print":
+            hit = ("print(...)", "blocks on its arguments (a D2H sync "
+                   "for device arrays) and serializes the loop on "
+                   "stdout")
+        if hit is None:
+            return
+        if not any(_references_taint(a, self.taint, self.jitted)
+                   for a in (*node.args,
+                             *(kw.value for kw in node.keywords))):
+            return
+        self.findings.append(Finding(
+            self.ctx.rel, node.lineno, self.rule.id,
+            f"{hit[0]} of a device value on the decode hot path "
+            f"{hit[1]} — every slot on the replica stalls; keep it on "
+            "device or hoist it off the per-token loop (annotate "
+            "'# noqa: stpu-host-sync <reason>' for a sanctioned "
+            "sync)"))
+
+
+@core.register
+class HostSyncRule(Rule):
+    id = "stpu-host-sync"
+    title = "implicit device sync on the decode hot path"
+    rationale = ("One D2H sync in the engine loop stalls every slot "
+                 "on the replica; the decode path's only sanctioned "
+                 "sync is the explicit device_get on sampled tokens.")
+
+    def targets(self, rel: str) -> bool:
+        # '/'-bounded: observe/decode_engine.py is not the engine.
+        return any(rel == t or rel.endswith("/" + t)
+                   for t in TARGET_FILES)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        jitted = _jitted_names(ctx)
+        hot = _hot_functions(ctx)
+        index = _function_index(ctx)
+
+        # .item() / .block_until_ready(): wrong anywhere in these files.
+        for node in ctx.nodes:
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _ALWAYS_SYNC_ATTRS:
+                yield Finding(
+                    ctx.rel, node.lineno, self.id,
+                    f".{node.func.attr}() forces a device sync — on "
+                    "the serving path it stalls every slot; keep the "
+                    "value on device (or '# noqa: stpu-host-sync "
+                    "<reason>' for a sanctioned sync point)")
+
+        # Taint-tracked float/np.asarray/print inside hot functions.
+        seen: Set[int] = set()
+        for name in hot:
+            fn = index.get(name)
+            if fn is None or id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            for finding in _FnScan(self, ctx, fn, jitted).findings:
+                yield finding

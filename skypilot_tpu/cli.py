@@ -488,11 +488,56 @@ def show_tpus(name_filter, region):
 
 
 @cli.command()
-def check():
-    """Probe cloud credentials and record enabled clouds."""
-    from skypilot_tpu import check as check_lib
-    enabled = check_lib.check()
-    click.echo(f"Enabled clouds: {', '.join(enabled) or 'none'}")
+@click.argument("paths", nargs=-1, type=click.Path(exists=True))
+@click.option("--rule", "rules", multiple=True,
+              help="Run only these rule ids (repeatable), e.g. "
+                   "--rule stpu-donation.")
+@click.option("--json", "as_json", is_flag=True,
+              help="Machine-readable findings "
+                   '([{"path","line","rule","message"}]).')
+@click.option("--list-rules", is_flag=True,
+              help="List registered rule ids and exit.")
+@click.option("--env-table", is_flag=True,
+              help="Emit the STPU_* env-knob table (markdown) from "
+                   "utils/env_contract.py and exit.")
+@click.option("--clouds", is_flag=True,
+              help="Probe provider credentials instead (the legacy "
+                   "`stpu check` behavior).")
+def check(paths, rules, as_json, list_rules, env_table, clouds):
+    """Static analysis: run the stpu-* rule suite over skypilot_tpu/
+    (or PATHS). Exit 1 on findings. See docs/static-analysis.md for
+    the rule catalog and the `# noqa: stpu-<rule> <reason>`
+    suppression grammar. `--clouds` keeps the old credential probe."""
+    if clouds:
+        from skypilot_tpu import check as check_lib
+        enabled = check_lib.check()
+        click.echo(f"Enabled clouds: {', '.join(enabled) or 'none'}")
+        return
+    from skypilot_tpu import analysis
+    if env_table:
+        from skypilot_tpu.utils import env_contract
+        click.echo(env_contract.render_markdown_table())
+        return
+    if list_rules:
+        for rule in analysis.all_rules():
+            click.echo(f"{rule.id}: {rule.title}")
+        return
+    try:
+        findings = analysis.run_check(paths=list(paths) or None,
+                                      rules=list(rules) or None)
+    except KeyError as e:
+        raise click.ClickException(str(e.args[0]))
+    if as_json:
+        from skypilot_tpu.analysis import core as analysis_core
+        click.echo(analysis_core.render_json(findings))
+    else:
+        for f in findings:
+            click.echo(f.render())
+        n_rules = len(rules) if rules else len(analysis.all_rules())
+        click.echo(f"{len(findings)} finding(s) from {n_rules} "
+                   "rule(s).")
+    if findings:
+        raise SystemExit(1)
 
 
 @cli.command(name="metrics")

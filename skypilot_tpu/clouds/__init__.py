@@ -1,7 +1,7 @@
 """Cloud registry: name -> capability object.
 
 Reference analog: sky/clouds/cloud_registry.py. The backend, optimizer,
-and `stpu check` resolve providers through here; adding a cloud means
+and `stpu check --clouds` resolve providers through here; adding a cloud
 registering one Cloud subclass (plus its provision module).
 """
 from __future__ import annotations

@@ -81,7 +81,7 @@ class Cloud:
             resources)
 
     def check_credentials(self) -> Tuple[bool, str]:
-        """(usable, reason) — the `stpu check` probe."""
+        """(usable, reason) — the `stpu check --clouds` probe."""
         return True, ""
 
     def __repr__(self) -> str:

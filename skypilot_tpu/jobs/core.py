@@ -208,7 +208,7 @@ def _cancel_local(job_ids: Optional[List[int]],
                 os.kill(pid, signal.SIGTERM)
             except (ProcessLookupError, PermissionError):
                 _finalize_dead_controller(job)
-        elif time.time() - (job.get("submitted_at") or 0) > 60:
+        elif time.time() - (job.get("submitted_at") or 0) > 60:  # noqa: stpu-wallclock submitted_at was persisted by another process
             # No pid a minute after submission: the controller died on
             # startup and will never observe CANCELLING — finalize here.
             _finalize_dead_controller(job)
@@ -270,7 +270,7 @@ def _reconcile_local(detach: bool) -> List[int]:
             # claim whose reconciler died must not wedge the job).
             continue
         if pid is None and (
-                time.time() - (job.get("submitted_at") or 0) < 60):
+                time.time() - (job.get("submitted_at") or 0) < 60):  # noqa: stpu-wallclock submitted_at was persisted by another process
             # Controller may still be starting up (pid not yet
             # recorded); give it the same minute the cancel path does.
             continue

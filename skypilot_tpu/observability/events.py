@@ -99,7 +99,7 @@ def parse_since(value: str) -> float:
     if m:
         mult = {"s": 1, "m": 60, "h": 3600, "d": 86400}[m.group(2)]
         # Threshold compared against persisted wall stamps.
-        return time.time() - float(m.group(1)) * mult  # wallclock: intentional
+        return time.time() - float(m.group(1)) * mult  # noqa: stpu-wallclock threshold against persisted wall stamps
     try:
         return float(value)
     except ValueError:

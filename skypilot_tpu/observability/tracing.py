@@ -26,7 +26,7 @@ span END so every record is complete):
 
 ``ts`` is wall clock for cross-host alignment; ``dur`` (and event
 offsets) come from ``time.perf_counter()`` so an NTP step mid-span
-cannot produce a negative duration (tools/check_clocks.py discipline).
+cannot produce a negative duration (the stpu-wallclock rule of `stpu check`).
 
 Context propagation:
 

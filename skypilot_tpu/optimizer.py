@@ -113,7 +113,7 @@ def launchable_candidates(
         drop_reasons: Optional[List[str]] = None) -> List[Candidate]:
     """Expand a task's resource set into priced, concrete candidates,
     dropping placements whose cloud lacks a required capability or was
-    not enabled by `stpu check` (reference:
+    not enabled by `stpu check --clouds` (reference:
     _fill_in_launchable_resources, sky/optimizer.py:1201).
 
     `drop_reasons`, if given, collects one human-readable line per
@@ -122,7 +122,7 @@ def launchable_candidates(
     from skypilot_tpu import clouds as clouds_lib
     from skypilot_tpu import global_user_state
     blocklist = blocklist or Blocklist()
-    # Empty set = `stpu check` never ran; plan over all registered clouds
+    # Empty set = `stpu check --clouds` never ran; plan over all clouds
     # (hermetic tests and first-run UX).
     enabled = set(global_user_state.get_enabled_clouds())
 
@@ -139,7 +139,7 @@ def launchable_candidates(
             if enabled and concrete.provider_name not in enabled:
                 drop(concrete,
                      f"cloud {concrete.provider_name!r} not enabled "
-                     f"(run `stpu check`)")
+                     f"(run `stpu check --clouds`)")
                 continue
             cloud = clouds_lib.get_cloud(concrete.provider_name)
             unsupported = cloud.unsupported_features_for_resources(

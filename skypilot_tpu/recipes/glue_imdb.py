@@ -104,7 +104,7 @@ def main(argv=None) -> dict:
         "steps": args.steps,
         "final_loss": float(loss),
         "test_accuracy": acc,
-        "wall_seconds": round(time.time() - t0, 2),
+        "wall_seconds": round(time.time() - t0, 2),  # noqa: stpu-wallclock workload wall-time report
     }
     print(json.dumps(metrics), flush=True)
     if args.steps >= 150 and acc < 0.75:

@@ -251,7 +251,7 @@ def run_lora(model_lib, cfg, args, recipe_name: str) -> dict:
     if saver is not None:
         saver.wait()
 
-    wall = time.time() - t0
+    wall = time.time() - t0  # noqa: stpu-wallclock workload wall-time report
     steps_run = max(args.steps - start_step, 0)
     tokens_seen = steps_run * args.batch_size * args.seq_len
     metrics = {

@@ -67,7 +67,7 @@ def main(argv=None) -> dict:
         state, metrics = step(state, {"tokens": jnp.asarray(tokens)})
         losses.append(float(metrics["loss"]))
     jax.block_until_ready(state.params)
-    wall = time.time() - t0
+    wall = time.time() - t0  # noqa: stpu-wallclock workload wall-time report
 
     out = {
         "recipe": "mixtral_ep",

@@ -173,7 +173,7 @@ def main(argv=None) -> dict:
             grads = distributed.kv_allreduce_mean(grads, ctx, tag=str(i))
         params, opt_state = apply_fn(params, opt_state, grads)
         jax.block_until_ready(params)
-        iter_times.append(time.time() - t0)
+        iter_times.append(time.time() - t0)  # noqa: stpu-wallclock workload wall-time report
 
     world_batch = args.batch_size * max(ctx.num_nodes, 1)
     p50 = float(np.median(iter_times[2:] or iter_times))

@@ -474,7 +474,7 @@ class SkyPilotReplicaManager:
         info.consecutive_successes = 0
         # Not answering. Within the initial grace window this is normal.
         if (info.first_ready_at is None and
-                time.time() - info.launched_at <
+                time.time() - info.launched_at <  # noqa: stpu-wallclock launched_at is persisted serve state read across controller restarts
                 spec.initial_delay_seconds):
             return
         info.consecutive_failures += 1

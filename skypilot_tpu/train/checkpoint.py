@@ -116,7 +116,7 @@ def atomic_write_bytes(path: os.PathLike, data: bytes) -> None:
     """THE durable-write primitive: temp + fsync + rename + dir fsync.
 
     Every state write in this module and jobs/state.py goes through
-    here (enforced by tools/check_atomic_writes.py): a crash at any
+    here (enforced by the stpu-atomic rule of `stpu check`): a crash at any
     instant leaves either the old file or the new one, never a torn
     hybrid.
     """
@@ -388,7 +388,7 @@ def gc(ckpt_dir: os.PathLike, keep: int = DEFAULT_KEEP) -> List[int]:
                     # tmp is younger than a minute or owned by us.
                     # (mtime is a wall stamp from a possibly-dead
                     # process, so wall clock is the right comparison.)
-                    if time.time() - tmp.stat().st_mtime > 60:  # wallclock: intentional
+                    if time.time() - tmp.stat().st_mtime > 60:  # noqa: stpu-wallclock mtime is a wall stamp from a possibly-dead process
                         os.unlink(tmp)
                 except OSError:
                     pass

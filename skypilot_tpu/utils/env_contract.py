@@ -1,0 +1,175 @@
+"""The STPU_* environment-variable contract — one registry, one truth.
+
+Every ``STPU_*`` knob the framework reads is declared here with its
+default and a one-line doc. The ``stpu-env`` analyzer
+(``analysis/rules_env.py``) statically cross-checks every
+``os.environ``/``os.getenv`` read in ``skypilot_tpu/`` against this
+table: an unregistered read fails, and a read whose inline default
+literal disagrees with the registered default fails — the config-drift
+failure mode where two layers parse the same knob differently.
+
+``stpu check --env-table`` renders the registry as the markdown knob
+table embedded in docs/static-analysis.md (a tier-1 test keeps the doc
+byte-identical to :func:`render_markdown_table`, so it can never
+drift).
+
+Stdlib-only and import-light: the analyzer and the CLI both import it,
+and neither wants jax.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+PREFIX = "STPU_"
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvKnob:
+    name: str
+    # The default literal as it appears at read sites (``None`` = the
+    # knob is unset-sensitive: code branches on presence, not value).
+    default: Optional[str]
+    doc: str
+
+
+def _k(name: str, default: Optional[str], doc: str) -> EnvKnob:
+    if not name.startswith(PREFIX):
+        raise ValueError(f"env knob {name!r} must start with {PREFIX}")
+    if not doc.strip():
+        raise ValueError(f"env knob {name!r} needs a doc line")
+    return EnvKnob(name, default, doc)
+
+
+_KNOBS = (
+    # ------------------------------------------------ client state
+    _k("STPU_HOME", "~/.stpu",
+       "Client state root (utils/paths.py). Controllers export the "
+       "expanded form $HOME/.stpu — same directory after expanduser."),
+    _k("STPU_SSH_CONFIG", "~/.ssh/config",
+       "SSH config parsed for cluster host aliases."),
+    _k("STPU_BUCKET_ROOT", None,
+       "Global local-bucket namespace root; controllers export "
+       "$STPU_HOME/buckets so head and client resolve one namespace."),
+    _k("STPU_TIMELINE_FILE", None,
+       "Write a Chrome-trace timeline of CLI phases to this path."),
+    # ------------------------------------------------ observability
+    _k("STPU_RUN_ID", None,
+       "Run id correlating lifecycle events CLI -> gang driver -> "
+       "hosts; auto-generated and exported when unset."),
+    _k("STPU_DISABLE_EVENTS", "0",
+       "\"1\" disables the JSONL lifecycle event log."),
+    _k("STPU_TRACE", "0",
+       "\"1\" arms distributed tracing in this process and children."),
+    _k("STPU_TRACE_SAMPLE", "1",
+       "Root-span sampling rate in [0, 1]; children inherit so traces "
+       "are whole-or-absent."),
+    _k("STPU_TRACE_CTX", None,
+       "Serialized parent span context stamped into child envs "
+       "(trace32-span16-flags)."),
+    _k("STPU_DISABLE_USAGE_COLLECTION", "0",
+       "\"1\" disables usage reporting (wins over configured sinks)."),
+    # ------------------------------------------------ chaos
+    _k("STPU_FAULTS", None,
+       "Fault-injection spec (point:mode:p=..;...) armed at import."),
+    _k("STPU_FAULTS_SEED", "0",
+       "Seed for the fault-injection RNG (bit-identical chaos runs)."),
+    # ------------------------------------------------ backends/agent
+    _k("STPU_SKIP_IDENTITY_CHECK", None,
+       "\"1\" skips the cloud-identity ownership check on cluster "
+       "state handover."),
+    _k("STPU_DISABLE_DAEMON", None,
+       "\"1\" skips spawning the head agent daemon (hermetic tests)."),
+    _k("STPU_DAEMON_INTERVAL", None,
+       "Agent daemon poll interval override, seconds."),
+    _k("STPU_AUTOSTOP_GRACE_SECONDS", "10",
+       "Grace window before autostop teardown after the idle trigger."),
+    _k("STPU_TEARDOWN_GRACE_SECONDS", "5",
+       "SIGTERM grace for local jobs to flush a final checkpoint "
+       "before teardown removes host dirs (0 disables)."),
+    _k("STPU_FORCE_PY_AGENT", None,
+       "Any value forces the pure-python gang coordinator over the "
+       "native host agent."),
+    _k("STPU_SKIP_HEALTH_PROBE", None,
+       "\"1\" skips the pre-barrier TPU health probe on gang launch."),
+    _k("STPU_EXEC_TOKEN", None,
+       "Auth token presented to the remote exec agent."),
+    _k("STPU_GANG_COORD_ADDR", None,
+       "host:port of the gang coordinator for host wrappers."),
+    _k("STPU_GANG_COORD_TOKEN", "",
+       "Auth token for the direct-connect gang coordinator; empty "
+       "selects the loopback-only unauthenticated mode."),
+    # ------------------------------------------------ jobs/training
+    _k("STPU_JOBS_POLL_SECONDS", "15",
+       "Managed-jobs controller watch-tick interval, seconds."),
+    _k("STPU_JOB_CKPT_DIR", None,
+       "Per-task checkpoint dir stamped into every (re)launch by the "
+       "jobs controller; recipes default --checkpoint-dir to it."),
+    _k("STPU_PROFILE_DIR", None,
+       "Write an on-device XLA profile of the training loop here."),
+    _k("STPU_BENCHMARK_LOG_DIR", None,
+       "Benchmark-harness summary-log dir (callbacks.init contract)."),
+    # ------------------------------------------------ serve control
+    _k("STPU_SERVE_TICK_SECONDS", "10",
+       "Serve controller reconcile tick, seconds."),
+    _k("STPU_LB_SYNC_SECONDS", "2",
+       "LB <-> controller sync interval, seconds."),
+    _k("STPU_LB_POLICY", None,
+       "Default load-balancing policy when the spec sets none."),
+    _k("STPU_LB_RETRIES", "2",
+       "Extra pre-first-byte attempts per proxied request."),
+    _k("STPU_LB_MAX_BODY_BYTES", "10485760",
+       "Request-body cap (413 above it, checked before buffering)."),
+    _k("STPU_LB_BREAKER_THRESHOLD", "3",
+       "Consecutive connect failures that eject a replica."),
+    _k("STPU_LB_BREAKER_BACKOFF", "2",
+       "Breaker half-open re-probe backoff base, seconds."),
+    _k("STPU_LB_BREAKER_BACKOFF_CAP", "60",
+       "Breaker backoff ceiling, seconds."),
+    # ------------------------------------------------ serve engine
+    _k("STPU_ENGINE_SLOTS", "4",
+       "Decode-engine slot count (continuous-batching concurrency)."),
+    _k("STPU_PREFIX_CACHE_MB", "64",
+       "Shared-prefix KV host-pool budget, MB (0 disables)."),
+    _k("STPU_STREAM_TIMEOUT", "600",
+       "Per-token stream timeout before the engine is declared "
+       "wedged, seconds."),
+    _k("STPU_ENGINE_MAX_RESTARTS", "3",
+       "Consecutive fast engine crashes before permanent-down."),
+    _k("STPU_ENGINE_RESTART_BACKOFF", "1.0",
+       "Engine crash-restart backoff base, seconds."),
+    # ------------------------------------------------ gang replicas
+    _k("STPU_REPLICA_TOPOLOGY", None,
+       "hosts x tp replica topology stamped by replica_managers into "
+       "every gang member's env."),
+    _k("STPU_GANG_SERVE_ADDR", None,
+       "Explicit gang channel address for self-spawned followers "
+       "(dev stacks); gang-launched followers derive it from the env "
+       "contract instead."),
+    _k("STPU_GANG_HB_SECONDS", "0.5",
+       "Gang follower heartbeat interval, seconds."),
+    _k("STPU_GANG_HB_TIMEOUT", "5",
+       "Heartbeat silence that marks a gang member dead, seconds."),
+    _k("STPU_GANG_MAX_RESTARTS", "3",
+       "Consecutive fast whole-gang restarts before permanent-down."),
+)
+
+REGISTRY: Dict[str, EnvKnob] = {k.name: k for k in _KNOBS}
+if len(REGISTRY) != len(_KNOBS):
+    raise RuntimeError("duplicate STPU_* names in env_contract")
+
+
+def get(name: str) -> EnvKnob:
+    return REGISTRY[name]
+
+
+def render_markdown_table() -> str:
+    """The knob table embedded in docs/static-analysis.md (a tier-1
+    test pins the doc to this exact output)."""
+    lines = ["| knob | default | meaning |",
+             "|---|---|---|"]
+    for knob in sorted(REGISTRY.values(), key=lambda k: k.name):
+        default = "(unset)" if knob.default is None else \
+            f"`{knob.default}`" if knob.default else "`\"\"`"
+        lines.append(f"| `{knob.name}` | {default} | {knob.doc} |")
+    return "\n".join(lines)

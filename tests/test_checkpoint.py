@@ -288,55 +288,6 @@ def test_sigterm_grace_saves_and_exits_143(tmp_path):
     assert ck.restore_latest(tmp_path) is not None
 
 
-# ------------------------------------------------------- atomic-writes lint
-def test_atomic_writes_lint_clean():
-    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
-    try:
-        import check_atomic_writes
-        assert check_atomic_writes.check() == []
-    finally:
-        sys.path.pop(0)
-
-
-def test_atomic_writes_lint_flags_bare_writes(tmp_path):
-    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
-    try:
-        import check_atomic_writes
-        bad = tmp_path / "bad.py"
-        bad.write_text(textwrap.dedent("""
-            import os, pathlib
-            def write_state(p, q):
-                with open(p, "w") as f:          # violation
-                    f.write("x")
-                pathlib.Path(q).write_text("y")  # violation
-                fd = os.open(p, os.O_WRONLY)     # violation
-                open(p).read()                   # read: fine
-                with open(p, "rb") as f:         # read: fine
-                    f.read()
-        """))
-        violations = check_atomic_writes.check([bad])
-        assert len(violations) == 3, violations
-        # noqa WITHOUT a reason still flags; with a reason passes.
-        noqa = tmp_path / "noqa.py"
-        noqa.write_text(
-            'f = open("x", "w")  # noqa: stpu-atomic\n'
-            'g = open("y", "w")  # noqa: stpu-atomic scratch file, '
-            'rebuilt on every boot\n')
-        violations = check_atomic_writes.check([noqa])
-        assert len(violations) == 1 and "reason" in violations[0]
-        # The atomic helper itself is exempt by name.
-        helper = tmp_path / "helper.py"
-        helper.write_text(textwrap.dedent("""
-            import os
-            def atomic_write_bytes(path, data):
-                fd = os.open(path, os.O_WRONLY | os.O_CREAT)
-                os.write(fd, data)
-        """))
-        assert check_atomic_writes.check([helper]) == []
-    finally:
-        sys.path.pop(0)
-
-
 # ------------------------------------------------------ observability
 def test_ckpt_metrics_families_exposed(tmp_path):
     """The ckpt metric families ride the shared registry exposition

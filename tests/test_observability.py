@@ -5,7 +5,7 @@ Covers the PR-1 acceptance bar: registry concurrency, Prometheus
 exposition golden text, LB /metrics histogram counts matching proxied
 request counts (with the controller's autoscaler/replica metrics riding
 the /sync snapshot), the autoscaler decision history, the timeline
-NTP-step fix, and the check_clocks tier-1 lint.
+NTP-step fix (the clock lint now lives in tests/test_static_analysis.py).
 """
 import json
 import threading
@@ -376,83 +376,6 @@ def test_timeline_duration_survives_clock_step(tmp_path, monkeypatch):
         event = next(e for e in timeline._events
                      if e["name"] == "stepped")
     assert event["dur"] >= 0
-
-
-# ------------------------------------------------------------ clock lint
-def test_clock_lint_clean():
-    """Tier-1 enforcement: no unannotated time.time() duration
-    arithmetic inside skypilot_tpu/."""
-    import importlib.util
-    import pathlib
-    spec = importlib.util.spec_from_file_location(
-        "check_clocks",
-        pathlib.Path(__file__).parent.parent / "tools" /
-        "check_clocks.py")
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    assert mod.check() == []
-    # And the lint actually catches the pattern.
-    bad = pathlib.Path(str(mod.TARGET_DIR))  # scan a synthetic tree
-    tmp = pathlib.Path(__file__).parent / "_clock_lint_probe"
-    tmp.mkdir(exist_ok=True)
-    try:
-        probe = tmp / "probe.py"
-        probe.write_text("import time\nd = time.time() - t0\n"
-                         "ok = time.time() - t1  "
-                         "# wallclock: intentional\n")
-        violations = mod.check(tmp)
-        assert len(violations) == 1 and "probe.py:2" in violations[0]
-    finally:
-        for p in tmp.iterdir():
-            p.unlink()
-        tmp.rmdir()
-    del bad
-
-
-def test_span_leak_lint(tmp_path):
-    """Tier-1 enforcement: every tracing.start_span() is either a
-    `with` context or assigned and .end()ed in the same function — an
-    un-ended span never writes its record, silently dropping the hop
-    from the trace."""
-    import importlib.util
-    import pathlib
-    spec = importlib.util.spec_from_file_location(
-        "check_clocks",
-        pathlib.Path(__file__).parent.parent / "tools" /
-        "check_clocks.py")
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    # The repo itself is clean (includes the new tracing call sites in
-    # serve/, jobs/, agent/, recipes/).
-    assert mod.check_spans() == []
-    # And the lint catches the leak patterns.
-    probe = tmp_path / "probe.py"
-    probe.write_text(
-        "from skypilot_tpu.observability import tracing\n"
-        "def good_with():\n"
-        "    with tracing.start_span('a') as s:\n"
-        "        s.event('e')\n"
-        "def good_assign():\n"
-        "    span = tracing.start_span('b')\n"
-        "    try:\n"
-        "        pass\n"
-        "    finally:\n"
-        "        span.end()\n"
-        "def good_nested_closer():\n"
-        "    span = tracing.start_span('c')\n"
-        "    def finish():\n"
-        "        span.end(status='ok')\n"
-        "    finish()\n"
-        "def bad_returned():\n"
-        "    return tracing.start_span('d')\n"
-        "def bad_dropped():\n"
-        "    tracing.start_span('e')\n"
-        "def bad_never_ended():\n"
-        "    leak = tracing.start_span('f')\n"
-        "    leak.event('x')\n")
-    violations = mod.check_spans(tmp_path)
-    lines = sorted(int(v.split(":")[1]) for v in violations)
-    assert lines == [17, 19, 21], violations
 
 
 # ------------------------------------------------------------------ CLI

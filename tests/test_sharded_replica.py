@@ -18,7 +18,7 @@ The contract under test (ISSUE 8 acceptance), strongest first:
     manager gang-launching all hosts as ONE replica (num_nodes + env),
     the stpu_replica_topology_info gauge, and loadgen report
     attribution;
-  * the serve/ collectives lint (check_clocks.py family).
+  * (the serve/ collectives lint now lives in tests/test_static_analysis.py).
 """
 import dataclasses
 import importlib.util
@@ -500,49 +500,3 @@ def test_loadgen_report_carries_replica_topology(tmp_path):
         assert sets == [{"hosts": "2", "tp": "2"}]
     finally:
         server.shutdown()
-
-
-# ==================================================== collectives lint
-def _load_check_collectives():
-    path = REPO / "tools" / "check_collectives.py"
-    spec = importlib.util.spec_from_file_location("check_collectives",
-                                                 path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
-def test_collectives_lint_repo_clean():
-    mod = _load_check_collectives()
-    assert mod.check() == []
-
-
-def test_collectives_lint_catches_and_allows(tmp_path):
-    mod = _load_check_collectives()
-    pkg = tmp_path / "skypilot_tpu" / "serve"
-    pkg.mkdir(parents=True)
-    (pkg / "bad.py").write_text(
-        "import jax\n"
-        "def f(x):\n"
-        "    return jax.lax.psum(x, 'tp')\n"
-        "def g(x):\n"
-        "    return jax.lax.all_gather(x, 'tp')\n")
-    (pkg / "ok.py").write_text(
-        "import jax\n"
-        "def f(x):\n"
-        "    return jax.lax.psum(x, 'tp')  "
-        "# noqa: stpu-collective — exercising the lint's allow path\n"
-        "def local(x):\n"
-        "    psum = 3  # a local name, not an imported collective\n"
-        "    return psum\n")
-    (pkg / "lazy.py").write_text(
-        "from jax.lax import psum\n"
-        "def f(x):\n"
-        "    return psum(x, 'tp')  # noqa: stpu-collective\n")
-    violations = mod.check(root=tmp_path)
-    files = sorted({v.split(":")[0] for v in violations})
-    # bad.py: both collectives flagged; ok.py: annotated + local name
-    # pass; lazy.py: marker without a reason is still a violation.
-    assert files == ["skypilot_tpu/serve/bad.py",
-                     "skypilot_tpu/serve/lazy.py"]
-    assert sum(1 for v in violations if "bad.py" in v) == 2

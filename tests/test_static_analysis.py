@@ -1,0 +1,563 @@
+"""The unified static-analysis framework (`stpu check`).
+
+One tier-1 test replaces the four scattered lint tests
+(test_observability / test_fault_tolerance / test_sharded_replica /
+test_checkpoint): the whole rule suite runs over ``skypilot_tpu/`` in
+one AST walk per file and must be clean. Every rule also gets a
+good/bad/noqa'd fixture corpus, the ``--json`` schema is pinned, and
+the env-knob table embedded in docs/static-analysis.md is asserted
+byte-identical to ``env_contract.render_markdown_table()`` so the doc
+can never drift from the registry.
+"""
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu import analysis
+from skypilot_tpu.utils import env_contract
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _write(tmp_path: pathlib.Path, rel: str, body: str) -> pathlib.Path:
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(body))
+    return path
+
+
+def _run(tmp_path, rule):
+    """Run ONE rule over the fixture tree; findings keyed by rel:line."""
+    findings = analysis.run_check(paths=[tmp_path], rules=[rule])
+    return findings
+
+
+def _lines(findings, rel):
+    return sorted(f.line for f in findings if f.path == rel)
+
+
+# ================================================= tier-1: repo clean
+def test_repo_clean_all_rules():
+    """`stpu check` over skypilot_tpu/ is clean across ALL rules —
+    including the three TPU-correctness analyzers (donation,
+    host-sync, env contract). This is THE lint gate; a finding here is
+    a real bug or a site that needs an explained noqa."""
+    findings = analysis.run_check()
+    assert findings == [], "\n".join(f.render() for f in findings)
+    # All seven+ advertised rules actually ran (registry intact).
+    ids = {r.id for r in analysis.all_rules()}
+    assert {"stpu-wallclock", "stpu-span-leak", "stpu-except",
+            "stpu-atomic", "stpu-collective", "stpu-donation",
+            "stpu-host-sync", "stpu-env"} <= ids
+
+
+# ================================================= suppression grammar
+def test_noqa_reason_mandatory(tmp_path):
+    """The unified grammar: `# noqa: stpu-<rule> <reason>` suppresses;
+    a marker with no (or a too-short) reason does NOT."""
+    _write(tmp_path, "probe.py", """\
+        import time
+        a = time.time() - t0
+        b = time.time() - t1  # noqa: stpu-wallclock
+        c = time.time() - t2  # noqa: stpu-wallclock persisted stamp from another boot
+        """)
+    findings = _run(tmp_path, "stpu-wallclock")
+    assert _lines(findings, "probe.py") == [2, 3]
+    missing = [f for f in findings if f.line == 3]
+    assert "reason is missing" in missing[0].message
+
+
+def test_noqa_multi_rule(tmp_path):
+    """One line can suppress several rules: `# noqa: stpu-a, stpu-b
+    <reason>` — and a rule NOT named on the line still fires."""
+    _write(tmp_path, "serve/probe.py", """\
+        import time
+        from jax import lax
+        x = lax.psum(time.time() - t0, 'tp')  # noqa: stpu-collective, stpu-wallclock both exercised by this fixture
+        y = lax.psum(1, 'tp')  # noqa: stpu-wallclock wrong rule named
+        """)
+    col = _run(tmp_path, "stpu-collective")
+    assert _lines(col, "serve/probe.py") == [4]
+    assert _run(tmp_path, "stpu-wallclock") == []
+
+
+# ================================================= ported rules corpus
+def test_wallclock_rule(tmp_path):
+    _write(tmp_path, "good.py", """\
+        import time
+        t0 = time.perf_counter()
+        dur = time.perf_counter() - t0
+        stamp = time.time()
+        """)
+    _write(tmp_path, "bad.py", """\
+        import time
+        dur = time.time() - t0
+        """)
+    findings = _run(tmp_path, "stpu-wallclock")
+    assert _lines(findings, "bad.py") == [2]
+    assert _lines(findings, "good.py") == []
+
+
+def test_span_leak_rule(tmp_path):
+    _write(tmp_path, "spans.py", """\
+        from skypilot_tpu.observability import tracing
+        def good_with():
+            with tracing.start_span('a') as s:
+                s.event('e')
+        def good_assign():
+            span = tracing.start_span('b')
+            try:
+                pass
+            finally:
+                span.end()
+        def good_nested_closer():
+            span = tracing.start_span('c')
+            def finish():
+                span.end(status='ok')
+            finish()
+        def bad_returned():
+            return tracing.start_span('d')
+        def bad_dropped():
+            tracing.start_span('e')
+        def bad_never_ended():
+            leak = tracing.start_span('f')
+            leak.event('x')
+        def noqad():
+            return tracing.start_span('g')  # noqa: stpu-span-leak caller owns the end()
+        """)
+    findings = _run(tmp_path, "stpu-span-leak")
+    assert _lines(findings, "spans.py") == [17, 19, 21]
+
+
+def test_except_rule(tmp_path):
+    _write(tmp_path, "serve/bad.py", """\
+        try:
+            x = 1
+        except Exception:
+            pass
+        try:
+            y = 1
+        except:
+            pass
+        try:
+            z = 1
+        except ValueError:
+            pass
+        """)
+    _write(tmp_path, "serve/ok.py", """\
+        try:
+            x = 1
+        except Exception:  # noqa: stpu-except best-effort probe, failure means no data
+            pass
+        """)
+    _write(tmp_path, "elsewhere/bad.py",
+           "try:\n    x = 1\nexcept Exception:\n    pass\n")
+    findings = _run(tmp_path, "stpu-except")
+    assert _lines(findings, "serve/bad.py") == [3, 7]
+    assert _lines(findings, "serve/ok.py") == []
+    # Only the control-plane dirs are in scope.
+    assert _lines(findings, "elsewhere/bad.py") == []
+
+
+def test_atomic_rule(tmp_path):
+    _write(tmp_path, "train/checkpoint.py", """\
+        import os, pathlib
+        def write_state(p, q):
+            with open(p, "w") as f:
+                f.write("x")
+            pathlib.Path(q).write_text("y")
+            fd = os.open(p, os.O_WRONLY)
+            open(p).read()
+            with open(p, "rb") as f:
+                f.read()
+        def atomic_write_bytes(path, data):
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT)
+            os.write(fd, data)
+        def scratch(p):
+            open(p, "w").write("tmp")  # noqa: stpu-atomic scratch file, rebuilt on every boot
+        """)
+    findings = _run(tmp_path, "stpu-atomic")
+    assert _lines(findings, "train/checkpoint.py") == [3, 5, 6]
+
+
+def test_collective_rule(tmp_path):
+    _write(tmp_path, "serve/bad.py", """\
+        import jax
+        def f(x):
+            return jax.lax.psum(x, 'tp')
+        """)
+    _write(tmp_path, "serve/ok.py", """\
+        def local(x):
+            psum = 3
+            return psum
+        """)
+    _write(tmp_path, "serve/lazy.py", """\
+        from jax.lax import psum
+        def f(x):
+            return psum(x, 'tp')  # noqa: stpu-collective
+        """)
+    findings = _run(tmp_path, "stpu-collective")
+    assert _lines(findings, "serve/bad.py") == [3]
+    assert _lines(findings, "serve/ok.py") == []
+    lazy = [f for f in findings if f.path == "serve/lazy.py"]
+    assert len(lazy) == 1 and "reason is missing" in lazy[0].message
+
+
+# ================================================= new TPU analyzers
+DONATION_FIXTURE = """\
+    import functools
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def step(tokens, cache):
+        cache = cache.at[0].set(tokens)
+        return tokens + 1, cache
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def dead_end(cache):
+        return jnp.zeros(3)
+
+    def bad_use_after_donate(tokens, cache):
+        logits, _ = step(tokens, cache)
+        return cache[0]
+
+    def bad_loop_no_rebind(tokens, cache):
+        for _ in range(4):
+            logits, _ = step(tokens, cache)
+        return logits
+
+    def good_rebinds(tokens, cache):
+        logits, cache = step(tokens, cache)
+        logits, cache = step(logits, cache)
+        return logits, cache
+
+    def good_goes_dead(tokens, cache):
+        logits, _ = step(tokens, cache)
+        return logits
+
+    def noqad(tokens, cache):
+        logits, _ = step(tokens, cache)
+        return cache[0]  # noqa: stpu-donation CPU-only diagnostic path, never runs on TPU
+    """
+
+
+def test_donation_rule_seeded_fixture(tmp_path):
+    """Acceptance: the donation analyzer catches a seeded
+    use-after-donate (and the no-output-alias callee trap), while the
+    engine's rebind convention passes."""
+    _write(tmp_path, "donation.py", DONATION_FIXTURE)
+    findings = _run(tmp_path, "stpu-donation")
+    lines = _lines(findings, "donation.py")
+    # 11: dead_end's donated param aliases no output;
+    # 16: read-after-donate; 20: donating call in a loop, no rebind.
+    assert lines == [11, 16, 20], [f.render() for f in findings]
+    by_line = {f.line: f.message for f in findings}
+    assert "aliases no output" in by_line[11]
+    assert "read after being donated" in by_line[16]
+    assert "inside a loop" in by_line[20]
+
+
+def test_donation_rule_fresh_buffer_per_iteration(tmp_path):
+    """A loop that stores a FRESH buffer before each donating call is
+    clean — the back-edge read sees the new buffer, not the donated
+    one."""
+    _write(tmp_path, "fresh.py", """\
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def step(b, cache):
+            return b, cache
+
+        def per_batch(batches, init_cache):
+            for b in batches:
+                cache = init_cache(b)
+                out, _ = step(b, cache)
+            return out
+        """)
+    assert _run(tmp_path, "stpu-donation") == []
+
+
+def test_donation_rule_self_attribute_paths(tmp_path):
+    """Dotted donation targets (`self._cache`) are tracked: rebinding
+    from the return is clean, a later read is use-after-donate —
+    exactly the decode-engine convention."""
+    _write(tmp_path, "engine.py", """\
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _engine_step(toks, cache):
+            return toks, cache
+
+        class Engine:
+            def good(self, toks):
+                toks, self._cache = _engine_step(toks, self._cache)
+                return toks
+            def bad(self, toks):
+                toks2, _ = _engine_step(toks, self._cache)
+                return self._cache
+        """)
+    findings = _run(tmp_path, "stpu-donation")
+    assert _lines(findings, "engine.py") == [14]
+
+
+def test_host_sync_rule(tmp_path):
+    _write(tmp_path, "serve/decode_engine.py", """\
+        import functools
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _decode_step(tokens, cache):
+            return tokens + 1, cache
+
+        def engine_loop(tokens, cache):
+            while True:
+                tokens, cache = _decode_step(tokens, cache)
+                t = tokens.item()
+                host = np.asarray(tokens)
+                print(tokens)
+                fetched = jax.device_get(tokens)
+                ok = float(fetched[0])
+                temp = float("0.7")
+
+        def hot_helper(tokens):
+            val = jnp.sum(tokens)
+            return float(val)
+
+        def cold_helper(request):
+            return float(request["temperature"])
+        """)
+    findings = _run(tmp_path, "stpu-host-sync")
+    lines = _lines(findings, "serve/decode_engine.py")
+    # .item(), np.asarray(device), print(device) flagged; the
+    # device_get fetch un-taints, so the post-fetch float() and host
+    # scalars (cold_helper's temperature) never trip the rule.
+    assert 13 in lines and 14 in lines and 15 in lines
+    assert 17 not in lines and 18 not in lines and 25 not in lines
+    # Reachability scope: hot_helper is never called from the per-token
+    # path, so its float(jnp.sum(...)) is out of scope by design.
+    assert 22 not in lines
+    # The rule only targets the two engine files: same sync pattern in
+    # another serve/ module is out of scope.
+    _write(tmp_path, "serve/other.py", "def f(a):\n    return a.item()\n")
+    findings = _run(tmp_path, "stpu-host-sync")
+    assert _lines(findings, "serve/other.py") == []
+
+
+def test_host_sync_noqa(tmp_path):
+    _write(tmp_path, "serve/gang_replica.py", """\
+        def broadcast_generate(arr):
+            arr.block_until_ready()  # noqa: stpu-host-sync gang barrier needs a hard sync point
+            return arr.item()
+        """)
+    findings = _run(tmp_path, "stpu-host-sync")
+    assert _lines(findings, "serve/gang_replica.py") == [3]
+
+
+def test_env_rule_seeded_fixture(tmp_path):
+    """Acceptance: an unregistered STPU_* read fails; a default
+    literal that disagrees with env_contract.py fails; registered
+    reads with the registered default pass."""
+    _write(tmp_path, "env_probe.py", """\
+        import os
+        A = os.environ.get("STPU_NOT_A_REAL_KNOB", "1")
+        B = os.environ.get("STPU_ENGINE_SLOTS", "8")
+        C = os.environ["STPU_ALSO_NOT_REAL"]
+        D = os.environ.get("STPU_ENGINE_SLOTS", "4")
+        E = os.environ.get("STPU_LB_POLICY")
+        F = os.getenv("STPU_THIRD_FAKE")
+        G = os.environ.get("HOME", "/root")
+        H = os.environ.get("STPU_GRANDFATHERED", "x")  # noqa: stpu-env migration shim removed next release
+        I = os.environ.get("STPU_DISABLE_EVENTS")
+        """)
+    findings = _run(tmp_path, "stpu-env")
+    lines = _lines(findings, "env_probe.py")
+    # Line 10: a presence-style read (no inline default) of a
+    # defaulted knob is NOT a disagreement — only inline literals are.
+    assert lines == [2, 3, 4, 7]
+    by_line = {f.line: f.message for f in findings}
+    assert "not registered" in by_line[2]
+    assert "registers '4'" in by_line[3]
+
+
+def test_env_rule_resolves_constants(tmp_path):
+    """Reads through module constants resolve: locally
+    (`ENABLE_ENV = "STPU_TRACE"`), and cross-file for dotted reads
+    (`tracing.ENV_CTX`). Ambiguous bare names never resolve."""
+    _write(tmp_path, "tracing.py", """\
+        import os
+        ENABLE_ENV = "STPU_TRACE"
+        FAKE_ENV = "STPU_CONSTANT_FAKE"
+        armed = os.environ.get(ENABLE_ENV, "0") == "1"
+        bad = os.environ.get(FAKE_ENV)
+        """)
+    _write(tmp_path, "consumer.py", """\
+        import os
+        from . import tracing
+        ctx = os.environ.get(tracing.FAKE_ENV)
+        """)
+    findings = _run(tmp_path, "stpu-env")
+    assert _lines(findings, "tracing.py") == [5]
+    assert _lines(findings, "consumer.py") == [3]
+
+
+def test_env_registry_covers_repo_reads():
+    """Every STPU_* env read in skypilot_tpu/ resolves through
+    env_contract.py (the repo-wide clean run enforces it; this pins
+    the rule actually VISITED the tree by checking a known knob)."""
+    findings = analysis.run_check(rules=["stpu-env"])
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert "STPU_ENGINE_SLOTS" in env_contract.REGISTRY
+    assert env_contract.REGISTRY["STPU_HOME"].default == "~/.stpu"
+
+
+def test_unparsable_file_is_a_finding(tmp_path):
+    """A file that fails ast.parse must FAIL the gate (stpu-parse), not
+    silently pass every AST rule."""
+    _write(tmp_path, "train/checkpoint.py", """\
+        def write_state(p):
+        <<<<<<< merge conflict
+            open(p, "w").write("x")
+        """)
+    findings = analysis.run_check(paths=[tmp_path])
+    parse = [f for f in findings if f.rule == "stpu-parse"]
+    assert len(parse) == 1 and parse[0].path == "train/checkpoint.py"
+    assert "syntax error" in parse[0].message
+
+
+def test_targets_are_path_bounded(tmp_path):
+    """Suffix matching is '/'-bounded: restrain/checkpoint.py is not
+    train/checkpoint.py, observe/decode_engine.py is not the engine."""
+    body = 'f = open("x", "w")\n'
+    _write(tmp_path, "restrain/checkpoint.py", body)
+    _write(tmp_path, "train/checkpoint.py", body)
+    findings = _run(tmp_path, "stpu-atomic")
+    assert _lines(findings, "train/checkpoint.py") == [1]
+    assert _lines(findings, "restrain/checkpoint.py") == []
+    _write(tmp_path, "observe/decode_engine.py",
+           "def f(a):\n    return a.item()\n")
+    findings = _run(tmp_path, "stpu-host-sync")
+    assert _lines(findings, "observe/decode_engine.py") == []
+
+
+def test_atomic_shim_lints_explicit_paths(tmp_path):
+    """Historical API: tools/check_atomic_writes.check([paths]) lints
+    exactly the files it is given, whatever they are named."""
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import check_atomic_writes
+        bad = _write(tmp_path, "some_state_writer.py",
+                     'f = open("x", "w")\n')
+        violations = check_atomic_writes.check([bad])
+        assert len(violations) == 1 and "stpu-atomic" in violations[0]
+    finally:
+        sys.path.pop(0)
+
+
+# ================================================= CLI + json schema
+def test_cli_check_clean_and_json(tmp_path):
+    from skypilot_tpu import cli
+    runner = CliRunner()
+    bad = _write(tmp_path, "bad.py",
+                 "import time\nd = time.time() - t0\n")
+    result = runner.invoke(cli.cli, ["check", str(bad)])
+    assert result.exit_code == 1
+    assert "bad.py:2:stpu-wallclock:" in result.output
+
+    result = runner.invoke(cli.cli, ["check", "--json", str(bad)])
+    assert result.exit_code == 1
+    payload = json.loads(result.output)
+    assert isinstance(payload, list) and payload
+    # Pinned schema: exactly these keys.
+    assert set(payload[0]) == {"path", "line", "rule", "message"}
+    assert payload[0]["rule"] == "stpu-wallclock"
+    assert payload[0]["line"] == 2
+
+    good = _write(tmp_path, "good.py", "x = 1\n")
+    result = runner.invoke(cli.cli, ["check", str(good)])
+    assert result.exit_code == 0, result.output
+    result = runner.invoke(cli.cli, ["check", "--json", str(good)])
+    assert json.loads(result.output) == []
+
+
+def test_cli_check_rule_selection(tmp_path):
+    from skypilot_tpu import cli
+    runner = CliRunner()
+    bad = _write(tmp_path, "bad.py",
+                 "import time\nd = time.time() - t0\n")
+    result = runner.invoke(
+        cli.cli, ["check", "--rule", "stpu-donation", str(bad)])
+    assert result.exit_code == 0, result.output
+    result = runner.invoke(
+        cli.cli, ["check", "--rule", "stpu-nonsense", str(bad)])
+    assert result.exit_code != 0
+    assert "unknown rule" in result.output
+
+    result = runner.invoke(cli.cli, ["check", "--list-rules"])
+    assert result.exit_code == 0
+    assert "stpu-donation" in result.output
+    assert "stpu-env" in result.output
+
+
+def test_cli_check_repo_default_clean():
+    """`stpu check` with no PATHS scans skypilot_tpu/ and exits 0."""
+    from skypilot_tpu import cli
+    runner = CliRunner()
+    result = runner.invoke(cli.cli, ["check"])
+    assert result.exit_code == 0, result.output
+    assert "0 finding(s)" in result.output
+
+
+# ================================================= tools/ shims
+def test_tools_shims_still_work():
+    """`python tools/check_*.py` invocations keep working (exit 0 on
+    the clean repo, framework-rendered output)."""
+    for script in ("check_clocks.py", "check_excepts.py",
+                   "check_collectives.py", "check_atomic_writes.py"):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / script)],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, (script, proc.stdout, proc.stderr)
+        assert "OK" in proc.stdout
+
+
+# ================================================= env-table doc sync
+def test_env_table_doc_in_sync():
+    """docs/static-analysis.md embeds `stpu check --env-table` output
+    between markers; it must be byte-identical to the registry render
+    so the doc can never drift from code."""
+    doc = (REPO / "docs" / "static-analysis.md").read_text()
+    begin = "<!-- env-table:begin (stpu check --env-table) -->"
+    end = "<!-- env-table:end -->"
+    assert begin in doc and end in doc
+    embedded = doc.split(begin, 1)[1].split(end, 1)[0].strip()
+    assert embedded == env_contract.render_markdown_table(), (
+        "docs/static-analysis.md env table is stale — regenerate with "
+        "`stpu check --env-table`")
+
+
+def test_cli_env_table_matches_registry():
+    from skypilot_tpu import cli
+    runner = CliRunner()
+    result = runner.invoke(cli.cli, ["check", "--env-table"])
+    assert result.exit_code == 0
+    assert result.output.strip() == env_contract.render_markdown_table()
+    # Every registered knob appears exactly once.
+    for name in env_contract.REGISTRY:
+        assert f"`{name}`" in result.output
+
+
+def test_registry_rejects_bad_knobs():
+    with pytest.raises(ValueError):
+        env_contract._k("NOT_STPU", None, "doc")
+    with pytest.raises(ValueError):
+        env_contract._k("STPU_X", None, "   ")

@@ -1,163 +1,37 @@
 #!/usr/bin/env python3
-"""Atomic-write discipline lint (tier-1).
+"""Thin shim over the unified static-analysis framework.
 
-The checkpoint/restore contract (train/checkpoint.py) and the managed-
-jobs state layer (jobs/state.py) are exactly the files whose writes a
-SIGKILL must never tear: a half-written checkpoint manifest or state
-file silently poisons the resume path the whole preemption story rests
-on. Every durable write in those files must go through the atomic
-temp + fsync + rename helper (``checkpoint.atomic_write_bytes``), never
-a bare ``open(path, "w")`` / ``Path.write_text`` that can be cut mid-
-buffer.
-
-Flagged patterns (AST, inside the target files only):
-
-  * builtin ``open(..., mode)`` where mode contains ``w``/``a``/``x``
-    (positional or ``mode=`` keyword);
-  * ``os.open(...)`` with ``O_WRONLY`` / ``O_RDWR`` / ``O_CREAT`` /
-    ``O_APPEND`` flags;
-  * ``<x>.write_text(...)`` / ``<x>.write_bytes(...)`` attribute calls
-    (the pathlib durable-write shortcuts).
-
-Exemptions:
-
-  * code inside the helper itself (functions named
-    ``atomic_write_bytes``) — someone has to own the raw fd;
-  * a line annotated ``# noqa: stpu-atomic <reason>`` — the reason is
-    MANDATORY (an unexplained exemption is how discipline rots).
-
-Runs as a tier-1 test (tests/test_checkpoint.py) and standalone:
+The atomic-write lint lives in
+``skypilot_tpu/analysis/rules_atomic.py`` (rule ``stpu-atomic``).
+This script keeps the historical invocation working:
 
     python tools/check_atomic_writes.py        # exit 1 on violations
+
+Prefer ``stpu check --rule stpu-atomic`` (or plain ``stpu check``).
 """
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
-REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
-
-# Durable-state modules under the crash-consistency contract.
-TARGETS: Sequence[pathlib.Path] = (
-    REPO_ROOT / "skypilot_tpu" / "train" / "checkpoint.py",
-    REPO_ROOT / "skypilot_tpu" / "jobs" / "state.py",
-)
-
-# Functions that ARE the atomic protocol (own the raw fd + fsync +
-# rename); their internals are the one sanctioned raw-write site.
-HELPER_FUNCTIONS = {"atomic_write_bytes"}
-
-NOQA_RE = re.compile(r"#\s*noqa:\s*stpu-atomic\b[ \t]*(?P<reason>.*)")
-
-_WRITE_OS_FLAGS = {"O_WRONLY", "O_RDWR", "O_CREAT", "O_APPEND",
-                   "O_TRUNC"}
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
-def _mode_of_open(call: ast.Call) -> str:
-    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
-            and isinstance(call.args[1].value, str):
-        return call.args[1].value
-    for kw in call.keywords:
-        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
-                and isinstance(kw.value.value, str):
-            return kw.value.value
-    return "r"
-
-
-def _os_flags(call: ast.Call) -> set:
-    names = set()
-    for node in ast.walk(call):
-        if isinstance(node, ast.Attribute) and node.attr.startswith("O_"):
-            names.add(node.attr)
-        elif isinstance(node, ast.Name) and node.id.startswith("O_"):
-            names.add(node.id)
-    return names
-
-
-def _violation_kind(node: ast.Call) -> str:
-    """'' when fine, else a short description of the raw write."""
-    func = node.func
-    if isinstance(func, ast.Name) and func.id == "open":
-        mode = _mode_of_open(node)
-        if any(c in mode for c in "wax+"):
-            return f"bare open(..., {mode!r})"
-    elif isinstance(func, ast.Attribute):
-        if func.attr == "open" and isinstance(func.value, ast.Name) \
-                and func.value.id == "os":
-            if _os_flags(node) & _WRITE_OS_FLAGS:
-                return "raw os.open() with write flags"
-        elif func.attr in ("write_text", "write_bytes"):
-            return f".{func.attr}() durable write"
-    return ""
-
-
-def _noqa_ok(line: str) -> bool:
-    """True iff the line carries a stpu-atomic noqa WITH a reason."""
-    m = NOQA_RE.search(line)
-    return bool(m and m.group("reason").strip())
-
-
-def _enclosing_helper(node: ast.AST, parents: dict) -> bool:
-    cur = node
-    while cur in parents:
-        cur = parents[cur]
-        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                and cur.name in HELPER_FUNCTIONS:
-            return True
-    return False
-
-
-def check(paths: Sequence[pathlib.Path] = TARGETS) -> List[str]:
-    """Return violation strings ('path:lineno: message')."""
-    violations: List[str] = []
-    for path in paths:
-        try:
-            text = pathlib.Path(path).read_text(errors="replace")
-            tree = ast.parse(text)
-        except (OSError, SyntaxError) as e:
-            violations.append(f"{path}: unreadable/unparsable: {e}")
-            continue
-        lines = text.splitlines()
-        rel = str(pathlib.Path(path))
-        if REPO_ROOT in pathlib.Path(path).parents:
-            rel = str(pathlib.Path(path).relative_to(REPO_ROOT))
-        parents: dict = {}
-        for node in ast.walk(tree):
-            for child in ast.iter_child_nodes(node):
-                parents[child] = node
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            kind = _violation_kind(node)
-            if not kind:
-                continue
-            if _enclosing_helper(node, parents):
-                continue
-            line = lines[node.lineno - 1] if \
-                node.lineno <= len(lines) else ""
-            if _noqa_ok(line):
-                continue
-            if NOQA_RE.search(line):
-                kind += " (noqa: stpu-atomic present but the reason " \
-                        "is missing — reasons are mandatory)"
-            violations.append(
-                f"{rel}:{node.lineno}: {kind} — durable state writes "
-                "must go through checkpoint.atomic_write_bytes "
-                "(temp + fsync + rename), or carry "
-                "'# noqa: stpu-atomic <reason>'")
-    return violations
+def check(paths: Optional[Sequence[pathlib.Path]] = None) -> List[str]:
+    from skypilot_tpu import analysis
+    # Historical API: explicitly passed paths are linted AS IF they
+    # were the crash-critical files, whatever they are named.
+    return [f.render() for f in analysis.run_check(
+        paths=paths, rules=["stpu-atomic"],
+        respect_targets=paths is None)]
 
 
 def main() -> int:
     violations = check()
+    for v in violations:
+        print(f"  {v}")
     if violations:
-        print("non-atomic durable writes in crash-consistency-critical "
-              "files:")
-        for v in violations:
-            print(f"  {v}")
         return 1
     print("atomic-write discipline OK")
     return 0

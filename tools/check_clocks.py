@@ -1,189 +1,51 @@
 #!/usr/bin/env python3
-"""Clock + span discipline lints (tier-1).
+"""Thin shim over the unified static-analysis framework.
 
-Lint 1: reject ``time.time()`` used in duration arithmetic.
-
-``time.time() - t0`` is wrong for measuring elapsed time: an NTP step
-(or a VM migration's clock slew) mid-interval yields negative or wildly
-wrong durations — exactly the bug this PR fixed in utils/timeline.py.
-Durations must come from ``time.perf_counter()`` / ``time.monotonic()``;
-``time.time()`` is for wall-clock *stamps* (cross-process comparison,
-persisted timestamps, trace alignment).
-
-Flagged pattern: ``time.time()`` adjacent to a ``-`` on the same line,
-inside ``skypilot_tpu/``. Wall-clock-INTENTIONAL sites — arithmetic
-against a timestamp persisted by another process/boot, where monotonic
-clocks are meaningless — are either allowlisted below or annotated
-inline with ``# wallclock: intentional``.
-
-Lint 2: reject LEAKED tracing spans. Every
-``tracing.start_span(...)`` call must either be the context expression
-of a ``with`` statement or be assigned to a name on which ``.end()``
-is called somewhere in the same function — an open span that is never
-ended is silently dropped (records are written on end), which is
-precisely the "request disappeared from the trace" bug distributed
-tracing exists to rule out. Phases whose boundaries are only known
-after the fact should use ``tracing.record_span`` (start+end in one
-call), which this lint does not constrain.
-
-Runs as a tier-1 test (tests/test_observability.py) and standalone:
+The clock + span lints live in ``skypilot_tpu/analysis/rules_clocks.py``
+(rules ``stpu-wallclock`` / ``stpu-span-leak``); the bespoke
+``# wallclock: intentional`` marker and the script-resident allowlist
+are gone — annotated sites carry ``# noqa: stpu-wallclock <reason>``
+like every other rule. This script keeps the historical invocation
+working:
 
     python tools/check_clocks.py        # exit 1 on violations
+
+Prefer ``stpu check --rule stpu-wallclock --rule stpu-span-leak`` (or
+plain ``stpu check`` for the whole suite).
 """
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
-from typing import List, Tuple
+from typing import List, Optional
 
-REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
-TARGET_DIR = REPO_ROOT / "skypilot_tpu"
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-PATTERN = re.compile(r"time\.time\(\)\s*-|-\s*time\.time\(\)")
-INLINE_MARKER = "# wallclock: intentional"
-
-# (path suffix, line substring, why wall clock is right there).
-ALLOWLIST: Tuple[Tuple[str, str, str], ...] = (
-    ("catalog/__init__.py", "csv_path.stat().st_mtime",
-     "age of an on-disk catalog file: mtime is wall clock"),
-    ("jobs/core.py", "job.get(\"submitted_at\")",
-     "submitted_at was persisted by another process"),
-    ("serve/replica_managers.py", "info.launched_at",
-     "launched_at is persisted to serve state and re-read after "
-     "controller restarts; monotonic clocks don't survive a process"),
-    ("agent/daemon.py", "time.time() - baseline",
-     "idle baseline mixes job-DB wall stamps with autostop.json "
-     "set_at written by the remote client"),
-    ("agent/native.py", "deadline - time.time()",
-     "socket-deadline bookkeeping in the gang coordinator; deadlines "
-     "are exchanged with code that stamps wall clock"),
-    # Recipes are user-workload exemplars reporting elapsed *wall* time
-    # of a training run — the number an operator compares to a wall
-    # clock, not an interval the framework acts on.
-    ("recipes/", "time.time() - t0",
-     "workload wall-time report"),
-    ("recipes/resnet_ddp.py", "iter_times.append",
-     "workload wall-time report"),
-)
+RULES = ("stpu-wallclock", "stpu-span-leak")
 
 
-def _allowed(rel_path: str, line: str) -> bool:
-    if INLINE_MARKER in line:
-        return True
-    for suffix, substring, _reason in ALLOWLIST:
-        if suffix in rel_path and substring in line:
-            return True
-    return False
+def _run(rules, root: Optional[pathlib.Path] = None) -> List[str]:
+    from skypilot_tpu import analysis
+    paths = [root] if root is not None else None
+    return [f.render()
+            for f in analysis.run_check(paths=paths, rules=list(rules))]
 
 
-def check(root: pathlib.Path = TARGET_DIR) -> List[str]:
-    """Return violation strings ('path:lineno: line')."""
-    violations = []
-    for path in sorted(root.rglob("*.py")):
-        rel = str(path.relative_to(REPO_ROOT))
-        try:
-            text = path.read_text(errors="replace")
-        except OSError:
-            continue
-        for lineno, line in enumerate(text.splitlines(), start=1):
-            stripped = line.strip()
-            if stripped.startswith("#"):
-                continue
-            if PATTERN.search(line) and not _allowed(rel, line):
-                violations.append(f"{rel}:{lineno}: {stripped}")
-    return violations
+def check(root: Optional[pathlib.Path] = None) -> List[str]:
+    """Wallclock violations (back-compat entry point)."""
+    return _run(("stpu-wallclock",), root)
 
 
-# --------------------------------------------------- span-leak lint
-def _is_start_span_call(node: "ast.AST") -> bool:
-    if not isinstance(node, ast.Call):
-        return False
-    func = node.func
-    name = (func.attr if isinstance(func, ast.Attribute)
-            else func.id if isinstance(func, ast.Name) else None)
-    return name == "start_span"
-
-
-def _span_closed(call: "ast.Call", parents: dict) -> bool:
-    """True iff the start_span() call cannot leak an open span: it is a
-    with-statement context expression, or its result is assigned to a
-    name with a matching ``<name>.end(...)`` in the enclosing function
-    (nested helpers like a shared finish() closure count)."""
-    stmt = call
-    while not isinstance(stmt, ast.stmt):
-        stmt = parents[stmt]
-    if isinstance(stmt, (ast.With, ast.AsyncWith)):
-        for item in stmt.items:
-            if call is item.context_expr or any(
-                    n is call for n in ast.walk(item.context_expr)):
-                return True
-        return False
-    target = None
-    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
-            and isinstance(stmt.targets[0], ast.Name):
-        target = stmt.targets[0].id
-    elif isinstance(stmt, ast.AnnAssign) \
-            and isinstance(stmt.target, ast.Name):
-        target = stmt.target.id
-    if target is None:
-        return False  # bare/returned span: nobody owns the .end()
-    scope = stmt
-    while not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.Module)):
-        scope = parents[scope]
-    for node in ast.walk(scope):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "end"
-                and isinstance(node.func.value, ast.Name)
-                and node.func.value.id == target):
-            return True
-    return False
-
-
-def check_spans(root: pathlib.Path = TARGET_DIR) -> List[str]:
-    """Return span-leak violation strings ('path:lineno: message')."""
-    violations = []
-    for path in sorted(root.rglob("*.py")):
-        rel = str(path.relative_to(REPO_ROOT)) \
-            if REPO_ROOT in path.parents else str(path)
-        try:
-            tree = ast.parse(path.read_text(errors="replace"))
-        except (OSError, SyntaxError):
-            continue
-        parents: dict = {}
-        for node in ast.walk(tree):
-            for child in ast.iter_child_nodes(node):
-                parents[child] = node
-        for node in ast.walk(tree):
-            if _is_start_span_call(node) and \
-                    not _span_closed(node, parents):
-                violations.append(
-                    f"{rel}:{node.lineno}: start_span() result is "
-                    "never ended (use `with`, or assign it and call "
-                    ".end() in the same function; for "
-                    "known-after-the-fact phases use record_span)")
-    return violations
+def check_spans(root: Optional[pathlib.Path] = None) -> List[str]:
+    """Span-leak violations (back-compat entry point)."""
+    return _run(("stpu-span-leak",), root)
 
 
 def main() -> int:
-    violations = check()
+    violations = _run(RULES)
+    for v in violations:
+        print(f"  {v}")
     if violations:
-        print("time.time() used in duration arithmetic (use "
-              "time.perf_counter()/time.monotonic(), or annotate "
-              f"'{INLINE_MARKER}' / extend the allowlist in "
-              "tools/check_clocks.py if wall clock is intentional):")
-        for v in violations:
-            print(f"  {v}")
-        return 1
-    span_violations = check_spans()
-    if span_violations:
-        print("leaked tracing spans (records are written on end(); an "
-              "un-ended span silently vanishes from the trace):")
-        for v in span_violations:
-            print(f"  {v}")
         return 1
     print("clock + span discipline OK")
     return 0

@@ -1,111 +1,36 @@
 #!/usr/bin/env python3
-"""Lint: no hand-rolled collectives in the serving stack.
+"""Thin shim over the unified static-analysis framework.
 
-Serving code expresses parallelism through ``parallel/mesh.py``
-(ShardingRules resolving logical axes onto a named mesh; XLA's SPMD
-partitioner inserts the collectives). A raw ``lax.psum`` /
-``all_gather`` / ``ppermute`` in ``skypilot_tpu/serve`` bypasses that
-layer: it hard-codes a mesh axis name into request-path code, breaks
-the moment the topology block changes shape (``replica_topology:
-{hosts: N, ici_axes: {...}}`` is operator-tunable), and silently
-decouples the engine from the single-process path the bit-parity tests
-compare against. Collectives belong where the mesh is managed —
-``parallel/`` (ring attention's shard_map, MoE dispatch) — never in
-``serve/``.
-
-Flagged pattern (AST-based): any attribute reference or call named
-after a collective primitive (psum, all_gather, ppermute, ...) inside
-``skypilot_tpu/serve``. A site that genuinely must issue one (none
-exists today) annotates the line with ``# noqa: stpu-collective`` plus
-a reason — the marker without prose is still a violation, because the
-reason IS the review artifact.
-
-Runs as a tier-1 test (tests/test_sharded_replica.py) and standalone:
+The serve/-collectives lint lives in
+``skypilot_tpu/analysis/rules_collectives.py`` (rule
+``stpu-collective``). This script keeps the historical invocation
+working:
 
     python tools/check_collectives.py    # exit 1 on violations
+
+Prefer ``stpu check --rule stpu-collective`` (or plain ``stpu check``).
 """
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
-from typing import List
+from typing import List, Optional
 
-REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
-TARGET_DIRS = ("skypilot_tpu/serve",)
-
-COLLECTIVES = frozenset({
-    "psum", "psum_scatter", "pmean", "pmax", "pmin",
-    "all_gather", "all_to_all", "ppermute", "pshuffle",
-    "pbroadcast", "axis_index", "pdot",
-})
-
-MARKER = "noqa: stpu-collective"
-MIN_REASON_CHARS = 8
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
-def _allowed(lines: List[str], lineno: int) -> bool:
-    line = lines[lineno - 1] if lineno - 1 < len(lines) else ""
-    if MARKER not in line:
-        return False
-    reason = line.split(MARKER, 1)[1].strip(" -—:\t")
-    return len(reason) >= MIN_REASON_CHARS
-
-
-def _name_of(node: ast.AST):
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    if isinstance(node, ast.Name):
-        return node.id
-    return None
-
-
-def check(root: pathlib.Path = REPO_ROOT) -> List[str]:
-    """Return violation strings ('relpath:lineno: message')."""
-    violations = []
-    for target in TARGET_DIRS:
-        base = root / target
-        if not base.is_dir():
-            continue
-        for path in sorted(base.rglob("*.py")):
-            rel = str(path.relative_to(root))
-            try:
-                text = path.read_text(errors="replace")
-                tree = ast.parse(text)
-            except (OSError, SyntaxError):
-                continue
-            lines = text.splitlines()
-            for node in ast.walk(tree):
-                name = _name_of(node)
-                if name not in COLLECTIVES:
-                    continue
-                # A bare Name only counts when it was imported as a
-                # collective (e.g. `from jax.lax import psum`); local
-                # variables that happen to share a name are fine —
-                # attribute access (lax.psum) is always flagged.
-                if isinstance(node, ast.Name) and not any(
-                        isinstance(n, (ast.ImportFrom,)) and any(
-                            a.name == name or a.asname == name
-                            for a in n.names)
-                        for n in ast.walk(tree)):
-                    continue
-                if _allowed(lines, node.lineno):
-                    continue
-                violations.append(
-                    f"{rel}:{node.lineno}: collective `{name}` in "
-                    f"serve/ — express parallelism through "
-                    f"parallel/mesh.py ShardingRules (XLA inserts the "
-                    f"collectives); annotate `# {MARKER} <reason>` if "
-                    f"a raw collective is truly unavoidable")
-    return violations
+def check(root: Optional[pathlib.Path] = None) -> List[str]:
+    from skypilot_tpu import analysis
+    paths = [root / "skypilot_tpu"] if root is not None else None
+    return [f.render() for f in analysis.run_check(
+        paths=paths, rules=["stpu-collective"], root=root)]
 
 
 def main() -> int:
     violations = check()
+    for v in violations:
+        print(f"  {v}")
     if violations:
-        print("hand-rolled collectives in the serving stack:")
-        for v in violations:
-            print(f"  {v}")
         return 1
     print("serve/ collective discipline OK")
     return 0

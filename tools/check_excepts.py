@@ -1,97 +1,35 @@
 #!/usr/bin/env python3
-"""Lint: reject silently-swallowed exceptions in the control plane.
+"""Thin shim over the unified static-analysis framework.
 
-``except Exception: pass`` (or a bare ``except: pass``) in the serving
-/ jobs / agent control planes is how zombie states are born: a probe
-loop that eats its own failure keeps a dead replica READY, a teardown
-that eats its failure leaks a billing cluster, and nothing ever
-surfaces in logs or metrics — the exact failure class the
-fault-tolerance work (LB breaker, engine supervisor, drain) exists to
-kill. Narrow catches with a recovery action are fine; catching
-EVERYTHING and doing NOTHING is not.
-
-Flagged pattern (AST-based, so formatting/comments can't dodge it): an
-``except``/``except Exception``/``except BaseException`` handler whose
-body is a single ``pass``, under ``skypilot_tpu/serve``,
-``skypilot_tpu/agent``, or ``skypilot_tpu/jobs``.
-
-Genuinely-best-effort sites (e.g. a metrics scrape where a dead
-replica simply contributes nothing) annotate the ``except`` line with
-``# noqa: stpu-except`` plus a reason — the marker without prose is
-still a violation, because the reason IS the review artifact.
-
-Runs as a tier-1 test (tests/test_fault_tolerance.py) and standalone:
+The swallowed-exception lint lives in
+``skypilot_tpu/analysis/rules_excepts.py`` (rule ``stpu-except``).
+This script keeps the historical invocation working:
 
     python tools/check_excepts.py       # exit 1 on violations
+
+Prefer ``stpu check --rule stpu-except`` (or plain ``stpu check``).
 """
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
-from typing import List
+from typing import List, Optional
 
-REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
-TARGET_DIRS = ("skypilot_tpu/serve", "skypilot_tpu/agent",
-               "skypilot_tpu/jobs")
-
-MARKER = "noqa: stpu-except"
-# The marker must carry a reason: at least this many non-space chars
-# after it on the line.
-MIN_REASON_CHARS = 8
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
-def _swallows_everything(handler: ast.ExceptHandler) -> bool:
-    if not (len(handler.body) == 1 and
-            isinstance(handler.body[0], ast.Pass)):
-        return False
-    if handler.type is None:
-        return True
-    return (isinstance(handler.type, ast.Name) and
-            handler.type.id in ("Exception", "BaseException"))
-
-
-def _allowed(lines: List[str], lineno: int) -> bool:
-    line = lines[lineno - 1] if lineno - 1 < len(lines) else ""
-    if MARKER not in line:
-        return False
-    reason = line.split(MARKER, 1)[1].strip(" -—:\t")
-    return len(reason) >= MIN_REASON_CHARS
-
-
-def check(root: pathlib.Path = REPO_ROOT) -> List[str]:
-    """Return violation strings ('path:lineno: except ...: pass')."""
-    violations = []
-    for target in TARGET_DIRS:
-        for path in sorted((root / target).rglob("*.py")):
-            rel = str(path.relative_to(root))
-            try:
-                text = path.read_text(errors="replace")
-                tree = ast.parse(text)
-            except (OSError, SyntaxError):
-                continue
-            lines = text.splitlines()
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.ExceptHandler):
-                    continue
-                if not _swallows_everything(node):
-                    continue
-                if _allowed(lines, node.lineno):
-                    continue
-                shown = lines[node.lineno - 1].strip() \
-                    if node.lineno - 1 < len(lines) else "except: pass"
-                violations.append(f"{rel}:{node.lineno}: {shown}")
-    return violations
+def check(root: Optional[pathlib.Path] = None) -> List[str]:
+    from skypilot_tpu import analysis
+    paths = [root / "skypilot_tpu"] if root is not None else None
+    return [f.render() for f in analysis.run_check(
+        paths=paths, rules=["stpu-except"], root=root)]
 
 
 def main() -> int:
     violations = check()
+    for v in violations:
+        print(f"  {v}")
     if violations:
-        print("swallowed exceptions (handle it, narrow the catch, or "
-              f"annotate the except line with '# {MARKER} <reason>' "
-              "if it is genuinely best-effort):")
-        for v in violations:
-            print(f"  {v}")
         return 1
     print("exception discipline OK")
     return 0
