@@ -359,6 +359,28 @@ def _serving_leg() -> dict:
         except Exception as e:  # noqa: BLE001
             out[key] = None
             out[f"{key}_error"] = str(e)[:200]
+        # Paged-KV serving leg: the engine on the block pool (HALF the
+        # dense HBM budget) under a mixed-length mix — throughput per
+        # byte of KV plus the pool's peak utilization, the capacity
+        # lever tracked round-over-round next to the dense ragged leg.
+        key = f"{family}_engine_paged_tok_s"
+        try:
+            # 16 slots over HALF the dense budget — twice the ragged
+            # leg's slot count on the same bytes is the leg's point.
+            r = run_tool(["--family", family, "--mode", "paged",
+                          "--slots", "16", "--requests", "48"],
+                         timeout=1200)
+            out[key] = r["engine_paged_tok_s"]
+            out[f"{family}_kv_pool_utilization"] = \
+                r["kv_pool_utilization"]
+            out[f"{family}_engine_paged_detail"] = {
+                k: r[k] for k in ("slots", "requests", "pool_blocks",
+                                  "block_tokens", "peak_live_slots",
+                                  "zero_copy_hits",
+                                  "generated_tokens", "wall_seconds")}
+        except Exception as e:  # noqa: BLE001
+            out[key] = None
+            out[f"{key}_error"] = str(e)[:200]
         # Shared-prefix serving leg: engine + prefix KV cache under a
         # shared-system-prompt mix — the hit rate and the warm/cold
         # TTFT split are the whole point of the cache, so they are

@@ -207,6 +207,12 @@ gather_cache_rows = llama.gather_cache_rows
 insert_cache_rows = llama.insert_cache_rows
 cache_specs = llama.cache_specs
 
+# Paged KV block pool (decode-engine paged mode): layout and block-
+# table attention are llama's shared machinery.
+init_paged_cache = llama.init_paged_cache
+paged_cache_specs = llama.paged_cache_specs
+forward_with_paged_cache = llama.forward_with_paged_cache
+
 
 def forward_with_cache(cfg: GemmaConfig, params: Params,
                        tokens: jax.Array, cache, start_pos,

@@ -418,6 +418,31 @@ def cache_specs(cfg: LlamaConfig) -> Dict[str, tuple]:
     return {"k": spec, "v": spec}
 
 
+def init_paged_cache(cfg: LlamaConfig, num_blocks: int,
+                     block_tokens: int) -> Dict[str, jax.Array]:
+    """ONE device-resident paged KV pool shared by every engine slot
+    AND the shared-prefix cache: ``num_blocks`` blocks of
+    ``block_tokens`` token rows each, stacked on the layer axis like
+    the dense cache (the decode step scans layers and pool together).
+    Slots map logical positions to blocks through per-slot block
+    tables (serve/kv_pool.py owns the accounting); block 0 is the
+    scratch block free slots write into."""
+    shape = (cfg.n_layers, num_blocks, block_tokens, cfg.n_kv_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype=cfg.dtype),
+            "v": jnp.zeros(shape, dtype=cfg.dtype)}
+
+
+def paged_cache_specs(cfg: LlamaConfig) -> Dict[str, tuple]:
+    """Logical-axis names for the paged pool. Identical to
+    :func:`cache_specs`: the (layers, num_blocks, block_tokens,
+    kv_heads, head_dim) layout keeps kv_heads at the same axis index
+    as the dense (layers, batch, max_seq, kv_heads, head_dim) cache,
+    so the TP sharding rules — including gang_replica.cache_shardings'
+    head_dim fallback — apply unchanged."""
+    return cache_specs(cfg)
+
+
 def gather_cache_rows(cache: Dict[str, jax.Array], slot: jax.Array,
                       start: jax.Array, length: int
                       ) -> Dict[str, jax.Array]:
@@ -452,6 +477,43 @@ def insert_cache_rows(cache: Dict[str, jax.Array],
             c, blk, (jnp.int32(0), slot, start, jnp.int32(0),
                      jnp.int32(0)))
     return out
+
+
+def _attn_tile(qf: jax.Array, scale: float, kb: jax.Array,
+               vb: jax.Array, msk: jax.Array, m: jax.Array,
+               el: jax.Array, acc: jax.Array):
+    """One online-softmax tile (running max / normalizer / accumulator
+    update) shared by the dense and paged split-KV loops — one
+    implementation so the two paths are the same arithmetic term for
+    term, which is what makes paged decode bit-identical to dense when
+    their tile boundaries align.
+
+    qf: (B, T, KVH, G, D) f32 queries; kb/vb: (B, W, KVH, D) f32 tile;
+    msk: (B, T, W) bool. Returns (m, el, acc) updated.
+    """
+    s_blk = jnp.einsum("btkgd,bskd->bkgts", qf, kb) * scale
+    s_blk = jnp.where(msk[:, None, None], s_blk, -1e30)
+    m_new = jnp.maximum(m, jnp.max(s_blk, axis=-1))
+    corr = jnp.exp(m - m_new)
+    # Masked entries multiplied to exactly 0 (not just exp(-big)):
+    # a fully-masked slot (free engine slot) must stay finite.
+    p = jnp.exp(s_blk - m_new[..., None]) * msk[:, None, None]
+    el = el * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bkgts,bskd->btkgd", p, vb)
+    corr_t = corr.transpose(0, 3, 1, 2)[..., None]
+    return m_new, el, acc * corr_t + pv
+
+
+def _attn_carry(b: int, t: int, kvh: int, g: int, d: int):
+    return (jnp.int32(0),
+            jnp.full((b, kvh, g, t), -1e30, jnp.float32),
+            jnp.zeros((b, kvh, g, t), jnp.float32),
+            jnp.zeros((b, t, kvh, g, d), jnp.float32))
+
+
+def _attn_normalize(el: jax.Array, acc: jax.Array) -> jax.Array:
+    el_t = el.transpose(0, 3, 1, 2)[..., None]
+    return jnp.where(el_t > 0, acc / jnp.maximum(el_t, 1e-30), 0.0)
 
 
 def _split_kv_attention(qg: jax.Array, ck: jax.Array, cv: jax.Array,
@@ -499,26 +561,65 @@ def _split_kv_attention(qg: jax.Array, ck: jax.Array, cv: jax.Array,
         msk = ((kpos[None, None, :] >= s0) &
                (kpos[None, None, :] <= positions[..., None]) &
                (kpos[None, None, :] < valid_len[:, None, None]))
-        s_blk = jnp.einsum("btkgd,bskd->bkgts", qf, kb) * scale
-        s_blk = jnp.where(msk[:, None, None], s_blk, -1e30)
-        m_new = jnp.maximum(m, jnp.max(s_blk, axis=-1))
-        corr = jnp.exp(m - m_new)
-        # Masked entries multiplied to exactly 0 (not just exp(-big)):
-        # a fully-masked slot (free engine slot) must stay finite.
-        p = jnp.exp(s_blk - m_new[..., None]) * msk[:, None, None]
-        el = el * corr + jnp.sum(p, axis=-1)
-        pv = jnp.einsum("bkgts,bskd->btkgd", p, vb)
-        corr_t = corr.transpose(0, 3, 1, 2)[..., None]
-        return s0 + block, m_new, el, acc * corr_t + pv
+        m_new, el, acc = _attn_tile(qf, scale, kb, vb, msk, m, el, acc)
+        return s0 + block, m_new, el, acc
 
-    carry = (jnp.int32(0),
-             jnp.full((b, kvh, g, t), -1e30, jnp.float32),
-             jnp.zeros((b, kvh, g, t), jnp.float32),
-             jnp.zeros((b, t, kvh, g, d), jnp.float32))
-    _, _, el, acc = jax.lax.while_loop(lambda c: c[0] < limit, body,
-                                       carry)
-    el_t = el.transpose(0, 3, 1, 2)[..., None]
-    return jnp.where(el_t > 0, acc / jnp.maximum(el_t, 1e-30), 0.0)
+    _, _, el, acc = jax.lax.while_loop(
+        lambda c: c[0] < limit, body, _attn_carry(b, t, kvh, g, d))
+    return _attn_normalize(el, acc)
+
+
+def _paged_split_kv_attention(qg: jax.Array, pk: jax.Array,
+                              pv: jax.Array, table: jax.Array,
+                              positions: jax.Array,
+                              valid_len: jax.Array,
+                              window: int) -> jax.Array:
+    """Split-KV attention reading K/V THROUGH a per-slot block table.
+
+    The paged twin of :func:`_split_kv_attention`: instead of each slot
+    owning a contiguous (max_seq, ...) cache row, K/V live in one
+    shared pool of fixed-size blocks and ``table[b, j]`` names the
+    physical block holding slot ``b``'s logical chunk ``j``. Each
+    ``lax.while_loop`` iteration gathers ``window // block_tokens``
+    blocks per slot (a batched dynamic-slice of the table + one gather
+    into the pool), reassembles the same (B, W, KVH, D) tile the dense
+    loop slices out, and runs the IDENTICAL online-softmax tile
+    (:func:`_attn_tile`) — so when ``window`` matches the dense path's
+    block and tile boundaries align (window | max_seq, true for every
+    shipped config), paged output is bit-identical to dense.
+
+    pk/pv: (num_blocks, block_tokens, KVH, D) — ONE layer's pool.
+    table: (B, table_len) int32; entries past a slot's frontier may be
+    stale/zero (the scratch block) — their rows are masked to exact 0
+    like any invalid dense row, so garbage never contributes.
+    """
+    b, t, kvh, g, d = qg.shape
+    bt = pk.shape[1]
+    nb_win = window // bt
+    if nb_win * bt != window:
+        raise ValueError(f"window {window} must be a multiple of the "
+                         f"block size {bt}")
+    qf = qg.astype(jnp.float32)
+    scale = d ** -0.5
+    limit = jnp.max(jnp.minimum(positions[:, -1] + 1, valid_len))
+    limit = jnp.minimum(limit, table.shape[1] * bt)
+
+    def body(carry):
+        s0, m, el, acc = carry
+        phys = jax.lax.dynamic_slice(
+            table, (jnp.int32(0), s0 // bt), (b, nb_win))  # (B, nbw)
+        kb = pk[phys].reshape(b, window, kvh, d).astype(jnp.float32)
+        vb = pv[phys].reshape(b, window, kvh, d).astype(jnp.float32)
+        kpos = s0 + jnp.arange(window)
+        msk = ((kpos[None, None, :] >= s0) &
+               (kpos[None, None, :] <= positions[..., None]) &
+               (kpos[None, None, :] < valid_len[:, None, None]))
+        m_new, el, acc = _attn_tile(qf, scale, kb, vb, msk, m, el, acc)
+        return s0 + window, m_new, el, acc
+
+    _, _, el, acc = jax.lax.while_loop(
+        lambda c: c[0] < limit, body, _attn_carry(b, t, kvh, g, d))
+    return _attn_normalize(el, acc)
 
 
 def cached_attention_block(cfg, x: jax.Array, lp: Params,
@@ -609,6 +710,111 @@ def forward_with_cache(cfg, params: Params,
     if logits_at is not None:
         # Serving prefill reads exactly one position — skip the
         # O(T x vocab) head on the padded chunk.
+        logits_at = jnp.asarray(logits_at, jnp.int32)
+        if logits_at.ndim == 0:
+            x = jax.lax.dynamic_slice_in_dim(x, logits_at, 1, axis=1)
+        else:  # per-slot read-out (ragged prompt lengths)
+            x = x[jnp.arange(b), logits_at][:, None]
+    logits = lm_head(cfg, params, x, lambda a, _spec: a)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def paged_attention_block(cfg, x: jax.Array, lp: Params,
+                          pk: jax.Array, pv: jax.Array,
+                          table: jax.Array, positions: jax.Array,
+                          start_pos: jax.Array, valid_len: jax.Array,
+                          window: int,
+                          write_block: Optional[jax.Array]):
+    """One pre-norm GQA attention residual block against the PAGED KV
+    pool (the block-table twin of :func:`cached_attention_block`).
+
+    Writes route through the table: T == 1 (batched decode step)
+    scatters each slot's new K/V row into block ``table[b, pos//bt]``
+    at offset ``pos % bt`` — free slots ride along with table row 0
+    (the scratch block), so their ignored writes can never clobber a
+    live slot's block. T == block_tokens (single-slot chunk prefill,
+    B == 1, chunk-aligned) overwrites the whole physical block
+    ``write_block``. Aliased (shared-prefix) blocks are never write
+    targets: admission aligns the cached prefix to whole blocks and
+    prefill/decode only ever write from the first non-cached block on.
+    Returns (x + attn_out, pk, pv) with the pool updated in place
+    under donation."""
+    b, t = x.shape[0], x.shape[1]
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    bt = pk.shape[1]
+    y = rms_norm(x, lp["attn_norm"], cfg.norm_eps,
+                 getattr(cfg, "norm_offset", 0.0))
+    q, k_new, v_new = qkv_proj(cfg, y, lp, positions)
+    if t == 1:
+        blk = jnp.take_along_axis(table, (start_pos // bt)[:, None],
+                                  axis=1)[:, 0]
+        off = start_pos % bt
+        pk = pk.at[blk, off].set(k_new[:, 0].astype(pk.dtype))
+        pv = pv.at[blk, off].set(v_new[:, 0].astype(pv.dtype))
+    else:
+        if b != 1 or t != bt or write_block is None:
+            raise ValueError(
+                "paged chunk prefill needs B == 1, T == block_tokens "
+                "and a write_block (chunk-aligned whole-block write); "
+                f"got B={b}, T={t}, block_tokens={bt}")
+        pk = pk.at[write_block].set(k_new[0].astype(pk.dtype))
+        pv = pv.at[write_block].set(v_new[0].astype(pv.dtype))
+    groups = h // kvh
+    qg = q.reshape(b, t, kvh, groups, hd)
+    attn = _paged_split_kv_attention(qg, pk, pv, table, positions,
+                                     valid_len, window)
+    attn = attn.astype(x.dtype).reshape(b, t, h * hd)
+    return x + lora_dense(attn, lp, "wo"), pk, pv
+
+
+def forward_with_paged_cache(cfg, params: Params, tokens: jax.Array,
+                             cache: Dict[str, jax.Array],
+                             table: jax.Array, start_pos: jax.Array,
+                             valid_len: Optional[jax.Array] = None,
+                             logits_at: Optional[jax.Array] = None, *,
+                             window: int,
+                             write_block: Optional[jax.Array] = None,
+                             mlp_fn=None
+                             ) -> Tuple[jax.Array,
+                                        Dict[str, jax.Array]]:
+    """Incremental forward against the paged block pool — the same
+    scalar-or-(B,) ``start_pos``/``valid_len``/``logits_at`` contract
+    as :func:`forward_with_cache`, with the KV cache replaced by
+    ``cache`` (init_paged_cache pool, DONATED by callers) plus
+    ``table`` (B, table_len) int32 block tables. ``window`` (static)
+    is the attention tile width; match it to the dense path's
+    ``min(SPLIT_KV_BLOCK, max_seq)`` for bit-parity. ``write_block``
+    is the single-slot prefill write target (see
+    :func:`paged_attention_block`)."""
+    b, t = tokens.shape
+    start_pos = jnp.asarray(start_pos, jnp.int32)
+    if start_pos.ndim == 0:
+        start_pos = jnp.broadcast_to(start_pos, (b,))
+    if valid_len is None:
+        valid_len = start_pos + t
+    valid_len = jnp.asarray(valid_len, jnp.int32)
+    if valid_len.ndim == 0:
+        valid_len = jnp.broadcast_to(valid_len, (b,))
+    positions = start_pos[:, None] + jnp.arange(t)[None, :]  # (B, T)
+    x = params["embed"][tokens]
+    scale = getattr(cfg, "embed_multiplier", 1.0)
+    if scale != 1.0:  # gemma: embeddings scaled by sqrt(dim)
+        x = (x.astype(jnp.float32) * scale).astype(x.dtype)
+
+    # Pluggable residual MLP half, exactly as in forward_with_cache
+    # (mixtral swaps in its dense-routed MoE).
+    mlp_fn = mlp_fn or (lambda cfg, x2, lp: mlp_block(cfg, x2, lp))
+
+    def layer_fn(x, scanned):
+        lp, pk, pv = scanned                               # per-layer
+        x2, pk, pv = paged_attention_block(
+            cfg, x, lp, pk, pv, table, positions, start_pos,
+            valid_len, window, write_block)
+        return mlp_fn(cfg, x2, lp), (pk, pv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache["k"], cache["v"]))
+    if logits_at is not None:
         logits_at = jnp.asarray(logits_at, jnp.int32)
         if logits_at.ndim == 0:
             x = jax.lax.dynamic_slice_in_dim(x, logits_at, 1, axis=1)

@@ -257,6 +257,11 @@ gather_cache_rows = llama.gather_cache_rows
 insert_cache_rows = llama.insert_cache_rows
 cache_specs = llama.cache_specs
 
+# Paged KV block pool: llama's layout/specs, experts add no per-token
+# cache state.
+init_paged_cache = llama.init_paged_cache
+paged_cache_specs = llama.paged_cache_specs
+
 
 def _moe_block(cfg: MixtralConfig, x: jax.Array, lp: Params) -> jax.Array:
     """Pre-norm dense-routed MoE residual block (inference)."""
@@ -277,6 +282,20 @@ def forward_with_cache(cfg: MixtralConfig, params: Params,
     return llama.forward_with_cache(
         cfg, params, tokens, cache, start_pos, valid_len=valid_len,
         logits_at=logits_at, mlp_fn=_moe_block)
+
+
+def forward_with_paged_cache(cfg: MixtralConfig, params: Params,
+                             tokens: jax.Array, cache, table,
+                             start_pos, valid_len=None,
+                             logits_at=None, *, window: int,
+                             write_block=None):
+    """Paged incremental MoE forward: llama's block-table cache loop
+    with the dense-routed top-2 expert MLP swapped in — same pattern
+    as forward_with_cache."""
+    return llama.forward_with_paged_cache(
+        cfg, params, tokens, cache, table, start_pos,
+        valid_len=valid_len, logits_at=logits_at, window=window,
+        write_block=write_block, mlp_fn=_moe_block)
 
 
 def decode(cfg: MixtralConfig, params: Params, prompt: jax.Array,

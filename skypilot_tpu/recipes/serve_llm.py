@@ -65,6 +65,18 @@ ENGINE_PREFILL_CHUNK = 64
 # is bit-identical to a cold prefill, and a miss costs one trie walk.
 ENGINE_PREFIX_CACHE_MB = float(
     os.environ.get("STPU_PREFIX_CACHE_MB", "64"))
+# Paged KV block pool (decode_engine paged mode): one device-resident
+# pool + per-slot block tables instead of dense per-slot cache rows —
+# admission is free-block based and prefix hits alias blocks
+# zero-copy. Off by default this release; bit-identical to dense when
+# on (pinned by tests/test_paged_kv.py).
+ENGINE_KV_PAGED = os.environ.get("STPU_KV_PAGED", "0") == "1"
+# 0 = auto-size the pool to the dense HBM budget
+# (slots * max_seq / block + 1 scratch).
+ENGINE_KV_POOL_BLOCKS = int(os.environ.get("STPU_KV_POOL_BLOCKS", "0"))
+# 0 = block size follows the prefill chunk (64).
+ENGINE_KV_BLOCK_TOKENS = int(
+    os.environ.get("STPU_KV_BLOCK_TOKENS", "0"))
 # Per-token stream timeout: how long a client handler waits for the
 # NEXT token before declaring the engine wedged (surfaced as a clean
 # EngineError, not a hang). Operator-tunable — the right bound is how
@@ -483,7 +495,10 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
           engine_restart_backoff: float = None,
           topology: "gang_replica.ReplicaTopology" = None,
           mesh=None, rules=None,
-          gang: "gang_replica.GangLeader" = None
+          gang: "gang_replica.GangLeader" = None,
+          kv_paged: bool = None,
+          kv_pool_blocks: int = None,
+          kv_block_tokens: int = None
           ) -> ThreadingHTTPServer:
     """Start the replica server. ``engine_slots`` > 0 (default: env
     STPU_ENGINE_SLOTS or 4) serves through the continuous-batching
@@ -512,6 +527,12 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
         engine_max_restarts = ENGINE_MAX_RESTARTS
     if engine_restart_backoff is None:
         engine_restart_backoff = ENGINE_RESTART_BACKOFF
+    if kv_paged is None:
+        kv_paged = ENGINE_KV_PAGED
+    if kv_pool_blocks is None:
+        kv_pool_blocks = ENGINE_KV_POOL_BLOCKS
+    if kv_block_tokens is None:
+        kv_block_tokens = ENGINE_KV_BLOCK_TOKENS
     ctx = {"cfg": cfg, "params": params, "lock": threading.Lock(),
            "ready": ready_event or threading.Event(), "engine": None,
            "stream_timeout": float(stream_timeout),
@@ -535,7 +556,10 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
                 max_seq=MAX_PROMPT_TOKENS + MAX_GEN_TOKENS,
                 prefill_chunk=ENGINE_PREFILL_CHUNK,
                 prefix_cache_mb=prefix_cache_mb,
-                mesh=mesh, rules=rules)
+                mesh=mesh, rules=rules,
+                paged=bool(kv_paged),
+                kv_pool_blocks=int(kv_pool_blocks),
+                kv_block_tokens=int(kv_block_tokens))
 
         ctx["engine"] = decode_engine.EngineSupervisor(
             _engine_factory, max_restarts=engine_max_restarts,
@@ -561,6 +585,23 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
 
     threading.Thread(target=warmup, daemon=True).start()
     return httpd
+
+
+def _resolve_kv(args) -> dict:
+    """CLI flags > STPU_KV_* env > defaults — resolved ONCE and used
+    for the local engine, the follower engines, and the gang kv-config
+    handshake, so every host of a gang replica pages (or not)
+    identically."""
+    return {
+        "paged": (bool(args.kv_paged) if args.kv_paged is not None
+                  else ENGINE_KV_PAGED),
+        "pool_blocks": (int(args.kv_pool_blocks)
+                        if args.kv_pool_blocks is not None
+                        else ENGINE_KV_POOL_BLOCKS),
+        "block_tokens": (int(args.kv_block_tokens)
+                         if args.kv_block_tokens is not None
+                         else ENGINE_KV_BLOCK_TOKENS),
+    }
 
 
 def _resolve_topology(args) -> "gang_replica.ReplicaTopology":
@@ -614,6 +655,12 @@ def _spawn_follower_cmd(args, rank: int, topology, leader_port: int):
         argv += ["--engine-slots", str(args.engine_slots)]
     if args.prefix_cache_mb is not None:
         argv += ["--prefix-cache-mb", str(args.prefix_cache_mb)]
+    if args.kv_paged is not None:
+        argv += ["--kv-paged", str(int(args.kv_paged))]
+    if args.kv_pool_blocks is not None:
+        argv += ["--kv-pool-blocks", str(args.kv_pool_blocks)]
+    if args.kv_block_tokens is not None:
+        argv += ["--kv-block-tokens", str(args.kv_block_tokens)]
     return subprocess.Popen(argv, env=env, start_new_session=True)
 
 
@@ -648,6 +695,22 @@ def main(argv=None):
                    help="shared-prefix KV pool budget in MB (0 "
                         "disables; default env STPU_PREFIX_CACHE_MB "
                         "or 64)")
+    p.add_argument("--kv-paged", type=int, choices=(0, 1),
+                   default=None,
+                   help="1 serves from the paged KV block pool (one "
+                        "device pool + per-slot block tables; prefix "
+                        "hits alias blocks zero-copy; admission is "
+                        "free-block based). Default env STPU_KV_PAGED "
+                        "or 0. Bit-identical to the dense path")
+    p.add_argument("--kv-pool-blocks", type=int, default=None,
+                   help="paged-KV pool size in blocks incl. scratch "
+                        "(0 = auto: slots * max_seq / block + 1, the "
+                        "dense HBM budget; default env "
+                        "STPU_KV_POOL_BLOCKS)")
+    p.add_argument("--kv-block-tokens", type=int, default=None,
+                   help="paged-KV block size in tokens (also the "
+                        "prefill chunk; 0 = the default 64-token "
+                        "chunk; default env STPU_KV_BLOCK_TOKENS)")
     p.add_argument("--stream-timeout", type=float, default=None,
                    help="seconds to wait for the NEXT token before "
                         "failing the request as engine-stalled "
@@ -689,6 +752,18 @@ def main(argv=None):
     if mesh is not None:
         params = gang_replica.shard_params(cfg, params, mesh, rules)
 
+    kv = _resolve_kv(args)
+    # The handshake compares EFFECTIVE geometry (auto-sized pool
+    # included), not raw knobs: two hosts with identical STPU_KV_* but
+    # different slot counts would auto-size different pools and pass a
+    # raw-knob check while diverging in admission.
+    kv_geo = decode_engine.resolve_kv_geometry(
+        slots=(args.engine_slots if args.engine_slots
+               else ENGINE_SLOTS),
+        max_seq=MAX_PROMPT_TOKENS + MAX_GEN_TOKENS,
+        prefill_chunk=ENGINE_PREFILL_CHUNK, paged=kv["paged"],
+        kv_pool_blocks=kv["pool_blocks"],
+        kv_block_tokens=kv["block_tokens"])
     if topology.hosts > 1 and rank > 0:
         # Non-zero hosts never front HTTP: they run the lockstep
         # follower loop against the leader's gang channel, mirroring
@@ -703,11 +778,15 @@ def main(argv=None):
                 prefix_cache_mb=(args.prefix_cache_mb
                                  if args.prefix_cache_mb is not None
                                  else ENGINE_PREFIX_CACHE_MB),
-                mesh=mesh, rules=rules)
+                mesh=mesh, rules=rules,
+                paged=kv["paged"],
+                kv_pool_blocks=kv["pool_blocks"],
+                kv_block_tokens=kv["block_tokens"])
 
         sys.exit(gang_replica.follower_serve(
             _follower_engine, topology,
-            gang_replica.follower_addr(args.port), rank))
+            gang_replica.follower_addr(args.port), rank,
+            kv_config=kv_geo))
 
     gang = None
     if topology.hosts > 1:
@@ -720,14 +799,16 @@ def main(argv=None):
             # port is fixed.
             gang = gang_replica.GangLeader(
                 topology,
-                port=args.port + gang_replica.GANG_PORT_OFFSET)
+                port=args.port + gang_replica.GANG_PORT_OFFSET,
+                kv_config=kv_geo)
         else:
             # Self-spawn dev gang: OS-assigned channel port, followers
             # on this machine with the address stamped explicitly
             # (the lambda reads gang.port after construction binds it).
             gang = gang_replica.GangLeader(
                 topology, spawn=lambda r: _spawn_follower_cmd(
-                    args, r, topology, gang.port))
+                    args, r, topology, gang.port),
+                kv_config=kv_geo)
             gang.start_followers()
 
     httpd = serve(cfg, params, args.port,
@@ -736,7 +817,9 @@ def main(argv=None):
                   stream_timeout=args.stream_timeout,
                   engine_max_restarts=args.engine_max_restarts,
                   topology=topology, mesh=mesh, rules=rules,
-                  gang=gang)
+                  gang=gang, kv_paged=kv["paged"],
+                  kv_pool_blocks=kv["pool_blocks"],
+                  kv_block_tokens=kv["block_tokens"])
     if gang is not None:
         if httpd.engine is not None:
             # Whole-gang restart rebuilds host 0's engine too.

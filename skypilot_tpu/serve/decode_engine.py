@@ -42,9 +42,28 @@ the sampled token stream never changes, only its latency. At least one
 trailing prompt token is always prefilled so the first token is still
 sampled from real logits.
 
+Paged KV-cache block pool (``paged=True`` / STPU_KV_PAGED=1): the
+capacity lever over all of the above. Instead of every slot owning a
+dense ``(layers, max_seq, ...)`` cache row — concurrency sized for the
+worst-case sequence — ONE device-resident pool of fixed-size blocks
+(block = the prefill chunk) backs every slot through per-slot block
+tables (serve/kv_pool.py owns the accounting; models/*
+forward_with_paged_cache gathers K/V through the table inside the same
+split-KV online-softmax loop, bit-identical to dense when tile
+boundaries align). Slots acquire blocks lazily as they prefill/decode;
+admission reserves the request's worst-case block count up front
+(free-block based — NOT a full max_seq row — with deterministic FIFO
+head-of-line backpressure, so admitted work is never preempted); and
+the prefix cache collapses into the pool: the trie maps chunk hashes
+to refcounted blocks, a hit is a block-table entry write (zero-copy —
+no insert_cache_rows splice, no host round-trip) and publish-on-free
+is a refcount transfer instead of a gather_cache_rows D2H. Same HBM
+budget, strictly more live slots under mixed-length traffic.
+
 Used by recipes/serve_llm.py (replacing its model-lock-per-request
 path) and benchmark/decode_bench.measure_engine_ragged (the
-`engine_ragged_tok_s` bench leg).
+`engine_ragged_tok_s` bench leg) / measure_engine_paged (the
+`engine_paged_tok_s` + pool-utilization legs).
 """
 from __future__ import annotations
 
@@ -60,9 +79,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu.models import model_api
+from skypilot_tpu.models.llama import SPLIT_KV_BLOCK
 from skypilot_tpu.observability import events
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.observability import tracing
+from skypilot_tpu.serve import kv_pool
 from skypilot_tpu.utils import fault_injection
 
 # ----------------------------------------------------------------- metrics
@@ -107,6 +128,22 @@ _PREFIX_TTFT = metrics.histogram(
     "stpu_engine_prefix_ttft_seconds",
     "Submit-to-first-token latency split by prefix-cache outcome.",
     ("cache",), buckets=metrics.LATENCY_BUCKETS)
+_KV_POOL_TOTAL = metrics.gauge(
+    "stpu_engine_kv_pool_blocks_total",
+    "Usable KV blocks in the paged pool (scratch block excluded).")
+_KV_POOL_FREE = metrics.gauge(
+    "stpu_engine_kv_pool_blocks_free",
+    "KV pool blocks on the free list (neither a live slot nor the "
+    "prefix trie holds them).")
+_KV_POOL_PINNED = metrics.gauge(
+    "stpu_engine_kv_pool_blocks_pinned",
+    "Distinct KV pool blocks referenced by live slots (pinned "
+    "against eviction).")
+_ZERO_COPY_HITS = metrics.counter(
+    "stpu_engine_prefix_zero_copy_hits_total",
+    "Prefix-cache hits served by aliasing pool blocks into the "
+    "slot's block table — no insert/gather copies, no host "
+    "round-trip.")
 _RESTARTS = metrics.counter(
     "stpu_engine_restarts_total",
     "Engine restarts by the supervisor after a compute-loop crash.")
@@ -195,10 +232,10 @@ class Request:
 
 
 class _Slot:
-    """Host-side state of one cache row."""
+    """Host-side state of one cache row (or, paged, one block table)."""
 
     __slots__ = ("request", "pos", "generated", "prefilled", "tok",
-                 "held", "cached")
+                 "held", "cached", "blocks", "reserved")
 
     def __init__(self):
         self.request: Optional[Request] = None
@@ -206,8 +243,10 @@ class _Slot:
         self.generated = 0
         self.prefilled = 0    # prompt tokens already prefilled
         self.tok = 0          # last emitted token (next step's input)
-        self.held: List["_ChunkNode"] = []  # pinned prefix-pool nodes
-        self.cached = 0       # prompt tokens to restore from the pool
+        self.held: List[Any] = []           # pinned prefix-pool nodes
+        self.cached = 0       # prompt tokens restored from the pool
+        self.blocks = 0       # paged: valid block-table entries
+        self.reserved = 0     # paged: blocks still promised, unclaimed
 
 
 class _ChunkNode:
@@ -421,6 +460,44 @@ def _insert_chunk(cfg, cache, kv, slot, start):
     return model_api(cfg).insert_cache_rows(cache, kv, slot, start)
 
 
+@functools.partial(jax.jit, static_argnums=(0, 8),
+                   donate_argnums=(2,))
+def _paged_prefill_chunk(cfg, params, cache, buf, table_row, start,
+                         valid, wb, window):
+    """Prefill ONE chunk of ONE slot's prompt into the paged pool.
+
+    The block-table twin of :func:`_prefill_chunk`: ``table_row`` is
+    the slot's block table (the attention gather path) and ``wb`` the
+    physical block the chunk lands in (a whole-block write — chunks
+    and blocks are the same granularity, which is what lets prefix
+    hits alias whole blocks instead of splicing rows). The pool is
+    donated: the write happens in place. Returns (last-real-token
+    logits (vocab,), pool)."""
+    api = model_api(cfg)
+    logits, cache = api.forward_with_paged_cache(
+        cfg, params, buf[None, :], cache, table_row[None, :], start,
+        valid_len=valid, logits_at=jnp.maximum(valid - start - 1, 0),
+        window=window, write_block=wb)
+    return logits[0, 0], cache
+
+
+@functools.partial(jax.jit, static_argnums=(0, 6),
+                   donate_argnums=(2,))
+def _paged_step(cfg, params, cache, toks, pos, table, window, temps,
+                seeds):
+    """One decode step over ALL slots through their block tables: each
+    slot's new K/V row scatters into block ``table[b, pos//bt]``, and
+    attention gathers every slot's valid prefix through its table.
+    Free slots ride along with table row 0 (the scratch block) and are
+    ignored host-side. The pool is donated (in-place update)."""
+    api = model_api(cfg)
+    logits, cache = api.forward_with_paged_cache(
+        cfg, params, toks[:, None], cache, table, pos, window=window)
+    logits = logits[:, -1]
+    nxt = _sample(logits, seeds, pos + 1, temps)
+    return nxt, cache
+
+
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
 def _engine_step(cfg, params, cache, toks, pos, temps, seeds):
     """One decode step over ALL slots: write each slot's last token at
@@ -452,6 +529,36 @@ def _sample(logits, seeds, positions, temps):
     return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
 
 
+def resolve_kv_geometry(*, slots: int, max_seq: int,
+                        prefill_chunk: int = 64, paged: bool = False,
+                        kv_pool_blocks: int = 0,
+                        kv_block_tokens: int = 0) -> Dict[str, int]:
+    """EFFECTIVE KV-cache geometry for an engine config — the single
+    derivation DecodeEngine.__init__, kv_config() and the gang
+    kv-handshake all share, so auto-sized values (pool blocks, shrunk
+    chunk, attention window, table length) can never drift between
+    what an engine actually runs and what the gang compares. Raw
+    knobs are NOT comparable across hosts: two hosts with identical
+    STPU_KV_* but different slot counts auto-size different pools."""
+    max_seq = int(max_seq)
+    if paged and kv_block_tokens:
+        prefill_chunk = int(kv_block_tokens)
+    chunk = max(min(int(prefill_chunk), max_seq), 1)
+    while max_seq % chunk:
+        chunk //= 2
+    out = {"paged": int(bool(paged)), "slots": int(slots),
+           "max_seq": max_seq, "chunk": chunk}
+    if paged:
+        total = int(kv_pool_blocks) or (
+            int(slots) * (max_seq // chunk) + 1)
+        window = max(min(SPLIT_KV_BLOCK, max_seq) // chunk * chunk,
+                     chunk)
+        nbw = window // chunk
+        out.update(pool_blocks=total, window=window,
+                   table_len=-(-(total - 1) // nbw) * nbw)
+    return out
+
+
 class DecodeEngine:
     """Fixed-slot continuous-batching scheduler over one shared cache.
 
@@ -465,7 +572,8 @@ class DecodeEngine:
     def __init__(self, cfg, params, *, slots: int = 4,
                  max_seq: int = 1024, prefill_chunk: int = 64,
                  max_queue: int = 256, prefix_cache_mb: float = 0.0,
-                 mesh=None, rules=None):
+                 mesh=None, rules=None, paged: bool = False,
+                 kv_pool_blocks: int = 0, kv_block_tokens: int = 0):
         if slots < 1:
             raise ValueError("slots must be >= 1")
         self._cfg = cfg
@@ -473,6 +581,8 @@ class DecodeEngine:
         self._api = model_api(cfg)
         self._slots = [_Slot() for _ in range(slots)]
         self._max_seq = int(max_seq)
+        self._paged = bool(paged)
+        self.peak_live_slots = 0
         # Tensor-parallel serving (serve/gang_replica.py): with a mesh,
         # params arrive pre-sharded (ShardingRules over param_specs)
         # and the KV cache is placed by cache_specs — the jitted entry
@@ -484,25 +594,61 @@ class DecodeEngine:
         # Chunks must tile the cache rows: prefill starts land on chunk
         # multiples, so chunk | max_seq guarantees every chunk window
         # fits the row (dynamic_update_slice would otherwise clamp the
-        # start and silently corrupt earlier positions).
-        chunk = max(min(int(prefill_chunk), self._max_seq), 1)
-        while self._max_seq % chunk:
-            chunk //= 2
+        # start and silently corrupt earlier positions). Paged mode
+        # reuses the same granularity as the BLOCK size — blocks and
+        # chunks being the same unit is what makes a prefix hit a
+        # whole-block alias. The derivation lives in
+        # resolve_kv_geometry so the gang handshake compares exactly
+        # what this engine runs.
+        geo = resolve_kv_geometry(
+            slots=slots, max_seq=self._max_seq,
+            prefill_chunk=prefill_chunk, paged=self._paged,
+            kv_pool_blocks=kv_pool_blocks,
+            kv_block_tokens=kv_block_tokens)
+        self._kv_geometry = geo
+        chunk = geo["chunk"]
         self._chunk = chunk
         self._max_queue = int(max_queue)
-        self._cache = self._api.init_cache(cfg, slots, max_seq)
+        self.prefix_cache: Optional[Any] = None
+        if self._paged:
+            # ONE device-resident pool for slot growth AND the prefix
+            # cache (serve/kv_pool.py). Default sizing matches the
+            # dense path's HBM budget exactly: slots * max_seq tokens
+            # of KV, plus the scratch block.
+            total = geo["pool_blocks"]
+            self._pool = kv_pool.BlockPool(total, chunk)
+            # Attention tile width, mirroring the dense engine's
+            # min(SPLIT_KV_BLOCK, max_seq) so paged and dense tile
+            # boundaries align (the bit-parity condition); floored to
+            # a block multiple so each tile gathers whole blocks.
+            self._window = geo["window"]
+            # Per-slot LOGICAL capacity is the pool, not a row: the
+            # table can address every usable block (rounded up so the
+            # last attention tile's table slice stays in bounds).
+            self._table_len = geo["table_len"]
+            self._table = np.zeros((slots, self._table_len), np.int32)
+            self._cache = self._api.init_paged_cache(cfg, total, chunk)
+            # The unified pool IS the prefix cache: the trie is just an
+            # index over blocks, so it is always on in paged mode (a
+            # hit is a table write; a miss costs one dict walk).
+            self.prefix_cache = kv_pool.PagedPrefixCache(self._pool,
+                                                         chunk)
+            _KV_POOL_TOTAL.set(self._pool.usable_blocks)
+            _KV_POOL_FREE.set(self._pool.free_blocks())
+        else:
+            self._cache = self._api.init_cache(cfg, slots, max_seq)
+            # Shared-prefix KV pool (module docstring): 0 disables.
+            # Chunk granularity is the (possibly shrunk) prefill
+            # chunk, so cached prefixes splice onto chunk-aligned
+            # prefill starts.
+            if prefix_cache_mb > 0:
+                self.prefix_cache = PrefixCache(
+                    int(prefix_cache_mb * 1024 * 1024), self._chunk)
         if mesh is not None:
             from skypilot_tpu.serve import gang_replica
             self._cache = jax.device_put(
                 self._cache,
                 gang_replica.cache_shardings(cfg, mesh, rules))
-        # Shared-prefix KV pool (module docstring): 0 disables. Chunk
-        # granularity is the (possibly shrunk) prefill chunk, so cached
-        # prefixes splice onto chunk-aligned prefill starts.
-        self.prefix_cache: Optional[PrefixCache] = None
-        if prefix_cache_mb > 0:
-            self.prefix_cache = PrefixCache(
-                int(prefix_cache_mb * 1024 * 1024), self._chunk)
         self._waiting: "collections.deque[Request]" = collections.deque()
         self._cond = threading.Condition()
         self._stop = False
@@ -529,7 +675,21 @@ class DecodeEngine:
                       trace=trace)
         if not req.prompt:
             raise EngineError("empty prompt")
-        if len(req.prompt) + req.max_tokens > self._max_seq:
+        if self._paged:
+            # Under paging the admission bound is POOL CAPACITY, not a
+            # per-slot row length: a request fits if its worst-case
+            # block count does — so a long prompt whose prefix is
+            # cached (or simply a pool sized beyond slots * max_seq)
+            # is admissible where the dense row check would reject it.
+            need = self._pool.blocks_for(len(req.prompt) +
+                                         req.max_tokens)
+            if need > self._pool.usable_blocks:
+                raise EngineError(
+                    f"prompt ({len(req.prompt)}) + max_tokens "
+                    f"({req.max_tokens}) exceeds the KV pool "
+                    f"({self._pool.usable_blocks} blocks x "
+                    f"{self._chunk} tokens)")
+        elif len(req.prompt) + req.max_tokens > self._max_seq:
             raise EngineError(
                 f"prompt ({len(req.prompt)}) + max_tokens "
                 f"({req.max_tokens}) exceeds the engine cache "
@@ -570,6 +730,15 @@ class DecodeEngine:
 
     def draining(self) -> bool:
         return self._draining
+
+    def kv_config(self) -> Dict[str, int]:
+        """The engine's EFFECTIVE KV-cache geometry
+        (resolve_kv_geometry output — auto-sized pool included), the
+        piece of state a gang leader and its followers must agree on
+        byte-for-byte or admission/backpressure decisions diverge
+        across hosts. serve_llm derives the same dict via
+        resolve_kv_geometry for the welcome handshake."""
+        return dict(self._kv_geometry)
 
     def in_flight(self) -> int:
         """Requests admitted or queued and not yet finished."""
@@ -619,11 +788,53 @@ class DecodeEngine:
             lambda j: {k: jax.device_get(v)
                        for k, v in gathered[j].items()})
 
+    def _publish_paged(self, i: int) -> None:
+        """Paged publish-on-free: ADOPT the slot's full prompt blocks
+        into the trie — a refcount transfer (kv_pool.publish retains,
+        the slot's own reference drops right after in _free_slot), not
+        a gather. Zero device work, zero host copies. The final
+        partial prompt block (prompt tail + generated tokens share it)
+        is never published, exactly like the dense path's full-chunk
+        granularity."""
+        slot = self._slots[i]
+        self.prefix_cache.publish(
+            slot.request.prompt, slot.prefilled,
+            lambda j: int(self._table[i, j]))
+
+    def _release_paged(self, i: int) -> None:
+        """Return every pool reference the slot holds: unpin aliased
+        prefix blocks (table[0:len(held)]), release fresh blocks
+        (table[len(held):blocks]), hand back unused reservation.
+        Idempotent at the slot level — held/blocks/reserved are
+        cleared, so a second call is a no-op instead of a
+        double-decrement (the cancel-mid-prefill hole the dense host
+        pool had)."""
+        slot = self._slots[i]
+        aliased = len(slot.held)
+        if slot.held:
+            self.prefix_cache.unpin(slot.held)
+            slot.held = []
+        for j in range(aliased, slot.blocks):
+            self._pool.release(int(self._table[i, j]))
+        if slot.blocks:
+            self._table[i, :slot.blocks] = 0
+        slot.blocks = 0
+        if slot.reserved:
+            self._pool.unreserve(slot.reserved)
+            slot.reserved = 0
+        _KV_POOL_FREE.set(self._pool.free_blocks())
+
     def _free_slot(self, i: int, error: Optional[str] = None,
                    outcome: str = "ok") -> None:
         slot = self._slots[i]
         if slot.request is not None:
-            if self.prefix_cache is not None and error is None:
+            if self._paged and error is None:
+                # Refcount transfer into the trie BEFORE the slot's
+                # own references drop; skipped on engine failure/
+                # shutdown (device state not trustworthy).
+                self._publish_paged(i)
+            elif not self._paged and self.prefix_cache is not None \
+                    and error is None:
                 # Publish before the row is reusable; skipped on engine
                 # failure/shutdown (device state not trustworthy).
                 self._publish_slot_chunks(i)
@@ -642,7 +853,9 @@ class DecodeEngine:
                            "outcome": outcome})
             slot.request._finish(error)
             _REQUESTS.labels(outcome=outcome).inc()
-        if slot.held:
+        if self._paged:
+            self._release_paged(i)
+        elif slot.held:
             self.prefix_cache.release(slot.held)
             slot.held = []
         slot.request = None
@@ -652,7 +865,124 @@ class DecodeEngine:
         # prefill, cache-full) is reflected even while the loop idles.
         _SLOTS_OCCUPIED.set(len(self._live()))
 
+    def _try_admit_paged(self, i: int, req: Request) -> bool:
+        """Reservation-based paged admission (compute thread): alias
+        the longest cached prefix into the slot's block table (pin —
+        the zero-copy hit), then reserve every block the request can
+        ever need, evicting LRU unpinned trie leaves to make room.
+        False = head-of-line backpressure: the request stays at the
+        queue head until slot frees / evictions make it fit —
+        deterministic and preemption-free (an admitted request can
+        never lose a block, so nothing decoding is ever rolled back).
+        """
+        nodes = self.prefix_cache.match(req.prompt)
+        self.prefix_cache.pin(nodes)
+        total = self._pool.blocks_for(len(req.prompt) + req.max_tokens)
+        needed = total - len(nodes)
+        while self._pool.available() < needed:
+            if not self.prefix_cache.evict_one():
+                self.prefix_cache.unpin(nodes)
+                return False
+        self._pool.reserve(needed)
+        slot = self._slots[i]
+        slot.request = req
+        slot.held = nodes
+        for j, node in enumerate(nodes):
+            self._table[i, j] = node.block
+        slot.blocks = len(nodes)
+        slot.reserved = needed
+        slot.cached = len(nodes) * self._chunk
+        # The "restore" is already done: the aliased blocks ARE the
+        # prefilled prefix. Prefill resumes at the first non-cached
+        # token; no insert_cache_rows splice, no host round-trip.
+        slot.prefilled = slot.pos = slot.cached
+        slot.generated = 0
+        slot.tok = 0
+        req.cached_prompt_tokens = slot.cached
+        self.prefix_cache.note_result(len(nodes))
+        if nodes:
+            _PREFIX_HITS.inc()
+            _ZERO_COPY_HITS.inc()
+            _PREFIX_SAVED.inc(slot.cached)
+        else:
+            _PREFIX_MISSES.inc()
+        return True
+
+    def _admit_paged(self) -> None:
+        emits: List[tuple] = []
+        with self._cond:
+            free = [i for i, s in enumerate(self._slots)
+                    if s.request is None]
+            free.reverse()          # pop() from the end = slot order
+            while self._waiting and free:
+                req = self._waiting[0]
+                if req.cancelled:
+                    self._waiting.popleft()
+                    req._finish()
+                    _REQUESTS.labels(outcome="cancelled").inc()
+                    continue
+                traced = (tracing.ENABLED and req.trace is not None
+                          and req.trace.sampled)
+                t0 = time.perf_counter() if traced else 0.0
+                i = free[-1]
+                if not self._try_admit_paged(i, req):
+                    break       # FIFO head-of-line backpressure
+                free.pop()
+                self._waiting.popleft()
+                slot = self._slots[i]
+                if traced:
+                    req.admitted_at = time.perf_counter()
+                    emits.append(("engine.queue", req.trace,
+                                  req.submitted_at, req.admitted_at,
+                                  {"slot": i}))
+                    emits.append(("engine.prefix_lookup", req.trace,
+                                  t0, time.perf_counter(),
+                                  {"hit": bool(slot.held),
+                                   "cached_tokens": slot.cached,
+                                   "zero_copy": True}))
+            _QUEUE_DEPTH.set(len(self._waiting))
+        live = len(self._live())
+        self.peak_live_slots = max(self.peak_live_slots, live)
+        _SLOTS_OCCUPIED.set(live)
+        self._update_pool_gauges()
+        for name, trace, t0, t1, attrs in emits:
+            tracing.record_span(name, "engine", trace,
+                                start_mono=t0, end_mono=t1,
+                                attrs=attrs)
+
+    def _update_pool_gauges(self) -> None:
+        _KV_POOL_FREE.set(self._pool.free_blocks())
+        pinned = set()
+        for i, s in enumerate(self._slots):
+            if s.request is not None:
+                pinned.update(int(b) for b in self._table[i, :s.blocks])
+        _KV_POOL_PINNED.set(len(pinned))
+
+    def _ensure_block(self, i: int, j: int) -> int:
+        """Back slot ``i``'s logical block ``j``, allocating from the
+        slot's admission reservation on first touch (lazy growth —
+        blocks are claimed as prefill/decode actually reaches them)."""
+        slot = self._slots[i]
+        if j < slot.blocks:
+            return int(self._table[i, j])
+        if j != slot.blocks:
+            raise EngineError(
+                f"non-contiguous block growth: slot {i} has "
+                f"{slot.blocks} blocks, asked for logical block {j}")
+        if slot.reserved <= 0:
+            raise EngineError(
+                f"slot {i} reservation exhausted — admission "
+                "under-reserved (worst-case block math is wrong)")
+        block = self._pool.alloc()
+        slot.reserved -= 1
+        self._table[i, j] = block
+        slot.blocks = j + 1
+        return block
+
     def _admit(self) -> None:
+        if self._paged:
+            self._admit_paged()
+            return
         # Traced-phase stamps taken under the lock, RECORDED after it:
         # record_span does file I/O, and a slow disk under the
         # admission condition would stall every concurrent submit().
@@ -697,7 +1027,9 @@ class DecodeEngine:
                                 {"hit": bool(slot.held),
                                  "cached_tokens": slot.cached}))
             _QUEUE_DEPTH.set(len(self._waiting))
-        _SLOTS_OCCUPIED.set(len(self._live()))
+        live = len(self._live())
+        self.peak_live_slots = max(self.peak_live_slots, live)
+        _SLOTS_OCCUPIED.set(live)
         for name, trace, t0, t1, attrs in emits:
             tracing.record_span(name, "engine", trace,
                                 start_mono=t0, end_mono=t1,
@@ -742,9 +1074,16 @@ class DecodeEngine:
             if fault_injection.ENABLED:
                 fault_injection.fire("engine.prefill", slot=i,
                                      start=start)
-            logits, self._cache = _prefill_chunk(
-                self._cfg, self._params, self._cache, buf,
-                jnp.int32(i), jnp.int32(start), jnp.int32(valid))
+            if self._paged:
+                wb = self._ensure_block(i, start // self._chunk)
+                logits, self._cache = _paged_prefill_chunk(
+                    self._cfg, self._params, self._cache, buf,
+                    jnp.asarray(self._table[i]), jnp.int32(start),
+                    jnp.int32(valid), jnp.int32(wb), self._window)
+            else:
+                logits, self._cache = _prefill_chunk(
+                    self._cfg, self._params, self._cache, buf,
+                    jnp.int32(i), jnp.int32(start), jnp.int32(valid))
             req.prefill_chunks += 1
             slot.prefilled = valid
             slot.pos = valid
@@ -789,7 +1128,8 @@ class DecodeEngine:
             self._free_slot(i, outcome="cancelled")
         elif slot.generated >= req.max_tokens:
             self._free_slot(i, outcome="ok")
-        elif slot.pos + 1 >= self._max_seq:
+        elif slot.pos + 1 >= (self._table_len * self._chunk
+                              if self._paged else self._max_seq):
             self._free_slot(i, outcome="cache_full")
 
     def _decode_step(self) -> bool:
@@ -811,9 +1151,19 @@ class DecodeEngine:
         t0 = time.perf_counter()
         if fault_injection.ENABLED:
             fault_injection.fire("engine.step", live=len(live))
-        nxt, self._cache = _engine_step(
-            self._cfg, self._params, self._cache, toks, pos, temps,
-            seeds)
+        if self._paged:
+            # Lazy growth BEFORE the step: each live slot's write
+            # position must be backed (reservation guarantees a block
+            # exists — admission is preemption-free).
+            for i in live:
+                self._ensure_block(i, self._slots[i].pos // self._chunk)
+            nxt, self._cache = _paged_step(
+                self._cfg, self._params, self._cache, toks, pos,
+                jnp.asarray(self._table), self._window, temps, seeds)
+        else:
+            nxt, self._cache = _engine_step(
+                self._cfg, self._params, self._cache, toks, pos, temps,
+                seeds)
         nxt = jax.device_get(nxt)
         dt = max(time.perf_counter() - t0, 1e-9)
         _TOK_RATE.observe(len(live) / dt)
@@ -975,6 +1325,10 @@ class EngineSupervisor:
 
     def draining(self) -> bool:
         return self._draining
+
+    def kv_config(self) -> Dict[str, int]:
+        engine = self._engine
+        return engine.kv_config() if engine is not None else {}
 
     def in_flight(self) -> int:
         engine = self._engine

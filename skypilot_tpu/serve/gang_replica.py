@@ -275,8 +275,17 @@ class GangLeader:
                  hb_timeout: Optional[float] = None,
                  max_restarts: Optional[int] = None,
                  fast_failure_seconds: float = 30.0,
-                 backoff_base: float = 0.5):
+                 backoff_base: float = 0.5,
+                 kv_config: Optional[Dict[str, Any]] = None):
         self.topology = topology
+        # KV-cache geometry the leader's engine serves with (paged /
+        # pool blocks / block size). Stamped into every follower's
+        # welcome: under paging, each host runs its OWN block pool and
+        # mirrors admissions, so the pools must be sized identically
+        # or admission backpressure diverges across the gang. A
+        # follower that disagrees dies loudly at join instead of
+        # silently falling out of lockstep mid-traffic.
+        self.kv_config = dict(kv_config) if kv_config else None
         self._expected = max(topology.hosts - 1, 0)
         self._spawn = spawn
         self._engine_reset = engine_reset
@@ -468,9 +477,12 @@ class GangLeader:
         # this buffered wfile yet — registering first would let a
         # concurrent broadcast interleave bytes mid-welcome and
         # corrupt the line protocol.
+        welcome: Dict[str, Any] = {"op": "welcome",
+                                   "hosts": self.topology.hosts}
+        if self.kv_config is not None:
+            welcome["kv"] = self.kv_config
         try:
-            _send_line(wfile, {"op": "welcome",
-                               "hosts": self.topology.hosts})
+            _send_line(wfile, welcome)
         except OSError:
             conn.close()
             return
@@ -644,7 +656,8 @@ def _drain_request(req) -> None:
 
 def follower_serve(engine_factory: Callable[[], Any], topology:
                    ReplicaTopology, addr: str, rank: int,
-                   connect_timeout: float = 60.0) -> int:
+                   connect_timeout: float = 60.0,
+                   kv_config: Optional[Dict[str, Any]] = None) -> int:
     """The lockstep loop a non-zero host runs instead of HTTP.
 
     Connects to the leader's gang channel, heartbeats, and mirrors
@@ -653,7 +666,16 @@ def follower_serve(engine_factory: Callable[[], Any], topology:
     ``drain`` stops admissions, ``restart`` rebuilds the engine with
     fresh state, ``shutdown``/EOF exits — the leader going away takes
     every follower with it, so no scale-down or crash-restart can
-    orphan this process. Returns the process exit code."""
+    orphan this process. Returns the process exit code.
+
+    ``kv_config`` is this host's KV-cache geometry (paged / pool
+    blocks / block size): when both sides declare one, the leader's
+    welcome is cross-checked and a mismatch kills the follower
+    IMMEDIATELY — under paging each host mirrors admissions into its
+    own block pool, so differently-sized pools would make admission
+    backpressure (and therefore slot state) silently diverge across
+    the gang. Token output is placement-independent (attention reads
+    through the table), but capacity decisions are not."""
     host, port_s = addr.rsplit(":", 1)
     deadline = time.monotonic() + connect_timeout
     sock = None
@@ -688,7 +710,10 @@ def follower_serve(engine_factory: Callable[[], Any], topology:
             sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
-    signal.signal(signal.SIGTERM, _on_term)
+    if threading.current_thread() is threading.main_thread():
+        # Signal handlers are main-thread-only; an in-process follower
+        # (tests) is torn down by leader EOF instead.
+        signal.signal(signal.SIGTERM, _on_term)
 
     def heartbeat() -> None:
         while not stop.is_set():
@@ -719,6 +744,14 @@ def follower_serve(engine_factory: Callable[[], Any], topology:
                 continue
             op = msg.get("op")
             if op == "welcome":
+                leader_kv = msg.get("kv")
+                if (leader_kv is not None and kv_config is not None
+                        and dict(leader_kv) != dict(kv_config)):
+                    events.emit("gang_replica", f"rank-{rank}",
+                                "kv_config_mismatch",
+                                leader=leader_kv, local=dict(kv_config))
+                    rc = 1
+                    break
                 continue
             # Deterministic follower chaos (tests): the same seam name
             # host_wrapper fires post-barrier, so one STPU_FAULTS

@@ -1,0 +1,303 @@
+"""Paged KV-cache block pool: host-side bookkeeping for the ONE
+device-resident pool of KV blocks the paged decode engine allocates
+slots and the shared-prefix cache out of.
+
+The dense engine gives every slot a full ``(layers, max_seq, ...)``
+cache row, so concurrency is sized for the worst-case sequence, and the
+PrefixCache keeps a SECOND, host-side chunk pool spliced in and out via
+D2H/H2D copies. Paging collapses both into one device buffer of
+``num_blocks`` fixed-size blocks (block = the engine's prefill chunk):
+
+  * slots acquire blocks lazily as they prefill/decode (a per-slot
+    block TABLE maps logical chunk index -> physical block id);
+  * shared prefixes are ALIASED: the trie (:class:`PagedPrefixCache`)
+    maps chunk token-tuples to refcounted pool blocks, so a hit is a
+    block-table entry write — zero-copy, no splice, no host round-trip
+    — and publish-on-free is a refcount transfer, not a D2H gather;
+  * eviction is block-LRU over unpinned trie leaves; blocks referenced
+    by a live slot are never evicted;
+  * admission is free-block based with a worst-case RESERVATION
+    (ceil((prompt + max_tokens) / block) minus aliased blocks), so an
+    admitted request can never stall mid-stream for a block —
+    backpressure is deterministic and preemption-free (FIFO head
+    waits; nothing already decoding is ever evicted or rolled back).
+
+Physical block ids are content-transparent: attention gathers K/V
+through the table, so two hosts of a gang replica may lay the same
+requests out on different physical blocks (admission timing skew) and
+still produce bit-identical tokens — the lockstep contract depends on
+request order and seeds, never on placement.
+
+Block 0 is a reserved SCRATCH block, never allocated: free slots ride
+along in the batched decode step with ``pos 0`` and their (ignored)
+K/V writes land there instead of clobbering a live slot's block.
+
+All mutation happens on the engine's compute thread; the trie lock
+only makes the read-only ``stats()``/``nodes()`` safe from tests and
+handlers. Stdlib + nothing else — no jax in here (the device arrays
+live in the engine; this module owns the arithmetic of who holds which
+block).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, List, Optional
+
+
+class BlockPool:
+    """Free-list + refcount accounting for ``num_blocks`` KV blocks of
+    ``block_tokens`` tokens each (block 0 reserved as scratch).
+
+    A block's refcount counts its OWNERS: +1 per live slot whose table
+    maps to it, +1 while the prefix trie holds it. It returns to the
+    free list when the count hits zero. Allocation order is FIFO over
+    a deque — deterministic, so seeded runs replay exactly.
+    """
+
+    def __init__(self, num_blocks: int, block_tokens: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"kv pool needs >= 2 blocks (1 scratch + 1 usable); "
+                f"got {num_blocks}")
+        if block_tokens < 1:
+            raise ValueError(f"block_tokens must be >= 1, got "
+                             f"{block_tokens}")
+        self.num_blocks = int(num_blocks)
+        self.block_tokens = int(block_tokens)
+        self._free: "collections.deque[int]" = collections.deque(
+            range(1, self.num_blocks))
+        self._refs: Dict[int, int] = {}
+        self._reserved = 0
+        self.peak_in_use = 0          # high-water mark (bench leg)
+
+    # ------------------------------------------------------------ sizing
+    @property
+    def usable_blocks(self) -> int:
+        """Blocks a request can actually occupy (scratch excluded)."""
+        return self.num_blocks - 1
+
+    def blocks_for(self, tokens: int) -> int:
+        return -(-int(tokens) // self.block_tokens)
+
+    # -------------------------------------------------------- accounting
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def in_use(self) -> int:
+        return self.usable_blocks - len(self._free)
+
+    def available(self) -> int:
+        """Free blocks not yet promised to an admitted slot — what a
+        NEW admission may reserve."""
+        return len(self._free) - self._reserved
+
+    def reserve(self, n: int) -> None:
+        """Promise ``n`` free blocks to an admitted slot (the
+        preemption-free admission contract: once admitted, every block
+        the request can ever need is already set aside)."""
+        if n > self.available():
+            raise RuntimeError(
+                f"reserve({n}) with only {self.available()} available "
+                "— admission must check available() first")
+        self._reserved += int(n)
+
+    def unreserve(self, n: int) -> None:
+        """Return unused reservation (slot finished under worst case)."""
+        self._reserved -= int(n)
+        if self._reserved < 0:
+            raise RuntimeError("kv pool reservation underflow")
+
+    def alloc(self, *, reserved: bool = True) -> int:
+        """Take a free block (refcount 1). ``reserved`` draws the block
+        against an admission reservation (the normal slot path)."""
+        if not self._free:
+            raise RuntimeError("kv pool exhausted — a reservation was "
+                               "bypassed or leaked")
+        block = self._free.popleft()
+        if reserved:
+            self.unreserve(1)
+        self._refs[block] = 1
+        self.peak_in_use = max(self.peak_in_use, self.in_use())
+        return block
+
+    def retain(self, block: int) -> None:
+        self._refs[block] += 1
+
+    def release(self, block: int) -> None:
+        refs = self._refs.get(int(block))
+        if refs is None:
+            raise RuntimeError(f"release of free block {block} — "
+                               "double-release (refcount leak inverse)")
+        if refs == 1:
+            del self._refs[int(block)]
+            self._free.append(int(block))
+        else:
+            self._refs[int(block)] = refs - 1
+
+    def refcount(self, block: int) -> int:
+        return self._refs.get(int(block), 0)
+
+
+class _BlockNode:
+    """One prompt chunk in the paged trie: a token-tuple key mapping to
+    one pool block. ``refs`` counts live slots whose admission aliased
+    this node (pins — never evicted while > 0)."""
+
+    __slots__ = ("key", "parent", "children", "block", "refs", "tick")
+
+    def __init__(self, key, parent: Optional["_BlockNode"], block: int):
+        self.key = key
+        self.parent = parent
+        self.children: Dict[tuple, "_BlockNode"] = {}
+        self.block = int(block)
+        self.refs = 0
+        self.tick = 0
+
+
+class PagedPrefixCache:
+    """Chunk-granular trie over POOL BLOCKS — the paged successor of
+    decode_engine.PrefixCache's host pool, with the storage half
+    deleted: a cached chunk IS a device block, a hit IS a table write.
+
+    Eviction is LRU over unpinned leaves (an interior node's block is a
+    dependency of every deeper cached prefix) and runs on demand from
+    admission: when a new request's reservation does not fit, leaves
+    are evicted until it does or nothing unpinned remains (then the
+    request waits — deterministic FIFO backpressure).
+    """
+
+    def __init__(self, pool: BlockPool, chunk: int):
+        self.pool = pool
+        self.chunk = int(chunk)
+        self._root = _BlockNode(None, None, -1)
+        self._lock = threading.Lock()
+        self._tick = 0
+        self._chunks = 0
+        self.hits = 0
+        self.misses = 0
+        self.tokens_saved = 0
+        self.zero_copy_hits = 0
+
+    # ------------------------------------------------------------ match
+    def match(self, prompt: List[int]) -> List[_BlockNode]:
+        """Longest cached prefix of ``prompt`` in full chunks, capped so
+        at least one prompt token is left to prefill (the first output
+        token must be sampled from real logits). Pure lookup — no pins,
+        no counters (admission may still fail on reservation)."""
+        max_chunks = (len(prompt) - 1) // self.chunk
+        with self._lock:
+            node, matched = self._root, []
+            for j in range(max_chunks):
+                key = tuple(prompt[j * self.chunk:(j + 1) * self.chunk])
+                child = node.children.get(key)
+                if child is None:
+                    break
+                matched.append(child)
+                node = child
+            return matched
+
+    def pin(self, nodes: List[_BlockNode]) -> None:
+        """Pin matched nodes for a slot: bumps each node's pin count
+        AND the block's pool refcount (the slot's table now owns a
+        reference — the zero-copy alias)."""
+        with self._lock:
+            self._tick += 1
+            for node in nodes:
+                node.refs += 1
+                node.tick = self._tick
+                self.pool.retain(node.block)
+
+    def unpin(self, nodes: List[_BlockNode]) -> None:
+        """Exact inverse of :meth:`pin` — admission rollback AND the
+        slot-free release path (callers clear their held list after,
+        which is what makes release idempotent at the slot level)."""
+        with self._lock:
+            for node in nodes:
+                node.refs -= 1
+                if node.refs < 0:
+                    raise RuntimeError(
+                        f"trie pin underflow on chunk {node.key!r} — "
+                        "double release")
+                self.pool.release(node.block)
+
+    def note_result(self, matched_chunks: int) -> None:
+        """Count a successful admission's hit/miss + tokens saved."""
+        with self._lock:
+            if matched_chunks:
+                self.hits += 1
+                self.zero_copy_hits += 1
+                self.tokens_saved += matched_chunks * self.chunk
+            else:
+                self.misses += 1
+
+    # ---------------------------------------------------------- publish
+    def publish(self, prompt: List[int], valid_tokens: int,
+                block_of) -> int:
+        """Adopt ``prompt``'s leading full chunks (up to
+        ``valid_tokens``, the prefilled frontier) into the trie.
+        ``block_of(j)`` returns the slot's physical block for chunk
+        ``j``; adoption is a refcount TRANSFER (pool.retain — the trie
+        becomes an owner; the freeing slot drops its own reference
+        right after), never a copy. Chunks already cached keep their
+        existing block; the slot's duplicate simply frees. Returns the
+        number of chunks adopted."""
+        n_chunks = min(valid_tokens, len(prompt)) // self.chunk
+        adopted = 0
+        with self._lock:
+            self._tick += 1
+            node = self._root
+            for j in range(n_chunks):
+                key = tuple(prompt[j * self.chunk:(j + 1) * self.chunk])
+                child = node.children.get(key)
+                if child is None:
+                    child = _BlockNode(key, node, block_of(j))
+                    node.children[key] = child
+                    self.pool.retain(child.block)
+                    self._chunks += 1
+                    adopted += 1
+                child.tick = self._tick
+                node = child
+        return adopted
+
+    # ----------------------------------------------------------- evict
+    def evict_one(self) -> bool:
+        """Drop the LRU unpinned LEAF (releasing its block back toward
+        the free list). False when everything left is pinned or
+        interior — the caller's admission then waits."""
+        with self._lock:
+            victim = None
+            stack = list(self._root.children.values())
+            while stack:
+                node = stack.pop()
+                if node.children:
+                    stack.extend(node.children.values())
+                elif node.refs <= 0 and (victim is None
+                                         or node.tick < victim.tick):
+                    victim = node
+            if victim is None:
+                return False
+            del victim.parent.children[victim.key]
+            self.pool.release(victim.block)
+            self._chunks -= 1
+            return True
+
+    # ------------------------------------------------------------ intro
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "tokens_saved": self.tokens_saved,
+                    "zero_copy_hits": self.zero_copy_hits,
+                    "chunks": self._chunks,
+                    "blocks_free": self.pool.free_blocks(),
+                    "blocks_total": self.pool.usable_blocks}
+
+    def nodes(self) -> List[_BlockNode]:
+        """All resident chunk nodes (tests: refcount/eviction safety)."""
+        with self._lock:
+            out, stack = [], list(self._root.children.values())
+            while stack:
+                node = stack.pop()
+                out.append(node)
+                stack.extend(node.children.values())
+            return out

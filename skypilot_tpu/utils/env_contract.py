@@ -129,8 +129,20 @@ _KNOBS = (
     # ------------------------------------------------ serve engine
     _k("STPU_ENGINE_SLOTS", "4",
        "Decode-engine slot count (continuous-batching concurrency)."),
+    _k("STPU_KV_PAGED", "0",
+       "\"1\" serves from the paged KV block pool (one device pool + "
+       "per-slot block tables, zero-copy prefix aliasing) instead of "
+       "dense per-slot cache rows."),
+    _k("STPU_KV_POOL_BLOCKS", "0",
+       "Paged-KV pool size in blocks incl. the scratch block (0 = "
+       "auto: slots * max_seq / block + 1, the dense HBM budget)."),
+    _k("STPU_KV_BLOCK_TOKENS", "0",
+       "Paged-KV block size in tokens; also becomes the prefill "
+       "chunk — blocks and chunks are one unit (0 = the engine's "
+       "prefill chunk, default 64)."),
     _k("STPU_PREFIX_CACHE_MB", "64",
-       "Shared-prefix KV host-pool budget, MB (0 disables)."),
+       "Shared-prefix KV host-pool budget, MB (0 disables; ignored "
+       "under STPU_KV_PAGED=1 — the pool IS the prefix cache)."),
     _k("STPU_STREAM_TIMEOUT", "600",
        "Per-token stream timeout before the engine is declared "
        "wedged, seconds."),

@@ -284,6 +284,60 @@ def test_donation_rule_fresh_buffer_per_iteration(tmp_path):
     assert _run(tmp_path, "stpu-donation") == []
 
 
+def test_donation_rule_covers_paged_entry_points():
+    """The analyzer SEES the paged block-table entry points: both
+    _paged_prefill_chunk and _paged_step register as donators with the
+    pool (positional index 2) donated — so a future use-after-donate
+    of the paged pool fails the gate exactly like the dense cache."""
+    from skypilot_tpu.analysis import rules_donation
+    src = REPO / "skypilot_tpu" / "serve" / "decode_engine.py"
+    ctx = analysis.core.FileContext(src, "serve/decode_engine.py")
+    donators = {d.name: d
+                for d in rules_donation._collect_donators(ctx)
+                if d.name}
+    for name in ("_paged_prefill_chunk", "_paged_step",
+                 "_prefill_chunk", "_engine_step", "_insert_chunk"):
+        assert name in donators, f"{name} not seen as a donator"
+        assert "cache" in donators[name].donated_params(), name
+
+
+def test_donation_rule_paged_block_table_fixture(tmp_path):
+    """The paged calling shape: the pool donated through a block-table
+    call with extra (table / static-window) operands. Rebinding from
+    the return is clean; reading the pool after donating it — or
+    donating in the decode loop without rebind — is flagged. The
+    TABLE is not donated, so reading it after the call stays clean."""
+    _write(tmp_path, "paged.py", """\
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnums=(0, 4),
+                           donate_argnums=(1,))
+        def paged_step(cfg, pool, toks, table, window):
+            pool = pool.at[table[0]].set(toks)
+            return toks + 1, pool
+
+        def good_engine_loop(cfg, pool, toks, table):
+            for _ in range(8):
+                toks, pool = paged_step(cfg, pool, toks, table, 64)
+                probe = table[0]        # table NOT donated: fine
+            return toks, pool
+
+        def bad_pool_read(cfg, pool, toks, table):
+            nxt, _ = paged_step(cfg, pool, toks, table, 64)
+            return pool[0]
+
+        def bad_loop_no_rebind(cfg, pool, toks, table):
+            for _ in range(8):
+                nxt, _ = paged_step(cfg, pool, toks, table, 64)
+            return nxt
+        """)
+    findings = _run(tmp_path, "stpu-donation")
+    lines = _lines(findings, "paged.py")
+    assert lines == [19, 23], [f.render() for f in findings]
+
+
 def test_donation_rule_self_attribute_paths(tmp_path):
     """Dotted donation targets (`self._cache`) are tracked: rebinding
     from the return is clean, a later read is use-after-donate —

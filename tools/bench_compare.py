@@ -36,6 +36,8 @@ DEFAULT_METRICS = (
     "detail.eight_b_shape.tokens_per_sec_per_chip",
     "detail.serving.*_decode_tok_s_b*",
     "detail.serving.*_engine_ragged_tok_s",
+    "detail.serving.*_engine_paged_tok_s",
+    "detail.serving.*_kv_pool_utilization",
     "detail.serving.*_engine_tp_tok_s",
     "detail.serving.*_engine_prefix_tok_s",
     "detail.serving.*_prefix_hit_rate",

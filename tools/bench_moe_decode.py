@@ -25,12 +25,17 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--family", choices=("mixtral", "llama", "gemma"),
                    default="mixtral")
-    p.add_argument("--mode", choices=("fixed", "engine", "prefix",
-                                      "ckpt", "loadgen", "tp"),
+    p.add_argument("--mode", choices=("fixed", "engine", "paged",
+                                      "prefix", "ckpt", "loadgen",
+                                      "tp"),
                    default="fixed",
                    help="fixed: bucketed batch decode (r01-r05 "
                         "comparable); engine: continuous-batching "
-                        "decode engine under ragged arrivals; prefix: "
+                        "decode engine under ragged arrivals; paged: "
+                        "the engine on the paged KV block pool (one "
+                        "device pool + block tables, half the dense "
+                        "HBM budget) under a mixed-length mix — "
+                        "tok/s + pool utilization; prefix: "
                         "engine under shared-prefix traffic with the "
                         "shared-prefix KV cache on (warm/cold TTFT "
                         "split + hit rate); ckpt: crash-consistent "
@@ -100,6 +105,10 @@ def main() -> None:
     from skypilot_tpu.benchmark import decode_bench
     if args.mode == "engine":
         result = decode_bench.measure_engine_ragged(
+            args.family, slots=args.slots, n_requests=args.requests,
+            **shape_kw)
+    elif args.mode == "paged":
+        result = decode_bench.measure_engine_paged(
             args.family, slots=args.slots, n_requests=args.requests,
             **shape_kw)
     elif args.mode == "prefix":
