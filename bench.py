@@ -353,9 +353,18 @@ def _serving_leg() -> dict:
             r = run_tool(["--family", family, "--mode", "engine"],
                          timeout=1200)
             out[key] = r["engine_ragged_tok_s"]
+            # Phase-breakdown detail (stepstats): the measurable
+            # objective the autotuner / disagg-autoscaler items will
+            # consume — carried round-over-round next to the tok/s
+            # headline (details are not bench_compare-gated).
             out[f"{family}_engine_ragged_detail"] = {
-                k: r[k] for k in ("slots", "requests",
-                                  "generated_tokens", "wall_seconds")}
+                k: r.get(k) for k in ("slots", "requests",
+                                      "generated_tokens",
+                                      "wall_seconds",
+                                      "phase_breakdown",
+                                      "busy_fraction",
+                                      "dispatch_ms_mean",
+                                      "device_ms_mean")}
         except Exception as e:  # noqa: BLE001
             out[key] = None
             out[f"{key}_error"] = str(e)[:200]
@@ -374,10 +383,14 @@ def _serving_leg() -> dict:
             out[f"{family}_kv_pool_utilization"] = \
                 r["kv_pool_utilization"]
             out[f"{family}_engine_paged_detail"] = {
-                k: r[k] for k in ("slots", "requests", "pool_blocks",
-                                  "block_tokens", "peak_live_slots",
-                                  "zero_copy_hits",
-                                  "generated_tokens", "wall_seconds")}
+                k: r.get(k) for k in ("slots", "requests",
+                                      "pool_blocks", "block_tokens",
+                                      "peak_live_slots",
+                                      "zero_copy_hits",
+                                      "generated_tokens",
+                                      "wall_seconds",
+                                      "phase_breakdown",
+                                      "busy_fraction")}
         except Exception as e:  # noqa: BLE001
             out[key] = None
             out[f"{key}_error"] = str(e)[:200]

@@ -24,6 +24,15 @@ Scope: ``serve/decode_engine.py`` and ``serve/gang_replica.py``.
     UN-taints (its result is a host array), so post-fetch host math
     never trips the rule, and neither do host scalars like an HTTP
     request's ``temperature``.
+  * The function form ``jax.block_until_ready(...)`` is flagged like
+    the method form — same sync, different spelling.
+
+One call IS sanctioned: ``stepstats.sampled_sync(...)``
+(observability/stepstats.py) — the step-telemetry subsystem's timed
+block_until_ready, fired every STPU_STEPSTATS_SYNC_EVERY-th step to
+split dispatch vs device time. It is rate-limited by design and the
+only approved way to put a sync on the serve hot path; anything else
+must either use it or carry a noqa.
 
 Annotate a genuinely-required sync with
 ``# noqa: stpu-host-sync <reason>``.
@@ -46,6 +55,13 @@ EXTRA_HOT_ROOTS = {"follower_serve", "broadcast_generate",
 
 # Flagged anywhere in the target files.
 _ALWAYS_SYNC_ATTRS = {"item", "block_until_ready"}
+# BARE-name function-form sync (`from jax import block_until_ready`);
+# the dotted `jax.block_until_ready(...)` spelling is already caught
+# by the attribute branch below (_ALWAYS_SYNC_ATTRS).
+_ALWAYS_SYNC_CALLS = {"block_until_ready"}
+# THE sanctioned sync seam (module docstring): the step-telemetry
+# sampled dispatch/device split. Never flagged.
+_SANCTIONED_CALLS = {"stepstats.sampled_sync", "sampled_sync"}
 _NP_MODULES = {"np", "numpy", "onp"}
 _NP_FUNCS = {"asarray", "array"}
 _DEVICE_MODULES = ("jnp.", "jax.")
@@ -272,17 +288,32 @@ class HostSyncRule(Rule):
         hot = _hot_functions(ctx)
         index = _function_index(ctx)
 
-        # .item() / .block_until_ready(): wrong anywhere in these files.
+        # .item() / .block_until_ready(): wrong anywhere in these files
+        # (method form), plus the jax.block_until_ready(...) function
+        # form. stepstats.sampled_sync is the ONE sanctioned seam.
         for node in ctx.nodes:
-            if isinstance(node, ast.Call) \
-                    and isinstance(node.func, ast.Attribute) \
+            if not isinstance(node, ast.Call):
+                continue
+            path = core.dotted_path(node.func)
+            if path in _SANCTIONED_CALLS:
+                continue
+            if isinstance(node.func, ast.Attribute) \
                     and node.func.attr in _ALWAYS_SYNC_ATTRS:
                 yield Finding(
                     ctx.rel, node.lineno, self.id,
                     f".{node.func.attr}() forces a device sync — on "
                     "the serving path it stalls every slot; keep the "
-                    "value on device (or '# noqa: stpu-host-sync "
-                    "<reason>' for a sanctioned sync point)")
+                    "value on device, or use the sanctioned sampled "
+                    "seam stepstats.sampled_sync (or '# noqa: "
+                    "stpu-host-sync <reason>' for a one-off sync "
+                    "point)")
+            elif path in _ALWAYS_SYNC_CALLS:
+                yield Finding(
+                    ctx.rel, node.lineno, self.id,
+                    f"{path}(...) forces a device sync — on the "
+                    "serving path it stalls every slot; the only "
+                    "sanctioned sync seam is stepstats.sampled_sync "
+                    "(or '# noqa: stpu-host-sync <reason>')")
 
         # Taint-tracked float/np.asarray/print inside hot functions.
         seen: Set[int] = set()

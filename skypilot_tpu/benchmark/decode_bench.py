@@ -197,7 +197,14 @@ def measure_engine_ragged(family: str, slots: int = 8,
     per-token work) — comparing the armed and unarmed tok/s is the
     tracing-overhead acceptance check; unarmed, the tracing cost is
     one module-flag check per seam.
+
+    The leg runs with step telemetry (observability/stepstats.py)
+    armed and reports the PHASE BREAKDOWN (prefill vs decode vs mixed
+    seconds, busy fraction, sampled dispatch/device split) as bench
+    detail fields — the objective the attention-constant autotuner and
+    the disagg-autoscaler roadmap items consume via bench_compare.
     """
+    from skypilot_tpu.observability import stepstats
     from skypilot_tpu.observability import tracing
     from skypilot_tpu.serve.decode_engine import DecodeEngine
 
@@ -217,13 +224,19 @@ def measure_engine_ragged(family: str, slots: int = 8,
     span = tracing.start_span("bench.engine_ragged", kind="bench",
                               attrs={"requests": n_requests})
     trace_ctx = span.context()  # None unless tracing is armed
+    was_armed = stepstats.ENABLED
+    stepstats.arm(ring=8192, sync_every=16)
+    stepstats.reset()
     try:
         t0 = time.perf_counter()
         reqs = [engine.submit(p, max_tokens=mt, trace=trace_ctx)
                 for p, mt in specs]
         total = sum(len(r.result(timeout=1800.0)) for r in reqs)
         dt = time.perf_counter() - t0
+        snap = stepstats.snapshot()
     finally:
+        if not was_armed:
+            stepstats.disarm()
         span.end()
         engine.shutdown()
     return {
@@ -236,6 +249,11 @@ def measure_engine_ragged(family: str, slots: int = 8,
         "generated_tokens": total,
         "wall_seconds": round(dt, 3),
         "engine_ragged_tok_s": round(total / dt, 1),
+        "phase_breakdown": snap.get("phases", {}),
+        "busy_fraction": snap.get("busy_fraction"),
+        "dispatch_ms_mean": snap.get("dispatch_ms_mean"),
+        "device_ms_mean": (snap.get("sync") or {}).get(
+            "device_ms_mean"),
     }
 
 
@@ -254,9 +272,12 @@ def measure_engine_paged(family: str, slots: int = 16,
     mixed mix sustains more live slots per byte of KV. Reports
     generated tok/s (``engine_paged_tok_s``), the pool high-water
     utilization (``kv_pool_utilization`` — peak blocks in use over
-    usable blocks; higher = denser packing of the same HBM), and the
-    peak concurrent live slots. The request mix is seeded identically
-    to measure_engine_ragged so the two legs stay comparable."""
+    usable blocks; higher = denser packing of the same HBM), the
+    peak concurrent live slots, and the stepstats phase breakdown
+    (same detail contract as measure_engine_ragged). The request mix
+    is seeded identically to measure_engine_ragged so the two legs
+    stay comparable."""
+    from skypilot_tpu.observability import stepstats
     from skypilot_tpu.serve.decode_engine import DecodeEngine
 
     mdl, cfg = build(family, **shape_kw)
@@ -276,16 +297,22 @@ def measure_engine_paged(family: str, slots: int = 16,
                for _ in range(rng.randint(8, max_prompt))],
               rng.randint(8, max_tokens))
              for _ in range(n_requests)]
+    was_armed = stepstats.ENABLED
+    stepstats.arm(ring=8192, sync_every=16)
+    stepstats.reset()
     try:
         t0 = time.perf_counter()
         reqs = [engine.submit(p, max_tokens=mt) for p, mt in specs]
         total = sum(len(r.result(timeout=1800.0)) for r in reqs)
         dt = time.perf_counter() - t0
+        snap = stepstats.snapshot()
         pool = engine._pool
         utilization = pool.peak_in_use / max(pool.usable_blocks, 1)
         peak_slots = engine.peak_live_slots
         zero_copy = engine.prefix_cache.stats()["zero_copy_hits"]
     finally:
+        if not was_armed:
+            stepstats.disarm()
         engine.shutdown()
     return {
         "model": _model_info(family, cfg, params),
@@ -301,6 +328,8 @@ def measure_engine_paged(family: str, slots: int = 16,
         "kv_pool_utilization": round(utilization, 3),
         "peak_live_slots": peak_slots,
         "zero_copy_hits": zero_copy,
+        "phase_breakdown": snap.get("phases", {}),
+        "busy_fraction": snap.get("busy_fraction"),
     }
 
 

@@ -233,13 +233,21 @@ class MetricsScraper:
         self._t0 = 0.0
         self.first: Optional[Dict[str, promtext.Family]] = None
         self.last: Optional[Dict[str, promtext.Family]] = None
+        # Monotonic stamps of the first/last SUCCESSFUL snapshots: the
+        # report's scrape window. Wall ``ts`` is kept per record for
+        # cross-host alignment, but window arithmetic must ride the
+        # monotonic clock — an NTP step mid-run would otherwise
+        # stretch/shrink the window the server-side percentiles and
+        # rates are computed over (the stpu-wallclock rationale).
+        self.first_mono: Optional[float] = None
+        self.last_mono: Optional[float] = None
         self.snapshots = 0
         self.failures = 0
 
     def scrape_once(self) -> Optional[Dict[str, promtext.Family]]:
         now = time.time()
-        offset = round(time.perf_counter() - self._t0, 3) \
-            if self._t0 else 0.0
+        mono = time.perf_counter()
+        offset = round(mono - self._t0, 3) if self._t0 else 0.0
         try:
             with urllib.request.urlopen(self._url, timeout=5) as resp:
                 text = resp.read().decode("utf-8", "replace")
@@ -247,17 +255,19 @@ class MetricsScraper:
         except Exception as e:  # noqa: BLE001 — a scrape failure is a
             # data point (the stack was unreachable), never a crash.
             self.failures += 1
-            record = {"ts": now, "offset": offset,
+            record = {"ts": now, "mono": mono, "offset": offset,
                       "error": f"{type(e).__name__}: {e}"}
             jsonl_log.append_line(self.series_path, json.dumps(record),
                                   _SERIES_MAX_BYTES, self._lock)
             return None
         if self.first is None:
             self.first = families
+            self.first_mono = mono
         self.last = families
+        self.last_mono = mono
         self.snapshots += 1
         record = {
-            "ts": now, "offset": offset,
+            "ts": now, "mono": mono, "offset": offset,
             "families": {
                 name: {"kind": fam.kind,
                        "samples": [[s.name, dict(s.labels), s.value]
@@ -267,6 +277,14 @@ class MetricsScraper:
         jsonl_log.append_line(self.series_path, json.dumps(record),
                               _SERIES_MAX_BYTES, self._lock)
         return families
+
+    def window_seconds(self) -> float:
+        """Monotonic span first→last successful snapshot — the window
+        the report's server-side deltas cover; immune to wall-clock
+        steps mid-run."""
+        if self.first_mono is None or self.last_mono is None:
+            return 0.0
+        return max(self.last_mono - self.first_mono, 0.0)
 
     def start(self) -> None:
         self._t0 = time.perf_counter()
@@ -645,7 +663,12 @@ def _build_report(spec, schedule, digest, results, wall, scraper,
         errors_by_kind[kind] = errors_by_kind.get(kind, 0) + 1
 
     server: Dict[str, Any] = {"scrapes": scraper.snapshots,
-                              "scrape_failures": scraper.failures}
+                              "scrape_failures": scraper.failures,
+                              # Monotonic first→last-scrape span: the
+                              # window every server-side delta below
+                              # covers (wall-clock-step immune).
+                              "scrape_window_seconds": round(
+                                  scraper.window_seconds(), 3)}
     ttft_hist = scraper.histogram_delta(_TTFT_FAMILY)
     if ttft_hist is not None and ttft_hist.count > 0:
         server["engine_ttft"] = {

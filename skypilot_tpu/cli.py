@@ -540,6 +540,74 @@ def check(paths, rules, as_json, list_rules, env_table, clouds):
         raise SystemExit(1)
 
 
+def _resolve_service_url(url, service):
+    """Shared --url/--service endpoint resolution (metrics/perf/
+    profile): explicit URL wins, a service name resolves to its LB
+    endpoint, neither returns None (local rendering)."""
+    if url is not None:
+        return url
+    if service is not None:
+        from skypilot_tpu.serve import core as serve_core
+        matches = serve_core.status([service])
+        if not matches:
+            raise click.ClickException(
+                f"Service {service!r} not found.")
+        return matches[0]["endpoint"]
+    return None
+
+
+def _counter_samples(text: str) -> dict:
+    """``{series-id: value}`` for every counter-family sample in an
+    exposition document. Series ids are the literal ``name{labels}``
+    text — canonical in our renderer, so two scrapes key identically."""
+    out: dict = {}
+    family, kind = None, None
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            family = parts[2] if len(parts) > 2 else None
+            kind = parts[3] if len(parts) > 3 else "untyped"
+            continue
+        if not line or line.startswith("#"):
+            continue
+        if kind != "counter" or family is None:
+            continue
+        sid, _, val = line.rpartition(" ")
+        if not sid or not sid.startswith(family):
+            continue
+        try:
+            out[sid] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def _annotate_counter_rates(text: str, prev: dict, dt: float) -> str:
+    """Append per-interval rates (``(+delta/dt /s)``) to counter
+    sample lines — raw ``*_total`` values only show that traffic ever
+    happened; under --watch the rate is what the operator is looking
+    for. Gauges/histograms pass through untouched; a counter reset
+    (process restart) shows ``(reset)`` instead of a negative rate."""
+    if not prev or dt <= 0:
+        return text
+    lines = []
+    for line in text.splitlines():
+        sid, _, val = line.rpartition(" ")
+        # `sid in prev` suffices: prev only holds counter series ids
+        # (a family cannot change type between scrapes), so no second
+        # parse of the current document is needed.
+        if sid in prev:
+            try:
+                delta = float(val) - prev[sid]
+            except ValueError:
+                delta = None
+            if delta is not None:
+                line = (f"{line}  (reset)" if delta < 0
+                        else f"{line}  (+{delta / dt:.4g}/s)")
+        lines.append(line)
+    return "\n".join(lines) + ("\n" if text.endswith("\n") else "")
+
+
 @cli.command(name="metrics")
 @click.option("--url", default=None,
               help="Scrape a remote /metrics endpoint (e.g. a serve "
@@ -547,7 +615,10 @@ def check(paths, rules, as_json, list_rules, env_table, clouds):
 @click.option("--service", "-s", default=None,
               help="Scrape the named service's LB endpoint.")
 @click.option("--watch", "-w", is_flag=True,
-              help="Refresh every 2 seconds until interrupted.")
+              help="Refresh every 2 seconds until interrupted; "
+                   "counter families additionally show the "
+                   "per-interval rate (delta/dt) next to the "
+                   "cumulative value.")
 def metrics_cmd(url, service, watch):
     """Render Prometheus metrics: the local registry by default, a serve
     LB's /metrics with --url/--service (same exposition `curl
@@ -556,21 +627,10 @@ def metrics_cmd(url, service, watch):
 
     from skypilot_tpu import core
 
-    def resolve_url():
-        if url is not None:
-            return url
-        if service is not None:
-            from skypilot_tpu.serve import core as serve_core
-            matches = serve_core.status([service])
-            if not matches:
-                raise click.ClickException(
-                    f"Service {service!r} not found.")
-            return matches[0]["endpoint"]
-        return None
-
     # Resolve once: the endpoint cannot change mid-watch, and with
     # --service each resolution is a full serve status() call.
-    target = resolve_url()
+    target = _resolve_service_url(url, service)
+    prev = {"samples": None, "mono": 0.0}
 
     def render_once():
         import http.client
@@ -581,6 +641,15 @@ def metrics_cmd(url, service, watch):
             # malformed --url; ValueError covers unknown URL types.
             # All must read as a scrape failure, not a crash.
             raise click.ClickException(f"scrape failed: {e}") from e
+        now = time_lib.perf_counter()
+        if watch:
+            # Samples from the RAW text, before annotations land.
+            samples = _counter_samples(text)
+            if prev["samples"] is not None:
+                text = _annotate_counter_rates(text, prev["samples"],
+                                               now - prev["mono"])
+            prev["samples"] = samples
+            prev["mono"] = now
         click.echo(text if text.strip() else "(no metrics recorded)")
 
     if not watch:
@@ -590,6 +659,269 @@ def metrics_cmd(url, service, watch):
         click.clear()
         render_once()
         time_lib.sleep(2.0)
+
+
+def _fmt_ms(seconds) -> str:
+    if seconds is None:
+        return "-"
+    return f"{float(seconds) * 1000:.2f}ms"
+
+
+def _perf_snapshot_lines(doc: dict, label: str = "") -> list:
+    """Human rendering of one stepstats snapshot document."""
+    lines = []
+    head = f"perf{(' ' + label) if label else ''}"
+    armed = "armed" if doc.get("armed") else "DISARMED (export " \
+                                            "STPU_STEPSTATS=1)"
+    lines.append(
+        f"{head:<10} {armed}  steps {doc.get('steps', 0)}"
+        f"/{doc.get('ring_size', 0)} in ring"
+        f" ({doc.get('total_steps', 0)} total)"
+        f"  window {doc.get('window_s', 0):.2f}s"
+        f"  busy {doc.get('busy_fraction', 0) * 100:.1f}%")
+    phases = doc.get("phases") or {}
+    if phases:
+        lines.append("{:<10} {:>8} {:>10} {:>7} ".format(
+            "phase", "steps", "seconds", "share"))
+        for p in ("prefill", "decode", "mixed"):
+            d = phases.get(p)
+            if not d:
+                continue
+            lines.append("{:<10} {:>8} {:>10.3f} {:>6.1f}%".format(
+                p, d.get("steps", 0), d.get("seconds", 0.0),
+                d.get("share", 0.0) * 100))
+    tok = doc.get("tokens_per_sec") or {}
+    if tok:
+        lines.append(f"tok/s      prefill {tok.get('prefill', 0)}"
+                     f"  decode {tok.get('decode', 0)}")
+    occ = doc.get("occupancy") or {}
+    lines.append(f"slots      mean {occ.get('mean', 0)}  last "
+                 f"{occ.get('last', 0)}  queue "
+                 f"{doc.get('queue_depth', 0)}")
+    if doc.get("kv_pool"):
+        pool = doc["kv_pool"]
+        lines.append(f"kv pool    free {pool.get('free')}"
+                     f" / usable {pool.get('usable')} blocks (paged)")
+    if doc.get("dispatch_ms_mean") is not None or doc.get("sync"):
+        sync = doc.get("sync") or {}
+        lines.append(
+            f"split      dispatch {doc.get('dispatch_ms_mean', '-')}"
+            f"ms mean  device "
+            f"{sync.get('device_ms_mean', '-')}ms mean"
+            + (f" (sampled every {sync.get('every')} steps, "
+               f"n={sync.get('samples')})" if sync else
+               "  (device: set STPU_STEPSTATS_SYNC_EVERY=N)"))
+    eng = doc.get("engine") or {}
+    if eng:
+        lines.append(
+            f"engine     {'healthy' if eng.get('healthy') else 'DOWN'}"
+            f"  in_flight {eng.get('in_flight', 0)}"
+            f"  restarts {eng.get('restarts', 0)}"
+            + ("  draining" if eng.get("draining") else ""))
+    return lines
+
+
+def _render_perf_doc(doc: dict) -> str:
+    """Render a replica /perf snapshot OR the LB's merged
+    {replicas, aggregate} document."""
+    if "replicas" in doc and isinstance(doc.get("replicas"), dict):
+        lines = []
+        agg = doc.get("aggregate") or {}
+        lines.append(f"merged     {agg.get('replicas', 0)} replica(s)")
+        tok = agg.get("tokens_per_sec") or {}
+        if tok:
+            lines.append(
+                f"tok/s      prefill {tok.get('prefill', 0)}"
+                f"  decode {tok.get('decode', 0)}"
+                + (f"  busy {agg['busy_fraction_mean'] * 100:.1f}%"
+                   if agg.get("busy_fraction_mean") is not None
+                   else ""))
+        for url in sorted(doc["replicas"]):
+            lines.append("")
+            lines.extend(_perf_snapshot_lines(doc["replicas"][url],
+                                              label=url))
+        return "\n".join(lines)
+    return "\n".join(_perf_snapshot_lines(doc))
+
+
+class _PerfGroup(click.Group):
+    """`stpu perf SERVICE` — a leading token that is not a subcommand
+    is the service name for the default snapshot action (the ISSUE-
+    shaped UX), rewritten to `--service` before normal parsing."""
+
+    def parse_args(self, ctx, args):
+        if args and not args[0].startswith("-") \
+                and args[0] not in self.commands:
+            args = ["--service", args[0]] + list(args[1:])
+        return super().parse_args(ctx, args)
+
+
+@cli.group(name="perf", cls=_PerfGroup, invoke_without_command=True)
+@click.option("--service", "-s", default=None,
+              help="Service whose LB /perf to fetch (also accepted "
+                   "as a bare leading argument: `stpu perf svc`).")
+@click.option("--url", default=None,
+              help="Fetch a replica's (or LB's) /perf endpoint "
+                   "directly.")
+@click.option("--watch", "-w", is_flag=True,
+              help="Refresh every 2 seconds until interrupted.")
+@click.pass_context
+def perf(ctx, service, url, watch):
+    """Per-step engine performance telemetry (arm with
+    STPU_STEPSTATS=1 on the replicas).
+
+    Fetches the step-ring snapshot — phase breakdown (prefill vs
+    decode), busy fraction, slot occupancy, sampled dispatch-vs-device
+    split, KV-pool state — from a replica's GET /perf or the LB's
+    merged view. See docs/observability.md."""
+    if ctx.invoked_subcommand is not None:
+        return
+    import time as time_lib
+
+    from skypilot_tpu import core
+    target = _resolve_service_url(url, service)
+    if target is None:
+        raise click.UsageError(
+            "give a SERVICE or --url (or use `stpu perf dump|show` "
+            "for flight-recorder dumps).")
+
+    def render_once():
+        import http.client
+        try:
+            doc = core.perf_snapshot(target)
+        except (OSError, ValueError, http.client.HTTPException) as e:
+            raise click.ClickException(f"fetch failed: {e}") from e
+        click.echo(_render_perf_doc(doc))
+
+    if not watch:
+        render_once()
+        return
+    while True:
+        click.clear()
+        render_once()
+        time_lib.sleep(2.0)
+
+
+@perf.command(name="dump")
+@click.argument("run", required=False)
+def perf_dump(run):
+    """Flight-recorder dumps: list them (no RUN), or print one dump's
+    raw JSON. RUN may be a file name, a unique prefix, or a path."""
+    import json as json_lib
+    import time as time_lib
+
+    from skypilot_tpu.observability import stepstats
+    if run is None:
+        dumps = stepstats.list_dumps()
+        if not dumps:
+            click.echo("No flight-recorder dumps (arm "
+                       "STPU_STEPSTATS=1; dumps are written on engine "
+                       "crash/restart and SIGTERM).")
+            return
+        click.echo("{:<52} {:<14} {:<20}".format(
+            "DUMP", "REASON", "WHEN"))
+        for name in dumps:
+            try:
+                doc = stepstats.read_dump(name)
+            except (OSError, ValueError):
+                continue
+            stamp = time_lib.strftime(
+                "%Y-%m-%d %H:%M:%S",
+                time_lib.localtime(doc.get("ts", 0)))
+            click.echo("{:<52} {:<14} {:<20}".format(
+                name, doc.get("reason", "?"), stamp))
+        return
+    try:
+        doc = stepstats.read_dump(run)
+    except (OSError, ValueError) as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(json_lib.dumps(doc, indent=1, default=str))
+
+
+@perf.command(name="show")
+@click.argument("run", required=False)
+@click.option("--steps", "-n", type=int, default=10,
+              help="Step records shown from the tail of the ring.")
+def perf_show(run, steps):
+    """Render one flight-recorder dump: trigger, terminal exception,
+    aggregate phase breakdown, and the last step/admission records.
+    RUN defaults to the newest dump."""
+    import time as time_lib
+
+    from skypilot_tpu.observability import stepstats
+    try:
+        doc = stepstats.read_dump(run)
+    except (OSError, ValueError) as e:
+        raise click.ClickException(str(e)) from e
+    stamp = time_lib.strftime("%Y-%m-%d %H:%M:%S",
+                              time_lib.localtime(doc.get("ts", 0)))
+    click.echo(f"dump       {doc.get('path', '-')}")
+    click.echo(f"trigger    {doc.get('reason', '?')} at {stamp} "
+               f"(run {doc.get('run_id', '-')}, pid "
+               f"{doc.get('pid', '-')})")
+    if doc.get("error"):
+        click.echo(f"error      {doc['error']}")
+    snap = doc.get("snapshot") or {}
+    if snap:
+        for line in _perf_snapshot_lines(snap):
+            click.echo(line)
+    recs = (doc.get("steps") or [])[-steps:] if steps > 0 else []
+    if recs:
+        click.echo(f"last {len(recs)} step(s):")
+        click.echo("  {:>8} {:<8} {:>9} {:>6} {:>6} {:>6} {:>6}".format(
+            "seq", "phase", "dur", "slots", "queue", "ptok", "dtok"))
+        for r in recs:
+            click.echo(
+                "  {:>8} {:<8} {:>9} {:>6} {:>6} {:>6} {:>6}".format(
+                    r.get("seq", "-"), r.get("phase", "?"),
+                    _fmt_ms(r.get("dur")), r.get("live_slots", 0),
+                    r.get("queue_depth", 0),
+                    r.get("prefill_tokens", 0),
+                    r.get("decode_tokens", 0)))
+    admits = (doc.get("admissions") or [])[-5:]
+    if admits:
+        click.echo(f"last {len(admits)} admission(s) "
+                   f"({len(doc.get('admissions') or [])} recorded):")
+        for a in admits:
+            click.echo(
+                f"  slot {a.get('slot')}  prompt "
+                f"{a.get('prompt_tokens')}  max {a.get('max_tokens')}"
+                f"  cached {a.get('cached_tokens')}  wait "
+                f"{_fmt_ms(a.get('queue_wait_s'))}")
+
+
+@cli.command(name="profile")
+@click.argument("service", required=False)
+@click.option("--url", default=None,
+              help="POST a replica's (or LB's) /profile endpoint "
+                   "directly.")
+@click.option("--seconds", "-t", type=float, default=5.0,
+              show_default=True,
+              help="Capture window (clamped to [0.05, 120]s "
+                   "replica-side).")
+def profile_cmd(service, url, seconds):
+    """Capture an on-demand jax.profiler trace on a serving replica
+    (written replica-side to ~/.stpu/logs/profiles/<stamp>/; load in
+    TensorBoard / Perfetto alongside `stpu trace export`)."""
+    import json as json_lib
+    import urllib.request
+    target = _resolve_service_url(url, service)
+    if target is None:
+        raise click.UsageError("give a SERVICE or --url.")
+    if "://" not in target:
+        target = f"http://{target}"
+    endpoint = (target.rstrip("/")
+                + f"/profile?seconds={float(seconds)}")
+    req = urllib.request.Request(endpoint, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            doc = json_lib.loads(resp.read().decode("utf-8",
+                                                    "replace"))
+    except (OSError, ValueError) as e:
+        raise click.ClickException(f"profile request failed: {e}") \
+            from e
+    click.echo(f"capturing {doc.get('seconds')}s of profile to "
+               f"{doc.get('profile_dir')} (replica-side)")
 
 
 @cli.group(name="loadgen", invoke_without_command=True)

@@ -243,6 +243,25 @@ def metrics_snapshot(url: Optional[str] = None) -> str:
         return resp.read().decode("utf-8", "replace")
 
 
+def perf_snapshot(url: Optional[str] = None) -> Dict[str, Any]:
+    """Step-telemetry snapshot: this process's stepstats ring, or a
+    remote ``GET /perf`` when ``url`` is given — a replica's snapshot
+    document, or the LB's merged ``{"replicas", "aggregate"}`` form."""
+    if url is None:
+        from skypilot_tpu.observability import stepstats
+        return stepstats.snapshot()
+    import json
+    import urllib.request
+    target = url if "://" in url else f"http://{url}"
+    if not target.rstrip("/").endswith("/perf"):
+        target = target.rstrip("/") + "/perf"
+    with urllib.request.urlopen(target, timeout=10) as resp:
+        doc = json.loads(resp.read().decode("utf-8", "replace"))
+    if not isinstance(doc, dict):
+        raise ValueError(f"{target} did not return a JSON object")
+    return doc
+
+
 def storage_ls() -> List[Dict[str, Any]]:
     """Registered storage objects (reference: sky/core.py storage_ls)."""
     return global_user_state.get_storage()

@@ -17,6 +17,11 @@ Two stdlib-only primitives every long-running stpu process shares:
 * ``promtext`` — the exposition PARSER dual to ``metrics.render()``,
   shared by the loadgen scraper, bench gates, and tests so ad-hoc
   string matching over scraped documents never reappears.
+* ``stepstats`` — per-engine-step performance telemetry (fixed-size
+  step ring recorded from the decode engine's supervisor loop, phase
+  breakdown on ``GET /perf``, sampled dispatch-vs-device sync split)
+  plus the crash flight recorder (``~/.stpu/logs/flightrec/``). Off
+  by default; hot paths guard on ``stepstats.ENABLED``.
 
 None may ever break the instrumented call: all I/O failures are
 swallowed, and recording is lock-free on hot paths except for the
@@ -25,6 +30,7 @@ single child-update lock held for the increment itself.
 from skypilot_tpu.observability import events
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.observability import promtext
+from skypilot_tpu.observability import stepstats
 from skypilot_tpu.observability import tracing
 
-__all__ = ["events", "metrics", "promtext", "tracing"]
+__all__ = ["events", "metrics", "promtext", "stepstats", "tracing"]

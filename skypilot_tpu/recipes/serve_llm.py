@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from skypilot_tpu.agent import constants as agent_constants
 from skypilot_tpu.models import gemma, llama, mixtral, model_api
 from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import stepstats
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import decode_engine
 from skypilot_tpu.serve import gang_replica
@@ -214,6 +215,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, {"status": "ok"})
         elif self.path == "/drain":
             self._json(200, self._drain_payload())
+        elif self.path == "/perf":
+            # Step-telemetry snapshot (observability/stepstats.py):
+            # phase breakdown, occupancy, sampled dispatch/device
+            # split over the step ring. Meaningful content needs
+            # STPU_STEPSTATS=1 on the replica; disarmed it reports
+            # armed=false with an empty ring. The LB merges every
+            # ready replica's /perf like it merges /metrics.
+            self._json(200, self._perf_payload())
         elif self.path == "/gang":
             gang = self.server_ctx.get("gang")
             if gang is None:
@@ -236,6 +245,56 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
         else:
             self._json(404, {"error": "not found"})
+
+    # ------------------------------------------------------------ perf
+    def _perf_payload(self) -> dict:
+        ctx = self.server_ctx
+        doc = stepstats.snapshot()
+        engine = ctx.get("engine")
+        if engine is not None:
+            doc["engine"] = {
+                "healthy": engine.healthy(),
+                "in_flight": engine.in_flight(),
+                "draining": engine.draining(),
+                "restarts": getattr(engine, "restarts", 0),
+            }
+        return doc
+
+    def _start_profile(self) -> None:
+        """POST /profile?seconds=N: capture an on-device
+        ``jax.profiler`` trace to ``~/.stpu/logs/profiles/<stamp>/``.
+        The capture runs on its own thread (the handler answers 202
+        immediately with the target directory); one capture at a time
+        per process."""
+        import urllib.parse
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(self.path).query)
+        try:
+            seconds = float(query.get("seconds", ["5"])[0])
+        except ValueError:
+            self._json(400, {"error": "seconds must be numeric"})
+            return
+        # Atomic claim BEFORE the 202: a racing second request must be
+        # told 409, not promised a directory that never appears.
+        if not stepstats.begin_profile():
+            self._json(409, {"error": "a profile capture is already "
+                                      "running"})
+            return
+        out_dir = os.path.join(
+            str(stepstats.profiles_dir()),
+            time.strftime("%Y%m%d-%H%M%S"))
+
+        def capture():
+            try:
+                stepstats.capture_profile(seconds, out_dir=out_dir,
+                                          claimed=True)
+            except Exception:  # noqa: stpu-except — best-effort capture; the 202 already told the client where to look
+                pass
+
+        threading.Thread(target=capture, daemon=True,
+                         name="profile-capture").start()
+        self._json(202, {"profile_dir": out_dir,
+                         "seconds": min(max(seconds, 0.05), 120.0)})
 
     # ----------------------------------------------------------- drain
     def _drain_payload(self) -> dict:
@@ -279,6 +338,9 @@ class _Handler(BaseHTTPRequestHandler):
         raw = self.rfile.read(length) if length else b""
         if self.path == "/drain":
             self._start_drain()
+            return
+        if self.path == "/profile" or self.path.startswith("/profile?"):
+            self._start_profile()
             return
         if self.path != "/generate":
             self._json(404, {"error": "not found"})
@@ -820,19 +882,25 @@ def main(argv=None):
                   gang=gang, kv_paged=kv["paged"],
                   kv_pool_blocks=kv["pool_blocks"],
                   kv_block_tokens=kv["block_tokens"])
-    if gang is not None:
-        if httpd.engine is not None:
-            # Whole-gang restart rebuilds host 0's engine too.
-            gang.set_engine_reset(httpd.engine.restart_now)
+    if gang is not None and httpd.engine is not None:
+        # Whole-gang restart rebuilds host 0's engine too.
+        gang.set_engine_reset(httpd.engine.restart_now)
 
-        def _term(signum, frame):
-            del signum, frame
-            # SIGTERM (teardown / scale-down) propagates to every
-            # host: followers get an explicit shutdown, self-spawned
-            # ones are reaped — no orphan processes.
+    def _term(signum, frame):
+        del signum, frame
+        # Flight recorder first: a SIGTERM'd replica's last step ring
+        # is the only record of what it was doing when the teardown /
+        # scale-down landed (armed replicas only — an unarmed ring is
+        # empty and a dump per routine teardown would just be noise).
+        if stepstats.ENABLED:
+            stepstats.dump_flight("sigterm")
+        if gang is not None:
+            # SIGTERM propagates to every host: followers get an
+            # explicit shutdown, self-spawned ones are reaped — no
+            # orphan processes.
             gang.shutdown()
-            os._exit(143)
-        signal.signal(signal.SIGTERM, _term)
+        os._exit(143)
+    signal.signal(signal.SIGTERM, _term)
     if args.lb_port:
         from skypilot_tpu.serve import load_balancer as lb_lib
         policy = load_balancing_policies.make_policy(args.lb_policy)

@@ -82,6 +82,7 @@ from skypilot_tpu.models import model_api
 from skypilot_tpu.models.llama import SPLIT_KV_BLOCK
 from skypilot_tpu.observability import events
 from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import stepstats
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import kv_pool
 from skypilot_tpu.utils import fault_injection
@@ -655,6 +656,13 @@ class DecodeEngine:
         self._draining = False
         self._thread: Optional[threading.Thread] = None
         self._failed: Optional[str] = None
+        # Step-telemetry scratch (stepstats armed only): the decode
+        # step's dispatch/device split, consumed by the loop's record.
+        self._step_dispatch_s: Optional[float] = None
+        self._step_device_s: Optional[float] = None
+        # Flight-recorder dump written by the crash path, stamped into
+        # the supervisor's engine_failed event.
+        self.flightrec: Optional[str] = None
         _SLOTS_TOTAL.set(slots)
 
     # ------------------------------------------------------------- public
@@ -865,6 +873,16 @@ class DecodeEngine:
         # prefill, cache-full) is reflected even while the loop idles.
         _SLOTS_OCCUPIED.set(len(self._live()))
 
+    def _record_admission(self, i: int, req: Request,
+                          slot: "_Slot") -> None:
+        """One admission-telemetry record, shared by the dense and
+        paged admit paths (only reached while stepstats.ENABLED — the
+        call sites guard)."""
+        stepstats.record_admission(
+            slot=i, prompt_tokens=len(req.prompt),
+            max_tokens=req.max_tokens, cached_tokens=slot.cached,
+            queue_wait_s=time.perf_counter() - req.submitted_at)
+
     def _try_admit_paged(self, i: int, req: Request) -> bool:
         """Reservation-based paged admission (compute thread): alias
         the longest cached prefix into the slot's block table (pin —
@@ -930,6 +948,8 @@ class DecodeEngine:
                 free.pop()
                 self._waiting.popleft()
                 slot = self._slots[i]
+                if stepstats.ENABLED:
+                    self._record_admission(i, req, slot)
                 if traced:
                     req.admitted_at = time.perf_counter()
                     emits.append(("engine.queue", req.trace,
@@ -1026,6 +1046,8 @@ class DecodeEngine:
                                 t0, time.perf_counter(),
                                 {"hit": bool(slot.held),
                                  "cached_tokens": slot.cached}))
+                    if stepstats.ENABLED:
+                        self._record_admission(i, req, slot)
             _QUEUE_DEPTH.set(len(self._waiting))
         live = len(self._live())
         self.peak_live_slots = max(self.peak_live_slots, live)
@@ -1035,9 +1057,12 @@ class DecodeEngine:
                                 start_mono=t0, end_mono=t1,
                                 attrs=attrs)
 
-    def _prefill_one(self) -> bool:
+    def _prefill_one(self) -> int:
         """Advance the first slot with un-prefilled prompt by ONE
-        chunk; on the final chunk, sample and emit the first token."""
+        chunk; on the final chunk, sample and emit the first token.
+        Returns the number of prompt tokens prefilled (0 = no prefill
+        work) — truthy exactly when work happened, and the per-step
+        telemetry's prefill-token count when stepstats is armed."""
         for i, slot in enumerate(self._slots):
             req = slot.request
             if req is None or slot.prefilled >= len(req.prompt):
@@ -1116,8 +1141,8 @@ class DecodeEngine:
                                "steps_to_first_token":
                                    req.prefill_chunks})
                 self._maybe_finish(i)
-            return True
-        return False
+            return len(piece)
+        return 0
 
     def _maybe_finish(self, i: int) -> None:
         slot = self._slots[i]
@@ -1132,14 +1157,15 @@ class DecodeEngine:
                               if self._paged else self._max_seq):
             self._free_slot(i, outcome="cache_full")
 
-    def _decode_step(self) -> bool:
+    def _decode_step(self) -> int:
         """One batched step over every slot whose prompt is fully
-        prefilled and which still owes tokens."""
+        prefilled and which still owes tokens. Returns the number of
+        tokens emitted (0 = no decode work)."""
         live = [i for i in self._live()
                 if self._slots[i].prefilled >=
                 len(self._slots[i].request.prompt)]
         if not live:
-            return False
+            return 0
         toks = jnp.asarray([s.tok for s in self._slots], jnp.int32)
         pos = jnp.asarray([s.pos for s in self._slots], jnp.int32)
         temps = jnp.asarray(
@@ -1164,6 +1190,16 @@ class DecodeEngine:
             nxt, self._cache = _engine_step(
                 self._cfg, self._params, self._cache, toks, pos, temps,
                 seeds)
+        if stepstats.ENABLED:
+            # The jitted call returned at DISPATCH (device still
+            # executing): the gap from t0 is host dispatch work. Every
+            # Nth step the sanctioned sampled_sync times the remaining
+            # device wait — the only sync this loop is allowed beyond
+            # the token fetch below (stpu-host-sync blesses exactly
+            # stepstats.sampled_sync).
+            self._step_dispatch_s = time.perf_counter() - t0
+            self._step_device_s = (stepstats.sampled_sync(nxt)
+                                   if stepstats.sync_due() else None)
         nxt = jax.device_get(nxt)
         dt = max(time.perf_counter() - t0, 1e-9)
         _TOK_RATE.observe(len(live) / dt)
@@ -1176,7 +1212,27 @@ class DecodeEngine:
             _TOKENS.inc()
             self._maybe_finish(i)
         _SLOTS_OCCUPIED.set(len(self._live()))
-        return True
+        return len(live)
+
+    def _record_step(self, t0: float, pf: int, dc: int) -> None:
+        """One step-ring record for an iteration that did work (only
+        reached while stepstats.ENABLED — the caller guards)."""
+        kv_free = kv_usable = None
+        if self._paged:
+            kv_free = self._pool.free_blocks()
+            kv_usable = self._pool.usable_blocks
+        stepstats.record(
+            dur=time.perf_counter() - t0,
+            phase=("mixed" if pf and dc
+                   else "prefill" if pf else "decode"),
+            live_slots=len(self._live()),
+            queue_depth=len(self._waiting),
+            prefill_tokens=pf, decode_tokens=dc, paged=self._paged,
+            kv_free=kv_free, kv_usable=kv_usable,
+            dispatch_s=self._step_dispatch_s if dc else None,
+            device_s=self._step_device_s if dc else None)
+        self._step_dispatch_s = None
+        self._step_device_s = None
 
     def _loop(self) -> None:
         try:
@@ -1184,9 +1240,20 @@ class DecodeEngine:
                 with self._cond:
                     if self._stop:
                         break
+                # Per-step telemetry (observability/stepstats.py) is
+                # recorded around the WHOLE iteration — admit + one
+                # prefill chunk + one batched decode step — so the
+                # ring shows where supervisor-loop time goes. Disarmed
+                # cost: one module-flag load and a falsy branch
+                # (pinned by the monkeypatch-bomb test).
+                armed = stepstats.ENABLED
+                t0 = time.perf_counter() if armed else 0.0
                 self._admit()
-                did = self._prefill_one()
-                did = self._decode_step() or did
+                pf = self._prefill_one()
+                dc = self._decode_step()
+                did = bool(pf or dc)
+                if armed and did:
+                    self._record_step(t0, pf, dc)
                 if not did:
                     with self._cond:
                         if not self._waiting and not self._stop:
@@ -1194,6 +1261,11 @@ class DecodeEngine:
         except Exception as e:  # noqa: BLE001 — a dead compute thread
             # must fail every caller loudly, not hang their queues.
             msg = f"{type(e).__name__}: {e}"
+            # Flight recorder: the last ring of step/admission records
+            # plus the terminal exception survive the crash on disk —
+            # the supervisor stamps the path into engine_failed.
+            self.flightrec = stepstats.dump_flight("engine_crash",
+                                                   error=msg)
             with self._cond:
                 self._failed = msg
                 self._stop = True
@@ -1342,6 +1414,10 @@ class EngineSupervisor:
         requests fail with the shutdown EngineError — their stream died
         with the gang. Not a crash: the consecutive-fast-failure ladder
         is untouched."""
+        # The outgoing engine's step ring documents what the gang was
+        # doing when the member died — dump it before the state is
+        # superseded (reason distinguishes it from a crash dump).
+        flightrec = stepstats.dump_flight("gang_restart")
         new_engine = self._factory().start()
         with self._lock:
             # Capture the outgoing engine under the SAME lock as the
@@ -1365,7 +1441,7 @@ class EngineSupervisor:
         _RESTARTS.inc()
         _ENGINE_UP.set(1)
         events.emit("engine", "decode-engine", "engine_restarted",
-                    reason="gang")
+                    reason="gang", flightrec=flightrec)
 
     def shutdown(self) -> None:
         self._stop = True
@@ -1401,8 +1477,13 @@ class EngineSupervisor:
             fast = (time.monotonic() - self._started_at <
                     self.fast_failure_seconds)
             self._consecutive = self._consecutive + 1 if fast else 1
+            # The crash path wrote a flight-recorder dump (last step
+            # ring + terminal exception); reference it from the event
+            # so `stpu status --events` leads straight to the
+            # post-mortem artifact.
             events.emit("engine", "decode-engine", "engine_failed",
-                        error=error, consecutive=self._consecutive)
+                        error=error, consecutive=self._consecutive,
+                        flightrec=getattr(engine, "flightrec", None))
             if self._consecutive > self.max_restarts:
                 # Deterministic crash loop: stop burning device time.
                 # /health stays 503; the replica manager's probe path

@@ -297,33 +297,92 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
         except NotImplementedError:
             return []
 
-    def _scrape_replicas(self, timeout: float = 2.0) -> List[str]:
-        """Fetch each ready replica's /metrics CONCURRENTLY, so scrape
-        latency is bounded by one timeout, not timeout x replicas (a
-        wave of mid-restart replicas must not stall Prometheus).
-        Unreachable replicas / missing endpoints are skipped."""
-        urls = self._replica_urls()
+    def _fetch_replicas(self, path: str, timeout: float = 2.0,
+                        urls: Optional[List[str]] = None
+                        ) -> Dict[str, str]:
+        """Fetch ``path`` from each ready replica CONCURRENTLY, so
+        fetch latency is bounded by one timeout, not timeout x
+        replicas (a wave of mid-restart replicas must not stall the
+        caller). Unreachable replicas / missing endpoints are skipped.
+        Returns url -> response text. ``urls`` lets the caller pin one
+        snapshot of the ready set (it can change under a concurrent
+        controller sync)."""
+        if urls is None:
+            urls = self._replica_urls()
         if not urls:
-            return []
-        docs: Dict[int, str] = {}
+            return {}
+        docs: Dict[str, str] = {}
 
-        def fetch(i: int, url: str) -> None:
+        def fetch(url: str) -> None:
             try:
                 with urllib.request.urlopen(
-                        url.rstrip("/") + "/metrics",
+                        url.rstrip("/") + path,
                         timeout=timeout) as resp:
-                    docs[i] = resp.read().decode("utf-8", "replace")
+                    docs[url] = resp.read().decode("utf-8", "replace")
             except Exception:  # noqa: stpu-except — best-effort scrape; an unreachable replica just contributes no doc
                 pass
 
-        threads = [threading.Thread(target=fetch, args=(i, u),
-                                    daemon=True)
-                   for i, u in enumerate(urls)]
+        threads = [threading.Thread(target=fetch, args=(u,),
+                                    daemon=True) for u in urls]
         for t in threads:
             t.start()
         for t in threads:
             t.join(timeout=timeout + 0.5)
-        return [docs[i] for i in sorted(docs)]
+        return docs
+
+    def _scrape_replicas(self, timeout: float = 2.0) -> List[str]:
+        """Each ready replica's /metrics exposition, replica order.
+        The url list is snapshotted ONCE — re-reading it for ordering
+        would drop a fetched doc whose replica a concurrent controller
+        sync just rotated out."""
+        urls = self._replica_urls()
+        docs = self._fetch_replicas("/metrics", timeout=timeout,
+                                    urls=urls)
+        return [docs[u] for u in urls if u in docs]
+
+    def _serve_perf(self) -> None:
+        """GET /perf: every ready replica's step-telemetry snapshot
+        (observability/stepstats.py — phase breakdown, occupancy,
+        sampled dispatch/device split) merged into ONE JSON document
+        keyed by replica URL, plus a cross-replica aggregate — the
+        /metrics merge pattern applied to the perf view, so one fetch
+        of the service endpoint covers the whole serving stack."""
+        import json as json_lib
+        replicas: Dict[str, dict] = {}
+        for url, text in self._fetch_replicas("/perf").items():
+            try:
+                doc = json_lib.loads(text)
+            except ValueError:
+                continue
+            if isinstance(doc, dict):
+                replicas[url] = doc
+        agg: Dict[str, object] = {"replicas": len(replicas)}
+        phases: Dict[str, Dict[str, float]] = {}
+        tok = {"prefill": 0.0, "decode": 0.0}
+        busy = []
+        for doc in replicas.values():
+            for p, d in (doc.get("phases") or {}).items():
+                slot = phases.setdefault(p, {"steps": 0,
+                                             "seconds": 0.0})
+                slot["steps"] += int(d.get("steps", 0))
+                slot["seconds"] += float(d.get("seconds", 0.0))
+            for p in tok:
+                tok[p] += float(
+                    (doc.get("tokens_per_sec") or {}).get(p, 0.0))
+            if doc.get("steps"):
+                busy.append(float(doc.get("busy_fraction", 0.0)))
+        agg["phases"] = phases
+        agg["tokens_per_sec"] = {p: round(v, 1)
+                                 for p, v in tok.items()}
+        if busy:
+            agg["busy_fraction_mean"] = round(sum(busy) / len(busy), 4)
+        body = json_lib.dumps({"replicas": replicas,
+                               "aggregate": agg}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def _proxy(self, method: str) -> None:
         self.recorder.record()
@@ -583,6 +642,9 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path == "/metrics":
             self._serve_metrics()
+            return
+        if self.path == "/perf":
+            self._serve_perf()
             return
         self._proxy("GET")
 

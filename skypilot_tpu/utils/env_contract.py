@@ -67,6 +67,16 @@ _KNOBS = (
     _k("STPU_TRACE_CTX", None,
        "Serialized parent span context stamped into child envs "
        "(trace32-span16-flags)."),
+    _k("STPU_STEPSTATS", "0",
+       "\"1\" arms per-engine-step performance telemetry (step ring, "
+       "/perf phase breakdown, flight-recorder context)."),
+    _k("STPU_STEPSTATS_RING", "1024",
+       "Step-ring capacity in records (the window /perf aggregates "
+       "over and the flight recorder dumps)."),
+    _k("STPU_STEPSTATS_SYNC_EVERY", "0",
+       "Sample a timed block_until_ready every N decode steps to "
+       "split dispatch vs device time (0 disables; the only "
+       "sanctioned sync on the serve hot path)."),
     _k("STPU_DISABLE_USAGE_COLLECTION", "0",
        "\"1\" disables usage reporting (wins over configured sinks)."),
     # ------------------------------------------------ chaos

@@ -407,6 +407,36 @@ def test_host_sync_rule(tmp_path):
     assert _lines(findings, "serve/other.py") == []
 
 
+def test_host_sync_sanctioned_sampled_sync(tmp_path):
+    """stepstats.sampled_sync is THE blessed sync seam on the serve
+    hot path: never flagged, while every other block_until_ready
+    spelling (method form AND jax.block_until_ready call form) is."""
+    _write(tmp_path, "serve/decode_engine.py", """\
+        import jax
+        from skypilot_tpu.observability import stepstats
+
+        @jax.jit
+        def _engine_step(tokens, cache):
+            return tokens + 1, cache
+
+        def engine_loop(tokens, cache):
+            while True:
+                tokens, cache = _engine_step(tokens, cache)
+                if stepstats.ENABLED and stepstats.sync_due():
+                    device_s = stepstats.sampled_sync(tokens)
+                jax.block_until_ready(tokens)
+                tokens.block_until_ready()
+        """)
+    findings = _run(tmp_path, "stpu-host-sync")
+    lines = _lines(findings, "serve/decode_engine.py")
+    # The sanctioned helper (line 12) passes; both raw sync spellings
+    # (13: call form, 14: method form) are findings.
+    assert 12 not in lines
+    assert 13 in lines and 14 in lines
+    by_line = {f.line: f.message for f in findings}
+    assert "sampled_sync" in by_line[13]
+
+
 def test_host_sync_noqa(tmp_path):
     _write(tmp_path, "serve/gang_replica.py", """\
         def broadcast_generate(arr):
