@@ -394,6 +394,35 @@ def _serving_leg() -> dict:
         except Exception as e:  # noqa: BLE001
             out[key] = None
             out[f"{key}_error"] = str(e)[:200]
+        # Speculative-decoding serving leg: n-gram self-drafts + one
+        # batched multi-token verify pass per step, on the chat
+        # (shared-prefix) mix at the ragged leg's b8 slot count — the
+        # per-request speed lever batching can't reach. The leg
+        # bit-asserts speculative streams == non-speculative before
+        # reporting, runs the same-mix baseline for the honest
+        # speedup ratio, and carries the acceptance rate that
+        # explains the number (tokens per verify pass ~= 1 + rate*k).
+        key = f"{family}_engine_spec_tok_s"
+        try:
+            r = run_tool(["--family", family, "--mode", "spec"],
+                         timeout=1200)
+            out[key] = r["engine_spec_tok_s"]
+            out[f"{family}_spec_accept_rate"] = r["spec_accept_rate"]
+            out[f"{family}_engine_spec_detail"] = {
+                k: r.get(k) for k in ("slots", "requests",
+                                      "shared_prefix", "spec_k",
+                                      "spec_ngram",
+                                      "engine_spec_baseline_tok_s",
+                                      "spec_speedup",
+                                      "drafted_tokens",
+                                      "accepted_tokens",
+                                      "generated_tokens",
+                                      "wall_seconds",
+                                      "phase_breakdown",
+                                      "busy_fraction")}
+        except Exception as e:  # noqa: BLE001
+            out[key] = None
+            out[f"{key}_error"] = str(e)[:200]
         # Shared-prefix serving leg: engine + prefix KV cache under a
         # shared-system-prompt mix — the hit rate and the warm/cold
         # TTFT split are the whole point of the cache, so they are

@@ -694,6 +694,12 @@ def _perf_snapshot_lines(doc: dict, label: str = "") -> list:
     if tok:
         lines.append(f"tok/s      prefill {tok.get('prefill', 0)}"
                      f"  decode {tok.get('decode', 0)}")
+    spec = doc.get("spec") or {}
+    if spec:
+        lines.append(
+            f"spec       drafted {spec.get('drafted', 0)}"
+            f"  accepted {spec.get('accepted', 0)}"
+            f"  accept {spec.get('accept_rate', 0.0) * 100:.1f}%")
     occ = doc.get("occupancy") or {}
     lines.append(f"slots      mean {occ.get('mean', 0)}  last "
                  f"{occ.get('last', 0)}  queue "

@@ -213,6 +213,12 @@ init_paged_cache = llama.init_paged_cache
 paged_cache_specs = llama.paged_cache_specs
 forward_with_paged_cache = llama.forward_with_paged_cache
 
+# Speculative decoding (decode-engine verify path): the multi-token
+# verify window is llama's shared machinery driven by this config's
+# knobs (norm offset, GeGLU, scaled embeddings, MQA cache layout).
+verify_step = llama.verify_step
+verify_step_paged = llama.verify_step_paged
+
 
 def forward_with_cache(cfg: GemmaConfig, params: Params,
                        tokens: jax.Array, cache, start_pos,

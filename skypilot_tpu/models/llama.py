@@ -625,20 +625,36 @@ def _paged_split_kv_attention(qg: jax.Array, pk: jax.Array,
 def cached_attention_block(cfg, x: jax.Array, lp: Params,
                            ck: jax.Array, cv: jax.Array,
                            positions: jax.Array, start_pos: jax.Array,
-                           valid_len: jax.Array):
+                           valid_len: jax.Array,
+                           write_pos: Optional[jax.Array] = None):
     """One pre-norm GQA attention residual block against the KV cache
     (shared by llama's and mixtral's decode paths). ``start_pos`` and
     ``valid_len`` are per-slot (B,) vectors — every slot in the batch
     may sit at a different sequence position (continuous batching).
+    ``write_pos`` (B, T), when given, replaces the contiguous
+    dynamic-update-slice cache write with a per-token row scatter whose
+    out-of-bounds rows are DROPPED — the speculative verify_step write
+    path, where a slot's draft tail may be shorter than the batch's
+    static T (junk columns carry a sentinel >= max_seq and write
+    nothing, so a short-draft slot can never clobber valid rows the
+    way a clamped dynamic_update_slice would).
     Returns (x + attn_out, updated ck, updated cv)."""
     b, t = x.shape[0], x.shape[1]
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     y = rms_norm(x, lp["attn_norm"], cfg.norm_eps,
                  getattr(cfg, "norm_offset", 0.0))
     q, k_new, v_new = qkv_proj(cfg, y, lp, positions)
-    upd = lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (s, 0, 0))
-    ck = jax.vmap(upd)(ck, k_new.astype(ck.dtype), start_pos)
-    cv = jax.vmap(upd)(cv, v_new.astype(cv.dtype), start_pos)
+    if write_pos is None:
+        upd = lambda c, u, s: jax.lax.dynamic_update_slice(c, u,
+                                                           (s, 0, 0))
+        ck = jax.vmap(upd)(ck, k_new.astype(ck.dtype), start_pos)
+        cv = jax.vmap(upd)(cv, v_new.astype(cv.dtype), start_pos)
+    else:
+        b_iota = jnp.arange(b)[:, None]
+        ck = ck.at[b_iota, write_pos].set(k_new.astype(ck.dtype),
+                                          mode="drop")
+        cv = cv.at[b_iota, write_pos].set(v_new.astype(cv.dtype),
+                                          mode="drop")
     # GQA grouped attention against the UNEXPANDED cache (the head-
     # order convention of ops/attention.py): q regrouped per KV head
     # so no repeat()ed copy of the cache hits HBM on the hot path.
@@ -654,6 +670,7 @@ def forward_with_cache(cfg, params: Params,
                        start_pos: jax.Array,
                        valid_len: Optional[jax.Array] = None,
                        logits_at: Optional[jax.Array] = None, *,
+                       write_pos: Optional[jax.Array] = None,
                        mlp_fn=None
                        ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Incremental forward: process a chunk, reading/writing the cache.
@@ -702,7 +719,8 @@ def forward_with_cache(cfg, params: Params,
         lp, ck, cv = scanned                               # per-layer
         x2, ck, cv = cached_attention_block(cfg, x, lp, ck, cv,
                                             positions, start_pos,
-                                            valid_len)
+                                            valid_len,
+                                            write_pos=write_pos)
         return mlp_fn(cfg, x2, lp), (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -724,7 +742,8 @@ def paged_attention_block(cfg, x: jax.Array, lp: Params,
                           table: jax.Array, positions: jax.Array,
                           start_pos: jax.Array, valid_len: jax.Array,
                           window: int,
-                          write_block: Optional[jax.Array]):
+                          write_block: Optional[jax.Array],
+                          write_pos: Optional[jax.Array] = None):
     """One pre-norm GQA attention residual block against the PAGED KV
     pool (the block-table twin of :func:`cached_attention_block`).
 
@@ -745,7 +764,21 @@ def paged_attention_block(cfg, x: jax.Array, lp: Params,
     y = rms_norm(x, lp["attn_norm"], cfg.norm_eps,
                  getattr(cfg, "norm_offset", 0.0))
     q, k_new, v_new = qkv_proj(cfg, y, lp, positions)
-    if t == 1:
+    if write_pos is not None:
+        # Speculative verify: per-(slot, token) scatter THROUGH the
+        # table. Junk columns (a slot's draft tail shorter than the
+        # batch's static T) carry a sentinel >= the table span and
+        # route to the scratch block — like free slots' rides, their
+        # garbage is masked to exact 0 by valid_len, never attendable.
+        span = table.shape[1] * bt
+        ok = write_pos < span
+        blk_idx = jnp.clip(write_pos // bt, 0, table.shape[1] - 1)
+        blk = jnp.where(ok, jnp.take_along_axis(table, blk_idx,
+                                                axis=1), 0)
+        off = jnp.where(ok, write_pos % bt, 0)
+        pk = pk.at[blk, off].set(k_new.astype(pk.dtype))
+        pv = pv.at[blk, off].set(v_new.astype(pv.dtype))
+    elif t == 1:
         blk = jnp.take_along_axis(table, (start_pos // bt)[:, None],
                                   axis=1)[:, 0]
         off = start_pos % bt
@@ -774,6 +807,7 @@ def forward_with_paged_cache(cfg, params: Params, tokens: jax.Array,
                              logits_at: Optional[jax.Array] = None, *,
                              window: int,
                              write_block: Optional[jax.Array] = None,
+                             write_pos: Optional[jax.Array] = None,
                              mlp_fn=None
                              ) -> Tuple[jax.Array,
                                         Dict[str, jax.Array]]:
@@ -809,7 +843,7 @@ def forward_with_paged_cache(cfg, params: Params, tokens: jax.Array,
         lp, pk, pv = scanned                               # per-layer
         x2, pk, pv = paged_attention_block(
             cfg, x, lp, pk, pv, table, positions, start_pos,
-            valid_len, window, write_block)
+            valid_len, window, write_block, write_pos=write_pos)
         return mlp_fn(cfg, x2, lp), (pk, pv)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -822,6 +856,89 @@ def forward_with_paged_cache(cfg, params: Params, tokens: jax.Array,
             x = x[jnp.arange(b), logits_at][:, None]
     logits = lm_head(cfg, params, x, lambda a, _spec: a)
     return logits, {"k": new_k, "v": new_v}
+
+
+def _verify_write_positions(t: int, start_pos: jax.Array,
+                            spec_len: jax.Array,
+                            span: int) -> jax.Array:
+    """(B, T) cache-write positions for a speculative verify window:
+    column j of slot b lands at start_pos[b] + j while j <= spec_len[b]
+    (the slot's real token + its drafts) and at the out-of-range
+    sentinel ``span`` past its draft tail — dense scatters DROP those
+    rows, the paged scatter routes them to the scratch block. Either
+    way a short-draft slot's junk columns write nothing attendable."""
+    offs = jnp.arange(t)[None, :]
+    wpos = start_pos[:, None] + offs
+    return jnp.where(offs <= spec_len[:, None], wpos, span)
+
+
+def verify_step(cfg, params: Params, tokens: jax.Array,
+                cache: Dict[str, jax.Array], start_pos: jax.Array,
+                spec_len: jax.Array, *, mlp_fn=None
+                ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Multi-token speculative verification against the dense cache.
+
+    ``tokens`` (B, T) is, per slot, its last emitted token followed by
+    up to T-1 drafted tokens (``spec_len`` (B,) real drafts; the tail
+    is padding). One forward computes logits at ALL T positions —
+    column j is the target distribution for the token at absolute
+    position ``start_pos + j + 1``, conditioned on the draft prefix
+    whose K/V this same pass wrote — which is what lets the engine
+    accept k drafted tokens for the price of one memory-bound pass
+    (the per-slot (B,) start_pos/valid_len contract generalized to a
+    per-slot (B, T) logits-at-positions read-out).
+
+    Writes scatter per token with out-of-bounds DROP semantics
+    (:func:`_verify_write_positions`), so rejected/padded suffixes
+    never land where a clamped dynamic_update_slice would corrupt
+    valid rows; ``valid_len = start_pos + spec_len + 1`` masks each
+    slot's junk columns out of every other query. The engine rolls a
+    rejected suffix back host-side by simply not advancing ``pos``
+    past the accepted frontier — rows beyond it are stale-masked, the
+    exact invariant slot reuse already relies on.
+
+    Returns (logits (B, T, vocab), cache).
+    """
+    b, t = tokens.shape
+    start_pos = jnp.asarray(start_pos, jnp.int32)
+    if start_pos.ndim == 0:
+        start_pos = jnp.broadcast_to(start_pos, (b,))
+    spec_len = jnp.asarray(spec_len, jnp.int32)
+    if spec_len.ndim == 0:
+        spec_len = jnp.broadcast_to(spec_len, (b,))
+    max_seq = cache["k"].shape[2]
+    wpos = _verify_write_positions(t, start_pos, spec_len, max_seq)
+    return forward_with_cache(
+        cfg, params, tokens, cache, start_pos,
+        valid_len=start_pos + spec_len + 1, write_pos=wpos,
+        mlp_fn=mlp_fn)
+
+
+def verify_step_paged(cfg, params: Params, tokens: jax.Array,
+                      cache: Dict[str, jax.Array], table: jax.Array,
+                      start_pos: jax.Array, spec_len: jax.Array, *,
+                      window: int, mlp_fn=None
+                      ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """:func:`verify_step` against the paged block pool: the same
+    (B, T) verify window with writes scattered THROUGH each slot's
+    block table (junk columns route to the scratch block) and
+    attention gathered by :func:`_paged_split_kv_attention`. The
+    engine backs the window's blocks from the slot's admission
+    reservation before the call and truncates the rejected suffix's
+    blocks back afterwards."""
+    b, t = tokens.shape
+    start_pos = jnp.asarray(start_pos, jnp.int32)
+    if start_pos.ndim == 0:
+        start_pos = jnp.broadcast_to(start_pos, (b,))
+    spec_len = jnp.asarray(spec_len, jnp.int32)
+    if spec_len.ndim == 0:
+        spec_len = jnp.broadcast_to(spec_len, (b,))
+    span = table.shape[1] * cache["k"].shape[2]
+    wpos = _verify_write_positions(t, start_pos, spec_len, span)
+    return forward_with_paged_cache(
+        cfg, params, tokens, cache, table, start_pos,
+        valid_len=start_pos + spec_len + 1, window=window,
+        write_pos=wpos, mlp_fn=mlp_fn)
 
 
 def decode(cfg: LlamaConfig, params: Params, prompt: jax.Array,

@@ -298,6 +298,25 @@ def forward_with_paged_cache(cfg: MixtralConfig, params: Params,
         write_block=write_block, mlp_fn=_moe_block)
 
 
+def verify_step(cfg: MixtralConfig, params: Params, tokens: jax.Array,
+                cache, start_pos, spec_len):
+    """Multi-token speculative verification for Mixtral: llama's dense
+    verify window with the dense-routed top-2 expert MLP swapped in —
+    per-token dense routing is composition-independent, so a verify
+    column's logits equal the 1-token step's by construction."""
+    return llama.verify_step(cfg, params, tokens, cache, start_pos,
+                             spec_len, mlp_fn=_moe_block)
+
+
+def verify_step_paged(cfg: MixtralConfig, params: Params,
+                      tokens: jax.Array, cache, table, start_pos,
+                      spec_len, *, window: int):
+    """Paged speculative verify window with the MoE MLP swapped in."""
+    return llama.verify_step_paged(cfg, params, tokens, cache, table,
+                                   start_pos, spec_len, window=window,
+                                   mlp_fn=_moe_block)
+
+
 def decode(cfg: MixtralConfig, params: Params, prompt: jax.Array,
            true_len: jax.Array, max_tokens: int, max_seq: int,
            temperature: float = 0.0,

@@ -23,7 +23,9 @@ Three layers:
        "decode_tokens": D,    # tokens emitted by the batched step
        "paged": 0|1, "kv_free": F|None, "kv_usable": U|None,
        "dispatch_s": <host dispatch seconds>|None,
-       "device_s": <sampled device-wait seconds>|None}
+       "device_s": <sampled device-wait seconds>|None,
+       "spec_drafted": D,     # speculative tokens drafted this step
+       "spec_accepted": A}    # ... and accepted by verification
 
   ``ts`` is wall clock (cross-host alignment); ``dur`` and ``mono``
   come from ``time.perf_counter()`` so an NTP step cannot corrupt a
@@ -151,6 +153,7 @@ class _Ring:
         self.phase_dur = {p: 0.0 for p in _PHASES}
         self.phase_steps = {p: 0 for p in _PHASES}
         self.tok_sum = {"prefill": 0, "decode": 0}
+        self.spec_sum = {"drafted": 0, "accepted": 0}
         self.dispatch_sum = 0.0
         self.dispatch_n = 0
         self.device_sum = 0.0
@@ -164,6 +167,8 @@ class _Ring:
         self.phase_steps[phase] += sign
         self.tok_sum["prefill"] += sign * rec["prefill_tokens"]
         self.tok_sum["decode"] += sign * rec["decode_tokens"]
+        self.spec_sum["drafted"] += sign * rec.get("spec_drafted", 0)
+        self.spec_sum["accepted"] += sign * rec.get("spec_accepted", 0)
         if rec.get("dispatch_s") is not None:
             self.dispatch_sum += sign * rec["dispatch_s"]
             self.dispatch_n += sign
@@ -254,9 +259,12 @@ def record(*, dur: float, phase: str, live_slots: int,
            kv_free: Optional[int] = None,
            kv_usable: Optional[int] = None,
            dispatch_s: Optional[float] = None,
-           device_s: Optional[float] = None) -> None:
+           device_s: Optional[float] = None,
+           spec_drafted: int = 0, spec_accepted: int = 0) -> None:
     """Append one engine-step record (engine compute thread only) and
-    refresh the derived metrics. Callers guard on ``ENABLED``."""
+    refresh the derived metrics. Callers guard on ``ENABLED``.
+    ``spec_drafted``/``spec_accepted`` are the speculative-decoding
+    draft/accept token counts of a verify step (0 on plain steps)."""
     if phase not in _PHASES:
         phase = "mixed"
     rec = {
@@ -274,6 +282,8 @@ def record(*, dur: float, phase: str, live_slots: int,
                       else int(kv_usable)),
         "dispatch_s": dispatch_s,
         "device_s": device_s,
+        "spec_drafted": int(spec_drafted),
+        "spec_accepted": int(spec_accepted),
     }
     with _lock:
         rec["seq"] = _ring.seq
@@ -388,6 +398,14 @@ def snapshot() -> Dict[str, Any]:
             "queue_depth": last["queue_depth"] if last else 0,
             "admissions": len(_admits),
         }
+        if _ring.spec_sum["drafted"]:
+            drafted = _ring.spec_sum["drafted"]
+            accepted = _ring.spec_sum["accepted"]
+            doc["spec"] = {
+                "drafted": drafted,
+                "accepted": accepted,
+                "accept_rate": round(accepted / drafted, 4),
+            }
         if _ring.dispatch_n:
             doc["dispatch_ms_mean"] = round(
                 _ring.dispatch_sum / _ring.dispatch_n * 1e3, 3)

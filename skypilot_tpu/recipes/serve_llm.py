@@ -69,15 +69,25 @@ ENGINE_PREFIX_CACHE_MB = float(
 # Paged KV block pool (decode_engine paged mode): one device-resident
 # pool + per-slot block tables instead of dense per-slot cache rows —
 # admission is free-block based and prefix hits alias blocks
-# zero-copy. Off by default this release; bit-identical to dense when
-# on (pinned by tests/test_paged_kv.py).
-ENGINE_KV_PAGED = os.environ.get("STPU_KV_PAGED", "0") == "1"
+# zero-copy. ON by default (bit-identical to dense, pinned by
+# tests/test_paged_kv.py); STPU_KV_PAGED=0 keeps the dense path
+# selectable until the splice path retires (ROADMAP).
+ENGINE_KV_PAGED = os.environ.get("STPU_KV_PAGED", "1") == "1"
 # 0 = auto-size the pool to the dense HBM budget
 # (slots * max_seq / block + 1 scratch).
 ENGINE_KV_POOL_BLOCKS = int(os.environ.get("STPU_KV_POOL_BLOCKS", "0"))
 # 0 = block size follows the prefill chunk (64).
 ENGINE_KV_BLOCK_TOKENS = int(
     os.environ.get("STPU_KV_BLOCK_TOKENS", "0"))
+# Self-speculative decoding (decode_engine spec mode): up to K n-gram
+# drafted tokens per slot per step, verified in one batched forward —
+# bit-identical output, fewer memory-bound passes per token on
+# repetitive/templated traffic. 0 disables (this release's default;
+# the bench legs and chat-heavy deployments turn it on).
+ENGINE_SPEC_K = int(os.environ.get("STPU_SPEC_K", "0"))
+ENGINE_SPEC_NGRAM = int(os.environ.get("STPU_SPEC_NGRAM", "3"))
+ENGINE_SPEC_MIN_ACCEPT = float(
+    os.environ.get("STPU_SPEC_MIN_ACCEPT", "0.2"))
 # Per-token stream timeout: how long a client handler waits for the
 # NEXT token before declaring the engine wedged (surfaced as a clean
 # EngineError, not a hang). Operator-tunable — the right bound is how
@@ -560,7 +570,10 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
           gang: "gang_replica.GangLeader" = None,
           kv_paged: bool = None,
           kv_pool_blocks: int = None,
-          kv_block_tokens: int = None
+          kv_block_tokens: int = None,
+          spec_k: int = None,
+          spec_ngram: int = None,
+          spec_min_accept: float = None
           ) -> ThreadingHTTPServer:
     """Start the replica server. ``engine_slots`` > 0 (default: env
     STPU_ENGINE_SLOTS or 4) serves through the continuous-batching
@@ -569,6 +582,9 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
     bounds the engine's shared-prefix KV pool; 0 disables it.
     ``stream_timeout`` (default: env STPU_STREAM_TIMEOUT or 600) is the
     per-token wait before a wedged engine surfaces as a clean error.
+    ``spec_k`` (default: env STPU_SPEC_K or 0) arms self-speculative
+    decoding — k n-gram-drafted tokens per slot verified in one
+    batched forward, bit-identical output.
     The engine runs under an EngineSupervisor: a crashed compute loop
     flips /health to 503 and is restarted with fresh state (capped
     backoff, ``engine_max_restarts`` consecutive fast failures →
@@ -595,6 +611,12 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
         kv_pool_blocks = ENGINE_KV_POOL_BLOCKS
     if kv_block_tokens is None:
         kv_block_tokens = ENGINE_KV_BLOCK_TOKENS
+    if spec_k is None:
+        spec_k = ENGINE_SPEC_K
+    if spec_ngram is None:
+        spec_ngram = ENGINE_SPEC_NGRAM
+    if spec_min_accept is None:
+        spec_min_accept = ENGINE_SPEC_MIN_ACCEPT
     ctx = {"cfg": cfg, "params": params, "lock": threading.Lock(),
            "ready": ready_event or threading.Event(), "engine": None,
            "stream_timeout": float(stream_timeout),
@@ -621,7 +643,10 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
                 mesh=mesh, rules=rules,
                 paged=bool(kv_paged),
                 kv_pool_blocks=int(kv_pool_blocks),
-                kv_block_tokens=int(kv_block_tokens))
+                kv_block_tokens=int(kv_block_tokens),
+                spec_k=int(spec_k),
+                spec_ngram=int(spec_ngram),
+                spec_min_accept=float(spec_min_accept))
 
         ctx["engine"] = decode_engine.EngineSupervisor(
             _engine_factory, max_restarts=engine_max_restarts,
@@ -663,6 +688,14 @@ def _resolve_kv(args) -> dict:
         "block_tokens": (int(args.kv_block_tokens)
                          if args.kv_block_tokens is not None
                          else ENGINE_KV_BLOCK_TOKENS),
+        "spec_k": (int(args.spec_k) if args.spec_k is not None
+                   else ENGINE_SPEC_K),
+        "spec_ngram": (int(args.spec_ngram)
+                       if args.spec_ngram is not None
+                       else ENGINE_SPEC_NGRAM),
+        "spec_min_accept": (float(args.spec_min_accept)
+                            if args.spec_min_accept is not None
+                            else ENGINE_SPEC_MIN_ACCEPT),
     }
 
 
@@ -723,6 +756,12 @@ def _spawn_follower_cmd(args, rank: int, topology, leader_port: int):
         argv += ["--kv-pool-blocks", str(args.kv_pool_blocks)]
     if args.kv_block_tokens is not None:
         argv += ["--kv-block-tokens", str(args.kv_block_tokens)]
+    if args.spec_k is not None:
+        argv += ["--spec-k", str(args.spec_k)]
+    if args.spec_ngram is not None:
+        argv += ["--spec-ngram", str(args.spec_ngram)]
+    if args.spec_min_accept is not None:
+        argv += ["--spec-min-accept", str(args.spec_min_accept)]
     return subprocess.Popen(argv, env=env, start_new_session=True)
 
 
@@ -773,6 +812,20 @@ def main(argv=None):
                    help="paged-KV block size in tokens (also the "
                         "prefill chunk; 0 = the default 64-token "
                         "chunk; default env STPU_KV_BLOCK_TOKENS)")
+    p.add_argument("--spec-k", type=int, default=None,
+                   help="speculative decoding: tokens drafted per "
+                        "slot per step from the slot's own n-gram "
+                        "history, verified in one batched forward (0 "
+                        "disables; default env STPU_SPEC_K or 0). "
+                        "Output is bit-identical either way — greedy "
+                        "AND seeded sampling")
+    p.add_argument("--spec-ngram", type=int, default=None,
+                   help="draft matcher n-gram length (default env "
+                        "STPU_SPEC_NGRAM or 3)")
+    p.add_argument("--spec-min-accept", type=float, default=None,
+                   help="per-slot acceptance-rate floor below which a "
+                        "slot stops drafting (default env "
+                        "STPU_SPEC_MIN_ACCEPT or 0.2)")
     p.add_argument("--stream-timeout", type=float, default=None,
                    help="seconds to wait for the NEXT token before "
                         "failing the request as engine-stalled "
@@ -825,7 +878,9 @@ def main(argv=None):
         max_seq=MAX_PROMPT_TOKENS + MAX_GEN_TOKENS,
         prefill_chunk=ENGINE_PREFILL_CHUNK, paged=kv["paged"],
         kv_pool_blocks=kv["pool_blocks"],
-        kv_block_tokens=kv["block_tokens"])
+        kv_block_tokens=kv["block_tokens"],
+        spec_k=kv["spec_k"], spec_ngram=kv["spec_ngram"],
+        spec_min_accept=kv["spec_min_accept"])
     if topology.hosts > 1 and rank > 0:
         # Non-zero hosts never front HTTP: they run the lockstep
         # follower loop against the leader's gang channel, mirroring
@@ -843,7 +898,10 @@ def main(argv=None):
                 mesh=mesh, rules=rules,
                 paged=kv["paged"],
                 kv_pool_blocks=kv["pool_blocks"],
-                kv_block_tokens=kv["block_tokens"])
+                kv_block_tokens=kv["block_tokens"],
+                spec_k=kv["spec_k"],
+                spec_ngram=kv["spec_ngram"],
+                spec_min_accept=kv["spec_min_accept"])
 
         sys.exit(gang_replica.follower_serve(
             _follower_engine, topology,
@@ -881,7 +939,9 @@ def main(argv=None):
                   topology=topology, mesh=mesh, rules=rules,
                   gang=gang, kv_paged=kv["paged"],
                   kv_pool_blocks=kv["pool_blocks"],
-                  kv_block_tokens=kv["block_tokens"])
+                  kv_block_tokens=kv["block_tokens"],
+                  spec_k=kv["spec_k"], spec_ngram=kv["spec_ngram"],
+                  spec_min_accept=kv["spec_min_accept"])
     if gang is not None and httpd.engine is not None:
         # Whole-gang restart rebuilds host 0's engine too.
         gang.set_engine_reset(httpd.engine.restart_now)

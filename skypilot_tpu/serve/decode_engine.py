@@ -60,10 +60,34 @@ no insert_cache_rows splice, no host round-trip) and publish-on-free
 is a refcount transfer instead of a gather_cache_rows D2H. Same HBM
 budget, strictly more live slots under mixed-length traffic.
 
+Self-speculative decoding (``spec_k > 0`` / STPU_SPEC_K): decode is
+memory-bound — every 1-token step streams the whole KV prefix and the
+params through HBM to emit ONE token per slot — so per-request speed
+is capped by bandwidth no matter how well slots batch. Speculation is
+the lever batching can't reach: a free n-gram / prompt-lookup matcher
+over each slot's OWN token history (prompt + output; an O(1)
+incremental index, no second model) drafts up to k tokens per slot
+per step, and one batched forward verifies all k+1 positions at once
+(models/*.verify_step — the (B,) start_pos/valid_len contract
+generalized to a (B, K+1) logits-at-positions window). Targets are
+re-sampled with the engine's own fold_in(seed, pos) keys, so
+acceptance is exact-match and the output stream is BIT-IDENTICAL to
+non-speculative decode for greedy and seeded sampling alike (under
+deterministic per-position keys, rejection sampling against a
+deterministic draft degenerates to exact match — stronger than
+distribution-preserving). A rejected suffix rolls back for free:
+dense rows past the accepted frontier stay valid_len-masked exactly
+like stale slot-reuse rows, and the paged path truncates the grown
+block-table tail back into the pool. Slots whose traffic doesn't
+repeat (acceptance below STPU_SPEC_MIN_ACCEPT) stop drafting
+automatically, so the worst case degrades to the plain step plus one
+dict lookup.
+
 Used by recipes/serve_llm.py (replacing its model-lock-per-request
 path) and benchmark/decode_bench.measure_engine_ragged (the
 `engine_ragged_tok_s` bench leg) / measure_engine_paged (the
-`engine_paged_tok_s` + pool-utilization legs).
+`engine_paged_tok_s` + pool-utilization legs) / measure_engine_spec
+(the `engine_spec_tok_s` + acceptance-rate legs).
 """
 from __future__ import annotations
 
@@ -145,6 +169,18 @@ _ZERO_COPY_HITS = metrics.counter(
     "Prefix-cache hits served by aliasing pool blocks into the "
     "slot's block table — no insert/gather copies, no host "
     "round-trip.")
+_SPEC_DRAFTED = metrics.counter(
+    "stpu_engine_spec_drafted_tokens_total",
+    "Tokens drafted by the self-speculative n-gram matcher and "
+    "submitted to a batched verify step.")
+_SPEC_ACCEPTED = metrics.counter(
+    "stpu_engine_spec_accepted_tokens_total",
+    "Drafted tokens accepted by verification (emitted without their "
+    "own decode step).")
+_SPEC_ACCEPT_RATE = metrics.histogram(
+    "stpu_engine_spec_accept_rate",
+    "Per-verify-step draft acceptance rate (accepted / drafted).",
+    buckets=(0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0))
 _RESTARTS = metrics.counter(
     "stpu_engine_restarts_total",
     "Engine restarts by the supervisor after a compute-loop crash.")
@@ -169,6 +205,11 @@ class Request:
         self.max_tokens = int(max_tokens)
         self.temperature = float(temperature)
         self.seed = int(seed) & 0xFFFFFFFF
+        # Speculative-decoding accounting (engine-set): tokens this
+        # request's slot drafted / had accepted by verification. Zero
+        # while speculation is off.
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         self.submitted_at = time.perf_counter()
         self.first_token_at: Optional[float] = None
         self.error: Optional[str] = None
@@ -236,7 +277,8 @@ class _Slot:
     """Host-side state of one cache row (or, paged, one block table)."""
 
     __slots__ = ("request", "pos", "generated", "prefilled", "tok",
-                 "held", "cached", "blocks", "reserved")
+                 "held", "cached", "blocks", "reserved", "history",
+                 "ngram_index", "drafted", "accepted", "spec_off")
 
     def __init__(self):
         self.request: Optional[Request] = None
@@ -248,6 +290,16 @@ class _Slot:
         self.cached = 0       # prompt tokens restored from the pool
         self.blocks = 0       # paged: valid block-table entries
         self.reserved = 0     # paged: blocks still promised, unclaimed
+        # Speculative decoding (spec_k > 0 only): the slot's full
+        # token history (prompt + emitted), an incremental n-gram ->
+        # last-start index over it (O(1) draft lookup), and the
+        # drafted/accepted counters the auto-disable threshold and the
+        # engine.verify span read.
+        self.history: List[int] = []
+        self.ngram_index: Dict[tuple, int] = {}
+        self.drafted = 0
+        self.accepted = 0
+        self.spec_off = False
 
 
 class _ChunkNode:
@@ -513,6 +565,70 @@ def _engine_step(cfg, params, cache, toks, pos, temps, seeds):
     return nxt, cache
 
 
+def _sample_multi(logits, seeds, pos, temps):
+    """Per-slot, per-column target sampling for a verify window:
+    column j of ``logits`` (B, T, vocab) is the distribution of the
+    token at absolute position pos + j + 1, so its key is the SAME
+    fold_in(fold_in(root, seed), pos + j + 1) the 1-token step would
+    fold — which is what makes speculative output bit-identical to
+    non-speculative decode for greedy AND seeded sampling (under
+    per-position keys, rejection sampling against a deterministic
+    draft collapses to exact-match verification)."""
+    t = logits.shape[1]
+    positions = pos[:, None] + 1 + jnp.arange(t)[None, :]   # (B, T)
+    return jax.vmap(
+        lambda lg, p: _sample(lg, seeds, p, temps),
+        in_axes=(1, 1), out_axes=1)(logits, positions)
+
+
+def _accept_counts(toks, targets, spec_len):
+    """Leading-match acceptance: drafts toks[:, 1:] are accepted up to
+    the first position where the draft disagrees with the target the
+    engine's sampler would have emitted (and never past the slot's
+    real draft count ``spec_len``). Returns (B,) accepted counts."""
+    k = toks.shape[1] - 1
+    match = ((toks[:, 1:] == targets[:, :-1]) &
+             (jnp.arange(k)[None, :] < spec_len[:, None]))
+    return jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                   axis=1)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _spec_step(cfg, params, cache, toks, pos, spec_len, temps, seeds):
+    """One speculative verify step over ALL slots (dense cache): each
+    slot's window [last token, draft_1..draft_k, padding] forwards in
+    one pass (models verify_step), targets are sampled per position
+    with the engine's fold_in(seed, pos) keys, and drafts are accepted
+    up to the first mismatch. Returns (targets (B, T), accepts (B,),
+    cache) — the engine emits targets[b, :accepts[b] + 1] per live
+    slot, so the device->host transfer is two small int arrays, never
+    the (B, T, vocab) logits. The cache is donated (in-place update);
+    rejected-suffix rows beyond each slot's accepted frontier stay
+    masked exactly like any stale slot-reuse row."""
+    api = model_api(cfg)
+    logits, cache = api.verify_step(cfg, params, toks, cache, pos,
+                                    spec_len)
+    targets = _sample_multi(logits, seeds, pos, temps)
+    return targets, _accept_counts(toks, targets, spec_len), cache
+
+
+@functools.partial(jax.jit, static_argnums=(0, 7),
+                   donate_argnums=(2,))
+def _paged_spec_step(cfg, params, cache, toks, pos, spec_len, table,
+                     window, temps, seeds):
+    """The paged twin of :func:`_spec_step`: the verify window writes
+    and gathers through each slot's block table (models
+    verify_step_paged); the pool is donated. The engine truncates the
+    rejected suffix's blocks back afterwards (block-table truncate +
+    reservation return)."""
+    api = model_api(cfg)
+    logits, cache = api.verify_step_paged(cfg, params, toks, cache,
+                                          table, pos, spec_len,
+                                          window=window)
+    targets = _sample_multi(logits, seeds, pos, temps)
+    return targets, _accept_counts(toks, targets, spec_len), cache
+
+
 @jax.jit
 def _sample(logits, seeds, positions, temps):
     """Per-slot sampling, reproducible per request: the key for the
@@ -533,22 +649,32 @@ def _sample(logits, seeds, positions, temps):
 def resolve_kv_geometry(*, slots: int, max_seq: int,
                         prefill_chunk: int = 64, paged: bool = False,
                         kv_pool_blocks: int = 0,
-                        kv_block_tokens: int = 0) -> Dict[str, int]:
+                        kv_block_tokens: int = 0,
+                        spec_k: int = 0, spec_ngram: int = 3,
+                        spec_min_accept: float = 0.0
+                        ) -> Dict[str, Any]:
     """EFFECTIVE KV-cache geometry for an engine config — the single
     derivation DecodeEngine.__init__, kv_config() and the gang
     kv-handshake all share, so auto-sized values (pool blocks, shrunk
     chunk, attention window, table length) can never drift between
     what an engine actually runs and what the gang compares. Raw
     knobs are NOT comparable across hosts: two hosts with identical
-    STPU_KV_* but different slot counts auto-size different pools."""
+    STPU_KV_* but different slot counts auto-size different pools.
+    The speculative-decoding knobs ride along: draft/accept decisions
+    are a pure function of the mirrored admission sequence ONLY when
+    every host drafts identically, so a spec mismatch must fail the
+    handshake like a pool mismatch would."""
     max_seq = int(max_seq)
     if paged and kv_block_tokens:
         prefill_chunk = int(kv_block_tokens)
     chunk = max(min(int(prefill_chunk), max_seq), 1)
     while max_seq % chunk:
         chunk //= 2
-    out = {"paged": int(bool(paged)), "slots": int(slots),
-           "max_seq": max_seq, "chunk": chunk}
+    out: Dict[str, Any] = {
+        "paged": int(bool(paged)), "slots": int(slots),
+        "max_seq": max_seq, "chunk": chunk,
+        "spec_k": int(spec_k), "spec_ngram": int(spec_ngram),
+        "spec_min_accept": float(spec_min_accept)}
     if paged:
         total = int(kv_pool_blocks) or (
             int(slots) * (max_seq // chunk) + 1)
@@ -574,15 +700,32 @@ class DecodeEngine:
                  max_seq: int = 1024, prefill_chunk: int = 64,
                  max_queue: int = 256, prefix_cache_mb: float = 0.0,
                  mesh=None, rules=None, paged: bool = False,
-                 kv_pool_blocks: int = 0, kv_block_tokens: int = 0):
+                 kv_pool_blocks: int = 0, kv_block_tokens: int = 0,
+                 spec_k: int = 0, spec_ngram: int = 3,
+                 spec_min_accept: float = 0.0):
         if slots < 1:
             raise ValueError("slots must be >= 1")
+        if spec_k < 0:
+            raise ValueError("spec_k must be >= 0 (0 disables)")
+        if spec_k and spec_ngram < 1:
+            raise ValueError("spec_ngram must be >= 1")
         self._cfg = cfg
         self._params = params
         self._api = model_api(cfg)
         self._slots = [_Slot() for _ in range(slots)]
         self._max_seq = int(max_seq)
         self._paged = bool(paged)
+        # Self-speculative decoding (module docstring): k drafted
+        # tokens per slot per step, verified in one batched forward.
+        # 0 disables — the decode step is then byte-for-byte the
+        # pre-speculation path.
+        self._spec_k = int(spec_k)
+        self._spec_ngram = int(spec_ngram)
+        self._spec_min_accept = float(spec_min_accept)
+        # Per-verify-step telemetry scratch (consumed by _record_step
+        # while stepstats is armed).
+        self._step_spec_drafted = 0
+        self._step_spec_accepted = 0
         self.peak_live_slots = 0
         # Tensor-parallel serving (serve/gang_replica.py): with a mesh,
         # params arrive pre-sharded (ShardingRules over param_specs)
@@ -605,7 +748,9 @@ class DecodeEngine:
             slots=slots, max_seq=self._max_seq,
             prefill_chunk=prefill_chunk, paged=self._paged,
             kv_pool_blocks=kv_pool_blocks,
-            kv_block_tokens=kv_block_tokens)
+            kv_block_tokens=kv_block_tokens, spec_k=self._spec_k,
+            spec_ngram=self._spec_ngram,
+            spec_min_accept=self._spec_min_accept)
         self._kv_geometry = geo
         chunk = geo["chunk"]
         self._chunk = chunk
@@ -739,11 +884,12 @@ class DecodeEngine:
     def draining(self) -> bool:
         return self._draining
 
-    def kv_config(self) -> Dict[str, int]:
+    def kv_config(self) -> Dict[str, Any]:
         """The engine's EFFECTIVE KV-cache geometry
-        (resolve_kv_geometry output — auto-sized pool included), the
-        piece of state a gang leader and its followers must agree on
-        byte-for-byte or admission/backpressure decisions diverge
+        (resolve_kv_geometry output — auto-sized pool and the
+        speculative-decoding knobs included), the piece of state a
+        gang leader and its followers must agree on byte-for-byte or
+        admission/backpressure (and draft/accept) decisions diverge
         across hosts. serve_llm derives the same dict via
         resolve_kv_geometry for the welcome handshake."""
         return dict(self._kv_geometry)
@@ -859,6 +1005,19 @@ class DecodeEngine:
                     status="error" if error else "ok",
                     attrs={"tokens": slot.generated,
                            "outcome": outcome})
+                if slot.drafted:
+                    # Speculative-verify child span: one retroactive
+                    # summary per request (a span per verify STEP
+                    # would be token-granular spam), so a trace shows
+                    # how much of the stream speculation paid for.
+                    tracing.record_span(
+                        "engine.verify", "engine", req.trace,
+                        start_mono=(req.first_token_at
+                                    or req.submitted_at),
+                        attrs={"drafted": slot.drafted,
+                               "accepted": slot.accepted,
+                               "accept_rate": round(
+                                   slot.accepted / slot.drafted, 4)})
             slot.request._finish(error)
             _REQUESTS.labels(outcome=outcome).inc()
         if self._paged:
@@ -869,6 +1028,10 @@ class DecodeEngine:
         slot.request = None
         slot.pos = slot.generated = slot.prefilled = slot.tok = 0
         slot.cached = 0
+        slot.history = []
+        slot.ngram_index = {}
+        slot.drafted = slot.accepted = 0
+        slot.spec_off = False
         # Gauge updated HERE so every free path (finish, cancel during
         # prefill, cache-full) is reflected even while the loop idles.
         _SLOTS_OCCUPIED.set(len(self._live()))
@@ -1057,6 +1220,18 @@ class DecodeEngine:
                                 start_mono=t0, end_mono=t1,
                                 attrs=attrs)
 
+    def _emit_token(self, slot: "_Slot", tok: int) -> None:
+        """ONE emission seam for all three token producers (final
+        prefill chunk, plain decode step, speculative verify step):
+        last-token state, the draft history index, the client queue
+        and the token counter advance together and can never drift."""
+        slot.tok = tok
+        slot.generated += 1
+        if self._spec_k:
+            self._spec_track(slot, tok)
+        slot.request._emit(tok)
+        _TOKENS.inc()
+
     def _prefill_one(self) -> int:
         """Advance the first slot with un-prefilled prompt by ONE
         chunk; on the final chunk, sample and emit the first token.
@@ -1070,6 +1245,11 @@ class DecodeEngine:
             if req.cancelled:
                 self._free_slot(i, outcome="cancelled")
                 continue
+            if self._spec_k and not slot.history:
+                # Every request passes through here at least once (the
+                # prefix cache always leaves >= 1 trailing prompt token
+                # to prefill), so this is the one draft-state seam.
+                self._spec_init(slot, req)
             if tracing.ENABLED and req.trace is not None \
                     and req.trace.sampled and req.prefill_start is None:
                 req.prefill_start = time.perf_counter()
@@ -1117,10 +1297,7 @@ class DecodeEngine:
                     logits[None], jnp.asarray([req.seed], jnp.uint32),
                     jnp.asarray([valid], jnp.int32),
                     jnp.asarray([req.temperature], jnp.float32))[0])
-                slot.tok = tok
-                slot.generated = 1
-                req._emit(tok)
-                _TOKENS.inc()
+                self._emit_token(slot, tok)
                 if self.prefix_cache is not None:
                     _PREFIX_TTFT.labels(
                         cache="hit" if slot.cached else "miss").observe(
@@ -1157,16 +1334,63 @@ class DecodeEngine:
                               if self._paged else self._max_seq):
             self._free_slot(i, outcome="cache_full")
 
-    def _decode_step(self) -> int:
-        """One batched step over every slot whose prompt is fully
-        prefilled and which still owes tokens. Returns the number of
-        tokens emitted (0 = no decode work)."""
-        live = [i for i in self._live()
-                if self._slots[i].prefilled >=
-                len(self._slots[i].request.prompt)]
-        if not live:
-            return 0
-        toks = jnp.asarray([s.tok for s in self._slots], jnp.int32)
+    # -------------------------------------------- speculative decoding
+    def _spec_init(self, slot: "_Slot", req: Request) -> None:
+        """Seed the slot's draft state from the prompt (spec_k > 0
+        only): the token history plus an incremental n-gram ->
+        latest-start index over every n-gram FULLY inside
+        history[:-1]. The final n-gram registers lazily when the next
+        token lands (:meth:`_spec_track`), so a lookup pattern can
+        never match itself. Called LAZILY from the compute thread's
+        first prefill touch, never under the admission condition — the
+        O(prompt) index build on a multi-thousand-token prompt must
+        not stall concurrent submit() callers."""
+        slot.history = list(req.prompt)
+        slot.ngram_index = {}
+        slot.drafted = slot.accepted = 0
+        slot.spec_off = False
+        h, n = slot.history, self._spec_ngram
+        for s in range(len(h) - n):
+            slot.ngram_index[tuple(h[s:s + n])] = s
+
+    def _spec_track(self, slot: "_Slot", tok: int) -> None:
+        """Append an emitted token to the slot's history and index the
+        n-gram that just became FULLY interior (ends at the previous
+        token). O(1) per token — the draft lookup is a dict get, not a
+        scan, so drafting costs the hot loop nothing measurable."""
+        h = slot.history
+        h.append(tok)
+        s = len(h) - self._spec_ngram - 1
+        if s >= 0:
+            slot.ngram_index[tuple(h[s:s + self._spec_ngram])] = s
+
+    def _draft(self, slot: "_Slot") -> List[int]:
+        """n-gram / prompt-lookup draft over the slot's OWN history:
+        the most recent earlier occurrence of the last n tokens
+        proposes its continuation — free (no second model), and strong
+        exactly on the shared-prefix / templated / self-repeating
+        output mixes production chat traffic is made of. Clamped to
+        remaining - 1 tokens so even a fully-accepted window never
+        writes past the request's admission-reserved worst case."""
+        req = slot.request
+        if slot.spec_off:
+            return []
+        k = min(self._spec_k, req.max_tokens - slot.generated - 1)
+        if k <= 0:
+            return []
+        h, n = slot.history, self._spec_ngram
+        if len(h) < n + 1:
+            return []
+        s = slot.ngram_index.get(tuple(h[-n:]))
+        if s is None:
+            return []
+        return h[s + n:s + n + k]
+
+    def _step_inputs(self, live: List[int]):
+        """(pos, temps, seeds) batch vectors shared by the plain
+        decode step and the speculative verify step — free slots ride
+        with temp 0 / seed 0 and are ignored host-side. ONE builder so
+        the two paths can never sample from different inputs."""
         pos = jnp.asarray([s.pos for s in self._slots], jnp.int32)
         temps = jnp.asarray(
             [s.request.temperature if i in live else 0.0
@@ -1174,6 +1398,139 @@ class DecodeEngine:
         seeds = jnp.asarray(
             [s.request.seed if i in live else 0
              for i, s in enumerate(self._slots)], jnp.uint32)
+        return pos, temps, seeds
+
+    def _stamp_dispatch(self, t0: float, synced) -> None:
+        """Step-telemetry dispatch/device split, shared by both decode
+        paths (armed only — callers guard on stepstats.ENABLED): the
+        jitted call returned at DISPATCH (device still executing), so
+        the gap from t0 is host dispatch work; every Nth step the
+        sanctioned sampled_sync times the remaining device wait."""
+        self._step_dispatch_s = time.perf_counter() - t0
+        self._step_device_s = (stepstats.sampled_sync(synced)
+                               if stepstats.sync_due() else None)
+
+    def _verify_decode_step(self, live: List[int],
+                            drafts: Dict[int, List[int]]) -> int:
+        """One speculative verify step replacing the 1-token decode
+        step: all live slots' [last token, drafts...] windows forward
+        in a single batched pass, targets are re-sampled with the
+        engine's own per-position keys, and each slot emits its
+        accepted prefix plus the correction token — 1..k+1 tokens for
+        one memory-bound pass. Rollback of a rejected suffix is a
+        host-side frontier rewind (dense: rows past the frontier stay
+        masked; paged: the grown block-table tail is truncated and its
+        reservation returned). Returns tokens emitted."""
+        t = self._spec_k + 1
+        toks_np = np.zeros((len(self._slots), t), np.int32)
+        spec_np = np.zeros((len(self._slots),), np.int32)
+        for i, slot in enumerate(self._slots):
+            toks_np[i, 0] = slot.tok
+        for i in live:
+            d = drafts.get(i)
+            if d:
+                toks_np[i, 1:1 + len(d)] = d
+                spec_np[i] = len(d)
+        pos, temps, seeds = self._step_inputs(live)
+        t0 = time.perf_counter()
+        if fault_injection.ENABLED:
+            fault_injection.fire("engine.verify", live=len(live),
+                                 drafted=int(spec_np.sum()))
+        if self._paged:
+            # Back every position the window may write from the slots'
+            # admission reservations (the remaining-1 draft clamp keeps
+            # the window inside the reserved worst case).
+            for i in live:
+                slot = self._slots[i]
+                for j in range(slot.pos // self._chunk,
+                               (slot.pos + int(spec_np[i]))
+                               // self._chunk + 1):
+                    self._ensure_block(i, j)
+            targets, accepts, self._cache = _paged_spec_step(
+                self._cfg, self._params, self._cache,
+                jnp.asarray(toks_np), pos, jnp.asarray(spec_np),
+                jnp.asarray(self._table), self._window, temps, seeds)
+        else:
+            targets, accepts, self._cache = _spec_step(
+                self._cfg, self._params, self._cache,
+                jnp.asarray(toks_np), pos, jnp.asarray(spec_np),
+                temps, seeds)
+        if stepstats.ENABLED:
+            self._stamp_dispatch(t0, accepts)
+        targets = jax.device_get(targets)
+        accepts = jax.device_get(accepts)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        emitted = 0
+        drafted_step = accepted_step = 0
+        for i in live:
+            slot = self._slots[i]
+            req = slot.request
+            k_i = int(spec_np[i])
+            a = int(accepts[i])
+            base_pos = slot.pos
+            for j in range(a + 1):
+                self._emit_token(slot, int(targets[i, j]))
+            slot.pos = base_pos + a + 1
+            emitted += a + 1
+            if k_i:
+                slot.drafted += k_i
+                slot.accepted += a
+                req.spec_drafted += k_i
+                req.spec_accepted += a
+                drafted_step += k_i
+                accepted_step += a
+                if (not slot.spec_off and slot.drafted >= 16
+                        and slot.accepted <
+                        self._spec_min_accept * slot.drafted):
+                    # This slot's traffic doesn't repeat: every future
+                    # draft would widen the verify window for nothing.
+                    slot.spec_off = True
+            if self._paged:
+                # Block-table truncate: blocks grown for the rejected
+                # suffix go back (refcount 1 — decode blocks are never
+                # shared) and their reservation draws are RE-PROMISED
+                # (release + reserve is atomic on this thread, and the
+                # just-freed block guarantees available() >= 1), so
+                # the preemption-free admission invariant holds: the
+                # slot keeps its worst case, it just returns the
+                # physical blocks until the frontier really gets there.
+                needed = (base_pos + a) // self._chunk + 1
+                while slot.blocks > needed:
+                    j = slot.blocks - 1
+                    self._pool.release(int(self._table[i, j]))
+                    self._pool.reserve(1)
+                    self._table[i, j] = 0
+                    slot.blocks = j
+                    slot.reserved += 1
+            self._maybe_finish(i)
+        if drafted_step:
+            _SPEC_DRAFTED.inc(drafted_step)
+            _SPEC_ACCEPTED.inc(accepted_step)
+            _SPEC_ACCEPT_RATE.observe(accepted_step / drafted_step)
+        if stepstats.ENABLED:
+            self._step_spec_drafted = drafted_step
+            self._step_spec_accepted = accepted_step
+        _TOK_RATE.observe(emitted / dt)
+        _SLOTS_OCCUPIED.set(len(self._live()))
+        return emitted
+
+    def _decode_step(self) -> int:
+        """One batched step over every slot whose prompt is fully
+        prefilled and which still owes tokens — a speculative verify
+        step when drafting is on and any slot found a draft, else the
+        plain 1-token step. Returns the number of tokens emitted
+        (0 = no decode work)."""
+        live = [i for i in self._live()
+                if self._slots[i].prefilled >=
+                len(self._slots[i].request.prompt)]
+        if not live:
+            return 0
+        if self._spec_k:
+            drafts = {i: self._draft(self._slots[i]) for i in live}
+            if any(drafts.values()):
+                return self._verify_decode_step(live, drafts)
+        toks = jnp.asarray([s.tok for s in self._slots], jnp.int32)
+        pos, temps, seeds = self._step_inputs(live)
         t0 = time.perf_counter()
         if fault_injection.ENABLED:
             fault_injection.fire("engine.step", live=len(live))
@@ -1191,25 +1548,14 @@ class DecodeEngine:
                 self._cfg, self._params, self._cache, toks, pos, temps,
                 seeds)
         if stepstats.ENABLED:
-            # The jitted call returned at DISPATCH (device still
-            # executing): the gap from t0 is host dispatch work. Every
-            # Nth step the sanctioned sampled_sync times the remaining
-            # device wait — the only sync this loop is allowed beyond
-            # the token fetch below (stpu-host-sync blesses exactly
-            # stepstats.sampled_sync).
-            self._step_dispatch_s = time.perf_counter() - t0
-            self._step_device_s = (stepstats.sampled_sync(nxt)
-                                   if stepstats.sync_due() else None)
+            self._stamp_dispatch(t0, nxt)
         nxt = jax.device_get(nxt)
         dt = max(time.perf_counter() - t0, 1e-9)
         _TOK_RATE.observe(len(live) / dt)
         for i in live:
             slot = self._slots[i]
             slot.pos += 1
-            slot.tok = int(nxt[i])
-            slot.generated += 1
-            slot.request._emit(slot.tok)
-            _TOKENS.inc()
+            self._emit_token(slot, int(nxt[i]))
             self._maybe_finish(i)
         _SLOTS_OCCUPIED.set(len(self._live()))
         return len(live)
@@ -1230,9 +1576,13 @@ class DecodeEngine:
             prefill_tokens=pf, decode_tokens=dc, paged=self._paged,
             kv_free=kv_free, kv_usable=kv_usable,
             dispatch_s=self._step_dispatch_s if dc else None,
-            device_s=self._step_device_s if dc else None)
+            device_s=self._step_device_s if dc else None,
+            spec_drafted=self._step_spec_drafted if dc else 0,
+            spec_accepted=self._step_spec_accepted if dc else 0)
         self._step_dispatch_s = None
         self._step_device_s = None
+        self._step_spec_drafted = 0
+        self._step_spec_accepted = 0
 
     def _loop(self) -> None:
         try:
@@ -1398,7 +1748,7 @@ class EngineSupervisor:
     def draining(self) -> bool:
         return self._draining
 
-    def kv_config(self) -> Dict[str, int]:
+    def kv_config(self) -> Dict[str, Any]:
         engine = self._engine
         return engine.kv_config() if engine is not None else {}
 

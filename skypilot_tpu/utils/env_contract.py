@@ -139,10 +139,22 @@ _KNOBS = (
     # ------------------------------------------------ serve engine
     _k("STPU_ENGINE_SLOTS", "4",
        "Decode-engine slot count (continuous-batching concurrency)."),
-    _k("STPU_KV_PAGED", "0",
-       "\"1\" serves from the paged KV block pool (one device pool + "
-       "per-slot block tables, zero-copy prefix aliasing) instead of "
-       "dense per-slot cache rows."),
+    _k("STPU_KV_PAGED", "1",
+       "\"0\" falls back to dense per-slot cache rows; default serves "
+       "from the paged KV block pool (one device pool + per-slot "
+       "block tables, zero-copy prefix aliasing). Bit-identical "
+       "either way."),
+    _k("STPU_SPEC_K", "0",
+       "Speculative decoding: tokens drafted per slot per decode "
+       "step, verified in one batched forward (0 disables; output "
+       "stays bit-identical to non-speculative decode)."),
+    _k("STPU_SPEC_NGRAM", "3",
+       "Speculative draft matcher n-gram length over each slot's own "
+       "token history (prompt lookup)."),
+    _k("STPU_SPEC_MIN_ACCEPT", "0.2",
+       "Per-slot draft acceptance-rate floor: a slot whose measured "
+       "acceptance falls below it (after >= 16 drafted tokens) stops "
+       "drafting."),
     _k("STPU_KV_POOL_BLOCKS", "0",
        "Paged-KV pool size in blocks incl. the scratch block (0 = "
        "auto: slots * max_seq / block + 1, the dense HBM budget)."),

@@ -52,6 +52,10 @@ Known points (callers may add more; names are dotted subsystem.seam):
                       batched decode step — an engine-loop crash
     engine.prefill    decode_engine._prefill_one, before a prefill
                       chunk — a crash while admitting a prompt
+    engine.verify     decode_engine._verify_decode_step, before the
+                      jitted speculative verify pass — a crash inside
+                      a multi-token verification step (rides the same
+                      EngineSupervisor restart ladder as engine.step)
     replica.probe     replica_managers._http_probe — a failed
                       readiness probe
     controller.sync   load_balancer.run_lb_process — the LB's

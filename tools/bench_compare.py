@@ -37,6 +37,7 @@ DEFAULT_METRICS = (
     "detail.serving.*_decode_tok_s_b*",
     "detail.serving.*_engine_ragged_tok_s",
     "detail.serving.*_engine_paged_tok_s",
+    "detail.serving.*_engine_spec_tok_s",
     "detail.serving.*_kv_pool_utilization",
     "detail.serving.*_engine_tp_tok_s",
     "detail.serving.*_engine_prefix_tok_s",

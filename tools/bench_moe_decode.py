@@ -26,8 +26,8 @@ def main() -> None:
     p.add_argument("--family", choices=("mixtral", "llama", "gemma"),
                    default="mixtral")
     p.add_argument("--mode", choices=("fixed", "engine", "paged",
-                                      "prefix", "ckpt", "loadgen",
-                                      "tp"),
+                                      "spec", "prefix", "ckpt",
+                                      "loadgen", "tp"),
                    default="fixed",
                    help="fixed: bucketed batch decode (r01-r05 "
                         "comparable); engine: continuous-batching "
@@ -35,7 +35,13 @@ def main() -> None:
                         "the engine on the paged KV block pool (one "
                         "device pool + block tables, half the dense "
                         "HBM budget) under a mixed-length mix — "
-                        "tok/s + pool utilization; prefix: "
+                        "tok/s + pool utilization; spec: "
+                        "self-speculative decoding (n-gram drafts + "
+                        "one batched verify pass) on the chat "
+                        "shared-prefix mix, with the same-mix "
+                        "non-speculative baseline and acceptance "
+                        "rate — streams bit-asserted identical; "
+                        "prefix: "
                         "engine under shared-prefix traffic with the "
                         "shared-prefix KV cache on (warm/cold TTFT "
                         "split + hit rate); ckpt: crash-consistent "
@@ -61,6 +67,8 @@ def main() -> None:
                    help="engine mode: ragged requests submitted")
     p.add_argument("--shared-prefix", type=int, default=256,
                    help="prefix mode: shared system-prompt tokens")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="spec mode: drafted tokens per slot per step")
     p.add_argument("--qps", type=float, default=6.0,
                    help="loadgen mode: offered Poisson arrival rate")
     p.add_argument("--duration", type=float, default=8.0,
@@ -111,6 +119,10 @@ def main() -> None:
         result = decode_bench.measure_engine_paged(
             args.family, slots=args.slots, n_requests=args.requests,
             **shape_kw)
+    elif args.mode == "spec":
+        result = decode_bench.measure_engine_spec(
+            args.family, slots=args.slots, n_requests=args.requests,
+            spec_k=args.spec_k, **shape_kw)
     elif args.mode == "prefix":
         result = decode_bench.measure_engine_prefix(
             args.family, slots=args.slots,
