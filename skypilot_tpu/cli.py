@@ -556,6 +556,25 @@ def _resolve_service_url(url, service):
     return None
 
 
+def _watch_render(render_once, watch: bool,
+                  interval: float = 2.0) -> None:
+    """The shared --watch loop (`stpu metrics`, `stpu perf`,
+    `stpu top`): render once, or clear-screen + re-render every
+    ``interval`` seconds until Ctrl-C — which exits cleanly, not with
+    a traceback (the interrupt is how a watch is MEANT to end)."""
+    if not watch:
+        render_once()
+        return
+    import time as time_lib
+    try:
+        while True:
+            click.clear()
+            render_once()
+            time_lib.sleep(interval)
+    except KeyboardInterrupt:
+        pass
+
+
 def _counter_samples(text: str) -> dict:
     """``{series-id: value}`` for every counter-family sample in an
     exposition document. Series ids are the literal ``name{labels}``
@@ -615,11 +634,13 @@ def _annotate_counter_rates(text: str, prev: dict, dt: float) -> str:
 @click.option("--service", "-s", default=None,
               help="Scrape the named service's LB endpoint.")
 @click.option("--watch", "-w", is_flag=True,
-              help="Refresh every 2 seconds until interrupted; "
-                   "counter families additionally show the "
-                   "per-interval rate (delta/dt) next to the "
-                   "cumulative value.")
-def metrics_cmd(url, service, watch):
+              help="Refresh until interrupted; counter families "
+                   "additionally show the per-interval rate "
+                   "(delta/dt) next to the cumulative value.")
+@click.option("--interval", "-n", type=float, default=2.0,
+              show_default=True,
+              help="Refresh period for --watch, seconds.")
+def metrics_cmd(url, service, watch, interval):
     """Render Prometheus metrics: the local registry by default, a serve
     LB's /metrics with --url/--service (same exposition `curl
     $LB/metrics` returns)."""
@@ -652,13 +673,7 @@ def metrics_cmd(url, service, watch):
             prev["mono"] = now
         click.echo(text if text.strip() else "(no metrics recorded)")
 
-    if not watch:
-        render_once()
-        return
-    while True:
-        click.clear()
-        render_once()
-        time_lib.sleep(2.0)
+    _watch_render(render_once, watch, interval)
 
 
 def _fmt_ms(seconds) -> str:
@@ -770,9 +785,12 @@ class _PerfGroup(click.Group):
               help="Fetch a replica's (or LB's) /perf endpoint "
                    "directly.")
 @click.option("--watch", "-w", is_flag=True,
-              help="Refresh every 2 seconds until interrupted.")
+              help="Refresh until interrupted.")
+@click.option("--interval", "-n", type=float, default=2.0,
+              show_default=True,
+              help="Refresh period for --watch, seconds.")
 @click.pass_context
-def perf(ctx, service, url, watch):
+def perf(ctx, service, url, watch, interval):
     """Per-step engine performance telemetry (arm with
     STPU_STEPSTATS=1 on the replicas).
 
@@ -782,8 +800,6 @@ def perf(ctx, service, url, watch):
     merged view. See docs/observability.md."""
     if ctx.invoked_subcommand is not None:
         return
-    import time as time_lib
-
     from skypilot_tpu import core
     target = _resolve_service_url(url, service)
     if target is None:
@@ -799,13 +815,7 @@ def perf(ctx, service, url, watch):
             raise click.ClickException(f"fetch failed: {e}") from e
         click.echo(_render_perf_doc(doc))
 
-    if not watch:
-        render_once()
-        return
-    while True:
-        click.clear()
-        render_once()
-        time_lib.sleep(2.0)
+    _watch_render(render_once, watch, interval)
 
 
 @perf.command(name="dump")
@@ -928,6 +938,177 @@ def profile_cmd(service, url, seconds):
             from e
     click.echo(f"capturing {doc.get('seconds')}s of profile to "
                f"{doc.get('profile_dir')} (replica-side)")
+
+
+def _fmt_val(v, fmt="{:.1f}", dash="-") -> str:
+    """Format a fleet-store reading, rendering missing data (None —
+    e.g. an empty histogram window whose quantile would be NaN) as
+    ``-`` instead of crashing or printing nan."""
+    if v is None:
+        return dash
+    try:
+        return fmt.format(float(v))
+    except (TypeError, ValueError):
+        return dash
+
+
+def _slo_lines(slo: dict) -> list:
+    lines = []
+    if not slo or not slo.get("objectives"):
+        lines.append("slo        (no objectives declared — add a "
+                     "service.slo section to the YAML)")
+        return lines
+    lines.append(
+        "slo        fast {}s / slow {}s windows, breach at burn >= {}"
+        .format(int(slo.get("fast_window_s", 0)),
+                int(slo.get("slow_window_s", 0)),
+                slo.get("burn_threshold", 1.0)))
+    lines.append("{:<12} {:>8} {:>10} {:>10} {:>10} {:>9}  {}".format(
+        "OBJECTIVE", "TARGET", "THRESHOLD", "BURN-FAST", "BURN-SLOW",
+        "BUDGET", "STATE"))
+    for obj in slo["objectives"]:
+        lines.append(
+            "{:<12} {:>8} {:>10} {:>10} {:>10} {:>9}  {}".format(
+                obj.get("kind", "?"),
+                _fmt_val(obj.get("target"), "{:.3g}"),
+                (_fmt_ms(obj.get("threshold_seconds"))
+                 if obj.get("threshold_seconds") is not None else "-"),
+                _fmt_val(obj.get("burn_fast"), "{:.2f}"),
+                _fmt_val(obj.get("burn_slow"), "{:.2f}"),
+                _fmt_val(obj.get("budget_remaining"), "{:.1%}"),
+                "BREACHING" if obj.get("breaching") else "ok"))
+    return lines
+
+
+def _render_fleet_doc(doc: dict) -> str:
+    """Human rendering of the GET /fleet document (`stpu top`)."""
+    import time as time_lib
+    lines = []
+    collected = doc.get("collected_at")
+    age = (f"{max(0.0, time_lib.time() - collected):.1f}s ago"
+           if collected else "never")
+    scaler = doc.get("autoscaler") or {}
+    lines.append(
+        f"fleet      {doc.get('service', '?')}  collected {age}  "
+        f"window {int(doc.get('window_s', 0))}s  "
+        f"policy {scaler.get('policy', '-')}  "
+        f"target {scaler.get('target', '-')} "
+        f"(qps {_fmt_val(scaler.get('qps'), '{:.2f}')})")
+    lb = doc.get("lb") or {}
+    ttfb = lb.get("ttfb") or {}
+    lines.append(
+        f"edge       ttfb p50 {_fmt_ms(ttfb.get('p50'))}"
+        f"  p99 {_fmt_ms(ttfb.get('p99'))}"
+        f"  (n={int(ttfb.get('count') or 0)})"
+        f"  rate {_fmt_val(lb.get('request_rate'), '{:.2f}')}/s")
+    slo = doc.get("slo")
+    degraded = bool(slo and slo.get("degraded"))
+    if slo:
+        lines.extend(_slo_lines(slo))
+    if degraded:
+        lines.append("state      DEGRADED (SLO breaching)")
+    replicas = doc.get("replicas") or {}
+    if replicas:
+        lines.append("")
+        lines.append(
+            "{:<44} {:>11} {:>6} {:>7} {:>6} {:>11} {:>9} {:>9}".format(
+                "REPLICA", "TOK/S(P/D)", "BUSY", "SLOTS", "QUEUE",
+                "POOL(F/T)", "TTFT-P50", "TTFT-P99"))
+        for url in sorted(replicas):
+            r = replicas[url]
+            tok = r.get("tokens_per_sec") or {}
+            decode = tok.get("decode")
+            if decode is None:
+                # Stepstats disarmed on the replica: fall back to the
+                # counter-derived decode rate from the store.
+                decode = r.get("decode_tokens_per_sec")
+            slots = r.get("slots") or {}
+            pool = r.get("kv_pool") or {}
+            ttft = r.get("ttft") or {}
+            lines.append(
+                "{:<44} {:>11} {:>6} {:>7} {:>6} {:>11} {:>9} {:>9}"
+                .format(
+                    url,
+                    f"{_fmt_val(tok.get('prefill'), '{:.0f}')}"
+                    f"/{_fmt_val(decode, '{:.0f}')}",
+                    _fmt_val(r.get("busy_fraction"), "{:.0%}"),
+                    f"{_fmt_val(slots.get('occupied'), '{:.0f}')}"
+                    f"/{_fmt_val(slots.get('total'), '{:.0f}')}",
+                    _fmt_val(r.get("queue_depth"), "{:.0f}"),
+                    f"{_fmt_val(pool.get('free'), '{:.0f}')}"
+                    f"/{_fmt_val(pool.get('total'), '{:.0f}')}",
+                    _fmt_ms(ttft.get("p50")), _fmt_ms(ttft.get("p99"))))
+    else:
+        lines.append("(no replica telemetry collected yet)")
+    decision = scaler.get("last_decision")
+    if decision:
+        ts, qps, target, ready = (list(decision) + [None] * 4)[:4]
+        stamp = time_lib.strftime("%H:%M:%S",
+                                  time_lib.localtime(ts or 0))
+        lines.append(
+            f"last plan  target {target} (qps "
+            f"{_fmt_val(qps, '{:.2f}')}, ready {ready}) at {stamp}")
+    return "\n".join(lines)
+
+
+@cli.command(name="top")
+@click.argument("service", required=False)
+@click.option("--url", default=None,
+              help="Fetch a service endpoint's (or controller sync "
+                   "server's) /fleet directly.")
+@click.option("--watch", "-w", is_flag=True,
+              help="Refresh until interrupted.")
+@click.option("--interval", "-n", type=float, default=2.0,
+              show_default=True,
+              help="Refresh period for --watch, seconds.")
+def top_cmd(service, url, watch, interval):
+    """Live fleet view from the controller's telemetry store: per-
+    replica tok/s, busy fraction, slot/pool occupancy, TTFT quantiles
+    (histogram deltas over the SLO fast window), SLO budget, and the
+    last scale decision. See docs/observability.md."""
+    from skypilot_tpu import core
+    target = _resolve_service_url(url, service)
+    if target is None:
+        raise click.UsageError("give a SERVICE or --url.")
+
+    def render_once():
+        import http.client
+        try:
+            doc = core.fleet_snapshot(target)
+        except (OSError, ValueError, http.client.HTTPException) as e:
+            raise click.ClickException(f"fetch failed: {e}") from e
+        if doc.get("error"):
+            raise click.ClickException(str(doc["error"]))
+        click.echo(_render_fleet_doc(doc))
+
+    _watch_render(render_once, watch, interval)
+
+
+@cli.command(name="slo")
+@click.argument("service", required=False)
+@click.option("--url", default=None,
+              help="Fetch a service endpoint's (or controller sync "
+                   "server's) /fleet directly.")
+def slo_cmd(service, url):
+    """Per-objective SLO status: burn rates over the fast/slow
+    windows, remaining error budget, and breach state (the burn-rate
+    monitor over the fleet telemetry store — docs/observability.md)."""
+    from skypilot_tpu import core
+    target = _resolve_service_url(url, service)
+    if target is None:
+        raise click.UsageError("give a SERVICE or --url.")
+    import http.client
+    try:
+        doc = core.fleet_snapshot(target)
+    except (OSError, ValueError, http.client.HTTPException) as e:
+        raise click.ClickException(f"fetch failed: {e}") from e
+    if doc.get("error"):
+        raise click.ClickException(str(doc["error"]))
+    click.echo(f"service    {doc.get('service', '?')}")
+    for line in _slo_lines(doc.get("slo") or {}):
+        click.echo(line)
+    if doc.get("slo") and doc["slo"].get("degraded"):
+        click.echo("state      DEGRADED (SLO breaching)")
 
 
 @cli.group(name="loadgen", invoke_without_command=True)
@@ -1569,7 +1750,13 @@ def serve_status(service_names):
     for svc in serve_core.status(list(service_names) or None):
         n_ready = sum(1 for r in svc["replicas"]
                       if r["status"] == "READY")
-        click.echo(fmt.format(svc["service_name"], svc["status"],
+        status_text = svc["status"]
+        if svc.get("degraded"):
+            # SLO burn-rate monitor flagged a live breach: the service
+            # still serves (status READY) but is DEGRADED — surface it
+            # on the line operators actually look at.
+            status_text += " [DEGRADED]"
+        click.echo(fmt.format(svc["service_name"], status_text,
                               svc["endpoint"], n_ready))
         for r in svc["replicas"]:
             kind = "[spot]" if r.get("is_spot") else ""
@@ -1582,6 +1769,13 @@ def serve_status(service_names):
                 f"{scale.get('previous')}->{scale.get('target')} "
                 f"replicas at {scale.get('qps')} qps "
                 f"({_human_ago(scale.get('ts'))})")
+        slo_ev = svc.get("slo_event")
+        if svc.get("degraded") and slo_ev:
+            click.echo(
+                f"  slo breach: {slo_ev.get('objective')} objective, "
+                f"burn fast {slo_ev.get('burn_fast')} / slow "
+                f"{slo_ev.get('burn_slow')} "
+                f"({_human_ago(slo_ev.get('ts'))}) — see `stpu slo`")
 
 
 def main():

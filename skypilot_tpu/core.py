@@ -262,6 +262,33 @@ def perf_snapshot(url: Optional[str] = None) -> Dict[str, Any]:
     return doc
 
 
+def fleet_snapshot(url: str, series: Optional[str] = None,
+                   since: Optional[float] = None) -> Dict[str, Any]:
+    """``GET /fleet`` on a service endpoint (or directly on a
+    controller sync server): the fleet telemetry document —
+    per-replica live view, SLO state, optional series dump (see
+    serve/fleet.py doc())."""
+    import json
+    import urllib.parse
+    import urllib.request
+    target = url if "://" in url else f"http://{url}"
+    target = target.rstrip("/")
+    if not target.endswith("/fleet"):
+        target += "/fleet"
+    query = {}
+    if series:
+        query["series"] = series
+    if since is not None:
+        query["since"] = str(since)
+    if query:
+        target += "?" + urllib.parse.urlencode(query)
+    with urllib.request.urlopen(target, timeout=10) as resp:
+        doc = json.loads(resp.read().decode("utf-8", "replace"))
+    if not isinstance(doc, dict):
+        raise ValueError(f"{target} did not return a JSON object")
+    return doc
+
+
 def storage_ls() -> List[Dict[str, Any]]:
     """Registered storage objects (reference: sky/core.py storage_ls)."""
     return global_user_state.get_storage()

@@ -1,0 +1,282 @@
+"""SLO burn-rate monitor over the fleet telemetry store.
+
+Per-service objectives are declared in the service YAML
+(``service.slo.objectives``, see serve/service_spec.py) and evaluated
+against the controller-resident TimeSeriesStore each collector tick:
+
+    slo:
+      objectives:
+        - kind: ttft          # ttft | tpot | error_rate
+          threshold_seconds: 1.0
+          target: 0.99
+
+An objective says "``target`` of requests are good", where *good* is
+kind-shaped: a ``ttft`` request whose service-edge first byte arrived
+within ``threshold_seconds`` (the LB's ``stpu_lb_ttfb_seconds``
+histogram — the client-observed TTFT including queueing, retries and
+upstream delays); a ``tpot`` decode step under ``threshold_seconds``
+(``stpu_engine_step_seconds{phase="decode"}``, present when replicas
+run with STPU_STEPSTATS=1); an ``error_rate`` request that did not
+fail (non-5xx/non-aborted ``stpu_lb_requests_total``).
+
+**Burn rate** (the Google-SRE multiwindow definition): over a window
+W, ``burn = bad_fraction / (1 - target)`` — the rate at which the
+error budget is being consumed, normalized so burn == 1 means
+consuming exactly the window's pro-rata budget. The monitor evaluates
+a FAST window (detection latency) and a SLOW window (noise rejection);
+an objective **breaches** when BOTH exceed the burn threshold, the
+standard guard against paging on a single bad scrape.
+``budget_remaining = max(0, 1 - burn_slow)`` — the fraction of the
+slow window's error budget left.
+
+An empty window (no traffic, or a family the fleet doesn't expose)
+yields ``burn = None`` — never NaN: ``quantile_from_cumulative`` and
+fraction math return NaN on all-zero deltas, and a NaN compared
+against a threshold is silently False, which would read as "SLO
+healthy" during an outage that stops all traffic. None is rendered as
+``-`` by ``stpu top``/``stpu slo`` and is excluded from breach edges.
+
+Emits ``slo_breach`` / ``slo_recovered`` lifecycle events (kind
+``slo``) on edges and keeps ``stpu_slo_burn_rate`` /
+``stpu_slo_budget_remaining`` gauges current. ``latency_signals()``
+is the seam the latency-aware autoscaler consumes
+(serve/autoscalers.py) — plain data, so the autoscaler stays
+import-light and unit-testable with synthetic signals.
+
+Stdlib-only, like everything else in observability/.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.observability import events
+from skypilot_tpu.observability import metrics
+
+DEFAULT_FAST_WINDOW = 300.0      # 5 min: detection
+DEFAULT_SLOW_WINDOW = 3600.0     # 1 h: noise rejection
+DEFAULT_BURN_THRESHOLD = 1.0     # burn >= 1 consumes budget too fast
+
+KINDS = ("ttft", "tpot", "error_rate")
+
+# Metric family each kind evaluates, and the extra label filter.
+_FAMILY = {
+    "ttft": ("stpu_lb_ttfb_seconds", {}),
+    "tpot": ("stpu_engine_step_seconds", {"phase": "decode"}),
+}
+_ERROR_FAMILY = "stpu_lb_requests_total"
+
+_BURN_GAUGE = metrics.gauge(
+    "stpu_slo_burn_rate",
+    "Error-budget burn rate per objective and window (1.0 = consuming "
+    "exactly the window's pro-rata budget; 0 when the window is "
+    "empty).", ("service", "objective", "window"))
+_BUDGET_GAUGE = metrics.gauge(
+    "stpu_slo_budget_remaining",
+    "Fraction of the slow window's error budget unconsumed, in "
+    "[0, 1].", ("service", "objective"))
+
+
+def fast_window_seconds() -> float:
+    return float(os.environ.get("STPU_SLO_FAST_WINDOW", "300"))
+
+
+def slow_window_seconds() -> float:
+    return float(os.environ.get("STPU_SLO_SLOW_WINDOW", "3600"))
+
+
+def burn_threshold() -> float:
+    return float(os.environ.get("STPU_SLO_BURN_THRESHOLD", "1.0"))
+
+
+@dataclasses.dataclass(frozen=True)
+class Objective:
+    kind: str                          # ttft | tpot | error_rate
+    target: float                      # good-fraction target, e.g. 0.99
+    threshold_s: Optional[float] = None  # latency kinds only
+
+    @classmethod
+    def from_config(cls, config: Dict[str, Any]) -> "Objective":
+        kind = config.get("kind")
+        if kind not in KINDS:
+            raise ValueError(
+                f"slo objective kind must be one of {KINDS}, "
+                f"got {kind!r}")
+        target = float(config.get("target", 0.99))
+        if not 0.0 < target < 1.0:
+            raise ValueError(
+                f"slo target must be in (0, 1), got {target}")
+        threshold = config.get("threshold_seconds")
+        if kind in ("ttft", "tpot"):
+            if threshold is None:
+                raise ValueError(
+                    f"slo objective {kind!r} needs threshold_seconds")
+            threshold = float(threshold)
+            if threshold <= 0:
+                raise ValueError("threshold_seconds must be > 0")
+        elif threshold is not None:
+            raise ValueError(
+                "error_rate objectives take no threshold_seconds")
+        return cls(kind=kind, target=target, threshold_s=threshold)
+
+    def to_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"kind": self.kind, "target": self.target}
+        if self.threshold_s is not None:
+            out["threshold_seconds"] = self.threshold_s
+        return out
+
+
+def _good_count(snap, threshold: float) -> float:
+    """Observations <= the bucket bound enclosing ``threshold`` (the
+    cumulative count at the first bound >= threshold — a threshold
+    between bounds resolves to the enclosing bucket, documented in
+    docs/observability.md)."""
+    for bound, cum in zip(snap.bounds, snap.cumulative):
+        if bound >= threshold:
+            return cum
+    return snap.count
+
+
+class SloMonitor:
+    def __init__(self, service_name: str, objectives: List[Objective],
+                 store, fast_window: Optional[float] = None,
+                 slow_window: Optional[float] = None,
+                 threshold: Optional[float] = None):
+        self.service_name = service_name
+        self.objectives = list(objectives)
+        self.store = store
+        self.fast_window = (fast_window_seconds()
+                            if fast_window is None else float(fast_window))
+        self.slow_window = (slow_window_seconds()
+                            if slow_window is None else float(slow_window))
+        self.threshold = (burn_threshold()
+                          if threshold is None else float(threshold))
+        self._breaching: Dict[str, bool] = {o.kind: False
+                                            for o in self.objectives}
+        self._last_state: Dict[str, Any] = {}
+
+    @classmethod
+    def from_spec(cls, service_name: str, spec,
+                  store) -> Optional["SloMonitor"]:
+        configs = getattr(spec, "slo_objectives", None)
+        if not configs:
+            return None
+        return cls(service_name,
+                   [Objective.from_config(c) for c in configs], store)
+
+    # ---------------------------------------------------------- burn math
+    def _bad_fraction(self, obj: Objective, window: float,
+                      now: float) -> Optional[float]:
+        if obj.kind == "error_rate":
+            total = self.store.window_delta(_ERROR_FAMILY, window, now)
+            if not total:
+                return None
+            bad = 0.0
+            for labels in self.store.labels_for(_ERROR_FAMILY):
+                code = labels.get("code", "")
+                if code.startswith("5") or code in ("0", "aborted"):
+                    bad += self.store.window_delta(
+                        _ERROR_FAMILY, window, now, **labels) or 0.0
+            frac = bad / total
+        else:
+            family, extra = _FAMILY[obj.kind]
+            snap = self.store.histogram_delta(family, window, now,
+                                              **extra)
+            if snap is None or snap.count <= 0:
+                return None
+            frac = 1.0 - _good_count(snap, obj.threshold_s) / snap.count
+        # The NaN guard: quantile/fraction math over a raced or
+        # clamped-to-zero delta must surface as "no data", never as a
+        # NaN that compares False against every threshold.
+        if math.isnan(frac):
+            return None
+        return min(max(frac, 0.0), 1.0)
+
+    def _burn(self, obj: Objective, window: float,
+              now: float) -> Optional[float]:
+        frac = self._bad_fraction(obj, window, now)
+        if frac is None:
+            return None
+        return frac / max(1e-9, 1.0 - obj.target)
+
+    # ---------------------------------------------------------- evaluate
+    def evaluate(self, now: float) -> Dict[str, Any]:
+        """One evaluation pass: refresh gauges, emit breach/recovery
+        events on edges, return (and cache) the state document."""
+        state: Dict[str, Any] = {
+            "service": self.service_name,
+            "fast_window_s": self.fast_window,
+            "slow_window_s": self.slow_window,
+            "burn_threshold": self.threshold,
+            "objectives": [],
+            "degraded": False,
+        }
+        for obj in self.objectives:
+            fast = self._burn(obj, self.fast_window, now)
+            slow = self._burn(obj, self.slow_window, now)
+            for window, burn in (("fast", fast), ("slow", slow)):
+                _BURN_GAUGE.labels(service=self.service_name,
+                                   objective=obj.kind,
+                                   window=window).set(burn or 0.0)
+            budget = (max(0.0, 1.0 - slow)
+                      if slow is not None else None)
+            _BUDGET_GAUGE.labels(
+                service=self.service_name, objective=obj.kind).set(
+                    1.0 if budget is None else budget)
+            breaching = (fast is not None and slow is not None and
+                         fast >= self.threshold and
+                         slow >= self.threshold)
+            was = self._breaching.get(obj.kind, False)
+            if breaching and not was:
+                events.emit("slo", self.service_name, "slo_breach",
+                            objective=obj.kind,
+                            burn_fast=round(fast, 3),
+                            burn_slow=round(slow, 3),
+                            target=obj.target)
+            elif was and not breaching:
+                events.emit("slo", self.service_name, "slo_recovered",
+                            objective=obj.kind,
+                            burn_fast=(round(fast, 3)
+                                       if fast is not None else None),
+                            burn_slow=(round(slow, 3)
+                                       if slow is not None else None))
+            self._breaching[obj.kind] = breaching
+            state["objectives"].append({
+                "kind": obj.kind,
+                "target": obj.target,
+                "threshold_seconds": obj.threshold_s,
+                "burn_fast": fast,
+                "burn_slow": slow,
+                "budget_remaining": budget,
+                "breaching": breaching,
+            })
+            state["degraded"] = state["degraded"] or breaching
+        self._last_state = state
+        return state
+
+    # ------------------------------------------------------------- views
+    def state(self) -> Dict[str, Any]:
+        """The last evaluation's document (for GET /fleet and
+        ``stpu slo``)."""
+        return dict(self._last_state)
+
+    def degraded(self) -> bool:
+        return any(self._breaching.values())
+
+    def latency_signals(self) -> Dict[str, Any]:
+        """The autoscaler seam: per-kind burn readings from the last
+        evaluation, as plain data. ``burn_fast``/``burn_slow`` are
+        None when the window held no observations — the latency policy
+        treats that as "no pressure", not as zero burn."""
+        signals: Dict[str, Any] = {"degraded": False}
+        for entry in self._last_state.get("objectives", []):
+            signals[entry["kind"]] = {
+                "burn_fast": entry["burn_fast"],
+                "burn_slow": entry["burn_slow"],
+                "breaching": entry["breaching"],
+            }
+            signals["degraded"] = (signals["degraded"] or
+                                   entry["breaching"])
+        return signals

@@ -1,0 +1,338 @@
+"""Ring-buffered fleet time-series store with downsampling tiers.
+
+The serve controller's fleet collector (serve/fleet.py) scrapes every
+ready replica's /metrics + /perf each tick and needs somewhere to PUT
+the history: the controller previously threw each scrape away, so an
+operator diagnosing a p99 regression had no view older than the
+current scrape and the autoscaler could only act on raw QPS. This
+store is that somewhere — bounded, stdlib-only, controller-resident.
+
+Two tiers per series (the Prometheus-recording-rule shape, collapsed
+into one in-process structure):
+
+* **raw** — one point per ``raw_seconds`` bucket, kept for
+  ``raw_retention`` seconds (default 10s resolution for 15 min);
+* **rollup** — raw points aging out of the raw window fold into
+  ``rollup_seconds`` buckets carrying ``(count, sum, min, max)``,
+  kept for ``rollup_retention`` seconds (default 1 min rollups for
+  24 h). A rollup bucket's value is its mean; min/max survive so a
+  spike is not averaged out of existence.
+
+Downsampling math: a point stamped ``ts`` belongs to rollup bucket
+``floor(ts / rollup_seconds) * rollup_seconds``; folding adds it to
+the bucket's running ``(count, sum, min, max)``. Memory is therefore
+bounded by ``raw_retention / raw_seconds + rollup_retention /
+rollup_seconds`` buckets per series, independent of scrape rate.
+
+Histograms are stored as CUMULATIVE bucket snapshots
+(promtext.HistogramSnapshot — the existing parser's shape), not as
+per-window deltas: cumulative counts are monotone, so the delta
+between ANY two retained snapshots is a valid window distribution
+(``HistogramSnapshot.delta``), and downsampling is just keeping fewer
+snapshots — one per rollup bucket beyond the raw window — with no
+re-aggregation. Quantiles over a window share
+``metrics.quantile_from_cumulative`` with the loadgen scraper, so a
+stored p99 and a client-side report can never disagree on the math.
+
+Counters are recorded as their cumulative totals (what the scrape
+returns); ``rate()``/``window_delta()`` difference them, clamping a
+process-restart reset to zero rather than reporting a negative rate.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu.observability.promtext import HistogramSnapshot
+
+DEFAULT_RAW_SECONDS = 10.0
+DEFAULT_RAW_RETENTION = 900.0           # 15 min
+DEFAULT_ROLLUP_SECONDS = 60.0
+DEFAULT_ROLLUP_RETENTION = 86400.0      # 24 h
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, Any]) -> _LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _matches(key: _LabelKey, subset: Dict[str, Any]) -> bool:
+    """Label-subset match, same contract as promtext.histogram: naming
+    no labels matches every series of the name."""
+    have = dict(key)
+    return all(have.get(str(k)) == str(v) for k, v in subset.items())
+
+
+class _RollupBucket:
+    __slots__ = ("ts", "count", "sum", "min", "max")
+
+    def __init__(self, ts: float, value: float):
+        self.ts = ts
+        self.count = 1
+        self.sum = value
+        self.min = value
+        self.max = value
+
+    def fold(self, value: float) -> None:
+        self.count += 1
+        self.sum += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count
+
+
+class _ScalarSeries:
+    """Raw (ts, value) points + rollup buckets for one labeled series."""
+
+    def __init__(self) -> None:
+        self.raw: List[Tuple[float, float]] = []
+        self.rollup: List[_RollupBucket] = []
+
+    def record(self, ts: float, value: float, raw_seconds: float) -> None:
+        # One point per raw bucket: a collector ticking faster than the
+        # raw resolution overwrites in place instead of growing the ring.
+        if self.raw and ts - self.raw[-1][0] < raw_seconds:
+            self.raw[-1] = (self.raw[-1][0], value)
+        else:
+            self.raw.append((ts, value))
+
+    def downsample(self, now: float, raw_retention: float,
+                   rollup_seconds: float, rollup_retention: float) -> None:
+        cutoff = now - raw_retention
+        while self.raw and self.raw[0][0] < cutoff:
+            ts, value = self.raw.pop(0)
+            bucket_ts = math.floor(ts / rollup_seconds) * rollup_seconds
+            if self.rollup and self.rollup[-1].ts == bucket_ts:
+                self.rollup[-1].fold(value)
+            else:
+                self.rollup.append(_RollupBucket(bucket_ts, value))
+        drop = now - rollup_retention
+        while self.rollup and self.rollup[0].ts < drop:
+            self.rollup.pop(0)
+
+
+class _HistSeries:
+    """Cumulative HistogramSnapshots, thinned to one per rollup bucket
+    beyond the raw window (cumulative snapshots delta-compose, so
+    keeping fewer IS the downsampling)."""
+
+    def __init__(self) -> None:
+        self.snaps: List[Tuple[float, HistogramSnapshot]] = []
+
+    def record(self, ts: float, snap: HistogramSnapshot,
+               raw_seconds: float) -> None:
+        if self.snaps and ts - self.snaps[-1][0] < raw_seconds:
+            self.snaps[-1] = (self.snaps[-1][0], snap)
+        else:
+            self.snaps.append((ts, snap))
+
+    def downsample(self, now: float, raw_retention: float,
+                   rollup_seconds: float, rollup_retention: float) -> None:
+        cutoff = now - raw_retention
+        kept: List[Tuple[float, HistogramSnapshot]] = []
+        last_bucket: Optional[float] = None
+        for ts, snap in self.snaps:
+            if ts >= cutoff:
+                kept.append((ts, snap))
+                continue
+            if ts < now - rollup_retention:
+                continue
+            bucket_ts = math.floor(ts / rollup_seconds) * rollup_seconds
+            if bucket_ts != last_bucket:
+                kept.append((ts, snap))
+                last_bucket = bucket_ts
+            else:
+                # Newest snapshot wins within a bucket: cumulative
+                # counts make the latest the most informative.
+                kept[-1] = (kept[-1][0], snap)
+        self.snaps = kept
+
+
+class TimeSeriesStore:
+    """Thread-safe store; all reads/writes take one lock (collector
+    thread writes, the /fleet handler and SLO monitor read)."""
+
+    def __init__(self, raw_seconds: float = DEFAULT_RAW_SECONDS,
+                 raw_retention: float = DEFAULT_RAW_RETENTION,
+                 rollup_seconds: float = DEFAULT_ROLLUP_SECONDS,
+                 rollup_retention: float = DEFAULT_ROLLUP_RETENTION):
+        self.raw_seconds = float(raw_seconds)
+        self.raw_retention = float(raw_retention)
+        self.rollup_seconds = float(rollup_seconds)
+        self.rollup_retention = float(rollup_retention)
+        self._lock = threading.Lock()
+        self._scalars: Dict[Tuple[str, _LabelKey], _ScalarSeries] = {}
+        self._hists: Dict[Tuple[str, _LabelKey], _HistSeries] = {}
+
+    # --------------------------------------------------------- writes
+    def record(self, name: str, value: float, ts: float,
+               **labels: Any) -> None:
+        """Record one scalar point (gauge reading or cumulative counter
+        total). NaN points are dropped at the door: NaN in the store
+        would poison every mean/rate computed over the window."""
+        value = float(value)
+        if math.isnan(value):
+            return
+        key = (name, _label_key(labels))
+        with self._lock:
+            series = self._scalars.get(key)
+            if series is None:
+                series = self._scalars[key] = _ScalarSeries()
+            series.record(ts, value, self.raw_seconds)
+            series.downsample(ts, self.raw_retention,
+                              self.rollup_seconds, self.rollup_retention)
+
+    def record_histogram(self, name: str, snap: HistogramSnapshot,
+                         ts: float, **labels: Any) -> None:
+        key = (name, _label_key(labels))
+        with self._lock:
+            series = self._hists.get(key)
+            if series is None:
+                series = self._hists[key] = _HistSeries()
+            series.record(ts, snap, self.raw_seconds)
+            series.downsample(ts, self.raw_retention,
+                              self.rollup_seconds, self.rollup_retention)
+
+    # ---------------------------------------------------------- reads
+    def series_names(self) -> List[str]:
+        with self._lock:
+            return sorted({n for n, _ in self._scalars} |
+                          {n for n, _ in self._hists})
+
+    def labels_for(self, name: str) -> List[Dict[str, str]]:
+        with self._lock:
+            keys = [k for (n, k) in list(self._scalars) +
+                    list(self._hists) if n == name]
+        return [dict(k) for k in sorted(set(keys))]
+
+    def latest(self, name: str, **labels: Any) -> Optional[float]:
+        """Newest raw point across matching series (summed when more
+        than one matches — the counter-family convention)."""
+        with self._lock:
+            vals = [s.raw[-1][1]
+                    for (n, k), s in self._scalars.items()
+                    if n == name and _matches(k, labels) and s.raw]
+        if not vals:
+            return None
+        return sum(vals)
+
+    def points(self, name: str, since: Optional[float] = None,
+               **labels: Any) -> List[Tuple[float, float]]:
+        """Merged (ts, value) points for one series, rollup tier first
+        (rollup buckets surface their mean), bounded by ``since``.
+        Matching multiple label-sets concatenates them — name enough
+        labels to address one series when plotting."""
+        out: List[Tuple[float, float]] = []
+        with self._lock:
+            for (n, k), s in self._scalars.items():
+                if n != name or not _matches(k, labels):
+                    continue
+                out.extend((b.ts, b.mean) for b in s.rollup)
+                out.extend(s.raw)
+        out.sort()
+        if since is not None:
+            out = [(t, v) for t, v in out if t >= since]
+        return out
+
+    def window_delta(self, name: str, window: float, now: float,
+                     **labels: Any) -> Optional[float]:
+        """Cumulative-counter increase over the trailing window, summed
+        across matching series. A reset (value dropped) clamps that
+        series' contribution to the post-reset total — never negative.
+        None when no matching series has any data."""
+        found = False
+        total = 0.0
+        with self._lock:
+            items = [(k, list(s.rollup), list(s.raw))
+                     for (n, k), s in self._scalars.items()
+                     if n == name and _matches(k, labels)]
+        for _, rollup, raw in items:
+            pts = [(b.ts, b.max) for b in rollup] + raw
+            if not pts:
+                continue
+            found = True
+            cutoff = now - window
+            # Baseline = newest point at-or-before the window start
+            # (the counter total as the window opened); fall back to
+            # the oldest retained point for short histories.
+            baseline = None
+            for ts, v in pts:
+                if ts <= cutoff:
+                    baseline = v
+                else:
+                    break
+            if baseline is None:
+                baseline = pts[0][1]
+            latest = pts[-1][1]
+            total += latest - baseline if latest >= baseline else latest
+        return total if found else None
+
+    def rate(self, name: str, window: float, now: float,
+             **labels: Any) -> Optional[float]:
+        delta = self.window_delta(name, window, now, **labels)
+        if delta is None or window <= 0:
+            return None
+        return delta / window
+
+    def histogram_delta(self, name: str, window: float, now: float,
+                        **labels: Any) -> Optional[HistogramSnapshot]:
+        """The distribution observed over the trailing window: latest
+        snapshot minus the snapshot at the window's start, summed
+        bucket-wise across matching series (per-replica histograms
+        compose into the fleet view). None when no series matches or
+        bucket bounds changed mid-window."""
+        with self._lock:
+            series = [list(s.snaps)
+                      for (n, k), s in self._hists.items()
+                      if n == name and _matches(k, labels) and s.snaps]
+        merged: Optional[HistogramSnapshot] = None
+        for snaps in series:
+            cutoff = now - window
+            baseline = None
+            for ts, snap in snaps:
+                if ts <= cutoff:
+                    baseline = snap
+                else:
+                    break
+            latest = snaps[-1][1]
+            if baseline is None:
+                # Short history: the oldest snapshot is the baseline —
+                # unless it IS the latest, in which case the window
+                # holds zero observations by construction.
+                baseline = snaps[0][1]
+            try:
+                delta = latest.delta(baseline)
+            except ValueError:
+                # Bucket bounds changed (replica restart with a new
+                # layout): the delta is undefined — skip the series.
+                continue
+            if merged is None:
+                merged = delta
+            elif merged.bounds == delta.bounds:
+                merged = HistogramSnapshot(
+                    bounds=list(merged.bounds),
+                    cumulative=[a + b for a, b in
+                                zip(merged.cumulative, delta.cumulative)],
+                    sum=merged.sum + delta.sum,
+                    count=merged.count + delta.count)
+            # Mismatched bounds across series: keep the first; summing
+            # incompatible layouts would fabricate a distribution.
+        return merged
+
+    def to_doc(self, name: str, since: Optional[float] = None
+               ) -> Dict[str, Any]:
+        """JSON-ready series dump for GET /fleet?series=NAME."""
+        series = []
+        with self._lock:
+            label_sets = sorted({k for (n, k) in self._scalars
+                                 if n == name})
+        for key in label_sets:
+            series.append({"labels": dict(key),
+                           "points": self.points(name, since=since,
+                                                 **dict(key))})
+        return {"series": name, "data": series}

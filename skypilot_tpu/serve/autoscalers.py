@@ -97,6 +97,12 @@ class Autoscaler:
             self, request_timestamps: List[float]) -> None:
         del request_timestamps
 
+    def collect_latency_signals(self, signals: Dict[str, Any]) -> None:
+        """SLO burn readings from the fleet collector
+        (observability/slo.py latency_signals()). Ignored by the QPS
+        policies; LatencyAwareAutoscaler folds them into its target."""
+        del signals
+
     def _record_decision(self, now: float, target: int,
                          num_ready: Optional[int]) -> None:
         """History + gauges each evaluation; counter + pending event
@@ -166,6 +172,9 @@ class Autoscaler:
     def from_spec(cls, spec: SkyServiceSpec, use_spot: bool = False,
                   service_name: str = "") -> "Autoscaler":
         if spec.autoscaling_enabled:
+            if getattr(spec, "scaling_policy", "qps") == "latency":
+                return LatencyAwareAutoscaler(spec, use_spot=use_spot,
+                                              service_name=service_name)
             return RequestRateAutoscaler(spec, use_spot=use_spot,
                                          service_name=service_name)
         return cls(spec, use_spot=use_spot, service_name=service_name)
@@ -186,6 +195,9 @@ class Autoscaler:
         if isinstance(old, RequestRateAutoscaler) and isinstance(
                 self, RequestRateAutoscaler):
             self.request_timestamps = list(old.request_timestamps)
+        if isinstance(old, LatencyAwareAutoscaler) and isinstance(
+                self, LatencyAwareAutoscaler):
+            self._latency_signals = dict(old._latency_signals)
 
 
 class RequestRateAutoscaler(Autoscaler):
@@ -222,28 +234,98 @@ class RequestRateAutoscaler(Autoscaler):
             else lo
         return max(lo, min(hi, target))
 
-    def evaluate_scaling(self,
-                         now: Optional[float] = None) -> AutoscalerDecision:
-        now = time.time() if now is None else now
-        raw = self._raw_target(now)
+    def _apply_hysteresis(self, now: float, candidate: int,
+                          allow_down: bool = True) -> None:
+        """Move target toward ``candidate`` once it has persisted past
+        the direction's delay. ``allow_down=False`` (latency policy
+        while burning) vetoes the downscale AND resets its candidate
+        clock, so a downscale cannot fire the instant burn clears on
+        the strength of a window that was mid-breach."""
         current = self.target_num_replicas
-        if raw > current:
+        if candidate > current:
             self._downscale_candidate_since = None
             if self._upscale_candidate_since is None:
                 self._upscale_candidate_since = now
             if (now - self._upscale_candidate_since >=
                     self.spec.upscale_delay_seconds):
-                self.target_num_replicas = raw
+                self.target_num_replicas = candidate
                 self._upscale_candidate_since = None
-        elif raw < current:
+        elif candidate < current:
             self._upscale_candidate_since = None
+            if not allow_down:
+                self._downscale_candidate_since = None
+                return
             if self._downscale_candidate_since is None:
                 self._downscale_candidate_since = now
             if (now - self._downscale_candidate_since >=
                     self.spec.downscale_delay_seconds):
-                self.target_num_replicas = raw
+                self.target_num_replicas = candidate
                 self._downscale_candidate_since = None
         else:
             self._upscale_candidate_since = None
             self._downscale_candidate_since = None
+
+    def evaluate_scaling(self,
+                         now: Optional[float] = None) -> AutoscalerDecision:
+        now = time.time() if now is None else now
+        self._apply_hysteresis(now, self._raw_target(now))
+        return AutoscalerDecision(self.target_num_replicas)
+
+
+class LatencyAwareAutoscaler(RequestRateAutoscaler):
+    """``scaling_policy: latency`` — QPS remains the baseline target;
+    sustained TTFT-SLO burn (observability/slo.py, fed via
+    ``collect_latency_signals``) biases it:
+
+    - fast-window burn at/over the breach threshold raises the
+      candidate one replica above the current target (capped at
+      max_replicas), so a latency regression scales up even while QPS
+      alone would not;
+    - any ongoing burn (fast OR slow window) vetoes downscaling — the
+      fleet only sheds replicas when both the QPS target and the SLO
+      budget allow it.
+
+    Decision history, scale events, and the gauge/counter contract are
+    inherited unchanged: the controller cannot tell the policies apart.
+    """
+
+    # Fast-window burn at/over this consumes budget faster than the
+    # service can afford — scale up. Matches slo.DEFAULT_BURN_THRESHOLD
+    # (burn 1.0 = consuming exactly the window's pro-rata budget).
+    BURN_UP_THRESHOLD = 1.0
+
+    def __init__(self, spec: SkyServiceSpec, use_spot: bool = False,
+                 service_name: str = ""):
+        super().__init__(spec, use_spot=use_spot,
+                         service_name=service_name)
+        self._latency_signals: Dict[str, Any] = {}
+
+    def collect_latency_signals(self, signals: Dict[str, Any]) -> None:
+        self._latency_signals = dict(signals)
+
+    def _ttft_burn(self, window: str) -> Optional[float]:
+        ttft = self._latency_signals.get("ttft")
+        if not isinstance(ttft, dict):
+            return None
+        return ttft.get(window)
+
+    def evaluate_scaling(self,
+                         now: Optional[float] = None) -> AutoscalerDecision:
+        now = time.time() if now is None else now
+        candidate = self._raw_target(now)
+        fast = self._ttft_burn("burn_fast")
+        slow = self._ttft_burn("burn_slow")
+        lo = self.spec.min_replicas
+        hi = self.spec.max_replicas if self.spec.max_replicas is not None \
+            else lo
+        if fast is not None and fast >= self.BURN_UP_THRESHOLD:
+            # One replica at a time: burn says "too slow", not "how
+            # many" — each added replica re-measures before the next.
+            candidate = max(candidate,
+                            min(hi, self.target_num_replicas + 1))
+        burning = ((fast is not None and fast >= self.BURN_UP_THRESHOLD)
+                   or (slow is not None and
+                       slow >= self.BURN_UP_THRESHOLD))
+        self._apply_hysteresis(now, max(lo, candidate),
+                               allow_down=not burning)
         return AutoscalerDecision(self.target_num_replicas)

@@ -60,6 +60,10 @@ class SkyServeController:
         self._draining_since = 0.0   # when _draining last gained members
         self._last_sync_at = 0.0     # when the LB last adopted /sync
         self._ready_edge_at: Optional[float] = None  # empty→non-empty edge
+        # Fleet telemetry collector (serve/fleet.py), attached by
+        # service.py when armed; None keeps /fleet a clean 503 and the
+        # tick path collector-free.
+        self.fleet = None
 
     def stop(self) -> None:
         self._stop = True
@@ -273,6 +277,36 @@ class SkyServeController:
         class _SyncHandler(http.server.BaseHTTPRequestHandler):
             def log_message(self, *a):
                 pass
+
+            def do_GET(self):
+                """GET /fleet[?series=NAME&since=TS]: the fleet
+                telemetry document (serve/fleet.py doc()) — per-replica
+                live view, SLO state, series dumps. The LB forwards its
+                own /fleet here, so the service endpoint serves it."""
+                if self.path.split("?", 1)[0] != "/fleet":
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                if controller.fleet is None:
+                    body = json_lib.dumps(
+                        {"error": "fleet telemetry disabled "
+                                  "(STPU_FLEET=0)"}).encode()
+                    code = 503
+                else:
+                    import urllib.parse
+                    query = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query)
+                    since = query.get("since", [None])[0]
+                    body = json_lib.dumps(controller.fleet.doc(
+                        series=query.get("series", [None])[0],
+                        since=float(since) if since else None)).encode()
+                    code = 200
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def do_POST(self):
                 if self.path != "/sync":

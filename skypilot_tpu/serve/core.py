@@ -489,6 +489,12 @@ def _status_local(service_names: Optional[List[str]],
     last_scale = {rec.get("name"): rec
                   for rec in events.read(kind="autoscaler", limit=None,
                                          max_bytes=256 * 1024)}
+    # SLO breach/recovery edges (observability/slo.py): the LAST edge
+    # per service decides the degraded flag — same bounded-tail pattern
+    # as the scale events, and rides the same dump RPC in cluster mode.
+    last_slo = {rec.get("name"): rec
+                for rec in events.read(kind="slo", limit=None,
+                                       max_bytes=256 * 1024)}
     for svc in services:
         svc["replicas"] = serve_state.get_replicas(svc["service_name"])
         svc["endpoint"] = f"http://{host}:{svc['lb_port']}"
@@ -496,6 +502,10 @@ def _status_local(service_names: Optional[List[str]],
         for rep in svc["replicas"]:
             rep["status"] = getattr(rep["status"], "value", rep["status"])
         svc["last_scale_event"] = last_scale.get(svc["service_name"])
+        slo_event = last_slo.get(svc["service_name"])
+        svc["slo_event"] = slo_event
+        svc["degraded"] = bool(slo_event and
+                               slo_event.get("event") == "slo_breach")
     return services
 
 

@@ -1,0 +1,354 @@
+"""Fleet telemetry collector: replica scrapes → TimeSeriesStore → SLO.
+
+The controller previously scraped nothing and remembered nothing — the
+LB merges live /metrics on demand and throws the result away. This
+module gives the control plane a MEMORY: a collector thread (started
+by serve/service.py next to the LB supervisor, one per service) that
+each interval
+
+1. scrapes every ready replica's ``/metrics`` + ``/perf`` and the
+   LB's ``/metrics`` (for the ``stpu_lb_*`` service-edge families),
+2. records the interesting families into an
+   ``observability.timeseries.TimeSeriesStore`` (10s raw for 15 min →
+   1 min rollups for 24 h, histograms as cumulative snapshots),
+3. runs the service's ``observability.slo.SloMonitor`` over the store
+   (burn-rate windows, ``slo_breach``/``slo_recovered`` events,
+   ``stpu_slo_*`` gauges), and
+4. hands ``latency_signals()`` to the autoscaler — the seam the
+   ``scaling_policy: latency`` policy consumes.
+
+``GET /fleet`` (controller sync server, forwarded by the LB so the
+service endpoint serves it) returns ``doc()``: per-replica live view,
+SLO state, autoscaler state, and optional series dumps — what
+``stpu top`` and ``stpu slo`` render.
+
+Disarmed (``STPU_FLEET=0``) the thread never starts, no store or
+monitor is constructed, and the controller tick is untouched — the
+zero-overhead contract tests/test_fleet.py pins with monkeypatch
+bombs.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.observability import promtext
+from skypilot_tpu.observability import slo as slo_lib
+from skypilot_tpu.observability import timeseries
+
+# Replica /metrics families worth retaining (a bounded allowlist: the
+# store's memory is per-series, so "record everything" would scale
+# with whatever families a recipe adds).
+_REPLICA_GAUGES = (
+    "stpu_engine_slots_occupied",
+    "stpu_engine_slots_total",
+    "stpu_engine_queue_depth",
+    "stpu_engine_kv_pool_blocks_free",
+    "stpu_engine_kv_pool_blocks_total",
+)
+_REPLICA_COUNTERS = ("stpu_engine_decode_tokens_total",)
+_REPLICA_HISTS = ("stpu_engine_ttft_seconds",)
+# Decode-step histogram feeds the tpot SLO; recorded per replica with
+# the phase label preserved so histogram_delta(phase="decode") merges
+# the fleet.
+_STEP_FAMILY = "stpu_engine_step_seconds"
+# LB-local service-edge families (scraped from the LB's /metrics; the
+# merge puts LB-process values first, so these are authoritative).
+_LB_HISTS = ("stpu_lb_ttfb_seconds", "stpu_lb_request_duration_seconds")
+_LB_REQUESTS = "stpu_lb_requests_total"
+
+_SCRAPE_TIMEOUT = 2.0
+
+
+def enabled() -> bool:
+    return os.environ.get("STPU_FLEET", "1") == "1"
+
+
+def collect_seconds() -> float:
+    """Collector period; 0 = follow the controller tick."""
+    return float(os.environ.get("STPU_FLEET_COLLECT_SECONDS", "0"))
+
+
+def store_from_env() -> timeseries.TimeSeriesStore:
+    return timeseries.TimeSeriesStore(
+        raw_seconds=float(os.environ.get("STPU_FLEET_RAW_SECONDS",
+                                         "10")),
+        raw_retention=float(os.environ.get("STPU_FLEET_RAW_RETENTION",
+                                           "900")),
+        rollup_seconds=float(os.environ.get("STPU_FLEET_ROLLUP_SECONDS",
+                                            "60")),
+        rollup_retention=float(os.environ.get(
+            "STPU_FLEET_ROLLUP_RETENTION", "86400")))
+
+
+def _fetch(url: str, timeout: float = _SCRAPE_TIMEOUT) -> Optional[str]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.read().decode("utf-8", "replace")
+    except Exception:  # noqa: stpu-except — best-effort scrape; an unreachable target contributes no points this tick
+        return None
+
+
+def _sanitize(obj: Any) -> Any:
+    """NaN/Inf → None, recursively: ``json.dumps`` would emit bare
+    ``NaN`` (invalid JSON) and the CLI renders None as ``-``."""
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    if isinstance(obj, dict):
+        return {k: _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    return obj
+
+
+class FleetCollector:
+    """One per service, controller-resident. Thread-safety: the
+    collector thread writes; /fleet handlers and the controller read.
+    The store has its own lock; collector-local state mutated each
+    tick (_last_urls, _last_collect) is swapped atomically."""
+
+    def __init__(self, controller, lb_url: str,
+                 interval: Optional[float] = None,
+                 store: Optional[timeseries.TimeSeriesStore] = None):
+        self.controller = controller
+        self.lb_url = lb_url.rstrip("/")
+        if interval is None:
+            interval = collect_seconds()
+        if not interval:
+            from skypilot_tpu.serve import controller as controller_lib
+            interval = controller_lib._tick_seconds()
+        self.interval = float(interval)
+        self.store = store if store is not None else store_from_env()
+        self.monitor: Optional[slo_lib.SloMonitor] = None
+        self._monitor_spec: Any = None
+        self._last_urls: List[str] = []
+        self._last_collect: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # --------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="fleet-collector")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.collect_once()
+            except Exception as e:  # noqa: BLE001 — the collector must outlive any scrape/eval bug
+                print(f"fleet[{self.controller.service_name}]: "
+                      f"collect failed: {e!r}", flush=True)
+            self._stop.wait(self.interval)
+
+    def _refresh_monitor(self) -> None:
+        """(Re)build the SLO monitor when the spec object changes —
+        `serve update` swaps controller.spec wholesale, so identity is
+        the cheap change detector. Breach state does NOT survive an
+        update: new objectives mean new edges."""
+        spec = self.controller.spec
+        if spec is self._monitor_spec:
+            return
+        self._monitor_spec = spec
+        self.monitor = slo_lib.SloMonitor.from_spec(
+            self.controller.service_name, spec, self.store)
+
+    # --------------------------------------------------------- scraping
+    def collect_once(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        self._refresh_monitor()
+        urls = list(self.controller._ready_urls)
+        # Concurrent scrape, one timeout bound for the whole wave (a
+        # dead replica must not stall the others' freshness).
+        results: Dict[str, Dict[str, Optional[str]]] = {}
+
+        def scrape(url: str) -> None:
+            base = url.rstrip("/")
+            results[url] = {"metrics": _fetch(base + "/metrics"),
+                            "perf": _fetch(base + "/perf")}
+
+        threads = [threading.Thread(target=scrape, args=(u,),
+                                    daemon=True) for u in urls]
+        for t in threads:
+            t.start()
+        lb_text = _fetch(self.lb_url + "/metrics") if self.lb_url \
+            else None
+        for t in threads:
+            t.join(timeout=2 * _SCRAPE_TIMEOUT + 0.5)
+        for url in urls:
+            docs = results.get(url) or {}
+            if docs.get("metrics"):
+                self._record_replica_metrics(url, docs["metrics"], now)
+            if docs.get("perf"):
+                self._record_replica_perf(url, docs["perf"], now)
+        if lb_text:
+            self._record_lb_metrics(lb_text, now)
+        self._last_urls = urls
+        self._last_collect = now
+        if self.monitor is not None:
+            self.monitor.evaluate(now)
+            self.controller.autoscaler.collect_latency_signals(
+                self.monitor.latency_signals())
+
+    def _record_replica_metrics(self, url: str, text: str,
+                                now: float) -> None:
+        try:
+            families = promtext.parse(text)
+        except promtext.ParseError:
+            return
+        for name in _REPLICA_GAUGES + _REPLICA_COUNTERS:
+            fam = families.get(name)
+            if fam is None or not fam.samples:
+                continue
+            self.store.record(name,
+                              promtext.counter_total(families, name),
+                              now, replica=url)
+        for name in _REPLICA_HISTS:
+            try:
+                snap = promtext.histogram(families, name)
+            except ValueError:
+                snap = None
+            if snap is not None:
+                self.store.record_histogram(name, snap, now,
+                                            replica=url)
+        try:
+            step = promtext.histogram(families, _STEP_FAMILY,
+                                      phase="decode")
+        except ValueError:
+            step = None
+        if step is not None:
+            self.store.record_histogram(_STEP_FAMILY, step, now,
+                                        replica=url, phase="decode")
+
+    def _record_replica_perf(self, url: str, text: str,
+                             now: float) -> None:
+        try:
+            doc = json.loads(text)
+        except ValueError:
+            return
+        if not isinstance(doc, dict) or not doc.get("armed"):
+            return
+        self.store.record("stpu_perf_busy_fraction",
+                          doc.get("busy_fraction", 0.0), now,
+                          replica=url)
+        tok = doc.get("tokens_per_sec") or {}
+        for phase in ("prefill", "decode"):
+            self.store.record("stpu_perf_tokens_per_sec",
+                              tok.get(phase, 0.0), now,
+                              replica=url, phase=phase)
+
+    def _record_lb_metrics(self, text: str, now: float) -> None:
+        try:
+            families = promtext.parse(text)
+        except promtext.ParseError:
+            return
+        for name in _LB_HISTS:
+            try:
+                snap = promtext.histogram(families, name)
+            except ValueError:
+                snap = None
+            if snap is not None:
+                self.store.record_histogram(name, snap, now)
+        fam = families.get(_LB_REQUESTS)
+        if fam is not None:
+            by_code: Dict[str, float] = {}
+            for s in fam.samples:
+                code = s.label("code")
+                by_code[code] = by_code.get(code, 0.0) + s.value
+            for code, total in by_code.items():
+                self.store.record(_LB_REQUESTS, total, now, code=code)
+
+    # ------------------------------------------------------------ views
+    def _quantiles(self, name: str, window: float, now: float,
+                   **labels: Any) -> Optional[Dict[str, Any]]:
+        snap = self.store.histogram_delta(name, window, now, **labels)
+        if snap is None or snap.count <= 0:
+            return None
+        return {"p50": snap.quantile(0.5), "p99": snap.quantile(0.99),
+                "count": snap.count}
+
+    def _replica_view(self, url: str, window: float,
+                      now: float) -> Dict[str, Any]:
+        store = self.store
+        return {
+            "busy_fraction": store.latest("stpu_perf_busy_fraction",
+                                          replica=url),
+            "tokens_per_sec": {
+                phase: store.latest("stpu_perf_tokens_per_sec",
+                                    replica=url, phase=phase)
+                for phase in ("prefill", "decode")},
+            "decode_tokens_per_sec": store.rate(
+                "stpu_engine_decode_tokens_total", window, now,
+                replica=url),
+            "slots": {
+                "occupied": store.latest("stpu_engine_slots_occupied",
+                                         replica=url),
+                "total": store.latest("stpu_engine_slots_total",
+                                      replica=url)},
+            "kv_pool": {
+                "free": store.latest("stpu_engine_kv_pool_blocks_free",
+                                     replica=url),
+                "total": store.latest(
+                    "stpu_engine_kv_pool_blocks_total", replica=url)},
+            "queue_depth": store.latest("stpu_engine_queue_depth",
+                                        replica=url),
+            "ttft": self._quantiles("stpu_engine_ttft_seconds", window,
+                                    now, replica=url),
+        }
+
+    def doc(self, series: Optional[str] = None,
+            since: Optional[float] = None,
+            now: Optional[float] = None) -> Dict[str, Any]:
+        """The GET /fleet document (JSON-safe: non-finite floats are
+        None). Live views use the SLO fast window as their trailing
+        window so `stpu top` and the burn monitor read the same data."""
+        now = time.time() if now is None else now
+        window = (self.monitor.fast_window if self.monitor is not None
+                  else slo_lib.fast_window_seconds())
+        autoscaler = self.controller.autoscaler
+        doc: Dict[str, Any] = {
+            "service": self.controller.service_name,
+            "collected_at": self._last_collect,
+            "interval_s": self.interval,
+            "window_s": window,
+            "replicas": {url: self._replica_view(url, window, now)
+                         for url in self._last_urls},
+            "lb": {
+                "ttfb": self._quantiles("stpu_lb_ttfb_seconds", window,
+                                        now),
+                "request_rate": self.store.rate(_LB_REQUESTS, window,
+                                                now)},
+            "slo": ((self.monitor.state() or None)
+                    if self.monitor is not None else None),
+            "autoscaler": {
+                "policy": type(autoscaler).__name__,
+                "target": autoscaler.target_num_replicas,
+                "qps": autoscaler._last_qps,
+                "last_decision": (
+                    list(autoscaler.decision_history)[-1]
+                    if autoscaler.decision_history else None)},
+            "series_names": self.store.series_names(),
+        }
+        if series:
+            doc["series_data"] = self.store.to_doc(series, since=since)
+        return _sanitize(doc)
+
+
+def maybe_start(controller, lb_url: str) -> Optional[FleetCollector]:
+    """Start the collector for ``controller`` unless disarmed. The
+    disarmed path constructs NOTHING — no store, no monitor, no thread
+    (the zero-overhead contract)."""
+    if not enabled():
+        return None
+    collector = FleetCollector(controller, lb_url)
+    controller.fleet = collector
+    collector.start()
+    return collector

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import http.client
 import http.server
+import json
 import os
 import random
 import socketserver
@@ -45,6 +46,17 @@ _LATENCY = metrics.histogram(
     "stpu_lb_request_duration_seconds",
     "Wall time from request receipt to last proxied byte.",
     ("code",))
+# Service-edge TTFT: receipt → FIRST upstream byte proxied. This is
+# what a streaming client experiences as time-to-first-token —
+# including LB queueing, retries, and upstream delays the replica's
+# own stpu_engine_ttft_seconds cannot see — so the SLO ttft objective
+# (observability/slo.py) evaluates THIS family. Buckets match the
+# engine family so fleet-store quantiles stay comparable.
+_TTFB = metrics.histogram(
+    "stpu_lb_ttfb_seconds",
+    "Wall time from request receipt to first proxied response byte "
+    "(the service-edge TTFT a streaming client observes).",
+    buckets=metrics.LATENCY_BUCKETS)
 _STREAMED = metrics.histogram(
     "stpu_lb_streamed_bytes",
     "Response bytes streamed to the client per request.",
@@ -266,6 +278,11 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
     # (autoscaler decisions, replica-state gauges) — rides the /sync
     # reply in LB-as-a-process mode and is merged into /metrics.
     controller_metrics_text: str = ""
+    # Controller sync-server URL (LB-as-a-process mode): GET /fleet on
+    # the service endpoint is forwarded there, where the fleet
+    # telemetry store lives. Empty = no controller (bare in-process
+    # LB) → /fleet answers 503.
+    controller_url: str = ""
 
     def log_message(self, fmt, *args):  # quiet
         del fmt, args
@@ -298,7 +315,8 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
             return []
 
     def _fetch_replicas(self, path: str, timeout: float = 2.0,
-                        urls: Optional[List[str]] = None
+                        urls: Optional[List[str]] = None,
+                        errors: Optional[Dict[str, str]] = None
                         ) -> Dict[str, str]:
         """Fetch ``path`` from each ready replica CONCURRENTLY, so
         fetch latency is bounded by one timeout, not timeout x
@@ -306,7 +324,10 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
         caller). Unreachable replicas / missing endpoints are skipped.
         Returns url -> response text. ``urls`` lets the caller pin one
         snapshot of the ready set (it can change under a concurrent
-        controller sync)."""
+        controller sync). A caller-provided ``errors`` dict collects
+        url -> failure string for the skipped replicas (the degraded
+        /perf merge reports them instead of silently dropping them);
+        a thread still running at join-timeout is recorded there too."""
         if urls is None:
             urls = self._replica_urls()
         if not urls:
@@ -319,8 +340,9 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                         url.rstrip("/") + path,
                         timeout=timeout) as resp:
                     docs[url] = resp.read().decode("utf-8", "replace")
-            except Exception:  # noqa: stpu-except — best-effort scrape; an unreachable replica just contributes no doc
-                pass
+            except Exception as e:  # noqa: stpu-except — best-effort scrape; an unreachable replica just contributes no doc
+                if errors is not None:
+                    errors[url] = f"{type(e).__name__}: {e}"
 
         threads = [threading.Thread(target=fetch, args=(u,),
                                     daemon=True) for u in urls]
@@ -328,6 +350,11 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
             t.start()
         for t in threads:
             t.join(timeout=timeout + 0.5)
+        if errors is not None:
+            for url in urls:
+                if url not in docs and url not in errors:
+                    errors[url] = "timeout: no response within scrape "
+                    errors[url] += "window"
         return docs
 
     def _scrape_replicas(self, timeout: float = 2.0) -> List[str]:
@@ -349,18 +376,32 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
         of the service endpoint covers the whole serving stack."""
         import json as json_lib
         replicas: Dict[str, dict] = {}
-        for url, text in self._fetch_replicas("/perf").items():
+        healthy: Dict[str, dict] = {}
+        errors: Dict[str, str] = {}
+        for url, text in self._fetch_replicas(
+                "/perf", errors=errors).items():
             try:
                 doc = json_lib.loads(text)
             except ValueError:
+                errors[url] = "invalid JSON from /perf"
                 continue
             if isinstance(doc, dict):
-                replicas[url] = doc
-        agg: Dict[str, object] = {"replicas": len(replicas)}
+                healthy[url] = replicas[url] = doc
+            else:
+                errors[url] = "non-object /perf document"
+        # A replica that timed out mid-scrape (or answered garbage) is
+        # REPORTED, not silently dropped: it appears under `replicas`
+        # with an error marker and is excluded from the aggregate so
+        # the healthy fleet's numbers aren't diluted by zeros.
+        for url, err in errors.items():
+            replicas[url] = {"error": err}
+        agg: Dict[str, object] = {"replicas": len(healthy)}
+        if errors:
+            agg["errors"] = len(errors)
         phases: Dict[str, Dict[str, float]] = {}
         tok = {"prefill": 0.0, "decode": 0.0}
         busy = []
-        for doc in replicas.values():
+        for doc in healthy.values():
             for p, d in (doc.get("phases") or {}).items():
                 slot = phases.setdefault(p, {"steps": 0,
                                              "seconds": 0.0})
@@ -387,7 +428,7 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
     def _proxy(self, method: str) -> None:
         self.recorder.record()
         t0 = time.perf_counter()
-        stats = {"code": 0, "bytes": 0}
+        stats = {"code": 0, "bytes": 0, "t0": t0}
         # Root span of the request's trace (tracing.ENABLED guard =
         # zero tracing cost unarmed). A client that is itself traced
         # (e.g. a traced launch curling the endpoint) parents us via
@@ -611,6 +652,8 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
         Appends to ``started`` before the first write so the caller can
         tell a clean failure from a mid-stream one."""
         started.append(True)
+        if "t0" in stats:
+            _TTFB.observe(time.perf_counter() - stats["t0"])
         self.send_response(resp.status)
         clen = resp.getheader("Content-Length")
         for k, v in resp.getheaders():
@@ -639,12 +682,46 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                 stats["bytes"] += len(chunk)
             end_chunks(self.wfile)
 
+    def _serve_fleet(self) -> None:
+        """GET /fleet: forwarded to the controller's sync server (the
+        fleet telemetry store is controller-resident; the LB just makes
+        it reachable on the service endpoint). Not a proxied request —
+        like /metrics and /perf, observability never counts as
+        traffic."""
+        if not self.controller_url:
+            body = (b'{"error": "no controller attached; /fleet needs '
+                    b'the LB-as-a-process mode"}')
+            code = 503
+        else:
+            try:
+                with urllib.request.urlopen(
+                        self.controller_url.rstrip("/") + self.path,
+                        timeout=5.0) as resp:
+                    body = resp.read()
+                    code = resp.status
+            except urllib.error.HTTPError as e:
+                body = e.read()
+                code = e.code
+            except Exception as e:  # noqa: stpu-except — a dead controller yields a clean 502 document, not a hung scrape
+                body = json.dumps(
+                    {"error": f"controller unreachable: "
+                              f"{type(e).__name__}"}).encode()
+                code = 502
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         if self.path == "/metrics":
             self._serve_metrics()
             return
         if self.path == "/perf":
             self._serve_perf()
+            return
+        if self.path.split("?", 1)[0] == "/fleet":
+            self._serve_fleet()
             return
         self._proxy("GET")
 
@@ -702,8 +779,6 @@ def run_lb_process(port: int, controller_url: str,
     YAML's ``load_balancing_policy``); default env STPU_LB_POLICY or
     round_robin.
     """
-    import json
-
     from skypilot_tpu.serve.load_balancing_policies import make_policy
     policy = make_policy(policy_name
                          or os.environ.get("STPU_LB_POLICY"))
@@ -711,7 +786,10 @@ def run_lb_process(port: int, controller_url: str,
     breaker = CircuitBreaker()
     handler_cls = type("Handler", (_ProxyHandler,),
                        {"policy": policy, "recorder": recorder,
-                        "breaker": breaker})
+                        "breaker": breaker,
+                        # /fleet forwards to the controller, where the
+                        # fleet telemetry store lives.
+                        "controller_url": controller_url})
     server = _ThreadingHTTPServer(("0.0.0.0", port), handler_cls)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     while True:

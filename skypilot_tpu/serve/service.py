@@ -142,11 +142,20 @@ def run_service(service_name: str, task_yaml: str, lb_port: int) -> None:
     supervisor.spawn()
     threading.Thread(target=supervisor.watch, daemon=True).start()
 
+    # Fleet telemetry collector (scrapes replicas + LB into the
+    # controller-resident store, drives the SLO monitor and the
+    # latency autoscaling signal). No-op when STPU_FLEET=0.
+    from skypilot_tpu.serve import fleet
+    collector = fleet.maybe_start(controller,
+                                  f"http://127.0.0.1:{lb_port}")
+
     clean_exit = False
     try:
         controller.run()
         clean_exit = True
     finally:
+        if collector is not None:
+            collector.stop()
         if clean_exit:
             # Service is going away on purpose: stop the data plane too.
             supervisor.stop()

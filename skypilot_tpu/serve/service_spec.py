@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.utils import schemas
@@ -57,6 +57,15 @@ class SkyServiceSpec:
     # (serve/gang_replica.py). Stored as a plain dict so the frozen
     # spec stays json-round-trippable through serve_state.
     replica_topology: Optional[Dict[str, Any]] = None
+    # Autoscaling signal: "qps" (default, RequestRateAutoscaler) or
+    # "latency" (LatencyAwareAutoscaler — QPS target plus SLO burn
+    # pressure from the fleet collector's latency_signals() seam).
+    scaling_policy: str = "qps"
+    # SLO objectives ([{kind, target, threshold_seconds}, ...]) for
+    # observability/slo.py. Stored as plain dicts, like
+    # replica_topology, so the frozen spec stays json-round-trippable;
+    # Objective.from_config validates/normalizes each at build time.
+    slo_objectives: Optional[Tuple[Dict[str, Any], ...]] = None
 
     @property
     def autoscaling_enabled(self) -> bool:
@@ -100,9 +109,23 @@ class SkyServiceSpec:
                     topology).to_config()
             except gang_replica.GangError as e:
                 raise exceptions.InvalidTaskError(str(e)) from e
+        slo = config.get("slo")
+        slo_objectives = None
+        if slo is not None:
+            # Kind-specific validation beyond the schema's shape check
+            # (latency kinds need threshold_seconds); normalized dicts
+            # keep the frozen spec json-round-trippable.
+            from skypilot_tpu.observability import slo as slo_lib
+            try:
+                slo_objectives = tuple(
+                    slo_lib.Objective.from_config(obj).to_config()
+                    for obj in slo["objectives"])
+            except ValueError as e:
+                raise exceptions.InvalidTaskError(str(e)) from e
         kwargs: Dict[str, Any] = dict(
             readiness_path=path, initial_delay_seconds=delay,
             readiness_post_data=post,
+            slo_objectives=slo_objectives,
             upstream_timeout_seconds=config.get(
                 "upstream_timeout_seconds",
                 DEFAULT_UPSTREAM_TIMEOUT_SECONDS),
@@ -129,7 +152,14 @@ class SkyServiceSpec:
                     "base_ondemand_fallback_replicas", 0),
                 dynamic_ondemand_fallback=policy.get(
                     "dynamic_ondemand_fallback", False),
+                scaling_policy=policy.get("scaling_policy", "qps"),
             )
+            if (kwargs["scaling_policy"] == "latency" and
+                    policy.get("target_qps_per_replica") is None):
+                raise exceptions.InvalidTaskError(
+                    "scaling_policy: latency needs "
+                    "target_qps_per_replica — QPS remains the "
+                    "baseline signal; SLO burn only biases it.")
         elif static is not None:
             kwargs.update(min_replicas=static)
         return cls(**kwargs)
@@ -167,7 +197,12 @@ class SkyServiceSpec:
                     self.base_ondemand_fallback_replicas
             if self.dynamic_ondemand_fallback:
                 policy["dynamic_ondemand_fallback"] = True
+            if self.scaling_policy != "qps":
+                policy["scaling_policy"] = self.scaling_policy
             out["replica_policy"] = policy
         else:
             out["replicas"] = self.min_replicas
+        if self.slo_objectives:
+            out["slo"] = {"objectives":
+                          [dict(o) for o in self.slo_objectives]}
         return out

@@ -79,6 +79,31 @@ _KNOBS = (
        "sanctioned sync on the serve hot path)."),
     _k("STPU_DISABLE_USAGE_COLLECTION", "0",
        "\"1\" disables usage reporting (wins over configured sinks)."),
+    # ------------------------------------------------ fleet telemetry
+    _k("STPU_FLEET", "1",
+       "\"0\" disarms the controller-resident fleet telemetry "
+       "collector (no store, no SLO monitor, /fleet answers 503)."),
+    _k("STPU_FLEET_COLLECT_SECONDS", "0",
+       "Fleet collector scrape period, seconds (0 = follow the "
+       "controller tick)."),
+    _k("STPU_FLEET_RAW_SECONDS", "10",
+       "Fleet store raw-tier bucket width, seconds."),
+    _k("STPU_FLEET_RAW_RETENTION", "900",
+       "Fleet store raw-tier retention, seconds; older points "
+       "downsample into the rollup tier."),
+    _k("STPU_FLEET_ROLLUP_SECONDS", "60",
+       "Fleet store rollup-tier bucket width, seconds."),
+    _k("STPU_FLEET_ROLLUP_RETENTION", "86400",
+       "Fleet store rollup-tier retention, seconds (the telemetry "
+       "horizon)."),
+    _k("STPU_SLO_FAST_WINDOW", "300",
+       "SLO burn-rate fast window, seconds (page-worthy burn)."),
+    _k("STPU_SLO_SLOW_WINDOW", "3600",
+       "SLO burn-rate slow window, seconds (sustained burn; breach "
+       "needs BOTH windows over the threshold)."),
+    _k("STPU_SLO_BURN_THRESHOLD", "1.0",
+       "Burn-rate multiple that trips a breach in both windows (1.0 "
+       "= burning the error budget exactly at the sustainable rate)."),
     # ------------------------------------------------ chaos
     _k("STPU_FAULTS", None,
        "Fault-injection spec (point:mode:p=..;...) armed at import."),

@@ -119,6 +119,47 @@ _SERVICE_SCHEMA = {
                 "downscale_delay_seconds": {"type": "integer"},
                 "base_ondemand_fallback_replicas": {"type": "integer"},
                 "dynamic_ondemand_fallback": {"type": "boolean"},
+                # Keep in sync with serve.autoscalers.from_spec (the
+                # schema layer must not import the serve stack).
+                "scaling_policy": {
+                    "type": "string",
+                    "enum": ["qps", "latency"],
+                },
+            },
+        },
+        # SLO objectives evaluated by the controller's fleet collector
+        # (observability/slo.py). Kind-specific constraints (latency
+        # kinds need threshold_seconds) are enforced by
+        # slo.Objective.from_config at spec-build time.
+        "slo": {
+            "type": "object",
+            "additionalProperties": False,
+            "required": ["objectives"],
+            "properties": {
+                "objectives": {
+                    "type": "array",
+                    "minItems": 1,
+                    "items": {
+                        "type": "object",
+                        "additionalProperties": False,
+                        "required": ["kind"],
+                        "properties": {
+                            "kind": {
+                                "type": "string",
+                                "enum": ["ttft", "tpot", "error_rate"],
+                            },
+                            "target": {
+                                "type": "number",
+                                "exclusiveMinimum": 0,
+                                "exclusiveMaximum": 1,
+                            },
+                            "threshold_seconds": {
+                                "type": "number",
+                                "exclusiveMinimum": 0,
+                            },
+                        },
+                    },
+                },
             },
         },
     },

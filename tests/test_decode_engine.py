@@ -310,17 +310,23 @@ def _tiny_cfg(family):
     return llama, llama.LlamaConfig.tiny(vocab_size=128)
 
 
-@pytest.mark.parametrize("family", ["llama", "mixtral", "gemma"])
-def test_prefix_hit_token_identical_and_fewer_steps(family):
+@pytest.mark.parametrize("family,paged", [
+    ("llama", False), ("mixtral", False), ("gemma", False),
+    ("llama", True),
+], ids=["llama", "mixtral", "gemma", "llama-paged"])
+def test_prefix_hit_token_identical_and_fewer_steps(family, paged):
     """A prefix-cache hit must change ONLY latency: the warm stream is
     token-identical to the fixed-path (cold) decode, prefill tokens
     are actually saved, and steps-to-first-token (chunk prefills, the
-    deterministic TTFT) is STRICTLY lower than the cold run's."""
+    deterministic TTFT) is STRICTLY lower than the cold run's. The
+    contract holds identically for the dense splice cache and the
+    paged pool's zero-copy aliasing (same stats()/Request surface)."""
     mdl, cfg = _tiny_cfg(family)
     vocab = cfg.vocab_size
     params = mdl.init(cfg, jax.random.key(0))
     engine = DecodeEngine(cfg, params, slots=2, max_seq=64,
-                          prefill_chunk=8, prefix_cache_mb=8.0).start()
+                          prefill_chunk=8, prefix_cache_mb=8.0,
+                          paged=paged).start()
     try:
         shared = [int(t) for t in jax.random.randint(
             jax.random.key(11), (17,), 1, vocab)]  # 2 full 8-chunks
@@ -342,19 +348,24 @@ def test_prefix_hit_token_identical_and_fewer_steps(family):
         engine.shutdown()
 
 
-def test_prefix_hit_seeded_sampling_parity():
+@pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
+def test_prefix_hit_seeded_sampling_parity(paged):
     """A temperature>0 stream is bit-identical warm vs cold: the hit
     restores the exact KV rows prefill would recompute, and the
-    fold_in(seed, position) keys never see the cache."""
+    fold_in(seed, position) keys never see the cache. The cold
+    baseline is always the dense no-cache engine; the warm engine is
+    parametrized over both cache implementations (the paged pool's
+    prefix trie is always on, so its cold run would not be cold)."""
     cfg = llama.LlamaConfig.tiny(vocab_size=128)
     params = llama.init(cfg, jax.random.key(0))
     prompt = [int(t) for t in jax.random.randint(
         jax.random.key(3), (21,), 1, 128)]
 
-    def run(prefix_mb):
+    def run(prefix_mb, engine_paged=False):
         engine = DecodeEngine(cfg, params, slots=2, max_seq=64,
                               prefill_chunk=8,
-                              prefix_cache_mb=prefix_mb).start()
+                              prefix_cache_mb=prefix_mb,
+                              paged=engine_paged).start()
         try:
             # Sequential on purpose: the second submission must see the
             # first's published chunks (cache-hit path).
@@ -368,11 +379,12 @@ def test_prefix_hit_seeded_sampling_parity():
             engine.shutdown()
 
     cold1, cold2, _ = run(prefix_mb=0.0)
-    warm1, warm2, warm_req = run(prefix_mb=8.0)
+    warm1, warm2, warm_req = run(prefix_mb=8.0, engine_paged=paged)
     assert cold1 == cold2 == warm1 == warm2
     assert warm_req.cached_prompt_tokens > 0  # the hit really happened
 
 
+@pytest.mark.dense_splice
 def test_prefix_pool_lru_refcount_and_interior_protection():
     """Pool-level eviction contract: LRU leaves go first, nodes pinned
     by a live match are NEVER evicted even over budget, and an interior
@@ -420,6 +432,7 @@ def test_prefix_pool_lru_refcount_and_interior_protection():
     assert all(n.refs == 0 for n in pool.nodes())
 
 
+@pytest.mark.dense_splice
 def test_engine_slot_churn_respects_pool_budget_and_parity():
     """Slot churn through a ONE-chunk pool: every stream stays
     token-identical to the fixed path while eviction constantly
@@ -452,6 +465,7 @@ def test_engine_slot_churn_respects_pool_budget_and_parity():
         engine.shutdown()
 
 
+@pytest.mark.dense_splice
 def test_cancel_mid_prefill_releases_chunk_refcounts():
     """A request cancelled between admission and prefill completion
     must release every pinned pool node (engine driven step-by-step on
@@ -483,6 +497,7 @@ def test_cancel_mid_prefill_releases_chunk_refcounts():
     assert second.result(timeout=5.0) == []   # clean cancelled stream
 
 
+@pytest.mark.dense_splice
 def test_prefix_metrics_reach_replica_endpoint():
     """Hit/miss/tokens-saved counters, the occupancy gauge and the
     split TTFT histogram are part of the replica's /metrics surface

@@ -1,0 +1,517 @@
+"""Fleet telemetry store + SLO burn monitor + latency autoscaling e2e.
+
+The acceptance story (ISSUE 14): a loadgen run drives a stub serving
+stack whose LB-side TTFB lands in the controller-resident
+TimeSeriesStore via the fleet collector; the store's p99 matches the
+loadgen client's within one histogram bucket; an injected
+``lb.upstream`` delay fault trips the fast burn window → ``slo_breach``
+event → the ``scaling_policy: latency`` autoscaler scales up → after
+recovery both windows clear → ``slo_recovered`` → scale back down —
+all asserted through the real ``GET /fleet`` path (controller sync
+server, forwarded by the LB) and the ``stpu top`` / ``stpu slo`` CLI.
+
+Plus the pins: the collector's scrape→record→doc contract against
+canned endpoints, monitor rebuild on spec swap, the satellite-3 CLI
+guarantee (None renders as ``-``, never ``nan``), and the disarmed
+zero-overhead contract (STPU_FLEET=0 constructs nothing — enforced
+with monkeypatch bombs on every constructor the armed path uses).
+"""
+import bisect
+import http.server
+import json
+import socket
+import socketserver
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from skypilot_tpu.benchmark import loadgen
+from skypilot_tpu.observability import events
+from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability.timeseries import TimeSeriesStore
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import fleet
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve.load_balancing_policies import RoundRobinPolicy
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+from skypilot_tpu.utils import fault_injection as fi
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+class _Server(socketserver.ThreadingMixIn, http.server.HTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def handle_error(self, request, client_address):
+        pass
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _start(handler_cls):
+    server = _Server(("127.0.0.1", 0), handler_cls)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+def _canned(routes):
+    """HTTP server answering GET from a {path: body-or-callable} map."""
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = routes.get(self.path)
+            if body is None:
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            data = body() if callable(body) else body
+            if isinstance(data, str):
+                data = data.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    return _start(Handler)
+
+
+# ====================================================== collector unit
+def _fake_controller(spec=None):
+    spec = spec or SkyServiceSpec(min_replicas=1)
+    return SimpleNamespace(
+        service_name="svc", spec=spec, _ready_urls=[], fleet=None,
+        autoscaler=autoscalers.Autoscaler.from_spec(spec))
+
+
+def test_collector_scrape_record_and_doc():
+    """One collect tick pulls every allowlisted replica family plus
+    the LB edge families into the store; doc() is the JSON-safe live
+    view over them, with a dead replica degrading to None fields."""
+    reg = metrics.Registry()
+    slots = reg.gauge("stpu_engine_slots_occupied")
+    slots.set(3)
+    reg.gauge("stpu_engine_slots_total").set(8)
+    reg.gauge("stpu_engine_queue_depth").set(2)
+    reg.gauge("stpu_engine_kv_pool_blocks_free").set(10)
+    reg.gauge("stpu_engine_kv_pool_blocks_total").set(16)
+    decode_total = reg.counter("stpu_engine_decode_tokens_total")
+    decode_total.inc(100)
+    ttft = reg.histogram("stpu_engine_ttft_seconds", buckets=(0.1, 1.0))
+    ttft.observe(0.05)
+    step = reg.histogram("stpu_engine_step_seconds", "", ("phase",),
+                         buckets=(0.1, 1.0))
+    step.labels(phase="decode").observe(0.01)
+    perf = {"armed": True, "busy_fraction": 0.5,
+            "tokens_per_sec": {"prefill": 10.0, "decode": 200.0}}
+    replica_srv, replica_url = _canned({
+        "/metrics": reg.render,
+        "/perf": lambda: json.dumps(perf)})
+
+    lbreg = metrics.Registry()
+    ttfb = lbreg.histogram("stpu_lb_ttfb_seconds", buckets=(0.1, 1.0))
+    ttfb.observe(0.05)
+    requests = lbreg.counter("stpu_lb_requests_total", "", ("code",))
+    requests.labels(code="200").inc(5)
+    requests.labels(code="502").inc(1)
+    lb_srv, lb_url = _canned({"/metrics": lbreg.render})
+
+    dead_url = f"http://127.0.0.1:{_free_port()}"
+    controller = _fake_controller()
+    controller._ready_urls = [replica_url]
+    store = TimeSeriesStore(raw_seconds=1.0, raw_retention=10000.0)
+    collector = fleet.FleetCollector(controller, lb_url, interval=5.0,
+                                     store=store)
+    try:
+        collector.collect_once(now=100.0)
+        assert store.latest("stpu_engine_slots_occupied",
+                            replica=replica_url) == 3.0
+        assert store.latest("stpu_engine_decode_tokens_total",
+                            replica=replica_url) == 100.0
+        assert store.latest("stpu_perf_busy_fraction",
+                            replica=replica_url) == 0.5
+        assert store.latest("stpu_perf_tokens_per_sec",
+                            replica=replica_url, phase="decode") == 200.0
+        assert store.latest("stpu_lb_requests_total", code="200") == 5.0
+
+        # The world moves on; the dead replica joins the ready set.
+        slots.set(4)
+        decode_total.inc(60)
+        ttft.observe(0.05)
+        ttft.observe(0.5)
+        perf["busy_fraction"] = 0.7
+        perf["tokens_per_sec"]["decode"] = 250.0
+        for v in (0.05, 0.05, 0.5):
+            ttfb.observe(v)
+        requests.labels(code="200").inc(3)
+        requests.labels(code="502").inc(1)
+        controller._ready_urls = [replica_url, dead_url]
+        collector.collect_once(now=130.0)
+    finally:
+        replica_srv.shutdown()
+        lb_srv.shutdown()
+
+    doc = collector.doc(now=130.0)
+    assert doc["service"] == "svc"
+    assert doc["collected_at"] == 130.0
+    live = doc["replicas"][replica_url]
+    assert live["busy_fraction"] == 0.7
+    assert live["slots"] == {"occupied": 4.0, "total": 8.0}
+    assert live["tokens_per_sec"]["decode"] == 250.0
+    # Counter-derived decode rate: 60 new tokens over the live window.
+    assert live["decode_tokens_per_sec"] == pytest.approx(
+        60.0 / doc["window_s"])
+    assert live["ttft"]["count"] == 2
+    # The dead replica contributed no points: every field None, and
+    # the doc still JSON-serializes (sanitized, no NaN leakage).
+    dead = doc["replicas"][dead_url]
+    assert dead["busy_fraction"] is None and dead["ttft"] is None
+    json.dumps(doc)
+    assert doc["lb"]["ttfb"]["count"] == 3
+    assert doc["lb"]["request_rate"] == pytest.approx(
+        4.0 / doc["window_s"])
+    assert doc["slo"] is None                 # no objectives declared
+    assert doc["autoscaler"]["policy"] == "Autoscaler"
+    assert doc["autoscaler"]["target"] == 1
+    assert "stpu_lb_requests_total" in doc["series_names"]
+    with_series = collector.doc(series="stpu_perf_busy_fraction",
+                                now=130.0)
+    assert with_series["series_data"]["series"] == \
+        "stpu_perf_busy_fraction"
+    assert with_series["series_data"]["data"]
+
+
+def test_collector_rebuilds_monitor_on_spec_swap():
+    """`serve update` swaps controller.spec wholesale — the collector
+    rebuilds the monitor on identity change and keeps it otherwise
+    (breach edges must not reset every tick)."""
+    controller = _fake_controller()
+    store = TimeSeriesStore(raw_seconds=1.0, raw_retention=1000.0)
+    collector = fleet.FleetCollector(controller, "", interval=1.0,
+                                     store=store)
+    collector.collect_once(now=10.0)
+    assert collector.monitor is None
+    controller.spec = SkyServiceSpec(
+        min_replicas=1,
+        slo_objectives=({"kind": "error_rate", "target": 0.99},))
+    collector.collect_once(now=20.0)
+    assert collector.monitor is not None
+    assert collector.monitor.objectives[0].kind == "error_rate"
+    monitor = collector.monitor
+    collector.collect_once(now=30.0)
+    assert collector.monitor is monitor
+
+
+# ============================================ satellite 3: '-' not nan
+_CANNED_DOC = {
+    "service": "render-svc",
+    "collected_at": None,
+    "interval_s": 10.0,
+    "window_s": 300.0,
+    "replicas": {
+        "http://10.0.0.1:9009": {
+            "busy_fraction": None,
+            "tokens_per_sec": {"prefill": None, "decode": None},
+            "decode_tokens_per_sec": None,
+            "slots": {"occupied": None, "total": None},
+            "kv_pool": {"free": None, "total": None},
+            "queue_depth": None,
+            "ttft": None,
+        }},
+    "lb": {"ttfb": None, "request_rate": None},
+    "slo": {"service": "render-svc", "fast_window_s": 300.0,
+            "slow_window_s": 3600.0, "burn_threshold": 1.0,
+            "degraded": False,
+            "objectives": [{"kind": "ttft", "target": 0.99,
+                            "threshold_seconds": 1.0,
+                            "burn_fast": None, "burn_slow": None,
+                            "budget_remaining": None,
+                            "breaching": False}]},
+    "autoscaler": {"policy": "LatencyAwareAutoscaler", "target": 1,
+                   "qps": None, "last_decision": None},
+    "series_names": [],
+}
+
+
+def test_cli_top_and_slo_render_missing_data_as_dash(monkeypatch):
+    """An idle fleet (empty histogram windows → None readings) renders
+    as '-' in `stpu top`/`stpu slo` — never 'nan' or a crash."""
+    from click.testing import CliRunner
+
+    from skypilot_tpu import core
+    from skypilot_tpu.cli import cli
+    monkeypatch.setattr(
+        core, "fleet_snapshot",
+        lambda url, series=None, since=None: dict(_CANNED_DOC))
+    runner = CliRunner()
+    res = runner.invoke(cli, ["top", "--url", "http://fake"])
+    assert res.exit_code == 0, res.output
+    assert "render-svc" in res.output
+    assert "collected never" in res.output
+    assert "p50 -" in res.output and "rate -/s" in res.output
+    assert "-/-" in res.output                # tok/s, slots, pool cells
+    assert "(qps -)" in res.output
+    assert "nan" not in res.output.lower()
+    assert "None" not in res.output
+    assert "BREACHING" not in res.output and "DEGRADED" not in res.output
+
+    res = runner.invoke(cli, ["slo", "--url", "http://fake"])
+    assert res.exit_code == 0, res.output
+    assert "ttft" in res.output and "ok" in res.output
+    assert "nan" not in res.output.lower()
+    assert "BREACHING" not in res.output
+
+
+# ======================================= disarmed: zero-overhead pins
+def test_fleet_disarmed_constructs_nothing(monkeypatch):
+    """STPU_FLEET=0: maybe_start returns None without touching ANY of
+    the armed path's constructors — store, monitor, collector."""
+    assert fleet.enabled()                    # armed by default
+
+    def boom(*a, **kw):
+        raise AssertionError("constructed despite STPU_FLEET=0")
+
+    monkeypatch.setenv("STPU_FLEET", "0")
+    monkeypatch.setattr(fleet, "FleetCollector", boom)
+    monkeypatch.setattr(fleet, "store_from_env", boom)
+    monkeypatch.setattr(fleet.timeseries, "TimeSeriesStore", boom)
+    monkeypatch.setattr(fleet.slo_lib, "SloMonitor", boom)
+    controller = SimpleNamespace(fleet=None)
+    assert fleet.maybe_start(controller, "http://127.0.0.1:1") is None
+    assert controller.fleet is None
+
+
+# ================================================================= e2e
+class _StubReplica(http.server.BaseHTTPRequestHandler):
+    """Stub serving replica: SSE token stream with a pre-headers
+    'prefill' delay (so LB TTFB and client TTFT share a dominant
+    constant), /metrics from the process registry, /perf armed."""
+    protocol_version = "HTTP/1.1"
+    headers_delay = 0.12
+    delay = 0.002
+    token_cap = 4
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if self.path == "/perf":
+            body = json.dumps(
+                {"armed": True, "steps": 4, "busy_fraction": 0.25,
+                 "tokens_per_sec": {"prefill": 0.0,
+                                    "decode": 50.0}}).encode()
+        elif self.path == "/metrics":
+            body = metrics.render().encode()
+        else:
+            body = b"{}"
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        from skypilot_tpu.serve import decode_engine
+        length = int(self.headers.get("Content-Length") or 0)
+        req = json.loads(self.rfile.read(length) or b"{}")
+        time.sleep(self.headers_delay)
+        t0 = time.perf_counter()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        n = min(int(req.get("max_tokens", 4)), self.token_cap)
+        for i in range(n):
+            time.sleep(self.delay)
+            if i == 0:
+                decode_engine._TTFT.observe(time.perf_counter() - t0)
+            lb_lib.write_chunk(
+                self.wfile, f'data: {{"token": {i}}}\n\n'.encode())
+        lb_lib.write_chunk(self.wfile, b"data: [DONE]\n\n")
+        lb_lib.end_chunks(self.wfile)
+
+
+def _start_lb(policy, **handler_attrs):
+    port = _free_port()
+    handler = type("Handler", (lb_lib._ProxyHandler,), {
+        "policy": policy, "recorder": lb_lib.RequestRecorder(),
+        "breaker": lb_lib.CircuitBreaker(), **handler_attrs})
+    server = lb_lib._ThreadingHTTPServer(("127.0.0.1", port), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://127.0.0.1:{port}"
+
+
+def _bucket_idx(v: float) -> int:
+    return bisect.bisect_left(list(metrics.LATENCY_BUCKETS), v)
+
+
+def test_fleet_e2e_breach_scales_up_and_recovers(tmp_state_dir,
+                                                 tmp_path, monkeypatch):
+    """The acceptance e2e. Timeline (controlled collector timestamps,
+    live scrape content):
+
+      t0       baseline collect (zero-delta windows)
+      t0+10    clean loadgen → store p99 ≈ client p99 (±1 bucket)
+      t0+40    faulted loadgen (lb.upstream delay 0.8s > 0.5s SLO
+               threshold) → fast AND slow burn → slo_breach →
+               latency policy scales 1→2
+      t0+100   clean loadgen → both windows clean → slo_recovered →
+               scales 2→1
+    """
+    from click.testing import CliRunner
+
+    from skypilot_tpu import core
+    from skypilot_tpu.cli import cli
+    from skypilot_tpu.serve.controller import SkyServeController
+    monkeypatch.setenv("STPU_SLO_FAST_WINDOW", "30")
+    monkeypatch.setenv("STPU_SLO_SLOW_WINDOW", "60")
+    service = "fleet-e2e"
+    replica, replica_url = _start(
+        type("R", (_StubReplica,), {}))
+    policy = RoundRobinPolicy()
+    policy.set_ready_replicas([replica_url])
+    spec = SkyServiceSpec(
+        min_replicas=1, max_replicas=3, target_qps_per_replica=100.0,
+        qps_window_seconds=10, upscale_delay_seconds=0,
+        downscale_delay_seconds=0, scaling_policy="latency",
+        slo_objectives=(
+            {"kind": "ttft", "target": 0.9, "threshold_seconds": 0.5},
+            {"kind": "error_rate", "target": 0.9}))
+    controller = SkyServeController(
+        service, spec, task=SimpleNamespace(uses_spot=False))
+    controller._ready_urls = [replica_url]
+    sync_port = controller.start_sync_server()
+    lb, target = _start_lb(
+        policy, controller_url=f"http://127.0.0.1:{sync_port}")
+    scaler = controller.autoscaler
+    assert type(scaler) is autoscalers.LatencyAwareAutoscaler
+    runner = CliRunner()
+
+    # Before the collector attaches, /fleet (forwarded by the LB from
+    # the controller sync server) is a clean error, not a crash.
+    res = runner.invoke(cli, ["top", "--url", target])
+    assert res.exit_code != 0
+
+    store = TimeSeriesStore(raw_seconds=1.0, raw_retention=10000.0)
+    collector = fleet.FleetCollector(controller, target, interval=0.25,
+                                     store=store)
+    controller.fleet = collector   # manual ticks: deterministic tests
+    t0 = 1000.0
+    try:
+        # -------------------------------------------------- baseline
+        collector.collect_once(now=t0)
+        signals = scaler._latency_signals
+        assert signals["degraded"] is False
+        assert signals["ttft"]["burn_fast"] is None   # empty, not NaN
+
+        # ---------------------------------------------- clean traffic
+        clean = loadgen.run(
+            target,
+            loadgen.LoadSpec(mix="chat", qps=10, duration_s=1.5,
+                             seed=11, max_tokens=4),
+            slo_ttft_s=1.0, scrape_interval=0.6,
+            out_dir=str(tmp_path / "clean"))
+        assert clean["requests"]["ok"] > 0
+        collector.collect_once(now=t0 + 10)
+        snap = store.histogram_delta("stpu_lb_ttfb_seconds",
+                                     window=30.0, now=t0 + 10)
+        assert snap is not None and snap.count >= clean["requests"]["ok"]
+        # The tentpole accuracy claim: the store's service-edge p99
+        # lands within one LATENCY_BUCKETS bucket of the loadgen
+        # client's own measurement.
+        client_p99 = clean["latency_s"]["ttft"]["p99"]
+        store_p99 = snap.quantile(0.99)
+        assert abs(_bucket_idx(store_p99) - _bucket_idx(client_p99)) \
+            <= 1, (store_p99, client_p99)
+        signals = scaler._latency_signals
+        assert signals["ttft"]["burn_fast"] == 0.0    # all under 0.5s
+        assert signals["degraded"] is False
+        assert scaler.plan(now=t0 + 10, num_ready=1).total == 1
+
+        # ------------------------------------------------ fault phase
+        loadgen.run(
+            target,
+            loadgen.LoadSpec(mix="chat", qps=8, duration_s=1.2,
+                             seed=4, max_tokens=4),
+            slo_ttft_s=0.5, scrape_interval=0.6,
+            out_dir=str(tmp_path / "slow"),
+            faults="lb.upstream:delay:s=0.8", faults_at=0.0)
+        assert not fi.ENABLED
+        collector.collect_once(now=t0 + 40)
+        signals = scaler._latency_signals
+        # Fast window saw only faulted traffic: 100% bad, burn ==
+        # 1.0 / (1 - 0.9) == 10; slow window mixes clean + faulted but
+        # still burns over threshold.
+        assert signals["ttft"]["burn_fast"] == pytest.approx(10.0)
+        assert signals["ttft"]["burn_slow"] >= 1.0
+        assert signals["ttft"]["breaching"] is True
+        assert signals["degraded"] is True
+        recs = events.read(kind="slo", name=service)
+        assert [r["event"] for r in recs] == ["slo_breach"]
+        assert recs[0]["objective"] == "ttft"
+        # Latency policy: QPS alone says 1 replica; burn scales to 2.
+        assert scaler.plan(now=t0 + 40, num_ready=1).total == 2
+
+        # Asserted through the REAL path: GET /fleet on the service
+        # endpoint (LB → controller sync server → collector.doc()).
+        doc = core.fleet_snapshot(target)
+        assert doc["service"] == service
+        assert doc["slo"]["degraded"] is True
+        by_kind = {o["kind"]: o for o in doc["slo"]["objectives"]}
+        assert by_kind["ttft"]["breaching"] is True
+        assert by_kind["error_rate"]["breaching"] is False  # all 200s
+        assert doc["autoscaler"]["policy"] == "LatencyAwareAutoscaler"
+        assert doc["autoscaler"]["target"] == 2
+        assert replica_url in doc["replicas"]
+
+        res = runner.invoke(cli, ["top", "--url", target])
+        assert res.exit_code == 0, res.output
+        assert service in res.output
+        assert "BREACHING" in res.output
+        assert "DEGRADED" in res.output
+        assert "nan" not in res.output.lower()
+        res = runner.invoke(cli, ["slo", "--url", target])
+        assert res.exit_code == 0, res.output
+        assert "BREACHING" in res.output
+        assert "10.00" in res.output          # the fast burn, rendered
+
+        # --------------------------------------------------- recovery
+        loadgen.run(
+            target,
+            loadgen.LoadSpec(mix="chat", qps=10, duration_s=1.5,
+                             seed=21, max_tokens=4),
+            slo_ttft_s=1.0, scrape_interval=0.6,
+            out_dir=str(tmp_path / "recovered"))
+        collector.collect_once(now=t0 + 100)
+        signals = scaler._latency_signals
+        assert signals["ttft"]["burn_fast"] == 0.0
+        assert signals["degraded"] is False
+        recs = events.read(kind="slo", name=service)
+        assert [r["event"] for r in recs] == ["slo_breach",
+                                              "slo_recovered"]
+        # Burn cleared in BOTH windows: the downscale veto lifts and
+        # the QPS baseline takes the fleet back to 1.
+        assert scaler.plan(now=t0 + 100, num_ready=2).total == 1
+
+        res = runner.invoke(cli, ["top", "--url", target])
+        assert res.exit_code == 0, res.output
+        assert "DEGRADED" not in res.output
+        assert "BREACHING" not in res.output
+    finally:
+        lb.shutdown()
+        replica.shutdown()
+        controller._sync_server.shutdown()

@@ -142,3 +142,145 @@ def test_spec_fallback_yaml_round_trip():
     assert back.base_ondemand_fallback_replicas == 1
     assert back.dynamic_ondemand_fallback
     assert back.min_replicas == 3
+
+
+# --------------------------------------------------- latency-aware policy
+def _burn(fast=None, slow=None, breaching=False):
+    return {"degraded": breaching,
+            "ttft": {"burn_fast": fast, "burn_slow": slow,
+                     "breaching": breaching}}
+
+
+def test_from_spec_dispatches_latency_policy():
+    spec = _spec(scaling_policy="latency")
+    a = autoscalers.Autoscaler.from_spec(spec)
+    assert type(a) is autoscalers.LatencyAwareAutoscaler
+    # Default spec stays on the QPS policy — baseline unchanged.
+    assert type(autoscalers.Autoscaler.from_spec(_spec())) is \
+        autoscalers.RequestRateAutoscaler
+
+
+def test_latency_burn_scales_up_one_replica_at_a_time():
+    a = autoscalers.LatencyAwareAutoscaler(_spec())
+    t0 = 1000.0
+    # No QPS pressure at all: target would stay at min.
+    a.collect_latency_signals(_burn(fast=2.0, slow=2.0, breaching=True))
+    assert a.evaluate_scaling(now=t0).target_num_replicas == 1
+    # After the upscale delay: ONE step up, not a jump to max.
+    assert a.evaluate_scaling(now=t0 + 6).target_num_replicas == 2
+    # Still burning: the next step needs its own delay.
+    assert a.evaluate_scaling(now=t0 + 7).target_num_replicas == 2
+    assert a.evaluate_scaling(now=t0 + 13).target_num_replicas == 3
+
+
+def test_latency_burn_respects_max_replicas():
+    a = autoscalers.LatencyAwareAutoscaler(_spec(max_replicas=2))
+    a.collect_latency_signals(_burn(fast=9.0, slow=9.0, breaching=True))
+    t = 1000.0
+    for dt in (0, 6, 12, 18, 24):
+        a.evaluate_scaling(now=t + dt)
+    assert a.target_num_replicas == 2
+
+
+def test_latency_burn_vetoes_downscale_until_recovered():
+    """Scaled up by burn, QPS target says 1: the fleet must NOT shed
+    replicas while either window still burns, and the downscale clock
+    restarts at recovery (no instant drop on a mid-breach window)."""
+    a = autoscalers.LatencyAwareAutoscaler(_spec())
+    t0 = 1000.0
+    a.collect_latency_signals(_burn(fast=2.0, slow=2.0, breaching=True))
+    a.evaluate_scaling(now=t0)
+    a.evaluate_scaling(now=t0 + 6)
+    assert a.target_num_replicas == 2
+    # Fast window recovered, slow still burning: downscale stays vetoed
+    # far past downscale_delay_seconds.
+    a.collect_latency_signals(_burn(fast=0.1, slow=1.5))
+    for dt in (7, 20, 60):
+        assert a.evaluate_scaling(
+            now=t0 + dt).target_num_replicas == 2
+    assert a._downscale_candidate_since is None
+    # Fully recovered: the delay must elapse AFTER recovery.
+    a.collect_latency_signals(_burn(fast=0.1, slow=0.1))
+    assert a.evaluate_scaling(now=t0 + 61).target_num_replicas == 2
+    assert a.evaluate_scaling(now=t0 + 70).target_num_replicas == 2
+    assert a.evaluate_scaling(now=t0 + 82).target_num_replicas == 1
+
+
+def test_latency_policy_without_signals_is_pure_qps():
+    """No collector feed (STPU_FLEET=0, or warming up): the policy
+    degrades to the QPS baseline — None burn is "no pressure"."""
+    a = autoscalers.LatencyAwareAutoscaler(_spec())
+    t0 = 1000.0
+    a.collect_request_information([t0 - 10 + k / 3.0 for k in range(48)])
+    a.evaluate_scaling(now=t0)
+    assert a.evaluate_scaling(now=t0 + 6).target_num_replicas == 3
+    a.collect_latency_signals(_burn(fast=None, slow=None))
+    # Traffic stops: downscale proceeds normally (None never vetoes).
+    a.evaluate_scaling(now=t0 + 25)
+    assert a.evaluate_scaling(now=t0 + 46).target_num_replicas == 1
+
+
+def test_qps_policy_ignores_latency_signals():
+    a = autoscalers.RequestRateAutoscaler(_spec())
+    a.collect_latency_signals(_burn(fast=9.0, slow=9.0, breaching=True))
+    t0 = 1000.0
+    a.evaluate_scaling(now=t0)
+    assert a.evaluate_scaling(now=t0 + 6).target_num_replicas == 1
+
+
+def test_adopt_state_carries_latency_signals():
+    old = autoscalers.LatencyAwareAutoscaler(_spec())
+    old.collect_latency_signals(_burn(fast=2.0, slow=2.0,
+                                      breaching=True))
+    old.evaluate_scaling(now=1000.0)
+    old.evaluate_scaling(now=1006.0)
+    assert old.target_num_replicas == 2
+    new = autoscalers.Autoscaler.from_spec(_spec(
+        scaling_policy="latency"))
+    new.adopt_state(old)
+    assert new.target_num_replicas == 2
+    assert new._latency_signals == old._latency_signals
+
+
+def test_spec_scaling_policy_and_slo_yaml_round_trip():
+    spec = SkyServiceSpec.from_yaml_config({
+        "readiness_probe": "/health",
+        "replica_policy": {"min_replicas": 1, "max_replicas": 3,
+                           "target_qps_per_replica": 2.0,
+                           "scaling_policy": "latency"},
+        "slo": {"objectives": [
+            {"kind": "ttft", "target": 0.95, "threshold_seconds": 0.5},
+            {"kind": "error_rate"},
+        ]},
+    })
+    assert spec.scaling_policy == "latency"
+    assert spec.slo_objectives[0]["threshold_seconds"] == 0.5
+    back = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert back.scaling_policy == "latency"
+    assert back.slo_objectives == spec.slo_objectives
+    # Defaulted kinds round-trip with their resolved target.
+    assert back.slo_objectives[1] == {"kind": "error_rate",
+                                      "target": 0.99}
+
+
+def test_spec_latency_policy_needs_qps_target():
+    import pytest
+
+    from skypilot_tpu import exceptions
+    with pytest.raises(exceptions.InvalidTaskError, match="latency"):
+        SkyServiceSpec.from_yaml_config({
+            "readiness_probe": "/health",
+            "replica_policy": {"min_replicas": 1, "max_replicas": 3,
+                               "scaling_policy": "latency"},
+        })
+
+
+def test_spec_invalid_slo_objective_rejected():
+    import pytest
+
+    from skypilot_tpu import exceptions
+    with pytest.raises(exceptions.InvalidTaskError, match="threshold"):
+        SkyServiceSpec.from_yaml_config({
+            "readiness_probe": "/health",
+            "slo": {"objectives": [{"kind": "ttft"}]},
+        })

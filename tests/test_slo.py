@@ -1,0 +1,208 @@
+"""SLO burn-rate monitor (observability/slo.py).
+
+The contract under test: Objective config validation, the Google-SRE
+burn definition (bad_fraction / (1 - target)) over fast + slow
+windows, breach = BOTH windows over the threshold with edge-triggered
+slo_breach / slo_recovered events, and the satellite-3 guarantee that
+an empty or all-zero window yields burn None — never NaN, which would
+compare False against the threshold and read as healthy mid-outage.
+"""
+import pytest
+
+from skypilot_tpu.observability import events
+from skypilot_tpu.observability import slo
+from skypilot_tpu.observability.promtext import HistogramSnapshot
+from skypilot_tpu.observability.timeseries import TimeSeriesStore
+
+
+def _snap(counts, bounds=(0.1, 1.0)):
+    cum, total = [], 0.0
+    for c in counts:
+        total += c
+        cum.append(total)
+    return HistogramSnapshot(bounds=list(bounds), cumulative=cum,
+                             sum=float(total), count=total)
+
+
+def _store():
+    return TimeSeriesStore(raw_seconds=1.0, raw_retention=10000.0)
+
+
+def _monitor(store, kind="ttft", target=0.9, threshold_s=1.0,
+             **kw):
+    config = {"kind": kind, "target": target}
+    if threshold_s is not None:
+        config["threshold_seconds"] = threshold_s
+    return slo.SloMonitor(
+        "svc", [slo.Objective.from_config(config)], store,
+        fast_window=10.0, slow_window=100.0, **kw)
+
+
+# ----------------------------------------------------------- objectives
+def test_objective_config_validation():
+    obj = slo.Objective.from_config(
+        {"kind": "ttft", "target": 0.99, "threshold_seconds": 0.5})
+    assert obj.to_config() == {"kind": "ttft", "target": 0.99,
+                               "threshold_seconds": 0.5}
+    # error_rate: no threshold; default target applies.
+    obj = slo.Objective.from_config({"kind": "error_rate"})
+    assert obj.target == 0.99 and obj.threshold_s is None
+    with pytest.raises(ValueError, match="kind"):
+        slo.Objective.from_config({"kind": "latency"})
+    with pytest.raises(ValueError, match="target"):
+        slo.Objective.from_config(
+            {"kind": "ttft", "target": 1.0, "threshold_seconds": 1})
+    with pytest.raises(ValueError, match="threshold_seconds"):
+        slo.Objective.from_config({"kind": "tpot"})
+    with pytest.raises(ValueError, match="threshold_seconds"):
+        slo.Objective.from_config(
+            {"kind": "ttft", "threshold_seconds": 0})
+    with pytest.raises(ValueError, match="no threshold"):
+        slo.Objective.from_config(
+            {"kind": "error_rate", "threshold_seconds": 1})
+
+
+def test_from_spec_returns_none_without_objectives():
+    class Spec:
+        slo_objectives = None
+    assert slo.SloMonitor.from_spec("svc", Spec(), _store()) is None
+
+
+# ------------------------------------------------------------ burn math
+def test_burn_rate_latency_objective():
+    """10% of requests over a 1.0s threshold against a 0.9 target:
+    bad_fraction == 1 - target, so burn == 1.0 in both windows."""
+    store = _store()
+    store.record_histogram("stpu_lb_ttfb_seconds",
+                           _snap([0, 0, 0]), ts=0.0)
+    store.record_histogram("stpu_lb_ttfb_seconds",
+                           _snap([90, 0, 10]), ts=5.0)
+    monitor = _monitor(store, target=0.9, threshold_s=1.0)
+    state = monitor.evaluate(now=5.0)
+    entry = state["objectives"][0]
+    assert entry["burn_fast"] == pytest.approx(1.0)
+    assert entry["burn_slow"] == pytest.approx(1.0)
+    assert entry["budget_remaining"] == pytest.approx(0.0)
+
+
+def test_threshold_resolves_to_enclosing_bucket():
+    """A threshold between bounds counts the cumulative total at the
+    first bound >= threshold (documented bucket resolution)."""
+    store = _store()
+    store.record_histogram("stpu_lb_ttfb_seconds",
+                           _snap([0, 0, 0]), ts=0.0)
+    # 50 at <=0.1, 50 in (0.1, 1.0]; threshold 0.5 resolves to the
+    # 1.0 bound, so all 100 are good.
+    store.record_histogram("stpu_lb_ttfb_seconds",
+                           _snap([50, 50, 0]), ts=5.0)
+    monitor = _monitor(store, target=0.9, threshold_s=0.5)
+    entry = monitor.evaluate(now=5.0)["objectives"][0]
+    assert entry["burn_fast"] == pytest.approx(0.0)
+
+
+def test_tpot_objective_reads_decode_phase_only():
+    store = _store()
+    for phase, counts in (("decode", [0, 0, 0]),
+                          ("prefill", [0, 0, 0])):
+        store.record_histogram("stpu_engine_step_seconds",
+                               _snap(counts), ts=0.0, phase=phase)
+    # Decode clean, prefill awful: only decode may count.
+    store.record_histogram("stpu_engine_step_seconds",
+                           _snap([100, 0, 0]), ts=5.0, phase="decode")
+    store.record_histogram("stpu_engine_step_seconds",
+                           _snap([0, 0, 100]), ts=5.0, phase="prefill")
+    monitor = _monitor(store, kind="tpot", target=0.9, threshold_s=1.0)
+    entry = monitor.evaluate(now=5.0)["objectives"][0]
+    assert entry["burn_fast"] == pytest.approx(0.0)
+
+
+def test_error_rate_objective_counts_5xx_zero_and_aborted():
+    store = _store()
+    for code, t0, t1 in (("200", 0.0, 90.0), ("500", 0.0, 5.0),
+                         ("aborted", 0.0, 3.0), ("0", 0.0, 2.0),
+                         ("404", 0.0, 10.0)):
+        store.record("stpu_lb_requests_total", t0, ts=0.0, code=code)
+        store.record("stpu_lb_requests_total", t1, ts=5.0, code=code)
+    monitor = _monitor(store, kind="error_rate", target=0.9,
+                       threshold_s=None)
+    entry = monitor.evaluate(now=5.0)["objectives"][0]
+    # bad = 5 + 3 + 2 of 110 total (404 is a client error, not bad).
+    assert entry["burn_fast"] == pytest.approx((10 / 110) / 0.1)
+
+
+# --------------------------------------------- satellite 3: None not NaN
+def test_empty_window_yields_none_never_nan():
+    store = _store()
+    monitor = _monitor(store)
+    state = monitor.evaluate(now=5.0)
+    entry = state["objectives"][0]
+    assert entry["burn_fast"] is None
+    assert entry["burn_slow"] is None
+    assert entry["budget_remaining"] is None
+    assert entry["breaching"] is False
+    assert state["degraded"] is False
+
+
+def test_all_zero_window_yields_none_never_nan():
+    """Traffic stopped: the histogram delta over the window has
+    count == 0 (quantile math would be NaN). Burn must be None."""
+    store = _store()
+    store.record_histogram("stpu_lb_ttfb_seconds",
+                           _snap([50, 0, 0]), ts=0.0)
+    store.record_histogram("stpu_lb_ttfb_seconds",
+                           _snap([50, 0, 0]), ts=100.0)
+    monitor = _monitor(store)
+    entry = monitor.evaluate(now=100.0)["objectives"][0]
+    assert entry["burn_fast"] is None       # fast window: no new obs
+    assert entry["breaching"] is False
+
+
+# -------------------------------------------------- breach edges + events
+def test_breach_needs_both_windows_and_emits_edge_events(tmp_state_dir):
+    store = _store()
+    monitor = _monitor(store, target=0.9, threshold_s=1.0)
+
+    def feed(ts, good, bad):
+        store.record_histogram("stpu_lb_ttfb_seconds",
+                               _snap([good, 0, bad]), ts=ts)
+
+    feed(0.0, 0, 0)
+    feed(5.0, 0, 100)                       # both windows burning
+    state = monitor.evaluate(now=5.0)
+    assert state["objectives"][0]["breaching"] is True
+    assert state["degraded"] is True
+    assert monitor.degraded() is True
+    recs = events.read(kind="slo", name="svc")
+    assert [r["event"] for r in recs] == ["slo_breach"]
+    assert recs[-1]["objective"] == "ttft"
+    assert recs[-1]["burn_fast"] >= 1.0
+
+    # Still breaching: NO duplicate event (edge-triggered).
+    monitor.evaluate(now=6.0)
+    assert len(events.read(kind="slo", name="svc")) == 1
+
+    # Recovery: fast window goes clean (slow still remembers the bad
+    # spell) — breach needs BOTH, so this recovers and emits the edge.
+    feed(50.0, 1000, 0)
+    state = monitor.evaluate(now=50.0)
+    assert state["objectives"][0]["breaching"] is False
+    recs = events.read(kind="slo", name="svc")
+    assert [r["event"] for r in recs] == ["slo_breach", "slo_recovered"]
+    assert monitor.degraded() is False
+
+
+def test_latency_signals_seam():
+    store = _store()
+    monitor = _monitor(store, target=0.9, threshold_s=1.0)
+    # Before any evaluation: empty signals, not a crash.
+    assert monitor.latency_signals() == {"degraded": False}
+    store.record_histogram("stpu_lb_ttfb_seconds",
+                           _snap([0, 0, 0]), ts=0.0)
+    store.record_histogram("stpu_lb_ttfb_seconds",
+                           _snap([0, 0, 100]), ts=5.0)
+    monitor.evaluate(now=5.0)
+    signals = monitor.latency_signals()
+    assert signals["degraded"] is True
+    assert signals["ttft"]["breaching"] is True
+    assert signals["ttft"]["burn_fast"] == pytest.approx(10.0)
+    assert signals["ttft"]["burn_slow"] == pytest.approx(10.0)

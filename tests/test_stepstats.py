@@ -394,6 +394,64 @@ def test_replica_perf_endpoint_and_lb_merge(armed):
         httpd.shutdown()
 
 
+def test_lb_perf_merge_reports_dead_replica():
+    """A replica that cannot be scraped is REPORTED in the /perf merge
+    — an {"error": ...} entry under its url — and EXCLUDED from the
+    aggregate, so a half-dead fleet reads as degraded instead of
+    healthy-but-slower."""
+    import http.server
+    import socket
+
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.serve.load_balancing_policies import (
+        RoundRobinPolicy)
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    perf_doc = {"armed": True, "steps": 4,
+                "phases": {"decode": {"steps": 4, "seconds": 0.01}},
+                "tokens_per_sec": {"prefill": 0.0, "decode": 100.0},
+                "busy_fraction": 0.5}
+
+    class _Replica(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps(perf_doc).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            del args
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Replica)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    live = f"http://127.0.0.1:{httpd.server_address[1]}"
+    dead = f"http://127.0.0.1:{free_port()}"   # nothing listening
+
+    policy = RoundRobinPolicy()
+    policy.set_ready_replicas([live, dead])
+    lb = lb_lib.run_load_balancer(free_port(), policy,
+                                  lb_lib.RequestRecorder())
+    try:
+        lb_url = f"http://127.0.0.1:{lb.server_address[1]}"
+        with urllib.request.urlopen(lb_url + "/perf",
+                                    timeout=30) as resp:
+            merged = json.loads(resp.read())
+        assert merged["replicas"][live]["phases"]
+        assert "error" in merged["replicas"][dead]
+        assert merged["aggregate"]["replicas"] == 1   # healthy only
+        assert merged["aggregate"]["errors"] == 1
+        assert merged["aggregate"]["tokens_per_sec"]["decode"] == 100.0
+    finally:
+        lb.shutdown()
+        httpd.shutdown()
+
+
 def test_profile_endpoint_capture(armed, monkeypatch):
     import socket
 

@@ -1,0 +1,190 @@
+"""Fleet time-series store (observability/timeseries.py).
+
+The contract under test: two-tier downsampling (raw points fold into
+(count, sum, min, max) rollup buckets as they age out), one point per
+raw bucket (a fast collector overwrites in place instead of growing
+the ring), counter window_delta with reset clamping, and cumulative
+histogram snapshots whose window delta merges bucket-wise across
+series and survives a replica restart with changed bounds.
+"""
+import math
+
+import pytest
+
+from skypilot_tpu.observability.promtext import HistogramSnapshot
+from skypilot_tpu.observability.timeseries import TimeSeriesStore
+
+
+def _store(**kw):
+    defaults = dict(raw_seconds=10.0, raw_retention=60.0,
+                    rollup_seconds=30.0, rollup_retention=600.0)
+    defaults.update(kw)
+    return TimeSeriesStore(**defaults)
+
+
+def _snap(counts, bounds=(0.1, 1.0)):
+    """Cumulative snapshot from per-bucket counts (incl. +Inf)."""
+    cum, total = [], 0.0
+    for c in counts:
+        total += c
+        cum.append(total)
+    return HistogramSnapshot(bounds=list(bounds), cumulative=cum,
+                             sum=float(total), count=total)
+
+
+# ------------------------------------------------------------- scalars
+def test_one_point_per_raw_bucket_overwrites_in_place():
+    store = _store()
+    for i in range(5):
+        store.record("g", float(i), ts=100.0 + i)   # < raw_seconds apart
+    pts = store.points("g")
+    assert pts == [(100.0, 4.0)]                    # newest value wins
+    store.record("g", 9.0, ts=111.0)                # next raw bucket
+    assert store.points("g") == [(100.0, 4.0), (111.0, 9.0)]
+    assert store.latest("g") == 9.0
+
+
+def test_downsample_folds_raw_into_rollup_means():
+    store = _store()
+    # Points at t=0,10,20 (values 1,2,3) age out when t reaches 100
+    # (raw_retention=60): they fold into the t=0 rollup bucket
+    # (rollup_seconds=30 → floor(ts/30)*30 = 0 for all three).
+    for ts, v in ((0.0, 1.0), (10.0, 2.0), (20.0, 3.0)):
+        store.record("g", v, ts=ts)
+    store.record("g", 7.0, ts=100.0)
+    pts = store.points("g")
+    assert pts == [(0.0, 2.0), (100.0, 7.0)]        # rollup mean = 2.0
+    # min/max survive inside the bucket (spikes aren't averaged away):
+    series = next(iter(store._scalars.values()))
+    assert (series.rollup[0].min, series.rollup[0].max) == (1.0, 3.0)
+
+
+def test_rollup_retention_drops_ancient_buckets():
+    store = _store(raw_retention=10.0, rollup_retention=180.0)
+    store.record("g", 1.0, ts=0.0)
+    store.record("g", 2.0, ts=50.0)
+    # At t=200 the t=0 rollup bucket is > 180s old: dropped. The t=50
+    # point folded into bucket ts=30 (floor(50/30)*30), which survives.
+    store.record("g", 3.0, ts=200.0)
+    assert [t for t, _ in store.points("g")] == [30.0, 200.0]
+
+
+def test_nan_points_dropped_at_the_door():
+    store = _store()
+    store.record("g", float("nan"), ts=0.0)
+    assert store.points("g") == []
+    assert store.latest("g") is None
+
+
+def test_latest_sums_across_matching_label_sets():
+    store = _store()
+    store.record("c", 3.0, ts=0.0, code="200")
+    store.record("c", 2.0, ts=0.0, code="500")
+    assert store.latest("c") == 5.0
+    assert store.latest("c", code="500") == 2.0
+    assert store.latest("c", code="404") is None
+    assert store.labels_for("c") == [{"code": "200"}, {"code": "500"}]
+    assert store.series_names() == ["c"]
+
+
+# ------------------------------------------------------------ counters
+def test_window_delta_baseline_at_window_start():
+    store = _store(raw_seconds=1.0, raw_retention=1000.0)
+    for ts, total in ((0.0, 10.0), (10.0, 40.0), (20.0, 100.0)):
+        store.record("c", total, ts=ts)
+    # Window [5, 20]: baseline = newest point <= 5 → t=0 (10.0).
+    assert store.window_delta("c", 15.0, now=20.0) == 90.0
+    # Short history: window opens before the oldest point → oldest.
+    assert store.window_delta("c", 500.0, now=20.0) == 90.0
+    assert store.window_delta("c", 15.0, now=20.0, code="x") is None
+    assert store.rate("c", 15.0, now=20.0) == pytest.approx(6.0)
+
+
+def test_window_delta_clamps_counter_reset():
+    """A restarted replica's counter drops to near zero; the delta
+    clamps to the post-reset total instead of going negative."""
+    store = _store(raw_seconds=1.0, raw_retention=1000.0)
+    store.record("c", 100.0, ts=0.0)
+    store.record("c", 5.0, ts=10.0)     # reset: 100 → 5
+    assert store.window_delta("c", 20.0, now=10.0) == 5.0
+
+
+def test_window_delta_uses_rollup_max_for_aged_counters():
+    """A counter point that aged into a rollup bucket contributes its
+    bucket MAX as the baseline (the counter total at bucket close),
+    not the mean — a mean baseline would overstate the delta."""
+    store = _store(raw_seconds=1.0, raw_retention=50.0,
+                   rollup_seconds=30.0)
+    for ts, total in ((0.0, 10.0), (10.0, 20.0), (20.0, 30.0)):
+        store.record("c", total, ts=ts)
+    store.record("c", 90.0, ts=100.0)   # ages the first three out
+    # Window [60, 100]: baseline = rollup bucket t=0 with max=30.
+    assert store.window_delta("c", 40.0, now=100.0) == 60.0
+
+
+# ---------------------------------------------------------- histograms
+def test_histogram_delta_is_window_distribution():
+    store = _store(raw_seconds=1.0, raw_retention=1000.0)
+    store.record_histogram("h", _snap([5, 0, 0]), ts=0.0)
+    store.record_histogram("h", _snap([5, 10, 0]), ts=30.0)
+    delta = store.histogram_delta("h", window=20.0, now=30.0)
+    assert delta.count == 10            # only the window's observations
+    assert delta.cumulative == [0.0, 10.0, 10.0]
+    assert 0.1 <= delta.quantile(0.5) <= 1.0
+
+
+def test_histogram_delta_merges_equal_bounds_across_series():
+    store = _store(raw_seconds=1.0, raw_retention=1000.0)
+    store.record_histogram("h", _snap([0, 0, 0]), ts=0.0, replica="a")
+    store.record_histogram("h", _snap([0, 0, 0]), ts=0.0, replica="b")
+    store.record_histogram("h", _snap([2, 0, 0]), ts=30.0, replica="a")
+    store.record_histogram("h", _snap([0, 3, 0]), ts=30.0, replica="b")
+    merged = store.histogram_delta("h", window=100.0, now=30.0)
+    assert merged.count == 5
+    assert merged.cumulative == [2.0, 5.0, 5.0]
+
+
+def test_histogram_delta_skips_series_with_changed_bounds():
+    """A replica restart with a different bucket layout makes the
+    delta undefined for that series — it is skipped, not fabricated."""
+    store = _store(raw_seconds=1.0, raw_retention=1000.0)
+    store.record_histogram("h", _snap([5, 0, 0]), ts=0.0, replica="a")
+    store.record_histogram("h", _snap([5, 1, 0], bounds=(0.5, 2.0)),
+                           ts=30.0, replica="a")
+    assert store.histogram_delta("h", window=20.0, now=30.0) is None
+    assert store.histogram_delta("h", 20.0, now=30.0, replica="x") is None
+
+
+def test_histogram_snapshots_thin_to_one_per_rollup_bucket():
+    store = _store(raw_seconds=1.0, raw_retention=10.0,
+                   rollup_seconds=30.0)
+    for i in range(20):                 # t=0..19, all older than t=100-10
+        store.record_histogram("h", _snap([i, 0, 0]), ts=float(i))
+    store.record_histogram("h", _snap([50, 0, 0]), ts=100.0)
+    series = next(iter(store._hists.values()))
+    # One survivor per rollup bucket (t=0 bucket) + the raw point.
+    assert len(series.snaps) == 2
+    # The newest snapshot within the bucket won (cumulative counts
+    # make the latest the most informative).
+    assert series.snaps[0][1].count == 19
+
+
+def test_empty_window_delta_has_zero_count_not_nan():
+    """The satellite-3 substrate: a window with no new observations
+    deltas to count == 0 and quantile NaN — the SLO monitor and CLI
+    must map this to None/'-', never compare NaN to a threshold."""
+    store = _store(raw_seconds=1.0, raw_retention=1000.0)
+    store.record_histogram("h", _snap([5, 0, 0]), ts=0.0)
+    store.record_histogram("h", _snap([5, 0, 0]), ts=30.0)
+    delta = store.histogram_delta("h", window=20.0, now=30.0)
+    assert delta.count == 0
+    assert math.isnan(delta.quantile(0.99))
+
+
+def test_to_doc_shape():
+    store = _store()
+    store.record("g", 1.0, ts=0.0, replica="a")
+    doc = store.to_doc("g")
+    assert doc == {"series": "g",
+                   "data": [{"labels": {"replica": "a"},
+                             "points": [(0.0, 1.0)]}]}
