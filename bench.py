@@ -394,6 +394,36 @@ def _serving_leg() -> dict:
         except Exception as e:  # noqa: BLE001
             out[key] = None
             out[f"{key}_error"] = str(e)[:200]
+        # int8-quantized serving leg: the paged engine with int8 KV
+        # blocks (per-block/head scales in the pool) + int8 weights —
+        # the capacity lever. Two gated numbers: quantized tok/s and
+        # the block count the SAME HBM byte budget holds vs bf16
+        # (>= 1.8x, asserted inside the leg AND gated as a
+        # bench_compare metric so the ratio can never silently erode).
+        key = f"{family}_engine_q8_tok_s"
+        try:
+            r = run_tool(["--family", family, "--mode", "q8",
+                          "--slots", "16", "--requests", "48"],
+                         timeout=1200)
+            out[key] = r["engine_q8_tok_s"]
+            out[f"{family}_kv_pool_capacity_blocks"] = \
+                r["kv_pool_capacity_blocks"]
+            out[f"{family}_engine_q8_detail"] = {
+                k: r.get(k) for k in ("slots", "requests",
+                                      "block_tokens", "byte_budget",
+                                      "block_bytes_bf16",
+                                      "block_bytes_q8",
+                                      "kv_pool_capacity_blocks_bf16",
+                                      "kv_capacity_ratio",
+                                      "kv_pool_utilization",
+                                      "peak_live_slots",
+                                      "generated_tokens",
+                                      "wall_seconds",
+                                      "phase_breakdown",
+                                      "busy_fraction")}
+        except Exception as e:  # noqa: BLE001
+            out[key] = None
+            out[f"{key}_error"] = str(e)[:200]
         # Speculative-decoding serving leg: n-gram self-drafts + one
         # batched multi-token verify pass per step, on the chat
         # (shared-prefix) mix at the ragged leg's b8 slot count — the

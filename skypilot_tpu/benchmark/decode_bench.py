@@ -25,6 +25,10 @@ Two measurements:
     serve/kv_pool.py) under the same mixed-length mix, with the pool
     sized to HALF the dense budget: tok/s, peak pool utilization, and
     peak concurrent live slots — the capacity-per-byte story.
+  * ``measure_engine_q8`` — the paged engine with int8 KV blocks and
+    int8 weights (STPU_KV_QUANT / STPU_WEIGHT_QUANT): quantized tok/s
+    plus the block-capacity ratio vs bf16 at the SAME HBM byte budget
+    (the >= 1.8x floor bench_compare gates).
   * ``measure_engine_spec`` — self-speculative decoding (n-gram
     drafts + one batched multi-token verify pass per step) on the
     chat shared-prefix mix at the ragged leg's b8 slot count, with
@@ -339,6 +343,117 @@ def measure_engine_paged(family: str, slots: int = 16,
     }
 
 
+def measure_engine_q8(family: str, slots: int = 16,
+                      n_requests: int = 48, max_prompt: int = 192,
+                      max_tokens: int = 64, pool_tokens: int = 0,
+                      **shape_kw) -> Dict[str, Any]:
+    """int8-quantized serving: throughput through the quantized paged
+    engine plus the CAPACITY ratio the quantization exists for.
+
+    Capacity half: size a bf16 pool exactly like measure_engine_paged
+    (same byte budget), then count how many int8+scale blocks the SAME
+    byte budget holds — measured from the real device cache arrays'
+    nbytes, cross-checked against kv_pool.block_bytes — and assert the
+    >= 1.8x floor (the bench_compare-gated ``kv_pool_capacity_blocks``
+    leg; the theoretical ratio is just under 2x, the scale tax is one
+    f32 per layer/head per block).
+
+    Throughput half: the SAME seeded mixed-length mix as
+    measure_engine_paged runs through a kv_quant + weight_quant engine
+    whose pool holds the capacity-expanded block count, reported as
+    ``engine_q8_tok_s``. Output parity with bf16 is NOT asserted here
+    (quantization changes numerics by design) — that gate lives in
+    tests/test_quant.py (top-1 agreement + perplexity bound)."""
+    from skypilot_tpu.observability import stepstats
+    from skypilot_tpu.serve import kv_pool
+    from skypilot_tpu.serve.decode_engine import DecodeEngine
+
+    mdl, cfg = build(family, **shape_kw)
+    params = mdl.init(cfg, jax.random.key(0))
+    max_seq = max_prompt + max_tokens
+    chunk = 64
+    max_seq += (-max_seq) % chunk       # keep chunk | max_seq
+    budget = pool_tokens or (slots * max_seq) // 2
+    bf16_blocks = budget // chunk + 1
+
+    # Per-block bytes from REAL device arrays (a 2-block probe pool),
+    # cross-checked against the kv_pool sizing math the docs quote.
+    probe_b = mdl.init_paged_cache(cfg, 2, chunk)
+    probe_q = mdl.init_paged_cache(cfg, 2, chunk, quantized=True)
+    bb_bf16 = sum(v.nbytes for v in probe_b.values()) // 2
+    bb_q8 = sum(v.nbytes for v in probe_q.values()) // 2
+    del probe_b, probe_q
+    kv_bytes = jnp.dtype(cfg.dtype).itemsize
+    assert bb_bf16 == kv_pool.block_bytes(
+        chunk, cfg.n_layers, cfg.n_kv_heads, cfg.head_dim,
+        kv_dtype_bytes=kv_bytes), "bf16 block-byte math drifted"
+    assert bb_q8 == kv_pool.block_bytes(
+        chunk, cfg.n_layers, cfg.n_kv_heads, cfg.head_dim,
+        quantized=True), "int8 block-byte math drifted"
+
+    byte_budget = bf16_blocks * bb_bf16
+    q8_blocks = byte_budget // bb_q8
+    # Gate on the per-block byte ratio — blocks-per-byte is the
+    # capacity lever and is pool-size independent; the realized block
+    # counts below inherit it modulo integer flooring at tiny pools.
+    ratio = bb_bf16 / bb_q8
+    if ratio < 1.8:
+        raise RuntimeError(
+            f"quantized pool fits only {ratio:.2f}x the bf16 blocks "
+            f"({bb_q8} vs {bb_bf16} bytes/block) at the same HBM "
+            f"budget — below the 1.8x capacity gate")
+
+    engine = DecodeEngine(cfg, params, slots=slots, max_seq=max_seq,
+                          prefill_chunk=chunk, paged=True,
+                          kv_pool_blocks=q8_blocks,
+                          kv_quant=True, weight_quant=True)
+    engine.start()
+    engine.warmup()
+
+    rng = random.Random(0)
+    specs = [([rng.randint(1, cfg.vocab_size - 1)
+               for _ in range(rng.randint(8, max_prompt))],
+              rng.randint(8, max_tokens))
+             for _ in range(n_requests)]
+    was_armed = stepstats.ENABLED
+    stepstats.arm(ring=8192, sync_every=16)
+    stepstats.reset()
+    try:
+        t0 = time.perf_counter()
+        reqs = [engine.submit(p, max_tokens=mt) for p, mt in specs]
+        total = sum(len(r.result(timeout=1800.0)) for r in reqs)
+        dt = time.perf_counter() - t0
+        snap = stepstats.snapshot()
+        pool = engine._pool
+        utilization = pool.peak_in_use / max(pool.usable_blocks, 1)
+        peak_slots = engine.peak_live_slots
+    finally:
+        if not was_armed:
+            stepstats.disarm()
+        engine.shutdown()
+    return {
+        "model": _model_info(family, cfg, params),
+        "slots": slots,
+        "requests": n_requests,
+        "max_prompt": max_prompt,
+        "max_tokens": max_tokens,
+        "block_tokens": chunk,
+        "byte_budget": byte_budget,
+        "block_bytes_bf16": bb_bf16,
+        "block_bytes_q8": bb_q8,
+        "kv_pool_capacity_blocks_bf16": bf16_blocks,
+        "kv_pool_capacity_blocks": q8_blocks,
+        "kv_capacity_ratio": round(ratio, 3),
+        "generated_tokens": total,
+        "wall_seconds": round(dt, 3),
+        "engine_q8_tok_s": round(total / dt, 1),
+        "kv_pool_utilization": round(utilization, 3),
+        "peak_live_slots": peak_slots,
+        "phase_breakdown": snap.get("phases", {}),
+        "busy_fraction": snap.get("busy_fraction"),
+    }
+
+
 def measure_engine_spec(family: str, slots: int = 8,
                         n_requests: int = 32, shared_prefix: int = 128,
                         max_unique: int = 32, max_tokens: int = 64,
@@ -513,37 +628,31 @@ def measure_engine_prefix(family: str, slots: int = 8,
                           n_requests: int = 24,
                           shared_prefix: int = 256,
                           max_unique: int = 32, max_tokens: int = 48,
-                          prefix_cache_mb: float = 256.0,
                           **shape_kw) -> Dict[str, Any]:
-    """Engine throughput under shared-prefix traffic with the
-    shared-prefix KV cache enabled.
+    """Engine throughput under shared-prefix traffic through the paged
+    pool's zero-copy prefix cache (the only prefix representation —
+    the dense splice cache is retired).
 
     One ``shared_prefix``-token system prompt, a deterministic (seeded)
     unique tail per request. Phase 1 (cold): a single request prefills
-    the whole prompt and publishes its chunks on free. Phase 2 (warm):
-    ``n_requests`` concurrent requests restore the shared chunks from
-    the pool instead of recomputing them. Reported TTFT is split
-    cold/warm in BOTH wall seconds and steps-to-first-token (the
-    chunk-prefill count — deterministic, immune to the tunneled chip's
-    dispatch variance), and the hit rate / tokens saved come from the
-    engine's own pool stats so the bench and the /metrics counters can
-    never disagree.
+    the whole prompt and publishes its blocks on free (a refcount
+    adoption into the trie). Phase 2 (warm): ``n_requests`` concurrent
+    requests alias the shared blocks into their tables instead of
+    recomputing them. Reported TTFT is split cold/warm in BOTH wall
+    seconds and steps-to-first-token (the chunk-prefill count —
+    deterministic, immune to the tunneled chip's dispatch variance),
+    and the hit rate / tokens saved come from the engine's own pool
+    stats so the bench and the /metrics counters can never disagree.
     """
     from skypilot_tpu.serve.decode_engine import DecodeEngine
 
-    if prefix_cache_mb <= 0:
-        raise ValueError(
-            "measure_engine_prefix measures the shared-prefix cache; "
-            "prefix_cache_mb must be > 0 (use --mode engine for the "
-            "cache-off engine baseline)")
     mdl, cfg = build(family, **shape_kw)
     params = mdl.init(cfg, jax.random.key(0))
     chunk = 64
     max_seq = shared_prefix + max_unique + max_tokens
     max_seq += (-max_seq) % chunk       # keep chunk | max_seq
     engine = DecodeEngine(cfg, params, slots=slots, max_seq=max_seq,
-                          prefill_chunk=chunk,
-                          prefix_cache_mb=prefix_cache_mb)
+                          prefill_chunk=chunk, paged=True)
     engine.start()
     engine.warmup()
 
@@ -581,7 +690,6 @@ def measure_engine_prefix(family: str, slots: int = 8,
         "slots": slots,
         "requests": n_requests,
         "shared_prefix": shared_prefix,
-        "prefix_cache_mb": prefix_cache_mb,
         "generated_tokens": total,
         "wall_seconds": round(dt, 3),
         "engine_prefix_tok_s": round(total / dt, 1),

@@ -723,6 +723,15 @@ def _perf_snapshot_lines(doc: dict, label: str = "") -> list:
         pool = doc["kv_pool"]
         lines.append(f"kv pool    free {pool.get('free')}"
                      f" / usable {pool.get('usable')} blocks (paged)")
+    quant = doc.get("quant") or {}
+    if quant.get("kv_quant") or quant.get("weight_quant"):
+        modes = [m for m, on in (("kv int8", quant.get("kv_quant")),
+                                 ("weights int8",
+                                  quant.get("weight_quant"))) if on]
+        lines.append(
+            f"quant      {' + '.join(modes)}"
+            + (f"  pool {quant.get('pool_blocks')} blocks"
+               if quant.get("pool_blocks") else ""))
     if doc.get("dispatch_ms_mean") is not None or doc.get("sync"):
         sync = doc.get("sync") or {}
         lines.append(

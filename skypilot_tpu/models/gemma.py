@@ -116,9 +116,11 @@ class GemmaConfig:
                     1 if self.tie_embeddings else 2))
 
 
-def param_specs(cfg: GemmaConfig) -> Params:
+def param_specs(cfg: GemmaConfig, *, quantized: bool = False) -> Params:
     """Logical-axis names, mirroring init()'s tree (the default tied
-    head has no lm_head leaf; ``tie_embeddings=False`` adds one)."""
+    head has no lm_head leaf; ``tie_embeddings=False`` adds one).
+    ``quantized`` mirrors the quantize_params tree — see
+    llama.param_specs."""
     specs = {
         "embed": ("vocab", "embed"),
         "layers": {
@@ -136,6 +138,13 @@ def param_specs(cfg: GemmaConfig) -> Params:
     }
     if not cfg.tie_embeddings:
         specs["lm_head"] = ("embed", "vocab")
+    if quantized:
+        specs["embed_scale"] = ("vocab",)
+        for name in llama.QUANT_LAYER_WEIGHTS:
+            out_axis = specs["layers"][name][-1]
+            specs["layers"][name + "_scale"] = ("layers", out_axis)
+        if "lm_head" in specs:
+            specs["lm_head_scale"] = ("vocab",)
     return specs
 
 
@@ -201,11 +210,13 @@ def init_cache(cfg: GemmaConfig, batch: int, max_seq: int):
     return llama.init_cache(cfg, batch, max_seq)
 
 
-# Shared-prefix KV-cache row copy (decode-engine prefix cache); the
-# cache layout is llama's, so the copy entry points are too.
-gather_cache_rows = llama.gather_cache_rows
-insert_cache_rows = llama.insert_cache_rows
 cache_specs = llama.cache_specs
+
+# int8 weight serving: gemma's param tree uses llama's layer keys, so
+# the quantization transform (and its tied-head embed_scale handling)
+# is llama's shared machinery.
+quantize_params = llama.quantize_params
+params_quantized = llama.params_quantized
 
 # Paged KV block pool (decode-engine paged mode): layout and block-
 # table attention are llama's shared machinery.

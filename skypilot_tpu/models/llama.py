@@ -97,8 +97,15 @@ class LlamaConfig:
                 self.vocab_size * self.dim * (1 if self.tie_embeddings else 2))
 
 
-def param_specs(cfg: LlamaConfig) -> Params:
-    """Logical-axis names for every param, mirroring init()'s tree."""
+def param_specs(cfg: LlamaConfig, *, quantized: bool = False) -> Params:
+    """Logical-axis names for every param, mirroring init()'s tree.
+
+    ``quantized`` mirrors :func:`quantize_params`' tree instead: every
+    int8 weight keeps its bf16 spec (codes shard exactly like the
+    values they encode) and gains a ``<name>_scale`` entry whose spec
+    is the weight's OUTPUT axis — per-channel scales live on the same
+    device as the channel's matmul shard, so TP serving never gathers
+    them."""
     specs = {
         "embed": ("vocab", "embed"),
         "layers": {
@@ -117,6 +124,13 @@ def param_specs(cfg: LlamaConfig) -> Params:
     }
     if cfg.tie_embeddings:
         specs.pop("lm_head")
+    if quantized:
+        specs["embed_scale"] = ("vocab",)
+        for name in QUANT_LAYER_WEIGHTS:
+            out_axis = specs["layers"][name][-1]
+            specs["layers"][name + "_scale"] = ("layers", out_axis)
+        if "lm_head" in specs:
+            specs["lm_head_scale"] = ("vocab",)
     return specs
 
 
@@ -150,6 +164,53 @@ def init(cfg: LlamaConfig, key: jax.Array) -> Params:
     if cfg.tie_embeddings:
         params.pop("lm_head")
     return params
+
+
+# Layer weights the int8 serving path quantizes (norms and LoRA
+# adapters stay in their checkpoint dtype; mixtral extends this with
+# its expert tensors and keeps the f32 router exact).
+QUANT_LAYER_WEIGHTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up",
+                      "w_down")
+
+
+def _quantize_weight(w: jax.Array, reduce_axis: int):
+    """Symmetric per-channel int8: absmax over the in-features axis
+    (``reduce_axis``), one f32 scale per output channel. Codes span
+    [-127, 127] so the representation is sign-symmetric."""
+    wf = w.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(wf), axis=reduce_axis) / 127.0,
+                        1e-8)
+    q = jnp.round(wf / jnp.expand_dims(scale, reduce_axis))
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+def quantize_params(cfg: LlamaConfig, params: Params) -> Params:
+    """int8 weight-serving transform: every matmul weight becomes int8
+    codes plus a per-output-channel f32 ``<name>_scale`` (the embed
+    table's scale is per vocab ROW, which is simultaneously the tied
+    lm_head's per-output-channel scale). The tree shape mirrors
+    ``param_specs(cfg, quantized=True)`` so TP sharding
+    (gang_replica.shard_params) works unchanged; norms and LoRA
+    adapters keep their dtype. The matmuls upcast codes in-register at
+    use — the win is HBM: resident weight bytes halve, and decode is
+    memory-bound."""
+    out = dict(params)
+    out["embed"], out["embed_scale"] = _quantize_weight(
+        params["embed"], -1)
+    layers = dict(params["layers"])
+    for name in QUANT_LAYER_WEIGHTS:
+        layers[name], layers[name + "_scale"] = _quantize_weight(
+            layers[name], -2)
+    out["layers"] = layers
+    if "lm_head" in params:
+        out["lm_head"], out["lm_head_scale"] = _quantize_weight(
+            params["lm_head"], -2)
+    return out
+
+
+def params_quantized(params: Params) -> bool:
+    """True when ``params`` is a :func:`quantize_params` tree."""
+    return "embed_scale" in params
 
 
 def rms_norm(x: jax.Array, w: jax.Array, eps: float,
@@ -187,8 +248,19 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 def lora_dense(y: jax.Array, lp: Params, name: str) -> jax.Array:
     """y @ W, plus the low-rank LoRA path y @ A @ B when the layer params
     carry `<name>_lora_a`/`<name>_lora_b` adapters (recipes/llama_lora.py
-    injects them; base checkpoints don't have the keys and skip it)."""
-    out = y @ lp[name]
+    injects them; base checkpoints don't have the keys and skip it).
+
+    When the layer carries a `<name>_scale` (quantize_params tree) the
+    weight is int8: codes upcast to the activation dtype in-register,
+    the matmul runs as usual, and the per-output-channel f32 scale
+    multiplies the result — one extra VPU pass, half the HBM reads."""
+    w = lp[name]
+    scale = lp.get(name + "_scale")
+    if scale is None:
+        out = y @ w
+    else:
+        out = ((y @ w.astype(y.dtype)).astype(jnp.float32) *
+               scale).astype(y.dtype)
     a = lp.get(name + "_lora_a")
     if a is not None:
         out = out + (y @ a) @ lp[name + "_lora_b"]
@@ -225,10 +297,13 @@ def mlp_block(cfg, x: jax.Array, lp: Params,
     shared by training and decode."""
     y = rms_norm(x, lp["mlp_norm"], cfg.norm_eps,
                  getattr(cfg, "norm_offset", 0.0))
-    gate = _mlp_activation(cfg)(y @ lp["w_gate"])
-    up = y @ lp["w_up"]
+    # Through lora_dense so the int8 weight-serving path (per-channel
+    # `_scale` entries) covers the MLP projections too; without scales
+    # or adapters it is exactly `y @ w`.
+    gate = _mlp_activation(cfg)(lora_dense(y, lp, "w_gate"))
+    up = lora_dense(y, lp, "w_up")
     mlp = constrain(gate * up, ("batch", "act_seq", "mlp"))
-    return x + constrain(mlp @ lp["w_down"],
+    return x + constrain(lora_dense(mlp, lp, "w_down"),
                          ("batch", "act_seq", "act_embed"))
 
 
@@ -283,6 +358,23 @@ def embed_tokens(params: Params, tokens: jax.Array, constrain) -> jax.Array:
     return constrain(x, ("batch", "act_seq", "act_embed"))
 
 
+def _decode_embed(cfg, params: Params, tokens: jax.Array) -> jax.Array:
+    """Token-embedding gather for the serving decode paths: O(1)
+    single-device gather (decode never runs the one-hot SPMD matmul —
+    the table is either replicated or vocab-sharded with a cheap (B, T)
+    collective), dequantizing per-row embed scales when the table is
+    int8 and applying gemma's sqrt(dim) embed multiplier."""
+    x = params["embed"][tokens]
+    row_scale = params.get("embed_scale")
+    mult = getattr(cfg, "embed_multiplier", 1.0)
+    if row_scale is not None:
+        x = (x.astype(jnp.float32) *
+             (row_scale[tokens][..., None] * mult)).astype(cfg.dtype)
+    elif mult != 1.0:  # gemma: embeddings scaled by sqrt(dim)
+        x = (x.astype(jnp.float32) * mult).astype(x.dtype)
+    return x
+
+
 def _vocab_proj(params: Params, x: jax.Array, constrain) -> jax.Array:
     """(B,S,D) hidden -> fp32 logits. bf16 INPUTS into the MXU with f32
     accumulation (preferred_element_type) — casting the operands to f32
@@ -292,6 +384,13 @@ def _vocab_proj(params: Params, x: jax.Array, constrain) -> jax.Array:
     logits = jax.lax.dot_general(
         x, head_weights(params).astype(x.dtype), (((2,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
+    # int8 serving: the head's per-vocab-channel scale (embed_scale for
+    # a tied head — the embed table's per-ROW scale transposes into the
+    # head's per-column scale) folds into the f32 logits.
+    scale = (params.get("lm_head_scale") if "lm_head" in params
+             else params.get("embed_scale"))
+    if scale is not None:
+        logits = logits * scale
     return constrain(logits, ("batch", "act_seq", "vocab"))
 
 
@@ -419,18 +518,33 @@ def cache_specs(cfg: LlamaConfig) -> Dict[str, tuple]:
 
 
 def init_paged_cache(cfg: LlamaConfig, num_blocks: int,
-                     block_tokens: int) -> Dict[str, jax.Array]:
+                     block_tokens: int, *,
+                     quantized: bool = False) -> Dict[str, jax.Array]:
     """ONE device-resident paged KV pool shared by every engine slot
     AND the shared-prefix cache: ``num_blocks`` blocks of
     ``block_tokens`` token rows each, stacked on the layer axis like
     the dense cache (the decode step scans layers and pool together).
     Slots map logical positions to blocks through per-slot block
     tables (serve/kv_pool.py owns the accounting); block 0 is the
-    scratch block free slots write into."""
+    scratch block free slots write into.
+
+    ``quantized`` stores the pool as int8 codes plus parallel
+    per-(layer, block, kv_head) f32 scale arrays — sized off the same
+    block count, so the block table indexes codes and scales alike.
+    Bytes per block roughly halve against bf16 (codes are half, the
+    scale adds 4 bytes per kv_head per block against block_tokens *
+    head_dim rows), which is where the ~2x pool capacity at a fixed
+    HBM budget comes from."""
     shape = (cfg.n_layers, num_blocks, block_tokens, cfg.n_kv_heads,
              cfg.head_dim)
-    return {"k": jnp.zeros(shape, dtype=cfg.dtype),
-            "v": jnp.zeros(shape, dtype=cfg.dtype)}
+    if not quantized:
+        return {"k": jnp.zeros(shape, dtype=cfg.dtype),
+                "v": jnp.zeros(shape, dtype=cfg.dtype)}
+    sshape = (cfg.n_layers, num_blocks, cfg.n_kv_heads)
+    return {"k": jnp.zeros(shape, dtype=jnp.int8),
+            "v": jnp.zeros(shape, dtype=jnp.int8),
+            "k_scale": jnp.zeros(sshape, dtype=jnp.float32),
+            "v_scale": jnp.zeros(sshape, dtype=jnp.float32)}
 
 
 def paged_cache_specs(cfg: LlamaConfig) -> Dict[str, tuple]:
@@ -441,42 +555,6 @@ def paged_cache_specs(cfg: LlamaConfig) -> Dict[str, tuple]:
     so the TP sharding rules — including gang_replica.cache_shardings'
     head_dim fallback — apply unchanged."""
     return cache_specs(cfg)
-
-
-def gather_cache_rows(cache: Dict[str, jax.Array], slot: jax.Array,
-                      start: jax.Array, length: int
-                      ) -> Dict[str, jax.Array]:
-    """Read cache positions [start, start+length) of row ``slot`` as a
-    standalone {"k","v"} block of shape (layers, length, kv_heads,
-    head_dim) — the extraction half of the shared-prefix KV cache
-    (serve/decode_engine.PrefixCache publishes these blocks to a host
-    pool on slot free). ``length`` must be static (it sizes the output);
-    callers keep it at the engine's prefill-chunk granularity so every
-    gather shares one compile."""
-    def one(c):
-        n_layers, _, _, kvh, hd = c.shape
-        blk = jax.lax.dynamic_slice(c, (0, slot, start, 0, 0),
-                                    (n_layers, 1, length, kvh, hd))
-        return blk[:, 0]
-    return {k: one(v) for k, v in cache.items()}
-
-
-def insert_cache_rows(cache: Dict[str, jax.Array],
-                      kv: Dict[str, jax.Array], slot: jax.Array,
-                      start: jax.Array) -> Dict[str, jax.Array]:
-    """Splice a {"k","v"} block (layers, T, kv_heads, head_dim) into row
-    ``slot`` at position ``start`` — the restore half of the
-    shared-prefix KV cache: on a prefix hit the engine copies cached
-    rows in instead of re-running prefill over them. Pure
-    dynamic_update_slice, so with the cache DONATED through the jit
-    boundary the splice happens in place (no second full-size cache)."""
-    out = {}
-    for name, c in cache.items():
-        blk = kv[name].astype(c.dtype)[:, None]     # (L, 1, T, KVH, HD)
-        out[name] = jax.lax.dynamic_update_slice(
-            c, blk, (jnp.int32(0), slot, start, jnp.int32(0),
-                     jnp.int32(0)))
-    return out
 
 
 def _attn_tile(qf: jax.Array, scale: float, kb: jax.Array,
@@ -573,7 +651,10 @@ def _paged_split_kv_attention(qg: jax.Array, pk: jax.Array,
                               pv: jax.Array, table: jax.Array,
                               positions: jax.Array,
                               valid_len: jax.Array,
-                              window: int) -> jax.Array:
+                              window: int,
+                              k_scale: Optional[jax.Array] = None,
+                              v_scale: Optional[jax.Array] = None
+                              ) -> jax.Array:
     """Split-KV attention reading K/V THROUGH a per-slot block table.
 
     The paged twin of :func:`_split_kv_attention`: instead of each slot
@@ -592,6 +673,13 @@ def _paged_split_kv_attention(qg: jax.Array, pk: jax.Array,
     table: (B, table_len) int32; entries past a slot's frontier may be
     stale/zero (the scratch block) — their rows are masked to exact 0
     like any invalid dense row, so garbage never contributes.
+
+    ``k_scale``/``v_scale`` ((num_blocks, KVH) f32, one layer's slice)
+    arm the int8 pool: the SAME ``phys`` gather that pulls a tile's
+    code blocks pulls their per-(block, head) scales, and the dequant
+    multiply folds into the tile's existing f32 upcast — so
+    :func:`_attn_tile` below stays the ONE online-softmax kernel
+    shared with the dense loop, fed f32 tiles either way.
     """
     b, t, kvh, g, d = qg.shape
     bt = pk.shape[1]
@@ -608,8 +696,13 @@ def _paged_split_kv_attention(qg: jax.Array, pk: jax.Array,
         s0, m, el, acc = carry
         phys = jax.lax.dynamic_slice(
             table, (jnp.int32(0), s0 // bt), (b, nb_win))  # (B, nbw)
-        kb = pk[phys].reshape(b, window, kvh, d).astype(jnp.float32)
-        vb = pv[phys].reshape(b, window, kvh, d).astype(jnp.float32)
+        kb = pk[phys].astype(jnp.float32)       # (B, nbw, bt, KVH, D)
+        vb = pv[phys].astype(jnp.float32)
+        if k_scale is not None:
+            kb = kb * k_scale[phys][:, :, None, :, None]
+            vb = vb * v_scale[phys][:, :, None, :, None]
+        kb = kb.reshape(b, window, kvh, d)
+        vb = vb.reshape(b, window, kvh, d)
         kpos = s0 + jnp.arange(window)
         msk = ((kpos[None, None, :] >= s0) &
                (kpos[None, None, :] <= positions[..., None]) &
@@ -705,10 +798,7 @@ def forward_with_cache(cfg, params: Params,
     if valid_len.ndim == 0:
         valid_len = jnp.broadcast_to(valid_len, (b,))
     positions = start_pos[:, None] + jnp.arange(t)[None, :]  # (B, T)
-    x = params["embed"][tokens]
-    scale = getattr(cfg, "embed_multiplier", 1.0)
-    if scale != 1.0:  # gemma: embeddings scaled by sqrt(dim)
-        x = (x.astype(jnp.float32) * scale).astype(x.dtype)
+    x = _decode_embed(cfg, params, tokens)
 
     # Pluggable residual MLP half — mixtral swaps in its dense-routed
     # MoE (models/mixtral.py) while the attention/cache/mask contract
@@ -737,13 +827,66 @@ def forward_with_cache(cfg, params: Params,
     return logits, {"k": new_k, "v": new_v}
 
 
+def _quant_scatter_row(pk: jax.Array, ks: jax.Array, blk: jax.Array,
+                       off: jax.Array, row: jax.Array):
+    """Scatter one new K/V row per slot into the int8 pool, keeping
+    the one-scale-per-(block, head) invariant.
+
+    Works in CODE space: the row's absmax can only grow the block's
+    scale (never shrink it), and when it doesn't — the common decode
+    step — the rescale ratio is exactly 1.0, so existing codes round
+    back to themselves and repeated steps never random-walk. When the
+    row does grow the scale, the block's prior codes rescale once by
+    old/new. ``off == 0`` (first row of a freshly granted block)
+    resets the inherited scale: pool blocks recycle without zeroing,
+    and a dead block's stale scale must not inflate the new
+    sequence's quantization step. Free slots ride along targeting the
+    scratch block (possibly many per batch — last write wins, scratch
+    contents are never attendable).
+
+    pk: (NB, BT, KVH, D) int8; ks: (NB, KVH) f32; blk/off: (B,) int32;
+    row: (B, KVH, D). Returns (pk, ks) updated.
+    """
+    b = blk.shape[0]
+    cur = pk[blk].astype(jnp.float32)               # (B, BT, KVH, D)
+    old_s = jnp.where((off == 0)[:, None], 0.0, ks[blk])     # (B, KVH)
+    row_s = jnp.max(jnp.abs(row.astype(jnp.float32)),
+                    axis=-1) / 127.0
+    new_s = jnp.maximum(jnp.maximum(old_s, row_s), 1e-8)
+    ratio = (old_s / new_s)[:, None, :, None]
+    scaled = jnp.round(cur * ratio)
+    q_row = jnp.round(row.astype(jnp.float32) / new_s[..., None])
+    scaled = scaled.at[jnp.arange(b), off].set(q_row)
+    q = jnp.clip(scaled, -127, 127).astype(jnp.int8)
+    return pk.at[blk].set(q), ks.at[blk].set(new_s)
+
+
+def _quant_block_write(pk: jax.Array, ks: jax.Array,
+                       write_block: jax.Array, rows: jax.Array,
+                       valid_rows: jax.Array):
+    """Whole-block int8 overwrite (single-slot chunk prefill): a fresh
+    per-(block, head) scale from the chunk's VALID rows — a
+    right-padded final chunk's junk rows are excluded so padding can
+    never inflate the quantization step — then every row quantized
+    under it (junk rows too; they are masked at read like any invalid
+    row). rows: (BT, KVH, D); valid_rows: (BT,) bool."""
+    rf = rows.astype(jnp.float32)
+    masked = jnp.where(valid_rows[:, None, None], jnp.abs(rf), 0.0)
+    s = jnp.maximum(jnp.max(masked, axis=(0, 2)) / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(rf / s[None, :, None]),
+                 -127, 127).astype(jnp.int8)
+    return pk.at[write_block].set(q), ks.at[write_block].set(s)
+
+
 def paged_attention_block(cfg, x: jax.Array, lp: Params,
                           pk: jax.Array, pv: jax.Array,
                           table: jax.Array, positions: jax.Array,
                           start_pos: jax.Array, valid_len: jax.Array,
                           window: int,
                           write_block: Optional[jax.Array],
-                          write_pos: Optional[jax.Array] = None):
+                          write_pos: Optional[jax.Array] = None,
+                          ks: Optional[jax.Array] = None,
+                          vs: Optional[jax.Array] = None):
     """One pre-norm GQA attention residual block against the PAGED KV
     pool (the block-table twin of :func:`cached_attention_block`).
 
@@ -756,11 +899,17 @@ def paged_attention_block(cfg, x: jax.Array, lp: Params,
     ``write_block``. Aliased (shared-prefix) blocks are never write
     targets: admission aligns the cached prefix to whole blocks and
     prefill/decode only ever write from the first non-cached block on.
-    Returns (x + attn_out, pk, pv) with the pool updated in place
-    under donation."""
+    ``ks``/``vs`` ((num_blocks, KVH) f32 per-layer scale slices) arm
+    the int8 pool: every write path quantizes against the target
+    block's one-scale-per-(block, head) entry (fresh scale on
+    whole-block prefill, grow-only code-space rescale on row
+    scatters) and the attention gather dequantizes with the same
+    scales. Returns (x + attn_out, pk, pv, ks, vs) with the pool
+    updated in place under donation."""
     b, t = x.shape[0], x.shape[1]
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     bt = pk.shape[1]
+    quant = ks is not None
     y = rms_norm(x, lp["attn_norm"], cfg.norm_eps,
                  getattr(cfg, "norm_offset", 0.0))
     q, k_new, v_new = qkv_proj(cfg, y, lp, positions)
@@ -776,28 +925,51 @@ def paged_attention_block(cfg, x: jax.Array, lp: Params,
         blk = jnp.where(ok, jnp.take_along_axis(table, blk_idx,
                                                 axis=1), 0)
         off = jnp.where(ok, write_pos % bt, 0)
-        pk = pk.at[blk, off].set(k_new.astype(pk.dtype))
-        pv = pv.at[blk, off].set(v_new.astype(pv.dtype))
+        if quant:
+            # Columns in order: the verify window's positions are
+            # consecutive per slot, so a block boundary (off == 0,
+            # scale reset) is always crossed BEFORE that block's
+            # later offsets are written.
+            for j in range(t):
+                pk, ks = _quant_scatter_row(pk, ks, blk[:, j],
+                                            off[:, j], k_new[:, j])
+                pv, vs = _quant_scatter_row(pv, vs, blk[:, j],
+                                            off[:, j], v_new[:, j])
+        else:
+            pk = pk.at[blk, off].set(k_new.astype(pk.dtype))
+            pv = pv.at[blk, off].set(v_new.astype(pv.dtype))
     elif t == 1:
         blk = jnp.take_along_axis(table, (start_pos // bt)[:, None],
                                   axis=1)[:, 0]
         off = start_pos % bt
-        pk = pk.at[blk, off].set(k_new[:, 0].astype(pk.dtype))
-        pv = pv.at[blk, off].set(v_new[:, 0].astype(pv.dtype))
+        if quant:
+            pk, ks = _quant_scatter_row(pk, ks, blk, off, k_new[:, 0])
+            pv, vs = _quant_scatter_row(pv, vs, blk, off, v_new[:, 0])
+        else:
+            pk = pk.at[blk, off].set(k_new[:, 0].astype(pk.dtype))
+            pv = pv.at[blk, off].set(v_new[:, 0].astype(pv.dtype))
     else:
         if b != 1 or t != bt or write_block is None:
             raise ValueError(
                 "paged chunk prefill needs B == 1, T == block_tokens "
                 "and a write_block (chunk-aligned whole-block write); "
                 f"got B={b}, T={t}, block_tokens={bt}")
-        pk = pk.at[write_block].set(k_new[0].astype(pk.dtype))
-        pv = pv.at[write_block].set(v_new[0].astype(pv.dtype))
+        if quant:
+            valid_rows = positions[0] < valid_len[0]
+            pk, ks = _quant_block_write(pk, ks, write_block,
+                                        k_new[0], valid_rows)
+            pv, vs = _quant_block_write(pv, vs, write_block,
+                                        v_new[0], valid_rows)
+        else:
+            pk = pk.at[write_block].set(k_new[0].astype(pk.dtype))
+            pv = pv.at[write_block].set(v_new[0].astype(pv.dtype))
     groups = h // kvh
     qg = q.reshape(b, t, kvh, groups, hd)
     attn = _paged_split_kv_attention(qg, pk, pv, table, positions,
-                                     valid_len, window)
+                                     valid_len, window,
+                                     k_scale=ks, v_scale=vs)
     attn = attn.astype(x.dtype).reshape(b, t, h * hd)
-    return x + lora_dense(attn, lp, "wo"), pk, pv
+    return x + lora_dense(attn, lp, "wo"), pk, pv, ks, vs
 
 
 def forward_with_paged_cache(cfg, params: Params, tokens: jax.Array,
@@ -830,24 +1002,39 @@ def forward_with_paged_cache(cfg, params: Params, tokens: jax.Array,
     if valid_len.ndim == 0:
         valid_len = jnp.broadcast_to(valid_len, (b,))
     positions = start_pos[:, None] + jnp.arange(t)[None, :]  # (B, T)
-    x = params["embed"][tokens]
-    scale = getattr(cfg, "embed_multiplier", 1.0)
-    if scale != 1.0:  # gemma: embeddings scaled by sqrt(dim)
-        x = (x.astype(jnp.float32) * scale).astype(x.dtype)
+    x = _decode_embed(cfg, params, tokens)
 
     # Pluggable residual MLP half, exactly as in forward_with_cache
     # (mixtral swaps in its dense-routed MoE).
     mlp_fn = mlp_fn or (lambda cfg, x2, lp: mlp_block(cfg, x2, lp))
 
-    def layer_fn(x, scanned):
-        lp, pk, pv = scanned                               # per-layer
-        x2, pk, pv = paged_attention_block(
-            cfg, x, lp, pk, pv, table, positions, start_pos,
-            valid_len, window, write_block, write_pos=write_pos)
-        return mlp_fn(cfg, x2, lp), (pk, pv)
+    quantized = "k_scale" in cache
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_fn, x, (params["layers"], cache["k"], cache["v"]))
+    def layer_fn(x, scanned):
+        if quantized:
+            lp, pk, pv, ks, vs = scanned                   # per-layer
+        else:
+            (lp, pk, pv), ks, vs = scanned, None, None
+        x2, pk, pv, ks, vs = paged_attention_block(
+            cfg, x, lp, pk, pv, table, positions, start_pos,
+            valid_len, window, write_block, write_pos=write_pos,
+            ks=ks, vs=vs)
+        return mlp_fn(cfg, x2, lp), ((pk, pv, ks, vs) if quantized
+                                     else (pk, pv))
+
+    if quantized:
+        # Scales ride the layer scan beside the code pools so the
+        # whole cache tree stays donate-aliasable through the jitted
+        # serving entry points (scales update in place like codes).
+        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+            layer_fn, x, (params["layers"], cache["k"], cache["v"],
+                          cache["k_scale"], cache["v_scale"]))
+        new_cache = {"k": new_k, "v": new_v,
+                     "k_scale": new_ks, "v_scale": new_vs}
+    else:
+        x, (new_k, new_v) = jax.lax.scan(
+            layer_fn, x, (params["layers"], cache["k"], cache["v"]))
+        new_cache = {"k": new_k, "v": new_v}
     if logits_at is not None:
         logits_at = jnp.asarray(logits_at, jnp.int32)
         if logits_at.ndim == 0:
@@ -855,7 +1042,7 @@ def forward_with_paged_cache(cfg, params: Params, tokens: jax.Array,
         else:  # per-slot read-out (ragged prompt lengths)
             x = x[jnp.arange(b), logits_at][:, None]
     logits = lm_head(cfg, params, x, lambda a, _spec: a)
-    return logits, {"k": new_k, "v": new_v}
+    return logits, new_cache
 
 
 def _verify_write_positions(t: int, start_pos: jax.Array,

@@ -65,8 +65,8 @@ class MixtralConfig:
         return 6.0 * p_active
 
 
-def param_specs(cfg: MixtralConfig) -> Params:
-    return {
+def param_specs(cfg: MixtralConfig, *, quantized: bool = False) -> Params:
+    specs = {
         "embed": ("vocab", "embed"),
         "layers": {
             "attn_norm": ("layers", "embed"),
@@ -83,6 +83,20 @@ def param_specs(cfg: MixtralConfig) -> Params:
         "final_norm": ("embed",),
         "lm_head": ("embed", "vocab"),
     }
+    if quantized:
+        # int8 serving tree (quantize_params): per-output-channel
+        # scales keep the weight's trailing axes minus the reduced
+        # in-features axis — expert weights keep their expert axis so
+        # EP sharding places each expert's scales beside its codes.
+        # The f32 router is NOT quantized (routing decisions are
+        # discrete; a code flip would change which experts fire).
+        specs["embed_scale"] = ("vocab",)
+        for name in llama.QUANT_LAYER_WEIGHTS:
+            spec = specs["layers"][name]
+            specs["layers"][name + "_scale"] = (
+                spec[:-2] + spec[-1:])
+        specs["lm_head_scale"] = ("vocab",)
+    return specs
 
 
 def init(cfg: MixtralConfig, key: jax.Array) -> Params:
@@ -111,6 +125,29 @@ def init(cfg: MixtralConfig, key: jax.Array) -> Params:
         "final_norm": jnp.ones((d,), dtype=dt),
         "lm_head": dense(k[9], (d, cfg.vocab_size), d),
     }
+
+
+def quantize_params(cfg: MixtralConfig, params: Params) -> Params:
+    """int8 weight-serving transform, mirroring
+    ``param_specs(cfg, quantized=True)``: llama's per-output-channel
+    scheme over the shared attention weights plus the expert tensors
+    (in-features axis is always axis -2, expert axes survive into the
+    scale), with the f32 router left exact — routing is a discrete
+    argmax and must not move under quantization noise."""
+    out = dict(params)
+    out["embed"], out["embed_scale"] = llama._quantize_weight(
+        params["embed"], -1)
+    layers = dict(params["layers"])
+    for name in llama.QUANT_LAYER_WEIGHTS:
+        layers[name], layers[name + "_scale"] = llama._quantize_weight(
+            layers[name], -2)
+    out["layers"] = layers
+    out["lm_head"], out["lm_head_scale"] = llama._quantize_weight(
+        params["lm_head"], -2)
+    return out
+
+
+params_quantized = llama.params_quantized
 
 
 def _top2_dispatch(gates: jax.Array, capacity: int
@@ -239,9 +276,22 @@ def _moe_mlp_dense(cfg: MixtralConfig, y: jax.Array,
     sel = jax.nn.one_hot(idx, e, dtype=gates.dtype).sum(axis=-2)
     w = gates * sel
     w = w / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-9)
-    gate = jax.nn.silu(jnp.einsum("btd,edm->btem", y, lp["w_gate"]))
-    up = jnp.einsum("btd,edm->btem", y, lp["w_up"])
-    out = jnp.einsum("btem,emd->bted", gate * up, lp["w_down"])
+
+    def expert_mm(eq, x, name):
+        # Expert matmul, dequantizing per-(expert, channel) scales
+        # when the weight is int8 (quantize_params tree): the scale's
+        # trailing (E, out) axes broadcast against the einsum's
+        # (..., E, out) result.
+        wt = lp[name]
+        scale = lp.get(name + "_scale")
+        if scale is None:
+            return jnp.einsum(eq, x, wt)
+        r = jnp.einsum(eq, x, wt.astype(x.dtype))
+        return (r.astype(jnp.float32) * scale).astype(x.dtype)
+
+    gate = jax.nn.silu(expert_mm("btd,edm->btem", y, "w_gate"))
+    up = expert_mm("btd,edm->btem", y, "w_up")
+    out = expert_mm("btem,emd->bted", gate * up, "w_down")
     return jnp.einsum("bte,bted->btd", w.astype(out.dtype), out)
 
 
@@ -251,10 +301,6 @@ def init_cache(cfg: MixtralConfig, batch: int, max_seq: int):
     return llama.init_cache(cfg, batch, max_seq)
 
 
-# Shared-prefix KV-cache row copy (decode-engine prefix cache); the
-# cache layout is llama's, so the copy entry points are too.
-gather_cache_rows = llama.gather_cache_rows
-insert_cache_rows = llama.insert_cache_rows
 cache_specs = llama.cache_specs
 
 # Paged KV block pool: llama's layout/specs, experts add no per-token

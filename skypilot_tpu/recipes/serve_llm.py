@@ -61,9 +61,11 @@ GEN_BUCKET = 16
 # Engine defaults (overridable per serve() call / env).
 ENGINE_SLOTS = int(os.environ.get("STPU_ENGINE_SLOTS", "4"))
 ENGINE_PREFILL_CHUNK = 64
-# Shared-prefix KV pool budget (MB of host RAM; 0 disables). On by
-# default: shared system prompts are the common production mix, a hit
-# is bit-identical to a cold prefill, and a miss costs one trie walk.
+# Retired knob, still read so `stpu check`'s env contract and old
+# deployment env files stay valid: prefix caching is now the paged
+# pool's trie (always on under paging, zero-copy), and the dense
+# engine has no prefix cache at all — the value is accepted and
+# ignored.
 ENGINE_PREFIX_CACHE_MB = float(
     os.environ.get("STPU_PREFIX_CACHE_MB", "64"))
 # Paged KV block pool (decode_engine paged mode): one device-resident
@@ -71,14 +73,23 @@ ENGINE_PREFIX_CACHE_MB = float(
 # admission is free-block based and prefix hits alias blocks
 # zero-copy. ON by default (bit-identical to dense, pinned by
 # tests/test_paged_kv.py); STPU_KV_PAGED=0 keeps the dense path
-# selectable until the splice path retires (ROADMAP).
+# selectable for parity debugging (no prefix cache there).
 ENGINE_KV_PAGED = os.environ.get("STPU_KV_PAGED", "1") == "1"
 # 0 = auto-size the pool to the dense HBM budget
-# (slots * max_seq / block + 1 scratch).
+# (slots * max_seq / block + 1 scratch; doubled under KV_QUANT —
+# int8 blocks are ~half the bytes).
 ENGINE_KV_POOL_BLOCKS = int(os.environ.get("STPU_KV_POOL_BLOCKS", "0"))
 # 0 = block size follows the prefill chunk (64).
 ENGINE_KV_BLOCK_TOKENS = int(
     os.environ.get("STPU_KV_BLOCK_TOKENS", "0"))
+# Quantized serving (decode_engine quant mode): KV_QUANT stores int8
+# KV blocks + per-(layer, block, head) f32 scales in the paged pool
+# (~2x block capacity at the same HBM budget; requires KV_PAGED);
+# WEIGHT_QUANT serves int8 per-channel-scaled params. NOT
+# bit-identical to bf16 — gated by the tests/test_quant.py parity
+# suite (top-1 agreement + perplexity bound per family).
+ENGINE_KV_QUANT = os.environ.get("STPU_KV_QUANT", "0") == "1"
+ENGINE_WEIGHT_QUANT = os.environ.get("STPU_WEIGHT_QUANT", "0") == "1"
 # Self-speculative decoding (decode_engine spec mode): up to K n-gram
 # drafted tokens per slot per step, verified in one batched forward —
 # bit-identical output, fewer memory-bound passes per token on
@@ -268,6 +279,16 @@ class _Handler(BaseHTTPRequestHandler):
                 "draining": engine.draining(),
                 "restarts": getattr(engine, "restarts", 0),
             }
+            kv = engine.kv_config()
+            if kv:
+                # Quant mode line for `stpu perf`: which int8 paths
+                # this replica serves with (resolve_kv_geometry output
+                # — the same dict the gang handshake compares).
+                doc["quant"] = {
+                    "kv_quant": int(kv.get("kv_quant", 0)),
+                    "weight_quant": int(kv.get("weight_quant", 0)),
+                    "pool_blocks": int(kv.get("pool_blocks", 0)),
+                }
         return doc
 
     def _start_profile(self) -> None:
@@ -571,6 +592,8 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
           kv_paged: bool = None,
           kv_pool_blocks: int = None,
           kv_block_tokens: int = None,
+          kv_quant: bool = None,
+          weight_quant: bool = None,
           spec_k: int = None,
           spec_ngram: int = None,
           spec_min_accept: float = None
@@ -578,10 +601,13 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
     """Start the replica server. ``engine_slots`` > 0 (default: env
     STPU_ENGINE_SLOTS or 4) serves through the continuous-batching
     decode engine; 0 keeps the legacy locked fixed-batch path.
-    ``prefix_cache_mb`` (default: env STPU_PREFIX_CACHE_MB or 64)
-    bounds the engine's shared-prefix KV pool; 0 disables it.
+    ``prefix_cache_mb`` is accepted but inert (prefix caching is the
+    paged pool's trie, always on under paging).
     ``stream_timeout`` (default: env STPU_STREAM_TIMEOUT or 600) is the
     per-token wait before a wedged engine surfaces as a clean error.
+    ``kv_quant``/``weight_quant`` (default: env STPU_KV_QUANT /
+    STPU_WEIGHT_QUANT or 0) serve int8 KV blocks / int8 params —
+    ~2x KV capacity per HBM byte, parity-gated (NOT bit-identical).
     ``spec_k`` (default: env STPU_SPEC_K or 0) arms self-speculative
     decoding — k n-gram-drafted tokens per slot verified in one
     batched forward, bit-identical output.
@@ -611,6 +637,10 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
         kv_pool_blocks = ENGINE_KV_POOL_BLOCKS
     if kv_block_tokens is None:
         kv_block_tokens = ENGINE_KV_BLOCK_TOKENS
+    if kv_quant is None:
+        kv_quant = ENGINE_KV_QUANT
+    if weight_quant is None:
+        weight_quant = ENGINE_WEIGHT_QUANT
     if spec_k is None:
         spec_k = ENGINE_SPEC_K
     if spec_ngram is None:
@@ -644,6 +674,8 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
                 paged=bool(kv_paged),
                 kv_pool_blocks=int(kv_pool_blocks),
                 kv_block_tokens=int(kv_block_tokens),
+                kv_quant=bool(kv_quant),
+                weight_quant=bool(weight_quant),
                 spec_k=int(spec_k),
                 spec_ngram=int(spec_ngram),
                 spec_min_accept=float(spec_min_accept))
@@ -688,6 +720,11 @@ def _resolve_kv(args) -> dict:
         "block_tokens": (int(args.kv_block_tokens)
                          if args.kv_block_tokens is not None
                          else ENGINE_KV_BLOCK_TOKENS),
+        "kv_quant": (bool(args.kv_quant) if args.kv_quant is not None
+                     else ENGINE_KV_QUANT),
+        "weight_quant": (bool(args.weight_quant)
+                         if args.weight_quant is not None
+                         else ENGINE_WEIGHT_QUANT),
         "spec_k": (int(args.spec_k) if args.spec_k is not None
                    else ENGINE_SPEC_K),
         "spec_ngram": (int(args.spec_ngram)
@@ -756,6 +793,10 @@ def _spawn_follower_cmd(args, rank: int, topology, leader_port: int):
         argv += ["--kv-pool-blocks", str(args.kv_pool_blocks)]
     if args.kv_block_tokens is not None:
         argv += ["--kv-block-tokens", str(args.kv_block_tokens)]
+    if args.kv_quant is not None:
+        argv += ["--kv-quant", str(int(args.kv_quant))]
+    if args.weight_quant is not None:
+        argv += ["--weight-quant", str(int(args.weight_quant))]
     if args.spec_k is not None:
         argv += ["--spec-k", str(args.spec_k)]
     if args.spec_ngram is not None:
@@ -793,9 +834,9 @@ def main(argv=None):
                    help="decode-engine slots (0 = legacy locked path; "
                         "default env STPU_ENGINE_SLOTS or 4)")
     p.add_argument("--prefix-cache-mb", type=float, default=None,
-                   help="shared-prefix KV pool budget in MB (0 "
-                        "disables; default env STPU_PREFIX_CACHE_MB "
-                        "or 64)")
+                   help="accepted but inert (retired knob): prefix "
+                        "caching is the paged pool's zero-copy trie, "
+                        "always on under --kv-paged")
     p.add_argument("--kv-paged", type=int, choices=(0, 1),
                    default=None,
                    help="1 serves from the paged KV block pool (one "
@@ -812,6 +853,21 @@ def main(argv=None):
                    help="paged-KV block size in tokens (also the "
                         "prefill chunk; 0 = the default 64-token "
                         "chunk; default env STPU_KV_BLOCK_TOKENS)")
+    p.add_argument("--kv-quant", type=int, choices=(0, 1),
+                   default=None,
+                   help="1 stores int8 KV blocks (+ per-block/head "
+                        "scales) in the paged pool — ~2x blocks at "
+                        "the same HBM budget; requires --kv-paged. "
+                        "NOT bit-identical to bf16 (parity-gated by "
+                        "tests/test_quant.py). Default env "
+                        "STPU_KV_QUANT or 0")
+    p.add_argument("--weight-quant", type=int, choices=(0, 1),
+                   default=None,
+                   help="1 serves int8 per-channel-quantized params "
+                        "(matmul weights + embed/lm_head; norms, "
+                        "LoRA and the MoE router stay full "
+                        "precision). Default env STPU_WEIGHT_QUANT "
+                        "or 0")
     p.add_argument("--spec-k", type=int, default=None,
                    help="speculative decoding: tokens drafted per "
                         "slot per step from the slot's own n-gram "
@@ -879,6 +935,7 @@ def main(argv=None):
         prefill_chunk=ENGINE_PREFILL_CHUNK, paged=kv["paged"],
         kv_pool_blocks=kv["pool_blocks"],
         kv_block_tokens=kv["block_tokens"],
+        kv_quant=kv["kv_quant"], weight_quant=kv["weight_quant"],
         spec_k=kv["spec_k"], spec_ngram=kv["spec_ngram"],
         spec_min_accept=kv["spec_min_accept"])
     if topology.hosts > 1 and rank > 0:
@@ -899,6 +956,8 @@ def main(argv=None):
                 paged=kv["paged"],
                 kv_pool_blocks=kv["pool_blocks"],
                 kv_block_tokens=kv["block_tokens"],
+                kv_quant=kv["kv_quant"],
+                weight_quant=kv["weight_quant"],
                 spec_k=kv["spec_k"],
                 spec_ngram=kv["spec_ngram"],
                 spec_min_accept=kv["spec_min_accept"])
@@ -940,6 +999,8 @@ def main(argv=None):
                   gang=gang, kv_paged=kv["paged"],
                   kv_pool_blocks=kv["pool_blocks"],
                   kv_block_tokens=kv["block_tokens"],
+                  kv_quant=kv["kv_quant"],
+                  weight_quant=kv["weight_quant"],
                   spec_k=kv["spec_k"], spec_ngram=kv["spec_ngram"],
                   spec_min_accept=kv["spec_min_accept"])
     if gang is not None and httpd.engine is not None:

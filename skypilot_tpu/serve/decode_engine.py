@@ -26,39 +26,46 @@ Sampling is reproducible per request: the key for the token at
 position p is fold_in(fold_in(root, seed), p), independent of which
 slot the request landed in or what else shared the batch.
 
-Shared-prefix KV caching (``prefix_cache_mb > 0``): production traffic
-shares system prompts / few-shot templates, so identical leading
-tokens produce identical KV rows (causal attention) — recomputing them
-per request burns the prefill FLOPs that dominate TTFT. The engine
-keeps a chunk-granular trie (PrefixCache) over prefilled prompt
-chunks, host-pinned and bounded by a byte budget with LRU + refcount
-eviction: on admission the longest cached prefix is COPIED into the
-slot's cache rows (models/*.insert_cache_rows through a donated jit
-entry point — a memcpy-speed splice instead of a forward pass) and on
-slot free the slot's prompt chunks are published back into the pool
-(models/*.gather_cache_rows). A hit is bit-identical to a cold
-prefill — the copied rows are the ones prefill would recompute — so
-the sampled token stream never changes, only its latency. At least one
-trailing prompt token is always prefilled so the first token is still
-sampled from real logits.
-
 Paged KV-cache block pool (``paged=True`` / STPU_KV_PAGED=1): the
-capacity lever over all of the above. Instead of every slot owning a
-dense ``(layers, max_seq, ...)`` cache row — concurrency sized for the
-worst-case sequence — ONE device-resident pool of fixed-size blocks
-(block = the prefill chunk) backs every slot through per-slot block
-tables (serve/kv_pool.py owns the accounting; models/*
+capacity lever over the dense row layout. Instead of every slot owning
+a dense ``(layers, max_seq, ...)`` cache row — concurrency sized for
+the worst-case sequence — ONE device-resident pool of fixed-size
+blocks (block = the prefill chunk) backs every slot through per-slot
+block tables (serve/kv_pool.py owns the accounting; models/*
 forward_with_paged_cache gathers K/V through the table inside the same
 split-KV online-softmax loop, bit-identical to dense when tile
 boundaries align). Slots acquire blocks lazily as they prefill/decode;
 admission reserves the request's worst-case block count up front
 (free-block based — NOT a full max_seq row — with deterministic FIFO
-head-of-line backpressure, so admitted work is never preempted); and
-the prefix cache collapses into the pool: the trie maps chunk hashes
-to refcounted blocks, a hit is a block-table entry write (zero-copy —
-no insert_cache_rows splice, no host round-trip) and publish-on-free
-is a refcount transfer instead of a gather_cache_rows D2H. Same HBM
-budget, strictly more live slots under mixed-length traffic.
+head-of-line backpressure, so admitted work is never preempted). The
+pool IS the shared-prefix cache: production traffic shares system
+prompts / few-shot templates, so identical leading tokens produce
+identical KV blocks (causal attention) — a trie maps chunk hashes to
+refcounted blocks, a hit is a block-table entry write (zero-copy, no
+row splice, no host round-trip) and publish-on-free is a refcount
+transfer. Prefix caching exists ONLY in paged mode; the dense path's
+host-pinned splice cache was retired with the quantized pool (one
+cache representation — the ``prefix_cache_mb`` kwarg is accepted but
+inert). At least one trailing prompt token is always prefilled so the
+first token is sampled from real logits.
+
+Quantized KV serving (``kv_quant=True`` / STPU_KV_QUANT=1, paged
+only): every pool block stores int8 K/V codes plus ONE f32 scale per
+(layer, block, kv_head) in a parallel scales array sized off the same
+block table (models/llama.init_paged_cache(quantized=True)). Blocks
+quantize on write inside paged_attention_block — symmetric absmax
+codes with a grow-only per-block scale, so the common decode append
+re-uses the resident codes exactly — and dequantize inside the
+attention gather, folded into the f32 upcast the online-softmax tile
+already performs, so _attn_tile stays the ONE shared attention kernel.
+An int8+scale block is ~half the bytes of a bf16 block, so the same
+HBM budget holds ~2x the blocks (auto-sizing doubles pool_blocks):
+more concurrent slots AND more prefix-cache residency. Output is NOT
+bit-identical to bf16 (quantization changes numerics by design) — the
+gate is the parity suite in tests/test_quant.py (top-1 agreement +
+perplexity bound per family). ``weight_quant`` rides the same flags:
+params pass through models/*.quantize_params (int8 codes + per-output-
+channel scales, TP sharding and donation preserved).
 
 Self-speculative decoding (``spec_k > 0`` / STPU_SPEC_K): decode is
 memory-bound — every 1-token step streams the whole KV prefix and the
@@ -143,12 +150,6 @@ _PREFIX_SAVED = metrics.counter(
     "stpu_engine_prefill_tokens_saved_total",
     "Prompt tokens restored from the prefix cache instead of "
     "prefilled.")
-_PREFIX_BYTES = metrics.gauge(
-    "stpu_engine_prefix_cache_bytes",
-    "Host bytes held by the shared-prefix KV pool.")
-_PREFIX_CHUNKS = metrics.gauge(
-    "stpu_engine_prefix_cache_chunks",
-    "KV chunks resident in the shared-prefix pool.")
 _PREFIX_TTFT = metrics.histogram(
     "stpu_engine_prefix_ttft_seconds",
     "Submit-to-first-token latency split by prefix-cache outcome.",
@@ -164,6 +165,19 @@ _KV_POOL_PINNED = metrics.gauge(
     "stpu_engine_kv_pool_blocks_pinned",
     "Distinct KV pool blocks referenced by live slots (pinned "
     "against eviction).")
+_KV_POOL_BLOCK_BYTES = metrics.gauge(
+    "stpu_engine_kv_pool_block_bytes",
+    "Device bytes per KV pool block across all layers (codes + "
+    "scales when quantized) — pool HBM budget is this times "
+    "blocks_total.")
+_KV_QUANT_ENABLED = metrics.gauge(
+    "stpu_engine_kv_quant_enabled",
+    "1 while the paged pool stores int8 KV blocks (STPU_KV_QUANT), "
+    "else 0 — info gauge, rides the LB /metrics merge.")
+_WEIGHT_QUANT_ENABLED = metrics.gauge(
+    "stpu_engine_weight_quant_enabled",
+    "1 while the engine serves int8 quantized params "
+    "(STPU_WEIGHT_QUANT), else 0 — info gauge.")
 _ZERO_COPY_HITS = metrics.counter(
     "stpu_engine_prefix_zero_copy_hits_total",
     "Prefix-cache hits served by aliasing pool blocks into the "
@@ -302,175 +316,6 @@ class _Slot:
         self.spec_off = False
 
 
-class _ChunkNode:
-    """One prompt chunk in the prefix pool's trie.
-
-    ``kv`` holds the chunk's K/V as host numpy arrays in the model
-    cache dtype, shape (layers, chunk, kv_heads, head_dim) each.
-    ``refs`` counts live slots whose admission matched this node — a
-    referenced node (or any node with children, which a deeper cached
-    prefix depends on) is never evicted."""
-
-    __slots__ = ("key", "parent", "children", "kv", "nbytes", "refs",
-                 "tick")
-
-    def __init__(self, key, parent, kv, nbytes):
-        self.key = key
-        self.parent: Optional["_ChunkNode"] = parent
-        self.children: Dict[tuple, "_ChunkNode"] = {}
-        self.kv = kv
-        self.nbytes = int(nbytes)
-        self.refs = 0
-        self.tick = 0
-
-
-class PrefixCache:
-    """Bounded host pool of prefilled prompt chunks, trie-indexed.
-
-    Chunk-granular (the engine's prefill_chunk alignment): a prompt's
-    leading full chunks are the trie path, so the longest shared prefix
-    between any two prompts is found by a plain dict walk. Eviction is
-    LRU over LEAVES only (an interior node's K/V is a dependency of
-    every deeper cached prefix), and refcounted nodes — chunks a live
-    slot matched at admission — are never evicted even over budget:
-    the pool may transiently exceed ``capacity_bytes`` rather than pull
-    rows out from under an in-flight restore.
-
-    All mutation happens on the engine's compute thread; the lock makes
-    the read-only ``stats()`` safe from tests/handlers.
-    """
-
-    def __init__(self, capacity_bytes: int, chunk: int):
-        self._root = _ChunkNode(None, None, None, 0)
-        self._lock = threading.Lock()
-        self.capacity_bytes = int(capacity_bytes)
-        self.chunk = int(chunk)
-        self._bytes = 0
-        self._chunks = 0
-        self._tick = 0
-        self.hits = 0
-        self.misses = 0
-        self.tokens_saved = 0
-
-    # ------------------------------------------------------------ match
-    def match_and_acquire(self, prompt: List[int]) -> List[_ChunkNode]:
-        """Longest cached prefix of ``prompt``, capped so at least one
-        prompt token is left to prefill (the first output token must be
-        sampled from real logits). Pins every matched node (refcount)
-        until release(); counts the hit/miss."""
-        max_chunks = (len(prompt) - 1) // self.chunk
-        with self._lock:
-            self._tick += 1
-            node, matched = self._root, []
-            for j in range(max_chunks):
-                key = tuple(prompt[j * self.chunk:(j + 1) * self.chunk])
-                child = node.children.get(key)
-                if child is None:
-                    break
-                child.refs += 1
-                child.tick = self._tick
-                matched.append(child)
-                node = child
-            if matched:
-                self.hits += 1
-                self.tokens_saved += len(matched) * self.chunk
-                _PREFIX_HITS.inc()
-                _PREFIX_SAVED.inc(len(matched) * self.chunk)
-            else:
-                self.misses += 1
-                _PREFIX_MISSES.inc()
-        return matched
-
-    def release(self, nodes: List[_ChunkNode]) -> None:
-        with self._lock:
-            for node in nodes:
-                node.refs -= 1
-
-    # ---------------------------------------------------------- publish
-    def missing_chunks(self, prompt: List[int],
-                       valid_tokens: int) -> List[int]:
-        """Chunk indices publish() would have to fetch — lets the
-        caller dispatch every gather up front (async device compute +
-        overlapped host copies) instead of one blocking round-trip per
-        chunk inside publish()."""
-        n_chunks = min(valid_tokens, len(prompt)) // self.chunk
-        with self._lock:
-            node, j = self._root, 0
-            while j < n_chunks:
-                key = tuple(prompt[j * self.chunk:(j + 1) * self.chunk])
-                node = node.children.get(key)
-                if node is None:
-                    break
-                j += 1
-            return list(range(j, n_chunks))
-
-    def publish(self, prompt: List[int], valid_tokens: int,
-                fetch_kv) -> None:
-        """Insert ``prompt``'s leading full chunks (up to
-        ``valid_tokens``, the prefilled frontier — a cancelled slot has
-        valid K/V only that far) into the trie. ``fetch_kv(j)`` is
-        called ONLY for chunks not already cached and must return the
-        chunk's {"k","v"} host arrays. Evicts LRU leaves afterwards if
-        over budget."""
-        n_chunks = min(valid_tokens, len(prompt)) // self.chunk
-        with self._lock:
-            self._tick += 1
-            node = self._root
-            for j in range(n_chunks):
-                key = tuple(prompt[j * self.chunk:(j + 1) * self.chunk])
-                child = node.children.get(key)
-                if child is None:
-                    kv = fetch_kv(j)
-                    nbytes = sum(a.nbytes for a in kv.values())
-                    if nbytes > self.capacity_bytes:
-                        break  # one chunk over budget: don't thrash
-                    child = _ChunkNode(key, node, kv, nbytes)
-                    node.children[key] = child
-                    self._bytes += nbytes
-                    self._chunks += 1
-                child.tick = self._tick
-                node = child
-            self._evict_locked()
-            _PREFIX_BYTES.set(self._bytes)
-            _PREFIX_CHUNKS.set(self._chunks)
-
-    def _evict_locked(self) -> None:
-        """Drop LRU unreferenced leaves until back under budget. A leaf
-        removal can expose its parent as the next candidate, so loop."""
-        while self._bytes > self.capacity_bytes:
-            victim = None
-            stack = list(self._root.children.values())
-            while stack:
-                node = stack.pop()
-                if node.children:
-                    stack.extend(node.children.values())
-                elif node.refs <= 0 and (victim is None
-                                         or node.tick < victim.tick):
-                    victim = node
-            if victim is None:
-                return  # everything left is pinned by live slots
-            del victim.parent.children[victim.key]
-            self._bytes -= victim.nbytes
-            self._chunks -= 1
-
-    # ------------------------------------------------------------ intro
-    def stats(self) -> Dict[str, int]:
-        with self._lock:
-            return {"hits": self.hits, "misses": self.misses,
-                    "tokens_saved": self.tokens_saved,
-                    "bytes": self._bytes, "chunks": self._chunks}
-
-    def nodes(self) -> List[_ChunkNode]:
-        """All resident chunk nodes (tests: refcount/eviction safety)."""
-        with self._lock:
-            out, stack = [], list(self._root.children.values())
-            while stack:
-                node = stack.pop()
-                out.append(node)
-                stack.extend(node.children.values())
-            return out
-
-
 # ------------------------------------------------------- jitted entry points
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
 def _prefill_chunk(cfg, params, cache, buf, slot, start, valid):
@@ -493,24 +338,6 @@ def _prefill_chunk(cfg, params, cache, buf, slot, start, valid):
                                                     slot, axis=1)
              for k in cache}
     return logits[0, 0], cache
-
-
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _gather_chunk(cfg, length, cache, slot, start):
-    """Read one chunk of one slot's prefilled K/V out of the shared
-    cache (publish path). ``length`` is static — every gather at the
-    engine's chunk granularity shares one compile. The cache is NOT
-    donated: the slot is being freed, but the cache lives on."""
-    return model_api(cfg).gather_cache_rows(cache, slot, start, length)
-
-
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
-def _insert_chunk(cfg, cache, kv, slot, start):
-    """Splice one cached chunk's K/V into row ``slot`` at ``start``
-    (restore path — the prefix-hit replacement for a _prefill_chunk
-    forward pass). The cache is donated: pure dynamic_update_slice, so
-    the splice is in place, memcpy-speed, no model FLOPs."""
-    return model_api(cfg).insert_cache_rows(cache, kv, slot, start)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 8),
@@ -650,6 +477,8 @@ def resolve_kv_geometry(*, slots: int, max_seq: int,
                         prefill_chunk: int = 64, paged: bool = False,
                         kv_pool_blocks: int = 0,
                         kv_block_tokens: int = 0,
+                        kv_quant: bool = False,
+                        weight_quant: bool = False,
                         spec_k: int = 0, spec_ngram: int = 3,
                         spec_min_accept: float = 0.0
                         ) -> Dict[str, Any]:
@@ -663,8 +492,17 @@ def resolve_kv_geometry(*, slots: int, max_seq: int,
     The speculative-decoding knobs ride along: draft/accept decisions
     are a pure function of the mirrored admission sequence ONLY when
     every host drafts identically, so a spec mismatch must fail the
-    handshake like a pool mismatch would."""
+    handshake like a pool mismatch would. So do the quantization
+    flags: kv_quant halves bytes per block, so the AUTO pool sizing
+    doubles — a leader/follower quant-flag drift means differently
+    sized pools and divergent admission decisions, which the
+    handshake's dict comparison now rejects for free."""
     max_seq = int(max_seq)
+    if kv_quant and not paged:
+        raise ValueError(
+            "kv_quant requires paged=True — int8 KV lives in the "
+            "paged block pool (the dense row cache has no scales "
+            "array and was retired as a prefix-cache representation)")
     if paged and kv_block_tokens:
         prefill_chunk = int(kv_block_tokens)
     chunk = max(min(int(prefill_chunk), max_seq), 1)
@@ -673,10 +511,18 @@ def resolve_kv_geometry(*, slots: int, max_seq: int,
     out: Dict[str, Any] = {
         "paged": int(bool(paged)), "slots": int(slots),
         "max_seq": max_seq, "chunk": chunk,
+        "kv_quant": int(bool(kv_quant)),
+        "weight_quant": int(bool(weight_quant)),
         "spec_k": int(spec_k), "spec_ngram": int(spec_ngram),
         "spec_min_accept": float(spec_min_accept)}
     if paged:
+        # Auto sizing targets the dense path's HBM budget: slots *
+        # max_seq tokens of bf16 KV plus the scratch block. An int8
+        # block (codes + one f32 scale per layer/head) is ~half the
+        # bytes, so the same budget holds 2x the blocks — the capacity
+        # lever the q8 bench leg gates at >= 1.8x.
         total = int(kv_pool_blocks) or (
+            (2 if kv_quant else 1) *
             int(slots) * (max_seq // chunk) + 1)
         window = max(min(SPLIT_KV_BLOCK, max_seq) // chunk * chunk,
                      chunk)
@@ -701,8 +547,13 @@ class DecodeEngine:
                  max_queue: int = 256, prefix_cache_mb: float = 0.0,
                  mesh=None, rules=None, paged: bool = False,
                  kv_pool_blocks: int = 0, kv_block_tokens: int = 0,
+                 kv_quant: bool = False, weight_quant: bool = False,
                  spec_k: int = 0, spec_ngram: int = 3,
                  spec_min_accept: float = 0.0):
+        # prefix_cache_mb is accepted for call-site compatibility but
+        # inert: prefix caching is the paged pool's trie (always on in
+        # paged mode), the dense splice cache is gone.
+        del prefix_cache_mb
         if slots < 1:
             raise ValueError("slots must be >= 1")
         if spec_k < 0:
@@ -710,11 +561,23 @@ class DecodeEngine:
         if spec_k and spec_ngram < 1:
             raise ValueError("spec_ngram must be >= 1")
         self._cfg = cfg
-        self._params = params
         self._api = model_api(cfg)
+        # int8 weight serving: quantize here (idempotent — params may
+        # arrive pre-quantized from a checkpoint) and, under a mesh,
+        # re-place by the QUANTIZED spec tree so codes shard like the
+        # weights they encode and scales ride their output channel.
+        self._weight_quant = bool(weight_quant)
+        if self._weight_quant and not self._api.params_quantized(params):
+            params = self._api.quantize_params(cfg, params)
+            if mesh is not None:
+                from skypilot_tpu.serve import gang_replica
+                params = gang_replica.shard_params(cfg, params, mesh,
+                                                   rules)
+        self._params = params
         self._slots = [_Slot() for _ in range(slots)]
         self._max_seq = int(max_seq)
         self._paged = bool(paged)
+        self._kv_quant = bool(kv_quant)
         # Self-speculative decoding (module docstring): k drafted
         # tokens per slot per step, verified in one batched forward.
         # 0 disables — the decode step is then byte-for-byte the
@@ -748,7 +611,9 @@ class DecodeEngine:
             slots=slots, max_seq=self._max_seq,
             prefill_chunk=prefill_chunk, paged=self._paged,
             kv_pool_blocks=kv_pool_blocks,
-            kv_block_tokens=kv_block_tokens, spec_k=self._spec_k,
+            kv_block_tokens=kv_block_tokens,
+            kv_quant=self._kv_quant,
+            weight_quant=self._weight_quant, spec_k=self._spec_k,
             spec_ngram=self._spec_ngram,
             spec_min_accept=self._spec_min_accept)
         self._kv_geometry = geo
@@ -773,7 +638,8 @@ class DecodeEngine:
             # last attention tile's table slice stays in bounds).
             self._table_len = geo["table_len"]
             self._table = np.zeros((slots, self._table_len), np.int32)
-            self._cache = self._api.init_paged_cache(cfg, total, chunk)
+            self._cache = self._api.init_paged_cache(
+                cfg, total, chunk, quantized=self._kv_quant)
             # The unified pool IS the prefix cache: the trie is just an
             # index over blocks, so it is always on in paged mode (a
             # hit is a table write; a miss costs one dict walk).
@@ -781,20 +647,21 @@ class DecodeEngine:
                                                          chunk)
             _KV_POOL_TOTAL.set(self._pool.usable_blocks)
             _KV_POOL_FREE.set(self._pool.free_blocks())
+            _KV_POOL_BLOCK_BYTES.set(sum(
+                v.nbytes for v in self._cache.values()) // total)
         else:
             self._cache = self._api.init_cache(cfg, slots, max_seq)
-            # Shared-prefix KV pool (module docstring): 0 disables.
-            # Chunk granularity is the (possibly shrunk) prefill
-            # chunk, so cached prefixes splice onto chunk-aligned
-            # prefill starts.
-            if prefix_cache_mb > 0:
-                self.prefix_cache = PrefixCache(
-                    int(prefix_cache_mb * 1024 * 1024), self._chunk)
         if mesh is not None:
             from skypilot_tpu.serve import gang_replica
+            shardings = gang_replica.cache_shardings(cfg, mesh, rules)
+            # cache_shardings always carries k_scale/v_scale entries;
+            # a bf16 cache has no such leaves, so filter by the tree
+            # the engine actually holds.
             self._cache = jax.device_put(
                 self._cache,
-                gang_replica.cache_shardings(cfg, mesh, rules))
+                {k: shardings[k] for k in self._cache})
+        _KV_QUANT_ENABLED.set(int(self._kv_quant))
+        _WEIGHT_QUANT_ENABLED.set(int(self._weight_quant))
         self._waiting: "collections.deque[Request]" = collections.deque()
         self._cond = threading.Condition()
         self._stop = False
@@ -914,34 +781,6 @@ class DecodeEngine:
     def _live(self) -> List[int]:
         return [i for i, s in enumerate(self._slots) if s.request]
 
-    def _publish_slot_chunks(self, i: int) -> None:
-        """Slot-free half of the prefix cache: gather the slot's
-        prefilled PROMPT chunks off the device and hand them to the
-        pool. Chunks already cached are never gathered, and the ones
-        that are get ALL their gathers dispatched up front with the
-        device→host copies started asynchronously — the engine thread
-        pays roughly one transfer's latency per free, not one blocking
-        round-trip per chunk stacked on top of live decode."""
-        slot = self._slots[i]
-        prompt, valid = slot.request.prompt, slot.prefilled
-        missing = self.prefix_cache.missing_chunks(prompt, valid)
-        gathered = {}
-        for j in missing:
-            kv = _gather_chunk(self._cfg, self._chunk, self._cache,
-                               jnp.int32(i), jnp.int32(j * self._chunk))
-            for arr in kv.values():
-                try:
-                    arr.copy_to_host_async()
-                except AttributeError:  # backend without async D2H
-                    pass
-            gathered[j] = kv
-        if not gathered:
-            return
-        self.prefix_cache.publish(
-            prompt, valid,
-            lambda j: {k: jax.device_get(v)
-                       for k, v in gathered[j].items()})
-
     def _publish_paged(self, i: int) -> None:
         """Paged publish-on-free: ADOPT the slot's full prompt blocks
         into the trie — a refcount transfer (kv_pool.publish retains,
@@ -987,11 +826,6 @@ class DecodeEngine:
                 # own references drop; skipped on engine failure/
                 # shutdown (device state not trustworthy).
                 self._publish_paged(i)
-            elif not self._paged and self.prefix_cache is not None \
-                    and error is None:
-                # Publish before the row is reusable; skipped on engine
-                # failure/shutdown (device state not trustworthy).
-                self._publish_slot_chunks(i)
             req = slot.request
             if tracing.ENABLED and req.trace is not None \
                     and req.trace.sampled:
@@ -1022,9 +856,6 @@ class DecodeEngine:
             _REQUESTS.labels(outcome=outcome).inc()
         if self._paged:
             self._release_paged(i)
-        elif slot.held:
-            self.prefix_cache.release(slot.held)
-            slot.held = []
         slot.request = None
         slot.pos = slot.generated = slot.prefilled = slot.tok = 0
         slot.cached = 0
@@ -1192,23 +1023,6 @@ class DecodeEngine:
                             "engine.queue", req.trace,
                             req.submitted_at, req.admitted_at,
                             {"slot": i}))
-                    if self.prefix_cache is not None:
-                        # Trie walk + refcount pin only (host dicts);
-                        # the device-side row restore happens on the
-                        # compute path (_prefill_one), not under the
-                        # submit lock.
-                        t0 = time.perf_counter() if traced else 0.0
-                        slot.held = \
-                            self.prefix_cache.match_and_acquire(
-                                req.prompt)
-                        slot.cached = len(slot.held) * self._chunk
-                        req.cached_prompt_tokens = slot.cached
-                        if traced:
-                            emits.append((
-                                "engine.prefix_lookup", req.trace,
-                                t0, time.perf_counter(),
-                                {"hit": bool(slot.held),
-                                 "cached_tokens": slot.cached}))
                     if stepstats.ENABLED:
                         self._record_admission(i, req, slot)
             _QUEUE_DEPTH.set(len(self._waiting))
@@ -1253,18 +1067,6 @@ class DecodeEngine:
             if tracing.ENABLED and req.trace is not None \
                     and req.trace.sampled and req.prefill_start is None:
                 req.prefill_start = time.perf_counter()
-            if slot.prefilled == 0 and slot.cached:
-                # Prefix hit: splice the matched chunks' K/V into the
-                # row instead of prefilling them — chunk by chunk, so
-                # every restore shares the one compiled splice program
-                # regardless of how many chunks matched.
-                for j, node in enumerate(slot.held):
-                    kv = {k: jnp.asarray(v)
-                          for k, v in node.kv.items()}
-                    self._cache = _insert_chunk(
-                        self._cfg, self._cache, kv, jnp.int32(i),
-                        jnp.int32(j * self._chunk))
-                slot.prefilled = slot.pos = slot.cached
             start = slot.prefilled
             piece = req.prompt[start:start + self._chunk]
             # Pad host-side (numpy), NOT with a jnp zeros/at/set: the

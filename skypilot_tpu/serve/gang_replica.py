@@ -194,14 +194,17 @@ def build_mesh(topology: ReplicaTopology):
 
 
 def shard_params(cfg, params, mesh, rules):
-    """Place params by their logical param_specs under (mesh, rules)."""
+    """Place params by their logical param_specs under (mesh, rules).
+    An int8 quantize_params tree (detected by its embed_scale leaf)
+    places by the quantized spec tree — codes shard like the weights
+    they encode, scales ride their output channel's shard."""
     import jax
     from skypilot_tpu.models import model_api
     from skypilot_tpu.parallel import mesh as mesh_lib
     api = model_api(cfg)
+    specs = api.param_specs(cfg, quantized="embed_scale" in params)
     return jax.device_put(
-        params, mesh_lib.tree_shardings(mesh, rules,
-                                        api.param_specs(cfg)))
+        params, mesh_lib.tree_shardings(mesh, rules, specs))
 
 
 def cache_shardings(cfg, mesh, rules):
@@ -216,11 +219,21 @@ def cache_shardings(cfg, mesh, rules):
     cache; matching it keeps the donated input aliasable (a replicated
     cache would silently drop the donation and double the KV cache in
     HBM — pinned by tests/test_sharded_replica.py). Only when head_dim
-    does not divide either does the cache fall back to replicated."""
+    does not divide either does the cache fall back to replicated.
+
+    The returned dict also carries ``k_scale``/``v_scale`` entries for
+    the int8 paged pool's per-(layer, block, kv_head) scale arrays —
+    callers with a bf16 cache just ignore them (the engine filters by
+    its cache's keys). A scale array ENDS in kv_heads, so the head_dim
+    fallback cannot re-point its trailing axis; scales replicate
+    instead, which is byte-trivial (4 bytes per block-head against
+    block_tokens * head_dim code bytes)."""
     from jax.sharding import NamedSharding, PartitionSpec
     from skypilot_tpu.models import model_api
     api = model_api(cfg)
-    specs = api.cache_specs(cfg)
+    specs = dict(api.cache_specs(cfg))
+    specs.setdefault("k_scale", ("layers", None, "kv_heads"))
+    specs.setdefault("v_scale", ("layers", None, "kv_heads"))
 
     def axis_size(logical: str) -> int:
         axis = rules.resolve_axis(logical, mesh)
@@ -234,7 +247,8 @@ def cache_shardings(cfg, mesh, rules):
         if "kv_heads" not in spec or cfg.n_kv_heads % tp == 0:
             return rules.sharding(spec, mesh)
         resolved = [None] * len(spec)
-        if int(getattr(cfg, "head_dim", 0)) % tp == 0:
+        if (spec[-1] != "kv_heads" and
+                int(getattr(cfg, "head_dim", 0)) % tp == 0):
             resolved[-1] = rules.resolve_axis("kv_heads", mesh)
         return NamedSharding(mesh, PartitionSpec(*resolved))
 

@@ -3,10 +3,11 @@ device-resident pool of KV blocks the paged decode engine allocates
 slots and the shared-prefix cache out of.
 
 The dense engine gives every slot a full ``(layers, max_seq, ...)``
-cache row, so concurrency is sized for the worst-case sequence, and the
-PrefixCache keeps a SECOND, host-side chunk pool spliced in and out via
-D2H/H2D copies. Paging collapses both into one device buffer of
-``num_blocks`` fixed-size blocks (block = the engine's prefill chunk):
+cache row, so concurrency is sized for the worst-case sequence (and it
+has no prefix cache — the old host-side splice pool was retired when
+the paged trie subsumed it). Paging collapses slot growth and prefix
+sharing into one device buffer of ``num_blocks`` fixed-size blocks
+(block = the engine's prefill chunk):
 
   * slots acquire blocks lazily as they prefill/decode (a per-slot
     block TABLE maps logical chunk index -> physical block id);
@@ -43,6 +44,35 @@ from __future__ import annotations
 import collections
 import threading
 from typing import Dict, List, Optional
+
+
+def block_bytes(block_tokens: int, n_layers: int, n_kv_heads: int,
+                head_dim: int, *, quantized: bool = False,
+                kv_dtype_bytes: int = 2) -> int:
+    """Device bytes ONE pool block costs across all layers: K and V
+    codes for ``block_tokens`` rows, plus (quantized) one f32 scale
+    per (layer, kv_head) for each of K and V. The int8 layout is
+    1 byte/element + the scale tax, so at the usual geometries a
+    quantized block is just over half a bf16 block — which is why the
+    same HBM budget fits ~2x the blocks (the >= 1.8x capacity gate in
+    the q8 bench leg)."""
+    per_elem = 1 if quantized else int(kv_dtype_bytes)
+    rows = 2 * n_layers * block_tokens * n_kv_heads * head_dim
+    scales = 2 * n_layers * n_kv_heads * 4 if quantized else 0
+    return rows * per_elem + scales
+
+
+def blocks_for_budget(budget_bytes: int, block_tokens: int,
+                      n_layers: int, n_kv_heads: int, head_dim: int, *,
+                      quantized: bool = False,
+                      kv_dtype_bytes: int = 2) -> int:
+    """How many pool blocks (scratch included) fit in ``budget_bytes``
+    of HBM — the capacity half of the quantization bench: the q8 leg
+    sizes a bf16 pool and a quantized pool off the SAME byte budget
+    and asserts the quantized one holds >= 1.8x the blocks."""
+    bb = block_bytes(block_tokens, n_layers, n_kv_heads, head_dim,
+                     quantized=quantized, kv_dtype_bytes=kv_dtype_bytes)
+    return int(budget_bytes) // bb
 
 
 class BlockPool:
@@ -156,9 +186,9 @@ class _BlockNode:
 
 
 class PagedPrefixCache:
-    """Chunk-granular trie over POOL BLOCKS — the paged successor of
-    decode_engine.PrefixCache's host pool, with the storage half
-    deleted: a cached chunk IS a device block, a hit IS a table write.
+    """Chunk-granular trie over POOL BLOCKS — the only prefix-cache
+    representation (the dense host-pool splice cache is retired):
+    a cached chunk IS a device block, a hit IS a table write.
 
     Eviction is LRU over unpinned leaves (an interior node's block is a
     dependency of every deeper cached prefix) and runs on demand from

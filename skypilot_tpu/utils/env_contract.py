@@ -165,10 +165,22 @@ _KNOBS = (
     _k("STPU_ENGINE_SLOTS", "4",
        "Decode-engine slot count (continuous-batching concurrency)."),
     _k("STPU_KV_PAGED", "1",
-       "\"0\" falls back to dense per-slot cache rows; default serves "
-       "from the paged KV block pool (one device pool + per-slot "
-       "block tables, zero-copy prefix aliasing). Bit-identical "
-       "either way."),
+       "\"0\" falls back to dense per-slot cache rows (no prefix "
+       "cache, no quantized KV); default serves from the paged KV "
+       "block pool (one device pool + per-slot block tables, "
+       "zero-copy prefix aliasing). Bit-identical either way while "
+       "STPU_KV_QUANT=0."),
+    _k("STPU_KV_QUANT", "0",
+       "\"1\" stores int8 KV blocks + per-(layer, block, head) f32 "
+       "scales in the paged pool — ~2x blocks at the same HBM "
+       "budget (auto pool sizing doubles). Requires STPU_KV_PAGED=1; "
+       "NOT bit-identical to bf16, gated by the tests/test_quant.py "
+       "parity suite."),
+    _k("STPU_WEIGHT_QUANT", "0",
+       "\"1\" serves int8 per-output-channel-quantized params "
+       "(matmul weights + embed/lm_head; norms, LoRA adapters and "
+       "the MoE router stay full precision). Parity-gated like "
+       "STPU_KV_QUANT."),
     _k("STPU_SPEC_K", "0",
        "Speculative decoding: tokens drafted per slot per decode "
        "step, verified in one batched forward (0 disables; output "
@@ -182,14 +194,18 @@ _KNOBS = (
        "drafting."),
     _k("STPU_KV_POOL_BLOCKS", "0",
        "Paged-KV pool size in blocks incl. the scratch block (0 = "
-       "auto: slots * max_seq / block + 1, the dense HBM budget)."),
+       "auto: slots * max_seq / block + 1, the dense HBM budget; "
+       "doubled under STPU_KV_QUANT=1 — int8 blocks are ~half the "
+       "bytes)."),
     _k("STPU_KV_BLOCK_TOKENS", "0",
        "Paged-KV block size in tokens; also becomes the prefill "
        "chunk — blocks and chunks are one unit (0 = the engine's "
        "prefill chunk, default 64)."),
     _k("STPU_PREFIX_CACHE_MB", "64",
-       "Shared-prefix KV host-pool budget, MB (0 disables; ignored "
-       "under STPU_KV_PAGED=1 — the pool IS the prefix cache)."),
+       "Retired knob, still read for env-file compatibility and "
+       "always ignored: prefix caching is the paged pool's trie "
+       "(always on under STPU_KV_PAGED=1), and the dense path's "
+       "host splice cache no longer exists."),
     _k("STPU_STREAM_TIMEOUT", "600",
        "Per-token stream timeout before the engine is declared "
        "wedged, seconds."),

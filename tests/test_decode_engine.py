@@ -310,23 +310,20 @@ def _tiny_cfg(family):
     return llama, llama.LlamaConfig.tiny(vocab_size=128)
 
 
-@pytest.mark.parametrize("family,paged", [
-    ("llama", False), ("mixtral", False), ("gemma", False),
-    ("llama", True),
-], ids=["llama", "mixtral", "gemma", "llama-paged"])
-def test_prefix_hit_token_identical_and_fewer_steps(family, paged):
+@pytest.mark.parametrize("family", ["llama", "mixtral", "gemma"])
+def test_prefix_hit_token_identical_and_fewer_steps(family):
     """A prefix-cache hit must change ONLY latency: the warm stream is
     token-identical to the fixed-path (cold) decode, prefill tokens
     are actually saved, and steps-to-first-token (chunk prefills, the
-    deterministic TTFT) is STRICTLY lower than the cold run's. The
-    contract holds identically for the dense splice cache and the
-    paged pool's zero-copy aliasing (same stats()/Request surface)."""
+    deterministic TTFT) is STRICTLY lower than the cold run's. Prefix
+    caching is the paged pool's zero-copy aliasing — the only
+    representation left now the dense splice cache is retired — so
+    the contract is pinned per family on the paged engine."""
     mdl, cfg = _tiny_cfg(family)
     vocab = cfg.vocab_size
     params = mdl.init(cfg, jax.random.key(0))
     engine = DecodeEngine(cfg, params, slots=2, max_seq=64,
-                          prefill_chunk=8, prefix_cache_mb=8.0,
-                          paged=paged).start()
+                          prefill_chunk=8, paged=True).start()
     try:
         shared = [int(t) for t in jax.random.randint(
             jax.random.key(11), (17,), 1, vocab)]  # 2 full 8-chunks
@@ -348,23 +345,21 @@ def test_prefix_hit_token_identical_and_fewer_steps(family, paged):
         engine.shutdown()
 
 
-@pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
-def test_prefix_hit_seeded_sampling_parity(paged):
+def test_prefix_hit_seeded_sampling_parity():
     """A temperature>0 stream is bit-identical warm vs cold: the hit
     restores the exact KV rows prefill would recompute, and the
     fold_in(seed, position) keys never see the cache. The cold
-    baseline is always the dense no-cache engine; the warm engine is
-    parametrized over both cache implementations (the paged pool's
-    prefix trie is always on, so its cold run would not be cold)."""
+    baseline is the dense engine — which has NO prefix cache at all
+    now the splice pool is retired — and the warm engine is the paged
+    pool's always-on zero-copy trie."""
     cfg = llama.LlamaConfig.tiny(vocab_size=128)
     params = llama.init(cfg, jax.random.key(0))
     prompt = [int(t) for t in jax.random.randint(
         jax.random.key(3), (21,), 1, 128)]
 
-    def run(prefix_mb, engine_paged=False):
+    def run(engine_paged):
         engine = DecodeEngine(cfg, params, slots=2, max_seq=64,
                               prefill_chunk=8,
-                              prefix_cache_mb=prefix_mb,
                               paged=engine_paged).start()
         try:
             # Sequential on purpose: the second submission must see the
@@ -378,102 +373,62 @@ def test_prefix_hit_seeded_sampling_parity(paged):
         finally:
             engine.shutdown()
 
-    cold1, cold2, _ = run(prefix_mb=0.0)
-    warm1, warm2, warm_req = run(prefix_mb=8.0, engine_paged=paged)
+    cold1, cold2, _ = run(engine_paged=False)
+    warm1, warm2, warm_req = run(engine_paged=True)
     assert cold1 == cold2 == warm1 == warm2
     assert warm_req.cached_prompt_tokens > 0  # the hit really happened
 
 
-@pytest.mark.dense_splice
-def test_prefix_pool_lru_refcount_and_interior_protection():
-    """Pool-level eviction contract: LRU leaves go first, nodes pinned
-    by a live match are NEVER evicted even over budget, and an interior
-    chunk (a cached deeper prefix depends on it) outlives fresher
-    leaves."""
-    import numpy as np
-    from skypilot_tpu.serve.decode_engine import PrefixCache
-
-    chunk = 4
-    kv_bytes = 2 * 64                    # two 64-byte arrays per chunk
-    pool = PrefixCache(capacity_bytes=3 * kv_bytes, chunk=chunk)
-
-    def fake_kv(_j):
-        return {"k": np.zeros(64, np.uint8), "v": np.zeros(64, np.uint8)}
-
-    a = list(range(10, 14))
-    b = list(range(20, 24))
-    pool.publish(a + b + [1], valid_tokens=9, fetch_kv=fake_kv)  # a->b
-    pool.publish(list(range(30, 34)) + [1], 5, fake_kv)          # c
-    assert pool.stats()["chunks"] == 3
-
-    # Pin the a->b path like an admitted slot would.
-    held = pool.match_and_acquire(a + b + [1])
-    assert len(held) == 2 and all(n.refs == 1 for n in held)
-
-    # Over-budget publish: the unpinned LRU leaf (c) must go; the
-    # pinned chain must survive; interior node a is not a leaf.
-    pool.publish(list(range(40, 44)) + [1], 5, fake_kv)          # d
-    keys = {n.key for n in pool.nodes()}
-    assert tuple(a) in keys and tuple(b) in keys
-    assert tuple(range(30, 34)) not in keys
-
-    # Even a pool FORCED over budget (everything pinned) refuses to
-    # touch pinned chunks: shrink capacity to one chunk and publish.
-    pool.capacity_bytes = kv_bytes
-    pool.publish(list(range(50, 54)) + [1], 5, fake_kv)          # e
-    keys = {n.key for n in pool.nodes()}
-    assert tuple(a) in keys and tuple(b) in keys  # pinned: untouched
-
-    # Release: the chain becomes evictable again, leaf-first (b before
-    # its parent a).
-    pool.release(held)
-    pool.publish(list(range(60, 64)) + [1], 5, fake_kv)
-    assert pool.stats()["bytes"] <= pool.capacity_bytes
-    assert all(n.refs == 0 for n in pool.nodes())
+# The dense splice cache (PrefixCache + _insert_chunk/_gather_chunk)
+# is retired; its pool-level eviction contract lives on against the
+# paged trie in test_paged_kv.py::
+# test_paged_trie_lru_refcount_and_interior_protection.
 
 
-@pytest.mark.dense_splice
 def test_engine_slot_churn_respects_pool_budget_and_parity():
-    """Slot churn through a ONE-chunk pool: every stream stays
-    token-identical to the fixed path while eviction constantly
-    replaces the resident chunk (LRU + refcount safety under churn,
-    the acceptance-criteria wording)."""
+    """Slot churn through a SMALL block pool: every stream stays
+    token-identical to the fixed path while trie eviction constantly
+    recycles blocks (LRU + refcount safety under churn), and the pool
+    accounting identity free + trie == usable holds after every
+    request (engine driven step-by-step — no scheduler races)."""
     import random
     cfg = llama.LlamaConfig.tiny(vocab_size=128)
     params = llama.init(cfg, jax.random.key(0))
-    # Capacity = one chunk of this config's KV (L*chunk*KVH*HD * 2
-    # tensors * 2 bytes bf16).
-    one_chunk = cfg.n_layers * 8 * cfg.n_kv_heads * cfg.head_dim * 2 * 2
+    # 9 usable 8-token blocks: one live request plus a couple of
+    # cached chunks — publish-on-free forces constant eviction.
     engine = DecodeEngine(cfg, params, slots=2, max_seq=64,
-                          prefill_chunk=8,
-                          prefix_cache_mb=one_chunk / (1024 * 1024)
-                          ).start()
-    try:
-        rng = random.Random(2)
-        for _ in range(6):
-            prompt = [rng.randint(1, 127)
-                      for _ in range(rng.randint(9, 20))]
-            got = engine.submit(prompt, max_tokens=3).result(
-                timeout=300.0)
-            ref = llama.decode(cfg, params, jnp.asarray([prompt]),
-                               jnp.int32(len(prompt)), 3,
-                               len(prompt) + 3)
-            assert got == [int(t) for t in ref[0]]
-            stats = engine.prefix_cache.stats()
-            assert stats["bytes"] <= engine.prefix_cache.capacity_bytes
-    finally:
-        engine.shutdown()
+                          prefill_chunk=8, paged=True,
+                          kv_pool_blocks=10)
+    rng = random.Random(2)
+    for _ in range(6):
+        prompt = [rng.randint(1, 127)
+                  for _ in range(rng.randint(9, 20))]
+        req = engine.submit(prompt, max_tokens=3)
+        for _ in range(200):
+            engine._admit()
+            did = engine._prefill_one()
+            did = engine._decode_step() or did
+            if not did and not engine._waiting:
+                break
+        got = req.result(timeout=5.0)
+        ref = llama.decode(cfg, params, jnp.asarray([prompt]),
+                           jnp.int32(len(prompt)), 3,
+                           len(prompt) + 3)
+        assert got == [int(t) for t in ref[0]]
+        pool = engine._pool
+        assert pool.free_blocks() + len(engine.prefix_cache.nodes()) \
+            == pool.usable_blocks
 
 
-@pytest.mark.dense_splice
-def test_cancel_mid_prefill_releases_chunk_refcounts():
+def test_cancel_mid_prefill_releases_block_refcounts():
     """A request cancelled between admission and prefill completion
-    must release every pinned pool node (engine driven step-by-step on
-    this thread — no scheduler races)."""
+    must unpin every trie node it aliased and return its own blocks —
+    the pool accounting identity holds afterwards (engine driven
+    step-by-step on this thread — no scheduler races)."""
     cfg = llama.LlamaConfig.tiny(vocab_size=128)
     params = llama.init(cfg, jax.random.key(0))
     engine = DecodeEngine(cfg, params, slots=1, max_seq=64,
-                          prefill_chunk=8, prefix_cache_mb=8.0)
+                          prefill_chunk=8, paged=True)
     # NOT started: drive _admit/_prefill_one/_decode_step directly.
     shared = [int(t) for t in jax.random.randint(
         jax.random.key(5), (18,), 1, 128)]
@@ -494,21 +449,26 @@ def test_cancel_mid_prefill_releases_chunk_refcounts():
     second.cancel()
     engine._prefill_one()                     # cancel path frees slot
     assert all(n.refs == 0 for n in engine.prefix_cache.nodes())
+    pool = engine._pool
+    assert pool.free_blocks() + len(engine.prefix_cache.nodes()) \
+        == pool.usable_blocks
+    assert pool._reserved == 0
     assert second.result(timeout=5.0) == []   # clean cancelled stream
 
 
-@pytest.mark.dense_splice
 def test_prefix_metrics_reach_replica_endpoint():
-    """Hit/miss/tokens-saved counters, the occupancy gauge and the
-    split TTFT histogram are part of the replica's /metrics surface
-    (and therefore of the LB's merged scrape)."""
+    """Hit/miss/tokens-saved counters and the split TTFT histogram are
+    part of the replica's /metrics surface (and therefore of the LB's
+    merged scrape) — emitted by the paged zero-copy trie, the only
+    prefix-cache representation left. The quant info gauges ride the
+    same surface (0 here: bf16 engine)."""
     from skypilot_tpu.observability import metrics as metrics_lib
     cfg = llama.LlamaConfig.tiny(vocab_size=128)
     params = llama.init(cfg, jax.random.key(0))
     saved_before = metrics_lib.REGISTRY.counter(
         "stpu_engine_prefill_tokens_saved_total").get()
     engine = DecodeEngine(cfg, params, slots=2, max_seq=64,
-                          prefill_chunk=8, prefix_cache_mb=8.0).start()
+                          prefill_chunk=8, paged=True).start()
     try:
         shared = list(range(1, 18))
         engine.submit(shared, max_tokens=2).result(timeout=300.0)
@@ -520,8 +480,9 @@ def test_prefix_metrics_reach_replica_endpoint():
         saved_before + 16
     text = metrics_lib.render()
     assert "stpu_engine_prefix_cache_hits_total" in text
-    assert "stpu_engine_prefix_cache_bytes" in text
     assert 'stpu_engine_prefix_ttft_seconds_count{cache="hit"}' in text
+    assert "stpu_engine_kv_quant_enabled 0" in text
+    assert "stpu_engine_weight_quant_enabled 0" in text
 
 
 # ------------------------------------------------- prefix-affinity LB

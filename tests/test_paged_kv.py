@@ -7,8 +7,8 @@ The contract under test, strongest first:
     seeded sampling, all three families, across slot reuse and chunked
     prefill (the block-table gather feeds the same online-softmax tile
     as the dense slice, so aligned tiles produce the same floats);
-  * a prefix hit is a block-table entry write: ZERO
-    insert_cache_rows/gather_cache_rows copies on the hot path, and
+  * a prefix hit is a block-table entry write: zero splice copies on
+    the hot path (the dense splice entry points no longer exist), and
     publish-on-free is a refcount transfer;
   * block refcount/aliasing lifecycle: shared blocks survive a
     mid-stream cancel, eviction never frees a pinned block, and 500
@@ -186,8 +186,7 @@ def test_paged_seeded_sampling_parity_and_zero_copy_hit():
 
     def run(paged):
         eng = DecodeEngine(cfg, params, slots=2, max_seq=64,
-                           prefill_chunk=8, paged=paged,
-                           prefix_cache_mb=8.0).start()
+                           prefill_chunk=8, paged=paged).start()
         try:
             first = eng.submit(prompt, max_tokens=6, temperature=0.9,
                                seed=17).result(timeout=300.0)
@@ -205,16 +204,18 @@ def test_paged_seeded_sampling_parity_and_zero_copy_hit():
 
 
 # ========================================== zero-copy on the hot path
-def test_paged_prefix_hit_zero_copies_on_hot_path(monkeypatch):
-    """Under paging a prefix hit performs NO insert_cache_rows /
-    gather_cache_rows work: both dense splice entry points are rigged
-    to explode, and the warm request must still restore its prefix
-    (table aliasing) and publish on free (refcount transfer)."""
-    def boom(*_a, **_k):
-        raise AssertionError("dense splice entry point called on the "
-                             "paged hot path")
-    monkeypatch.setattr(decode_engine, "_insert_chunk", boom)
-    monkeypatch.setattr(decode_engine, "_gather_chunk", boom)
+def test_paged_prefix_hit_zero_copies_on_hot_path():
+    """Under paging a prefix hit performs NO splice work: the dense
+    splice entry points (_insert_chunk/_gather_chunk and the per-model
+    gather/insert_cache_rows) are RETIRED — asserted gone, so nothing
+    can quietly reintroduce a copy path — and the warm request must
+    still restore its prefix (table aliasing) and publish on free
+    (refcount transfer)."""
+    for retired in ("_insert_chunk", "_gather_chunk", "PrefixCache"):
+        assert not hasattr(decode_engine, retired), retired
+    for mod in (llama, mixtral, gemma):
+        for retired in ("gather_cache_rows", "insert_cache_rows"):
+            assert not hasattr(mod, retired), (mod.__name__, retired)
     mdl, cfg = _tiny()
     params = mdl.init(cfg, jax.random.key(0))
     eng = DecodeEngine(cfg, params, slots=2, max_seq=64,
@@ -399,9 +400,13 @@ def test_paged_entry_points_keep_donation_sharded_and_single():
             if shard:
                 params = gang_replica.shard_params(cfg, params, mesh,
                                                    rules)
+                shardings = gang_replica.cache_shardings(cfg, mesh,
+                                                         rules)
+                # shardings also carries k_scale/v_scale for the int8
+                # pool; a bf16 pool has no such leaves — filter like
+                # the engine does.
                 pool = jax.device_put(
-                    pool, gang_replica.cache_shardings(cfg, mesh,
-                                                       rules))
+                    pool, {k: shardings[k] for k in pool})
             table = jnp.ones((2, 8), jnp.int32)
             old_k, old_v = pool["k"], pool["v"]
             buf = jnp.zeros((8,), jnp.int32).at[:4].set(

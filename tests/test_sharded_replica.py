@@ -214,9 +214,13 @@ def test_sharded_engine_donation_preserved():
         cfg = dataclasses.replace(cfg, dtype=jnp.float32)
         params = gang_replica.shard_params(
             cfg, mdl.init(cfg, jax.random.key(0)), mesh, rules)
-        cache = jax.device_put(
-            mdl.init_cache(cfg, 2, 128),
-            gang_replica.cache_shardings(cfg, mesh, rules))
+        cache = mdl.init_cache(cfg, 2, 128)
+        shardings = gang_replica.cache_shardings(cfg, mesh, rules)
+        # shardings also carries k_scale/v_scale for the int8 paged
+        # pool; the dense cache has no such leaves — filter like the
+        # engine does.
+        cache = jax.device_put(cache,
+                               {k: shardings[k] for k in cache})
         old_k, old_v = cache["k"], cache["v"]
         buf = jnp.zeros((64,), jnp.int32).at[:4].set(
             jnp.asarray([1, 2, 3, 4]))

@@ -296,7 +296,7 @@ def test_donation_rule_covers_paged_entry_points():
                 for d in rules_donation._collect_donators(ctx)
                 if d.name}
     for name in ("_paged_prefill_chunk", "_paged_step",
-                 "_prefill_chunk", "_engine_step", "_insert_chunk"):
+                 "_prefill_chunk", "_engine_step", "_paged_spec_step"):
         assert name in donators, f"{name} not seen as a donator"
         assert "cache" in donators[name].donated_params(), name
 

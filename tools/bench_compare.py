@@ -37,8 +37,14 @@ DEFAULT_METRICS = (
     "detail.serving.*_decode_tok_s_b*",
     "detail.serving.*_engine_ragged_tok_s",
     "detail.serving.*_engine_paged_tok_s",
+    "detail.serving.*_engine_q8_tok_s",
     "detail.serving.*_engine_spec_tok_s",
     "detail.serving.*_kv_pool_utilization",
+    # Quantized pool capacity: blocks the q8 pool fits at the SAME HBM
+    # byte budget as bf16. The leg itself asserts >= 1.8x vs bf16;
+    # gating the block count here keeps the ratio from eroding
+    # round-over-round (e.g. scale-array bloat shrinking the pool).
+    "detail.serving.*_kv_pool_capacity_blocks",
     "detail.serving.*_engine_tp_tok_s",
     "detail.serving.*_engine_prefix_tok_s",
     "detail.serving.*_prefix_hit_rate",

@@ -25,7 +25,7 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--family", choices=("mixtral", "llama", "gemma"),
                    default="mixtral")
-    p.add_argument("--mode", choices=("fixed", "engine", "paged",
+    p.add_argument("--mode", choices=("fixed", "engine", "paged", "q8",
                                       "spec", "prefix", "ckpt",
                                       "loadgen", "tp"),
                    default="fixed",
@@ -35,7 +35,10 @@ def main() -> None:
                         "the engine on the paged KV block pool (one "
                         "device pool + block tables, half the dense "
                         "HBM budget) under a mixed-length mix — "
-                        "tok/s + pool utilization; spec: "
+                        "tok/s + pool utilization; q8: the paged "
+                        "engine with int8 KV blocks + int8 weights — "
+                        "quantized tok/s and the block-capacity "
+                        "ratio vs bf16 at the same HBM budget; spec: "
                         "self-speculative decoding (n-gram drafts + "
                         "one batched verify pass) on the chat "
                         "shared-prefix mix, with the same-mix "
@@ -77,8 +80,6 @@ def main() -> None:
                    help="loadgen mode: TTFT SLO in seconds")
     p.add_argument("--slo-tpot", type=float, default=0.5,
                    help="loadgen mode: per-output-token SLO in seconds")
-    p.add_argument("--prefix-cache-mb", type=float, default=256.0,
-                   help="prefix mode: shared-prefix KV pool budget")
     p.add_argument("--tp", type=int, default=2,
                    help="tp mode: tensor-parallel degree (mesh width)")
     p.add_argument("--dim", type=int, default=1024)
@@ -119,6 +120,10 @@ def main() -> None:
         result = decode_bench.measure_engine_paged(
             args.family, slots=args.slots, n_requests=args.requests,
             **shape_kw)
+    elif args.mode == "q8":
+        result = decode_bench.measure_engine_q8(
+            args.family, slots=args.slots, n_requests=args.requests,
+            **shape_kw)
     elif args.mode == "spec":
         result = decode_bench.measure_engine_spec(
             args.family, slots=args.slots, n_requests=args.requests,
@@ -126,8 +131,7 @@ def main() -> None:
     elif args.mode == "prefix":
         result = decode_bench.measure_engine_prefix(
             args.family, slots=args.slots,
-            shared_prefix=args.shared_prefix,
-            prefix_cache_mb=args.prefix_cache_mb, **shape_kw)
+            shared_prefix=args.shared_prefix, **shape_kw)
     elif args.mode == "ckpt":
         result = decode_bench.measure_ckpt(
             args.family, repeats=args.repeats, **shape_kw)
