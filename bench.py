@@ -53,28 +53,13 @@ def _enable_compilation_cache() -> None:
               file=sys.stderr)
 
 
-# bf16 peak FLOP/s per chip by TPU generation (public spec sheets).
-_PEAK_FLOPS = {
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6e": 918e12,
-    "v4": 275e12,
-    "v3": 123e12,
-    "v2": 45e12,
-}
-
-
+# Peak-FLOPs table and device matching live in
+# observability/trainstats.py now (one registry shared with the live
+# MFU gauge, so bench and telemetry can never disagree on a chip's
+# peak).
 def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    for key, val in _PEAK_FLOPS.items():
-        if key in kind:
-            return val
-    # TPU naming fallbacks ("TPU v5 lite" etc.).
-    if "v5 lite" in kind or "v5litepod" in kind:
-        return _PEAK_FLOPS["v5e"]
-    if "v5" in kind:
-        return _PEAK_FLOPS["v5p"]
-    return 0.0  # unknown / CPU
+    from skypilot_tpu.observability import trainstats
+    return trainstats.peak_flops_for_device(device)
 
 
 def _tpu_candidates(llama):
@@ -538,6 +523,60 @@ def _serving_leg() -> dict:
     return out
 
 
+def _train_leg() -> dict:
+    """Training-goodput legs: each family's FULL recipe loop in a fresh
+    subprocess with STPU_TRAINSTATS=1 armed — the MFU/goodput numbers
+    come from the recipe's own trainstats snapshot, i.e. exactly what
+    `stpu jobs top` shows for a managed run. The point is tracking the
+    instrumented loop (delayed loss fetch, data-wait/ckpt accounting)
+    round-over-round, so a regression in recipe-loop goodput or in the
+    telemetry itself fails the pipeline like an MFU regression does.
+    Small configs by design: the headline leg owns peak per-chip MFU;
+    this leg owns the recipe path."""
+    import subprocess
+
+    legs = {
+        "llama": ("skypilot_tpu.recipes.llama_lora",
+                  ["--model", "tiny", "--steps", "30",
+                   "--batch-size", "8", "--seq-len", "512"]),
+        "gemma": ("skypilot_tpu.recipes.gemma_lora",
+                  ["--model", "tiny", "--steps", "30",
+                   "--batch-size", "8", "--seq-len", "512"]),
+        "mixtral": ("skypilot_tpu.recipes.mixtral_ep",
+                    ["--model", "tiny", "--steps", "30",
+                     "--batch-size", "8", "--seq-len", "256"]),
+    }
+    out: dict = {}
+    for family, (mod, extra) in legs.items():
+        env = dict(os.environ)
+        env["STPU_TRAINSTATS"] = "1"
+        # Hermetic: no checkpoint resume, no shared trainstats dir.
+        env.pop("STPU_JOB_CKPT_DIR", None)
+        env.pop("STPU_TRAINSTATS_DIR", None)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", mod] + extra,
+                capture_output=True, text=True, timeout=900, env=env)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    proc.stderr.strip().splitlines()[-1]
+                    if proc.stderr.strip()
+                    else f"exit {proc.returncode}")
+            r = json.loads(proc.stdout.strip().splitlines()[-1])
+            out[f"{family}_train_mfu"] = r.get("train_mfu")
+            out[f"{family}_train_detail"] = {
+                k: r.get(k) for k in ("train_goodput",
+                                      "train_step_seconds",
+                                      "train_tokens_per_sec",
+                                      "tokens_per_second",
+                                      "steps", "final_loss")}
+        except Exception as e:  # noqa: BLE001 — a failed leg must be
+            # visible in the json, not sink the whole bench run.
+            out[f"{family}_train_mfu"] = None
+            out[f"{family}_train_mfu_error"] = str(e)[:200]
+    return out
+
+
 def main():
     _enable_compilation_cache()
     from skypilot_tpu.models import llama
@@ -577,6 +616,7 @@ def main():
             "long_context": _long_context_leg(llama, peak),
             "eight_b_shape": _eight_b_shape_leg(llama, peak),
             "serving": _serving_leg(),
+            "train": _train_leg(),
         }
         print(json.dumps({
             "metric": "llama_train_mfu_1chip",

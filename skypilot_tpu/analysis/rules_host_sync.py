@@ -1,5 +1,5 @@
-"""``stpu-host-sync`` — no implicit device syncs on the decode hot
-path.
+"""``stpu-host-sync`` — no implicit device syncs on the decode or
+train hot paths.
 
 Every ``.item()``, ``float(arr)``, ``np.asarray(arr)``, ``print(arr)``
 or ``.block_until_ready()`` on a device array forces a device→host
@@ -10,7 +10,14 @@ latency cliff. The engine's one sanctioned sync is the explicit
 ``jax.device_get`` on the sampled tokens (the tokens must reach the
 host to be emitted); everything else stays on device.
 
-Scope: ``serve/decode_engine.py`` and ``serve/gang_replica.py``.
+Scope: ``serve/decode_engine.py``, ``serve/gang_replica.py``, and the
+training loop — ``train/trainer.py`` plus the recipe loops
+(``recipes/llama_lora.py``, ``recipes/mixtral_ep.py``,
+``recipes/resnet_ddp.py``). A train loop that ``float()``s its loss
+every step serializes host and device exactly like the decode engine
+would; the sanctioned pattern there is the ONE-STEP-DELAYED fetch
+(``trainer.DelayedFetch``): hold the device handle one iteration and
+``jax.device_get`` it only after the next step is dispatched.
 
   * ``.item()`` and ``.block_until_ready()`` are flagged ANYWHERE in
     those files — they only exist on arrays and are never right on
@@ -27,12 +34,19 @@ Scope: ``serve/decode_engine.py`` and ``serve/gang_replica.py``.
   * The function form ``jax.block_until_ready(...)`` is flagged like
     the method form — same sync, different spelling.
 
-One call IS sanctioned: ``stepstats.sampled_sync(...)``
-(observability/stepstats.py) — the step-telemetry subsystem's timed
-block_until_ready, fired every STPU_STEPSTATS_SYNC_EVERY-th step to
-split dispatch vs device time. It is rate-limited by design and the
-only approved way to put a sync on the serve hot path; anything else
-must either use it or carry a noqa.
+Two calls ARE sanctioned: ``stepstats.sampled_sync(...)``
+(observability/stepstats.py) and its training twin
+``trainstats.sampled_sync(...)`` (observability/trainstats.py) — the
+step-telemetry subsystems' timed block_until_ready, fired every
+STPU_STEPSTATS_SYNC_EVERY-th / STPU_TRAINSTATS_SYNC_EVERY-th step to
+split dispatch vs device time. They are rate-limited by design and
+the only approved way to put a sync on a hot path; anything else
+must either use them or carry a noqa.
+
+Training loops usually build their jitted step through a factory
+(``step = trainer.make_train_step(...)``) rather than a local
+``@jax.jit`` — those factory results are treated as jitted entry
+points too (``_JIT_FACTORIES``), so the loop that calls them is hot.
 
 Annotate a genuinely-required sync with
 ``# noqa: stpu-host-sync <reason>``.
@@ -45,7 +59,9 @@ from typing import Dict, Iterable, List, Optional, Set
 from skypilot_tpu.analysis import core
 from skypilot_tpu.analysis.core import FileContext, Finding, Rule
 
-TARGET_FILES = ("serve/decode_engine.py", "serve/gang_replica.py")
+TARGET_FILES = ("serve/decode_engine.py", "serve/gang_replica.py",
+                "train/trainer.py", "recipes/llama_lora.py",
+                "recipes/mixtral_ep.py", "recipes/resnet_ddp.py")
 
 # Per-token mirror/broadcast loops that never call a jitted name
 # directly (the engine is driven through objects), but sit on the
@@ -59,13 +75,18 @@ _ALWAYS_SYNC_ATTRS = {"item", "block_until_ready"}
 # the dotted `jax.block_until_ready(...)` spelling is already caught
 # by the attribute branch below (_ALWAYS_SYNC_ATTRS).
 _ALWAYS_SYNC_CALLS = {"block_until_ready"}
-# THE sanctioned sync seam (module docstring): the step-telemetry
-# sampled dispatch/device split. Never flagged.
-_SANCTIONED_CALLS = {"stepstats.sampled_sync", "sampled_sync"}
+# THE sanctioned sync seams (module docstring): the step-telemetry
+# sampled dispatch/device splits. Never flagged.
+_SANCTIONED_CALLS = {"stepstats.sampled_sync",
+                     "trainstats.sampled_sync", "sampled_sync"}
 _NP_MODULES = {"np", "numpy", "onp"}
 _NP_FUNCS = {"asarray", "array"}
 _DEVICE_MODULES = ("jnp.", "jax.")
 _UNTAINT_CALLS = {"jax.device_get", "device_get"}
+# Factories whose RESULT is a jitted callable: `step =
+# trainer.make_train_step(...)` makes `step(...)` a jitted entry
+# point even though no local def carries @jax.jit.
+_JIT_FACTORIES = {"trainer.make_train_step", "make_train_step"}
 
 
 def _jitted_names(ctx: FileContext) -> Set[str]:
@@ -86,8 +107,8 @@ def _jitted_names(ctx: FileContext) -> Set[str]:
                     names.add(node.name)
         if isinstance(node, ast.Assign) \
                 and isinstance(node.value, ast.Call) \
-                and core.dotted_path(node.value.func) in ("jax.jit",
-                                                          "jit"):
+                and core.dotted_path(node.value.func) in (
+                    "jax.jit", "jit", *_JIT_FACTORIES):
             for t in node.targets:
                 if isinstance(t, ast.Name):
                     names.add(t.id)

@@ -1520,6 +1520,120 @@ def jobs_dashboard(port, host):
                   host or dashboard.DEFAULT_HOST)
 
 
+def _load_train_doc(job: dict) -> dict:
+    """Training telemetry for one managed job: the controller's
+    scraped dump (snapshot + time-series), falling back to the raw
+    ``snapshot.json`` in the job's trainstats dir when the controller
+    has not scraped a tick yet."""
+    import json as json_lib
+    import os
+    from skypilot_tpu.utils import paths
+    path = (paths.logs_dir() / "managed_jobs" /
+            f"controller-{job['job_id']}-train.json")
+    try:
+        with open(path) as f:
+            doc = json_lib.load(f)
+        if isinstance(doc, dict):
+            return doc
+    except (OSError, ValueError):
+        pass
+    ckpt_dir = job.get("ckpt_dir")
+    if ckpt_dir:
+        try:
+            with open(os.path.join(ckpt_dir, "trainstats",
+                                   "snapshot.json")) as f:
+                snap = json_lib.load(f)
+            if isinstance(snap, dict):
+                return {"snapshot": snap}
+        except (OSError, ValueError):
+            pass
+    return {}
+
+
+def _render_jobs_top(job: dict, doc: dict) -> str:
+    """Human rendering of one job's training telemetry (`stpu jobs
+    top`) — mirrors `stpu top`'s layout for the serving fleet."""
+    snap = doc.get("snapshot") or {}
+    goodput = snap.get("goodput") or {}
+    last = snap.get("last") or {}
+    # The controller-persisted columns are the fallback when the
+    # snapshot is missing (e.g. the task host died mid-write).
+    mfu = (snap.get("mfu") if snap.get("mfu") is not None
+           else job.get("mfu"))
+    tok_s = (snap.get("tokens_per_sec")
+             if snap.get("tokens_per_sec") is not None
+             else job.get("tok_s"))
+    productive = (goodput.get("productive")
+                  if goodput.get("productive") is not None
+                  else job.get("goodput"))
+    ckpt = job.get("last_ckpt_step")
+    lines = [
+        "job        {} ({})  {}  recoveries {}  ckpt {}".format(
+            job["job_id"], job.get("job_name") or "-", job["status"],
+            job.get("recovery_count") or 0,
+            "-" if ckpt is None else f"@{ckpt}"),
+        "train      step/s {}  tok/s {}  MFU {}  at step {}".format(
+            _fmt_val(snap.get("steps_per_sec"), "{:.2f}"),
+            _fmt_val(tok_s, "{:.0f}"),
+            _fmt_val(mfu, "{:.1%}"),
+            _fmt_val(last.get("step"), "{:.0f}")),
+        "loss       {}  grad_norm {}".format(
+            _fmt_val(last.get("loss"), "{:.4f}"),
+            _fmt_val(last.get("grad_norm"), "{:.4f}")),
+        "goodput    productive {}  data-wait {}  ckpt {}  "
+        "restart {}".format(
+            _fmt_val(productive, "{:.1%}"),
+            _fmt_val(goodput.get("data_wait"), "{:.1%}"),
+            _fmt_val(goodput.get("ckpt"), "{:.1%}"),
+            _fmt_val(goodput.get("restart"), "{:.1%}")),
+        "gang       hosts {}  skew {}s  stragglers {}".format(
+            snap.get("hosts") or 1,
+            _fmt_val(snap.get("host_skew_s"), "{:.2f}"),
+            ",".join(str(h) for h in snap.get("stragglers") or [])
+            or "-"),
+    ]
+    if not snap:
+        lines.append("(no trainstats snapshot yet — arm the task "
+                     "with STPU_TRAINSTATS=1; docs/observability.md)")
+    return "\n".join(lines)
+
+
+@jobs.command(name="top")
+@click.argument("job_id", required=False, type=int)
+@click.option("--watch", "-w", is_flag=True,
+              help="Refresh until interrupted.")
+@click.option("--interval", "-n", type=float, default=2.0,
+              show_default=True,
+              help="Refresh period for --watch, seconds.")
+def jobs_top(job_id, watch, interval):
+    """Live training telemetry for a managed job: step/s, tok/s, live
+    MFU, the goodput breakdown, gang skew/stragglers, last durable
+    checkpoint and recovery count — scraped each watch tick by the
+    jobs controller from the task's trainstats snapshot (arm the task
+    with STPU_TRAINSTATS=1; see docs/observability.md). Defaults to
+    the newest non-terminal job."""
+    from skypilot_tpu.jobs import core as jobs_core
+    from skypilot_tpu.jobs.state import ManagedJobStatus
+
+    def render_once():
+        queue = jobs_core.queue()
+        if not queue:
+            raise click.ClickException("no managed jobs.")
+        if job_id is not None:
+            matches = [j for j in queue if j["job_id"] == job_id]
+            if not matches:
+                raise click.ClickException(
+                    f"Managed job {job_id} not found.")
+            job = matches[0]
+        else:
+            live = [j for j in queue
+                    if not ManagedJobStatus(j["status"]).is_terminal()]
+            job = (live or queue)[0]  # queue is newest-first
+        click.echo(_render_jobs_top(job, _load_train_doc(job)))
+
+    _watch_render(render_once, watch, interval)
+
+
 @cli.group()
 def bench():
     """Benchmark a task across candidate TPU types ($/step report)."""

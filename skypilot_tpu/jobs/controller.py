@@ -114,6 +114,13 @@ class JobsController:
         self._cancel_requested = False
         self._adopt = adopt
         self._last_ckpt_reported: Optional[int] = None
+        # Training telemetry scraped from the task's trainstats
+        # snapshot each watch tick (PR 14 store; dumped as JSON next
+        # to the .prom so `stpu jobs top` — a separate process — can
+        # read the series back).
+        from skypilot_tpu.observability import timeseries
+        self._train_store = timeseries.TimeSeriesStore()
+        self._last_train_stats: Optional[tuple] = None
 
     # ------------------------------------------------------------------
     def _export_metrics(self) -> None:
@@ -228,6 +235,84 @@ class JobsController:
             self._last_ckpt_reported = step
         return step
 
+    def _poll_trainstats(self, ckpt_dir: str) -> None:
+        """Scrape the task's trainstats aggregate snapshot (host 0
+        writes ``<ckpt_dir>/trainstats/snapshot.json``) into the
+        controller's time-series store, persist the headline gauges
+        on the jobs row (write-on-change), and dump the series as
+        JSON for `stpu jobs top`. Best-effort: an absent or torn
+        snapshot is simply skipped."""
+        import json as json_lib
+        path = os.path.join(ckpt_dir, "trainstats", "snapshot.json")
+        try:
+            with open(path) as f:
+                snap = json_lib.load(f)
+        except (OSError, ValueError):
+            return
+        if not isinstance(snap, dict):
+            return
+        now = time.time()
+        label = {"job": str(self.job_id)}
+        mfu = snap.get("mfu")
+        tok_s = snap.get("tokens_per_sec")
+        goodput = (snap.get("goodput") or {}).get("productive")
+        if mfu is not None:
+            self._train_store.record("stpu_train_mfu", mfu, now,
+                                     **label)
+        if tok_s is not None:
+            self._train_store.record("stpu_train_tokens_per_sec",
+                                     tok_s, now, **label)
+        if goodput is not None:
+            self._train_store.record("stpu_train_goodput_fraction",
+                                     goodput, now, **label)
+        if snap.get("host_skew_s") is not None:
+            self._train_store.record("stpu_train_host_skew_seconds",
+                                     snap["host_skew_s"], now, **label)
+        stats = (mfu, tok_s, goodput)
+        if stats != self._last_train_stats:
+            # Write-on-change only, like _poll_ckpt_progress: stamping
+            # identical gauges every tick is pure WAL churn.
+            jobs_state.set_train_stats(self.job_id, mfu, tok_s,
+                                       goodput)
+            self._last_train_stats = stats
+        from skypilot_tpu.utils import paths
+        log_dir = paths.logs_dir() / "managed_jobs"
+        try:
+            log_dir.mkdir(parents=True, exist_ok=True)
+            out = log_dir / f"controller-{self.job_id}-train.json"
+            doc = {
+                "ts": now,
+                "job_id": self.job_id,
+                "snapshot": snap,
+                "series": {
+                    name: self._train_store.points(name, job=str(
+                        self.job_id))
+                    for name in ("stpu_train_mfu",
+                                 "stpu_train_tokens_per_sec",
+                                 "stpu_train_goodput_fraction",
+                                 "stpu_train_host_skew_seconds")
+                },
+            }
+            tmp = str(out) + ".tmp"
+            with open(tmp, "w") as f:
+                json_lib.dump(doc, f, default=str)
+            os.replace(tmp, out)
+        except OSError:
+            pass
+
+    def _dump_train_flight(self, ckpt_dir: str, reason: str) -> None:
+        """Post-mortem of a preempted/lost task: synthesize a
+        gang-wide flight dump from the per-host trainstats JSONL
+        sinks — the training processes are already dead, so the
+        controller writes it for them."""
+        if not ckpt_dir:
+            return
+        stats_dir = os.path.join(ckpt_dir, "trainstats")
+        if not os.path.isdir(stats_dir):
+            return
+        from skypilot_tpu.observability import trainstats
+        trainstats.dump_dir_flight(reason, stats_dir)
+
     def _run_one_task(self, task_index: int, task,
                       adopt: bool = False) -> None:
         cluster_name = self._cluster_name(task_index)
@@ -314,6 +399,7 @@ class JobsController:
                     mode="recover", cluster=cluster_name)
         span.event("adopted", mode="recover")
         resumed_step = self._poll_ckpt_progress(ckpt_dir) or 0
+        self._dump_train_flight(ckpt_dir, "controller_adopt")
         jobs_state.set_recovering(self.job_id)
         _RECOVERIES.inc()
         with tracing.start_span("jobs.recover", kind="jobs", parent=span,
@@ -336,6 +422,7 @@ class JobsController:
             self._check_cancelled()
             if ckpt_dir:
                 self._poll_ckpt_progress(ckpt_dir)
+                self._poll_trainstats(ckpt_dir)
             status = self._job_status(cluster_name, cluster_job_id)
             healthy = self._cluster_healthy(cluster_name)
             if status == job_lib.JobStatus.SUCCEEDED:
@@ -367,6 +454,9 @@ class JobsController:
             # recovery so the gauge reflects what the preemption cost.
             resumed_step = (self._poll_ckpt_progress(ckpt_dir) or 0
                             if ckpt_dir else 0)
+            # Post-mortem BEFORE recovery scribbles over the sinks:
+            # the dump captures the last steps of the dying attempt.
+            self._dump_train_flight(ckpt_dir, "job_preempted")
             jobs_state.set_recovering(self.job_id)
             _RECOVERIES.inc()
             if not healthy:

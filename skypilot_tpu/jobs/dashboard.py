@@ -30,9 +30,18 @@ _PAGE = """<!doctype html>
 </style></head>
 <body><h2>Managed jobs</h2><p>{now}</p>
 <table><tr><th>ID</th><th>Name</th><th>Status</th><th>Recoveries</th>
+<th>MFU</th><th>Goodput</th>
 <th>Cluster</th><th>Submitted</th><th>Failure</th></tr>
 {rows}
 </table></body></html>"""
+
+
+def _pct(value) -> str:
+    """Render a 0..1 fraction as a percentage cell ('-' when the
+    controller has not scraped one yet)."""
+    if value is None:
+        return "-"
+    return f"{float(value) * 100:.1f}%"
 
 
 def _render(jobs) -> str:
@@ -43,12 +52,14 @@ def _render(jobs) -> str:
             time.localtime(j.get("submitted_at") or 0))
         rows.append(
             "<tr><td>{}</td><td>{}</td>"
-            "<td class=\"{}\">{}</td><td>{}</td><td>{}</td>"
-            "<td>{}</td><td>{}</td></tr>".format(
+            "<td class=\"{}\">{}</td><td>{}</td><td>{}</td><td>{}</td>"
+            "<td>{}</td><td>{}</td><td>{}</td></tr>".format(
                 j["job_id"], html.escape(str(j.get("job_name") or "-")),
                 html.escape(str(j["status"])),
                 html.escape(str(j["status"])),
                 j.get("recovery_count") or 0,
+                _pct(j.get("mfu")),
+                _pct(j.get("goodput")),
                 html.escape(str(j.get("cluster_name") or "-")),
                 submitted,
                 html.escape(str(j.get("failure_reason") or ""))))

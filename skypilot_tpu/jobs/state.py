@@ -84,7 +84,10 @@ def _conn() -> sqlite3.Connection:
         failure_reason TEXT,
         last_ckpt_step INTEGER,
         ckpt_dir TEXT,
-        cluster_job_id INTEGER)""")
+        cluster_job_id INTEGER,
+        mfu REAL,
+        tok_s REAL,
+        goodput REAL)""")
     # Schema migration for DBs created before the checkpoint columns
     # existed (sqlite has no ADD COLUMN IF NOT EXISTS). Once per
     # process per DB path: every jobs_state call opens a fresh
@@ -95,7 +98,10 @@ def _conn() -> sqlite3.Connection:
         migrated = True
         for column, decl in (("last_ckpt_step", "INTEGER"),
                              ("ckpt_dir", "TEXT"),
-                             ("cluster_job_id", "INTEGER")):
+                             ("cluster_job_id", "INTEGER"),
+                             ("mfu", "REAL"),
+                             ("tok_s", "REAL"),
+                             ("goodput", "REAL")):
             try:
                 conn.execute(f"ALTER TABLE managed_jobs "
                              f"ADD COLUMN {column} {decl}")
@@ -115,7 +121,8 @@ _COLUMNS = ("job_id", "job_name", "dag_yaml_path", "resources_str",
             "cluster_name", "status", "submitted_at", "start_at", "end_at",
             "last_recovered_at", "recovery_count", "task_index",
             "num_tasks", "controller_pid", "failure_reason",
-            "last_ckpt_step", "ckpt_dir", "cluster_job_id")
+            "last_ckpt_step", "ckpt_dir", "cluster_job_id",
+            "mfu", "tok_s", "goodput")
 
 
 def add_job(job_name: str, dag_yaml_path: str, resources_str: str,
@@ -258,6 +265,19 @@ def set_last_ckpt_step(job_id: int, step: int) -> None:
         conn.execute(
             "UPDATE managed_jobs SET last_ckpt_step=? WHERE job_id=?",
             (step, job_id))
+
+
+def set_train_stats(job_id: int, mfu: Optional[float],
+                    tok_s: Optional[float],
+                    goodput: Optional[float]) -> None:
+    """Latest training telemetry the controller scraped from the
+    task's trainstats snapshot (live MFU, token rate, productive
+    goodput fraction) — `stpu jobs queue`/`top` surface them."""
+    with _conn() as conn:
+        conn.execute(
+            "UPDATE managed_jobs SET mfu=?, tok_s=?, goodput=? "
+            "WHERE job_id=?",
+            (mfu, tok_s, goodput, job_id))
 
 
 def claim_controller(job_id: int, expected_pid: Optional[int],

@@ -38,6 +38,7 @@ import numpy as np
 import optax
 
 from skypilot_tpu.models import llama
+from skypilot_tpu.observability import trainstats
 from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.recipes import synthetic_data
 from skypilot_tpu.train import checkpoint as checkpoint_lib
@@ -118,6 +119,7 @@ def run_lora(model_lib, cfg, args, recipe_name: str) -> dict:
     """LoRA finetune loop, generic over the dense model families (llama
     and gemma share forward/param_specs/lora_dense; gemma_lora.py passes
     its module + config here)."""
+    setup_t0 = time.perf_counter()
     ctx = distributed.initialize_from_env()
     if args.seq_len > cfg.max_seq_len:
         raise SystemExit(f"--seq-len {args.seq_len} exceeds model max "
@@ -125,7 +127,8 @@ def run_lora(model_lib, cfg, args, recipe_name: str) -> dict:
 
     mesh = mesh_lib.make_mesh({"fsdp": -1})
     rules = mesh_lib.DEFAULT_RULES
-    print(f"{recipe_name}: model={args.model} devices={jax.device_count()} "
+    print(f"{recipe_name}: model={args.model} "  # noqa: stpu-host-sync startup banner of host ints, before the loop
+          f"devices={jax.device_count()} "
           f"rank={ctx.rank}/{ctx.num_nodes}", flush=True)
 
     # Base params: sharded by the rule table (fsdp over embed axes); the
@@ -143,7 +146,8 @@ def run_lora(model_lib, cfg, args, recipe_name: str) -> dict:
     # Training PRNG key: carried in the checkpoint (full-TrainState
     # contract) so any stochastic op added later resumes mid-stream
     # instead of restarting its randomness.
-    train_rng = np.asarray(jax.random.PRNGKey(args.seed + 2))
+    rng_dev = jax.random.PRNGKey(args.seed + 2)
+    train_rng = jax.device_get(rng_dev)
 
     def _state_tree(step: int):
         return {"lora": lora, "opt_state": opt_state,
@@ -203,61 +207,114 @@ def run_lora(model_lib, cfg, args, recipe_name: str) -> dict:
     # Preemption grace: the gang layer forwards SIGTERM here; finish
     # the in-flight step, save, exit 143 (train/checkpoint.py).
     grace = checkpoint_lib.GraceHandler.install()
+    if trainstats.ENABLED:
+        trainstats.configure(
+            flops_per_token=cfg.flops_per_token(args.seq_len),
+            peak_flops=trainstats.detect_peak_flops(),
+            host=ctx.rank, hosts=ctx.num_nodes, job=recipe_name)
+        if start_step:
+            # A resumed run's setup wall (restore + re-init) is
+            # restart downtime in the goodput breakdown.
+            trainstats.note_downtime(time.perf_counter() - setup_t0)
     t0 = time.time()
     loss = None
     losses = []
+    # One-step-delayed loss fetch: each iteration fetches the PREVIOUS
+    # step's loss (already resident by then) so logging never syncs
+    # the hot loop — float(loss) here would stall every step.
+    delayed = trainer.DelayedFetch()
+    tokens_per_step = args.batch_size * args.seq_len
     # On-device XLA profile of the training loop when STPU_PROFILE_DIR
     # is set (tensorboard-loadable); zero-cost no-op otherwise. The
     # `with` guarantees the trace is finalized even when a step raises.
     from skypilot_tpu import callbacks
-    with callbacks.device_profile():
-        # Data position: skip replays the RNG draws of the completed
-        # steps, so step k's batch is the same whether or not the run
-        # was interrupted (bit-identical resume).
-        for i, (tokens,) in enumerate(
-                synthetic_data.batches((data,), args.batch_size,
-                                       args.seed,
-                                       args.steps - start_step,
-                                       skip=data_start)):
-            step = start_step + i + 1
-            lora, opt_state, loss = step_fn(base, lora, opt_state,
-                                            jnp.asarray(tokens))
-            losses.append(float(loss))
-            # Chaos seam: deterministic mid-epoch crash/preempt
-            # (STPU_FAULTS="train.step:kill:skip=K").
-            if fault_injection.ENABLED:
-                fault_injection.fire("train.step", step=step)
-            # Snapshot ONCE: SIGTERM landing between a save-condition
-            # read and the exit-branch read must not skip the grace
-            # save while still reporting it happened.
-            preempting = grace.triggered
-            if saver is not None and (step % args.ckpt_every == 0
-                                      or step == args.steps
-                                      or preempting):
-                saver.save(step, _state_tree(step))
-            if preempting:
-                if saver is not None:
-                    saver.wait()  # the grace save must be durable
-                print(json.dumps({
-                    "recipe": recipe_name, "preempted": True,
-                    "resumed_from": start_step, "stopped_at": step,
-                    "last_ckpt_step": (saver.last_saved_step
-                                       if saver is not None else None),
-                }), flush=True)
-                raise SystemExit(
-                    checkpoint_lib.GraceHandler.GRACE_EXIT_CODE)
-        if loss is not None:
-            loss.block_until_ready()
+    try:
+        with callbacks.device_profile():
+            # Data position: skip replays the RNG draws of the
+            # completed steps, so step k's batch is the same whether
+            # or not the run was interrupted (bit-identical resume).
+            mark = time.perf_counter()
+            for i, (tokens,) in enumerate(
+                    synthetic_data.batches((data,), args.batch_size,
+                                           args.seed,
+                                           args.steps - start_step,
+                                           skip=data_start)):
+                data_wait = time.perf_counter() - mark
+                step = start_step + i + 1
+                step_t0 = time.perf_counter()
+                lora, opt_state, loss = step_fn(base, lora, opt_state,
+                                                jnp.asarray(tokens))
+                dispatch_s = time.perf_counter() - step_t0
+                fetched = None
+                prev = delayed.rotate(loss)
+                if prev is not None:
+                    host_loss = jax.device_get(prev)
+                    fetched = float(host_loss)
+                    losses.append(fetched)
+                device_s = None
+                if trainstats.ENABLED and trainstats.sync_due():
+                    device_s = trainstats.sampled_sync(loss)
+                dur = time.perf_counter() - step_t0
+                # Chaos seam: deterministic mid-epoch crash/preempt
+                # (STPU_FAULTS="train.step:kill:skip=K").
+                if fault_injection.ENABLED:
+                    fault_injection.fire("train.step", step=step)
+                # Snapshot ONCE: SIGTERM landing between a
+                # save-condition read and the exit-branch read must not
+                # skip the grace save while still reporting it happened.
+                preempting = grace.triggered
+                ckpt_s = 0.0
+                if saver is not None and (step % args.ckpt_every == 0
+                                          or step == args.steps
+                                          or preempting):
+                    ckpt_t0 = time.perf_counter()
+                    saver.save(step, _state_tree(step))
+                    ckpt_s = time.perf_counter() - ckpt_t0
+                if trainstats.ENABLED:
+                    trainstats.record_step(
+                        step=step, dur=dur, tokens=tokens_per_step,
+                        data_wait_s=data_wait, ckpt_s=ckpt_s,
+                        dispatch_s=dispatch_s, device_s=device_s,
+                        delayed=({"loss": fetched}
+                                 if fetched is not None else None))
+                if preempting:
+                    if saver is not None:
+                        saver.wait()  # the grace save must be durable
+                    if trainstats.ENABLED:
+                        trainstats.dump_flight("sigterm")
+                    print(json.dumps({
+                        "recipe": recipe_name, "preempted": True,
+                        "resumed_from": start_step, "stopped_at": step,
+                        "last_ckpt_step": (saver.last_saved_step
+                                           if saver is not None
+                                           else None),
+                    }), flush=True)
+                    raise SystemExit(
+                        checkpoint_lib.GraceHandler.GRACE_EXIT_CODE)
+                mark = time.perf_counter()
+            # Drain the outstanding handle: the fetch both logs the
+            # final loss and blocks until the last step completed.
+            final = delayed.drain()
+            if final is not None:
+                host_loss = jax.device_get(final)
+                losses.append(float(host_loss))
+    except (Exception, KeyboardInterrupt) as e:
+        if trainstats.ENABLED:
+            trainstats.dump_flight("train_crash", error=repr(e))
+        raise
     if saver is not None:
         saver.wait()
 
     wall = time.time() - t0  # noqa: stpu-wallclock workload wall-time report
     steps_run = max(args.steps - start_step, 0)
     tokens_seen = steps_run * args.batch_size * args.seq_len
+    # Host copy for reporting: the adapters are tiny, and counting the
+    # device tree directly would sync it into the metrics print.
+    lora_host = jax.device_get(lora)
     metrics = {
         "recipe": recipe_name,
         "model": args.model,
-        "lora_params": num_params(lora),
+        "lora_params": num_params(lora_host),
         "base_params": cfg.num_params(),
         "resumed_from": start_step,
         "last_ckpt_step": (saver.last_saved_step
@@ -268,6 +325,13 @@ def run_lora(model_lib, cfg, args, recipe_name: str) -> dict:
         "tokens_per_second": round(tokens_seen / wall, 1) if wall else 0,
         "wall_seconds": round(wall, 2),
     }
+    if trainstats.ENABLED:
+        snap = trainstats.snapshot()
+        metrics["train_mfu"] = snap["mfu"]
+        metrics["train_goodput"] = snap["goodput"]
+        metrics["train_step_seconds"] = snap["step_seconds_mean"]
+        metrics["train_tokens_per_sec"] = snap["tokens_per_sec"]
+        trainstats.flush()
     print(json.dumps(metrics), flush=True)
     return metrics
 

@@ -32,6 +32,7 @@ import optax
 from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from skypilot_tpu.observability import trainstats
 from skypilot_tpu.recipes import synthetic_data
 from skypilot_tpu.train import distributed
 
@@ -106,7 +107,7 @@ def main(argv=None) -> dict:
     else:
         model = ResNet(stage_sizes=(3, 4, 23, 3), width=64)  # resnet101
 
-    print(f"resnet_ddp: rank={ctx.rank}/{ctx.num_nodes} "
+    print(f"resnet_ddp: rank={ctx.rank}/{ctx.num_nodes} "  # noqa: stpu-host-sync startup banner of host ints, before the loop
           f"local_devices={jax.local_device_count()} "
           f"global_devices={jax.device_count()} federated={ctx.federated}",
           flush=True)
@@ -137,7 +138,7 @@ def main(argv=None) -> dict:
             raise SystemExit(
                 f"global batch {world_batch_} not divisible by "
                 f"{jax.device_count()} devices; raise --batch-size")
-        mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+        mesh = Mesh(np.asarray(jax.devices()), ("dp",))  # noqa: stpu-host-sync device handles are host-side objects, not arrays
         batch_sharding = NamedSharding(mesh, P("dp"))
         replicated = NamedSharding(mesh, P())
         params = jax.device_put(params, replicated)
@@ -163,30 +164,58 @@ def main(argv=None) -> dict:
         updates, opt_state = tx.update(grads, opt_state)
         return optax.apply_updates(params, updates), opt_state
 
+    if trainstats.ENABLED:
+        trainstats.configure(
+            peak_flops=trainstats.detect_peak_flops(),
+            host=ctx.rank, hosts=ctx.num_nodes, job="resnet_ddp")
     iter_times = []
     loss = None
-    for i in range(args.steps):
-        x, y = sample_batch(i)
-        t0 = time.time()
-        grads, loss = step_fn(params, globalize(x), globalize(y))
-        if ctx.is_multiprocess and not ctx.federated:
-            grads = distributed.kv_allreduce_mean(grads, ctx, tag=str(i))
-        params, opt_state = apply_fn(params, opt_state, grads)
-        jax.block_until_ready(params)
-        iter_times.append(time.time() - t0)  # noqa: stpu-wallclock workload wall-time report
+    try:
+        for i in range(args.steps):
+            data_t0 = time.perf_counter()
+            x, y = sample_batch(i)
+            data_wait = time.perf_counter() - data_t0
+            t0 = time.perf_counter()
+            grads, loss = step_fn(params, globalize(x), globalize(y))
+            if ctx.is_multiprocess and not ctx.federated:
+                grads = distributed.kv_allreduce_mean(grads, ctx,
+                                                      tag=str(i))
+            params, opt_state = apply_fn(params, opt_state, grads)
+            # The DDP bench fences every iteration by design — iter
+            # times measure the full step, not just dispatch.
+            jax.block_until_ready(params)  # noqa: stpu-host-sync benchmark iteration fence by design
+            dur = time.perf_counter() - t0
+            iter_times.append(dur)
+            if trainstats.ENABLED:
+                trainstats.record_step(step=i + 1, dur=dur,
+                                       tokens=args.batch_size,
+                                       data_wait_s=data_wait)
+    except (Exception, KeyboardInterrupt) as e:
+        if trainstats.ENABLED:
+            trainstats.dump_flight("train_crash", error=repr(e))
+        raise
 
     world_batch = args.batch_size * max(ctx.num_nodes, 1)
     p50 = float(np.median(iter_times[2:] or iter_times))
+    # Host copies for the report: digesting/printing the device trees
+    # directly would sync them inside the metrics build.
+    params_host = jax.device_get(params)
+    loss_host = jax.device_get(loss)
     metrics = {
         "recipe": "resnet_ddp",
         "rank": ctx.rank,
         "num_nodes": ctx.num_nodes,
         "steps": args.steps,
-        "final_loss": float(loss),
+        "final_loss": float(loss_host),
         "p50_iter_seconds": round(p50, 4),
         "examples_per_second": round(world_batch / p50, 1),
-        "param_digest": _param_digest(params),
+        "param_digest": _param_digest(params_host),
     }
+    if trainstats.ENABLED:
+        snap = trainstats.snapshot()
+        metrics["train_goodput"] = snap["goodput"]
+        metrics["train_step_seconds"] = snap["step_seconds_mean"]
+        trainstats.flush()
     print(json.dumps(metrics), flush=True)
     if args.out_file:
         with open(args.out_file, "w") as f:

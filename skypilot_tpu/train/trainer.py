@@ -135,6 +135,48 @@ def chunked_cross_entropy_loss(hidden: jax.Array, head: jax.Array,
     return nll / jnp.maximum(cnt, 1.0)
 
 
+class DelayedFetch:
+    """One-step-delayed device→host fetch for loop telemetry.
+
+    Fetching a step's loss with ``float(loss)`` / ``.item()`` syncs
+    the host to the device INSIDE the hot loop — every step pays the
+    full device latency just to log. The async alternative: hold the
+    device handle for one iteration and fetch it only after the NEXT
+    step has been dispatched, so the transfer overlaps device compute
+    and the fetched value is already resident.
+
+    Analyzer contract (``stpu-host-sync``): this class never touches
+    the device itself — ``rotate`` just swaps handles. The CALLER
+    performs the literal ``jax.device_get(prev)`` on the returned
+    previous-step handle (the one blessed fetch form), keeping the
+    sanctioned sync visible at the call site::
+
+        prev = delayed.rotate(metrics["loss"])
+        if prev is not None:
+            host_loss = jax.device_get(prev)   # last step's, ready
+            log(float(host_loss))
+
+    ``drain()`` hands back the final outstanding handle after the
+    loop so the last step's value is not lost.
+    """
+
+    def __init__(self) -> None:
+        self._held: Any = None
+
+    def rotate(self, new: Any) -> Any:
+        """Store this step's device handle; return the previous one
+        (None on the first call)."""
+        prev = self._held
+        self._held = new
+        return prev
+
+    def drain(self) -> Any:
+        """Return the last outstanding handle (None if empty)."""
+        prev = self._held
+        self._held = None
+        return prev
+
+
 @dataclasses.dataclass
 class TrainState:
     params: PyTree

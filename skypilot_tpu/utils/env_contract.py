@@ -142,6 +142,24 @@ _KNOBS = (
        "jobs controller; recipes default --checkpoint-dir to it."),
     _k("STPU_PROFILE_DIR", None,
        "Write an on-device XLA profile of the training loop here."),
+    _k("STPU_TRAINSTATS", "0",
+       "\"1\" arms per-train-step goodput telemetry (step ring, live "
+       "MFU, goodput breakdown, straggler detection, flight-recorder "
+       "crash dumps)."),
+    _k("STPU_TRAINSTATS_RING", "512",
+       "Train-step ring capacity in records (the window MFU/goodput "
+       "aggregate over and the flight recorder dumps)."),
+    _k("STPU_TRAINSTATS_SYNC_EVERY", "0",
+       "Sample a timed block_until_ready every N train steps to split "
+       "dispatch vs device time (0 disables; the only sanctioned "
+       "sync on the train hot path)."),
+    _k("STPU_TRAINSTATS_DIR", None,
+       "Trainstats output dir for per-host JSONL + snapshot.json "
+       "(default $STPU_JOB_CKPT_DIR/trainstats when a managed job, "
+       "else in-memory only)."),
+    _k("STPU_TRAIN_STRAGGLER_SECONDS", "2.0",
+       "Per-host step-boundary lag over the gang median that flags a "
+       "straggler (host 0 scans; 0 disables)."),
     _k("STPU_BENCHMARK_LOG_DIR", None,
        "Benchmark-harness summary-log dir (callbacks.init contract)."),
     # ------------------------------------------------ serve control

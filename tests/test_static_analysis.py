@@ -447,6 +447,87 @@ def test_host_sync_noqa(tmp_path):
     assert _lines(findings, "serve/gang_replica.py") == [3]
 
 
+def test_host_sync_train_loop_bad_fixture(tmp_path):
+    """The rule now targets the train loops: a recipe loop that
+    float()s its loss every step, .item()s a metric, or hard-syncs
+    with block_until_ready is flagged like the decode engine."""
+    _write(tmp_path, "recipes/llama_lora.py", """\
+        import jax
+
+        @jax.jit
+        def step_fn(state, batch):
+            return state, batch.sum()
+
+        def run(state, batches):
+            for batch in batches:
+                state, loss = step_fn(state, batch)
+                log = float(loss)
+                item = loss.item()
+                loss.block_until_ready()
+        """)
+    findings = _run(tmp_path, "stpu-host-sync")
+    assert _lines(findings, "recipes/llama_lora.py") == [10, 11, 12]
+
+
+def test_host_sync_train_loop_good_fixture(tmp_path):
+    """The sanctioned train-loop pattern passes clean: DelayedFetch
+    rotation + the literal jax.device_get of the PREVIOUS handle, and
+    trainstats.sampled_sync as the only in-loop device sync."""
+    _write(tmp_path, "recipes/llama_lora.py", """\
+        import jax
+        from skypilot_tpu.observability import trainstats
+        from skypilot_tpu.train import trainer
+
+        @jax.jit
+        def step_fn(state, batch):
+            return state, batch.sum()
+
+        def run(state, batches):
+            delayed = trainer.DelayedFetch()
+            for batch in batches:
+                state, loss = step_fn(state, batch)
+                prev = delayed.rotate(loss)
+                if prev is not None:
+                    host_loss = jax.device_get(prev)
+                    fetched = float(host_loss)
+                if trainstats.ENABLED and trainstats.sync_due():
+                    device_s = trainstats.sampled_sync(loss)
+        """)
+    findings = _run(tmp_path, "stpu-host-sync")
+    assert _lines(findings, "recipes/llama_lora.py") == []
+
+
+def test_host_sync_jit_factory_taints_train_loop(tmp_path):
+    """`step = trainer.make_train_step(...)` is a jitted entry point
+    (_JIT_FACTORIES) even with no local @jax.jit — the loop calling it
+    is hot and a per-step float(metrics) there is a finding."""
+    _write(tmp_path, "recipes/mixtral_ep.py", """\
+        from skypilot_tpu.train import trainer
+
+        def run(state, batches, tx, mesh, rules):
+            step = trainer.make_train_step(lambda p, t, c: t, tx,
+                                           mesh, rules)
+            for batch in batches:
+                state, metrics = step(state, batch)
+                loss = float(metrics["loss"])
+        """)
+    findings = _run(tmp_path, "stpu-host-sync")
+    assert _lines(findings, "recipes/mixtral_ep.py") == [8]
+    # The same loop in a NON-target file stays out of scope.
+    _write(tmp_path, "recipes/other_recipe.py", """\
+        from skypilot_tpu.train import trainer
+
+        def run(state, batches, tx, mesh, rules):
+            step = trainer.make_train_step(lambda p, t, c: t, tx,
+                                           mesh, rules)
+            for batch in batches:
+                state, metrics = step(state, batch)
+                loss = float(metrics["loss"])
+        """)
+    findings = _run(tmp_path, "stpu-host-sync")
+    assert _lines(findings, "recipes/other_recipe.py") == []
+
+
 def test_env_rule_seeded_fixture(tmp_path):
     """Acceptance: an unregistered STPU_* read fails; a default
     literal that disagrees with env_contract.py fails; registered

@@ -50,6 +50,10 @@ DEFAULT_METRICS = (
     "detail.serving.*_prefix_hit_rate",
     "detail.serving.*_slo_goodput",
     "detail.serving.*_loadgen_tok_s",
+    # Training-goodput legs (bench.py _train_leg): live MFU from the
+    # armed trainstats recipe runs — a regression in recipe-loop
+    # goodput or the telemetry itself fails CI like a serving one.
+    "detail.train.*_train_mfu",
 )
 
 # Lower-is-better metrics (latencies): a regression is the value going
