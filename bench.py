@@ -505,6 +505,33 @@ def _serving_leg() -> dict:
         except Exception as e:  # noqa: BLE001
             out[key] = None
             out[f"{key}_error"] = str(e)[:200]
+        # Tuned-constants serving leg (`stpu tune`): the ragged engine
+        # leg re-run at the tuning manifest's constants, with the
+        # default-constants number beside it. bench_compare gates the
+        # tuned tok/s higher-is-better like the other engine legs;
+        # tuned >= default holds by construction (the tuner measures
+        # both through this same leg and only persists winners), so a
+        # flip here means the manifest went stale for this device.
+        # The manifest payload-sha tag lands in the detail so
+        # bench_compare --manifest can assert WHICH manifest produced
+        # a round.
+        key = f"{family}_engine_tuned_tok_s"
+        try:
+            r = run_tool(["--family", family, "--mode", "tuned"],
+                         timeout=1800)
+            out[key] = r["engine_tuned_tok_s"]
+            out[f"{family}_engine_tuned_detail"] = {
+                k: r.get(k) for k in ("slots", "requests",
+                                      "engine_tuned_default_tok_s",
+                                      "tuned_constants",
+                                      "tune_manifest",
+                                      "generated_tokens",
+                                      "wall_seconds",
+                                      "dispatch_ms_mean",
+                                      "device_ms_mean")}
+        except Exception as e:  # noqa: BLE001
+            out[key] = None
+            out[f"{key}_error"] = str(e)[:200]
         # Checkpoint save/restore latency for the family's full param
         # set (train/checkpoint.py): bounds the step-path cost of
         # --ckpt-every and the relaunch stall of a preemption recovery.

@@ -55,15 +55,31 @@ from __future__ import annotations
 import dataclasses
 import random
 import time
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 
 
 def build(family: str, dim: int = 1024, layers: int = 8,
-          experts: int = 8):
-    """(module, config) for a single-chip-sized model of the family."""
+          experts: int = 8, tiny: bool = False):
+    """(module, config) for a single-chip-sized model of the family.
+
+    ``tiny=True`` returns the families' ``.tiny()`` test configs
+    instead — CPU-friendly shapes for plumbing runs (`stpu tune
+    --tiny`, CI smoke); the numbers they produce are NOT comparable
+    with the single-chip bench trajectory."""
+    if tiny:
+        if family == "llama":
+            from skypilot_tpu.models import llama as mdl
+            return mdl, mdl.LlamaConfig.tiny(vocab_size=128)
+        if family == "mixtral":
+            from skypilot_tpu.models import mixtral as mdl
+            return mdl, mdl.MixtralConfig.tiny()
+        if family == "gemma":
+            from skypilot_tpu.models import gemma as mdl
+            return mdl, mdl.GemmaConfig.tiny(vocab_size=128)
+        raise ValueError(f"unknown family {family!r}")
     if family == "llama":
         from skypilot_tpu.models import llama as mdl
         cfg = mdl.LlamaConfig(
@@ -189,6 +205,7 @@ def measure_decode(family: str, batch: int = 8, prompt_len: int = 128,
 def measure_engine_ragged(family: str, slots: int = 8,
                           n_requests: int = 32, max_prompt: int = 192,
                           max_tokens: int = 64,
+                          engine_kw: Optional[Dict[str, Any]] = None,
                           **shape_kw) -> Dict[str, Any]:
     """Continuous-batching engine throughput under ragged arrivals.
 
@@ -220,9 +237,14 @@ def measure_engine_ragged(family: str, slots: int = 8,
 
     mdl, cfg = build(family, **shape_kw)
     params = mdl.init(cfg, jax.random.key(0))
+    # use_manifest=False: the bench measures EXPLICIT constants — an
+    # ambient tuning manifest must never contaminate a measurement
+    # (the tuner would chase its own prior output). engine_kw lets the
+    # tuner pin candidates (block, prefill_chunk).
+    kw = dict(prefill_chunk=64, use_manifest=False)
+    kw.update(engine_kw or {})
     engine = DecodeEngine(cfg, params, slots=slots,
-                          max_seq=max_prompt + max_tokens,
-                          prefill_chunk=64)
+                          max_seq=max_prompt + max_tokens, **kw)
     engine.start()
     engine.warmup()
 
@@ -271,6 +293,8 @@ def measure_engine_paged(family: str, slots: int = 16,
                          n_requests: int = 48, max_prompt: int = 192,
                          max_tokens: int = 64,
                          pool_tokens: int = 0,
+                         block_tokens: int = 0,
+                         engine_kw: Optional[Dict[str, Any]] = None,
                          **shape_kw) -> Dict[str, Any]:
     """Paged-KV engine throughput under a MIXED-LENGTH arrival mix —
     the capacity story of the block pool measured as a bench leg.
@@ -293,12 +317,14 @@ def measure_engine_paged(family: str, slots: int = 16,
     mdl, cfg = build(family, **shape_kw)
     params = mdl.init(cfg, jax.random.key(0))
     max_seq = max_prompt + max_tokens
-    chunk = 64
+    chunk = block_tokens or 64          # tuner-pinnable block size
     max_seq += (-max_seq) % chunk       # keep chunk | max_seq
     budget = pool_tokens or (slots * max_seq) // 2
+    kw = dict(prefill_chunk=chunk, paged=True,
+              kv_pool_blocks=budget // chunk + 1, use_manifest=False)
+    kw.update(engine_kw or {})
     engine = DecodeEngine(cfg, params, slots=slots, max_seq=max_seq,
-                          prefill_chunk=chunk, paged=True,
-                          kv_pool_blocks=budget // chunk + 1)
+                          **kw)
     engine.start()
     engine.warmup()
 
@@ -346,6 +372,8 @@ def measure_engine_paged(family: str, slots: int = 16,
 def measure_engine_q8(family: str, slots: int = 16,
                       n_requests: int = 48, max_prompt: int = 192,
                       max_tokens: int = 64, pool_tokens: int = 0,
+                      block_tokens: int = 0,
+                      engine_kw: Optional[Dict[str, Any]] = None,
                       **shape_kw) -> Dict[str, Any]:
     """int8-quantized serving: throughput through the quantized paged
     engine plus the CAPACITY ratio the quantization exists for.
@@ -371,7 +399,7 @@ def measure_engine_q8(family: str, slots: int = 16,
     mdl, cfg = build(family, **shape_kw)
     params = mdl.init(cfg, jax.random.key(0))
     max_seq = max_prompt + max_tokens
-    chunk = 64
+    chunk = block_tokens or 64          # tuner-pinnable block size
     max_seq += (-max_seq) % chunk       # keep chunk | max_seq
     budget = pool_tokens or (slots * max_seq) // 2
     bf16_blocks = budget // chunk + 1
@@ -403,10 +431,12 @@ def measure_engine_q8(family: str, slots: int = 16,
             f"({bb_q8} vs {bb_bf16} bytes/block) at the same HBM "
             f"budget — below the 1.8x capacity gate")
 
+    kw = dict(prefill_chunk=chunk, paged=True,
+              kv_pool_blocks=q8_blocks,
+              kv_quant=True, weight_quant=True, use_manifest=False)
+    kw.update(engine_kw or {})
     engine = DecodeEngine(cfg, params, slots=slots, max_seq=max_seq,
-                          prefill_chunk=chunk, paged=True,
-                          kv_pool_blocks=q8_blocks,
-                          kv_quant=True, weight_quant=True)
+                          **kw)
     engine.start()
     engine.warmup()
 
@@ -509,7 +539,8 @@ def measure_engine_spec(family: str, slots: int = 8,
         engine = DecodeEngine(cfg, params, slots=slots,
                               max_seq=max_seq, prefill_chunk=chunk,
                               paged=True, spec_k=k,
-                              spec_ngram=spec_ngram)
+                              spec_ngram=spec_ngram,
+                              use_manifest=False)
         engine.start()
         engine.warmup()
         if k:
@@ -597,7 +628,8 @@ def measure_engine_tp(family: str, tp: int = 2, slots: int = 8,
     params = gang_replica.shard_params(cfg, params, mesh, rules)
     engine = DecodeEngine(cfg, params, slots=slots,
                           max_seq=max_prompt + max_tokens,
-                          prefill_chunk=64, mesh=mesh, rules=rules)
+                          prefill_chunk=64, mesh=mesh, rules=rules,
+                          use_manifest=False)
     engine.start()
     engine.warmup()
     rng = random.Random(0)
@@ -652,7 +684,8 @@ def measure_engine_prefix(family: str, slots: int = 8,
     max_seq = shared_prefix + max_unique + max_tokens
     max_seq += (-max_seq) % chunk       # keep chunk | max_seq
     engine = DecodeEngine(cfg, params, slots=slots, max_seq=max_seq,
-                          prefill_chunk=chunk, paged=True)
+                          prefill_chunk=chunk, paged=True,
+                          use_manifest=False)
     engine.start()
     engine.warmup()
 

@@ -540,6 +540,58 @@ def check(paths, rules, as_json, list_rules, env_table, clouds):
         raise SystemExit(1)
 
 
+@cli.command()
+@click.option("--family", "families", multiple=True,
+              type=click.Choice(["llama", "mixtral", "gemma"]),
+              help="Model families to sweep (repeatable; default "
+                   "all three).")
+@click.option("--mode", "modes", multiple=True,
+              type=click.Choice(["ragged", "paged", "spec", "q8"]),
+              help="Engine modes to sweep (repeatable; default all). "
+                   "Each mode tunes its own axes: ragged = attention "
+                   "block x prefill chunk, paged/q8 = chunk x gather "
+                   "window, spec = draft depth.")
+@click.option("--out", type=click.Path(), default=None,
+              help="Manifest output path (default "
+                   "~/.stpu/tuning/manifest.json, where the engine "
+                   "auto-loads it on the next start).")
+@click.option("--quick", is_flag=True,
+              help="Small step budgets: a fast, noisier sweep for "
+                   "smoke tests and CI.")
+@click.option("--tiny", is_flag=True,
+              help="Sweep .tiny() model configs (CPU-friendly; the "
+                   "constants tuned this way are NOT representative "
+                   "of real model shapes — use for plumbing tests).")
+@click.option("--slots", type=int, default=8, show_default=True,
+              help="Engine slot count to tune for (keys the manifest "
+                   "entry's batch band).")
+def tune(families, modes, out, quick, tiny, slots):
+    """Autotune decode-engine constants into a sha-pinned manifest.
+
+    Sweeps the hand-pinned constants (split-KV attention block,
+    prefill chunk / paged KV block size, paged gather window,
+    speculative draft depth) per (family, batch band, tp, quant
+    mode), measuring each candidate through the same decode_bench
+    legs `stpu bench` records, pruning losers at a small step budget,
+    and parity-gating every winner (greedy + seeded engine output
+    must be bit-identical to default constants) before persisting.
+    Engines pick the manifest up at startup; see STPU_TUNE_MANIFEST
+    in docs/static-analysis.md and the Autotuning section of
+    docs/performance.md."""
+    import pathlib
+
+    from skypilot_tpu.tune import sweep as tune_sweep
+    doc = tune_sweep.run_sweep(
+        families=list(families) or tune_sweep.FAMILIES,
+        modes=list(modes) or tune_sweep.MODES,
+        quick=quick, slots=slots, tiny=tiny,
+        out_path=pathlib.Path(out) if out else None,
+        log=click.echo)
+    prov = doc["payload"]["provenance"]
+    click.echo(f"manifest sha {doc['sha256'][:12]}  device "
+               f"{prov['device_kind']}  commit {prov['commit']}")
+
+
 def _resolve_service_url(url, service):
     """Shared --url/--service endpoint resolution (metrics/perf/
     profile): explicit URL wins, a service name resolves to its LB
@@ -741,6 +793,14 @@ def _perf_snapshot_lines(doc: dict, label: str = "") -> list:
             + (f" (sampled every {sync.get('every')} steps, "
                f"n={sync.get('samples')})" if sync else
                "  (device: set STPU_STEPSTATS_SYNC_EVERY=N)"))
+    tuning = doc.get("tuning") or {}
+    if tuning:
+        lines.append(
+            f"tuning     block {tuning.get('block', 0)}"
+            f"  chunk {tuning.get('chunk', 0)}"
+            f"  window {tuning.get('window', 0)}"
+            f"  spec_k {tuning.get('spec_k', 0)}"
+            f"  manifest {tuning.get('manifest', 'default')}")
     eng = doc.get("engine") or {}
     if eng:
         lines.append(

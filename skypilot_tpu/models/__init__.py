@@ -16,3 +16,12 @@ def model_api(cfg):
     if isinstance(cfg, gemma.GemmaConfig):
         return gemma
     return llama
+
+
+def family_name(cfg) -> str:
+    """Config-type -> family string ("llama" / "mixtral" / "gemma").
+
+    The stable identifier the tuning manifest keys engine constants
+    by (skypilot_tpu/tune/) — the same dispatch as model_api, reduced
+    to a name that can live in a JSON file."""
+    return model_api(cfg).__name__.rsplit(".", 1)[-1]

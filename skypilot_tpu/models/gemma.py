@@ -233,10 +233,11 @@ verify_step_paged = llama.verify_step_paged
 
 def forward_with_cache(cfg: GemmaConfig, params: Params,
                        tokens: jax.Array, cache, start_pos,
-                       valid_len=None, logits_at=None):
+                       valid_len=None, logits_at=None, *,
+                       block: Optional[int] = None):
     return llama.forward_with_cache(cfg, params, tokens, cache,
                                     start_pos, valid_len=valid_len,
-                                    logits_at=logits_at)
+                                    logits_at=logits_at, block=block)
 
 
 def decode(cfg: GemmaConfig, params: Params, prompt: jax.Array,

@@ -719,7 +719,8 @@ def cached_attention_block(cfg, x: jax.Array, lp: Params,
                            ck: jax.Array, cv: jax.Array,
                            positions: jax.Array, start_pos: jax.Array,
                            valid_len: jax.Array,
-                           write_pos: Optional[jax.Array] = None):
+                           write_pos: Optional[jax.Array] = None,
+                           block: Optional[int] = None):
     """One pre-norm GQA attention residual block against the KV cache
     (shared by llama's and mixtral's decode paths). ``start_pos`` and
     ``valid_len`` are per-slot (B,) vectors — every slot in the batch
@@ -753,7 +754,8 @@ def cached_attention_block(cfg, x: jax.Array, lp: Params,
     # so no repeat()ed copy of the cache hits HBM on the hot path.
     groups = h // kvh
     qg = q.reshape(b, t, kvh, groups, hd)
-    attn = _split_kv_attention(qg, ck, cv, positions, valid_len)
+    attn = _split_kv_attention(qg, ck, cv, positions, valid_len,
+                               block)
     attn = attn.astype(x.dtype).reshape(b, t, h * hd)
     return x + lora_dense(attn, lp, "wo"), ck, cv
 
@@ -764,7 +766,7 @@ def forward_with_cache(cfg, params: Params,
                        valid_len: Optional[jax.Array] = None,
                        logits_at: Optional[jax.Array] = None, *,
                        write_pos: Optional[jax.Array] = None,
-                       mlp_fn=None
+                       mlp_fn=None, block: Optional[int] = None
                        ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Incremental forward: process a chunk, reading/writing the cache.
 
@@ -786,7 +788,12 @@ def forward_with_cache(cfg, params: Params,
     true length so padding K/V never becomes attendable (padding slots
     are overwritten by later decode steps before valid_len reaches
     them). ``logits_at`` (chunk-relative index) computes the lm_head at
-    just that position, returning (B, 1, vocab).
+    just that position, returning (B, 1, vocab). ``block`` (static)
+    overrides the split-KV attention tile width — the autotuner's
+    dense-path knob; None keeps the SPLIT_KV_BLOCK default. Any
+    aligned tile width is bit-identical (the online softmax is
+    exact), so this is a perf knob, not a numerics one — the tuner's
+    parity gate proves it per winner anyway.
     """
     b, t = tokens.shape
     start_pos = jnp.asarray(start_pos, jnp.int32)
@@ -810,7 +817,8 @@ def forward_with_cache(cfg, params: Params,
         x2, ck, cv = cached_attention_block(cfg, x, lp, ck, cv,
                                             positions, start_pos,
                                             valid_len,
-                                            write_pos=write_pos)
+                                            write_pos=write_pos,
+                                            block=block)
         return mlp_fn(cfg, x2, lp), (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -1061,7 +1069,8 @@ def _verify_write_positions(t: int, start_pos: jax.Array,
 
 def verify_step(cfg, params: Params, tokens: jax.Array,
                 cache: Dict[str, jax.Array], start_pos: jax.Array,
-                spec_len: jax.Array, *, mlp_fn=None
+                spec_len: jax.Array, *, mlp_fn=None,
+                block: Optional[int] = None
                 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Multi-token speculative verification against the dense cache.
 
@@ -1098,7 +1107,7 @@ def verify_step(cfg, params: Params, tokens: jax.Array,
     return forward_with_cache(
         cfg, params, tokens, cache, start_pos,
         valid_len=start_pos + spec_len + 1, write_pos=wpos,
-        mlp_fn=mlp_fn)
+        mlp_fn=mlp_fn, block=block)
 
 
 def verify_step_paged(cfg, params: Params, tokens: jax.Array,

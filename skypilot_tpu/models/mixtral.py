@@ -318,7 +318,8 @@ def _moe_block(cfg: MixtralConfig, x: jax.Array, lp: Params) -> jax.Array:
 def forward_with_cache(cfg: MixtralConfig, params: Params,
                        tokens: jax.Array, cache, start_pos: jax.Array,
                        valid_len: Optional[jax.Array] = None,
-                       logits_at: Optional[jax.Array] = None):
+                       logits_at: Optional[jax.Array] = None, *,
+                       block: Optional[int] = None):
     """Incremental MoE forward: llama's cache loop (attention/mask
     contract lives there, in one place) with the dense-routed top-2
     expert MLP swapped in — the serving loop the reference delegates to
@@ -327,7 +328,7 @@ def forward_with_cache(cfg: MixtralConfig, params: Params,
     llama.forward_with_cache."""
     return llama.forward_with_cache(
         cfg, params, tokens, cache, start_pos, valid_len=valid_len,
-        logits_at=logits_at, mlp_fn=_moe_block)
+        logits_at=logits_at, mlp_fn=_moe_block, block=block)
 
 
 def forward_with_paged_cache(cfg: MixtralConfig, params: Params,
@@ -345,13 +346,14 @@ def forward_with_paged_cache(cfg: MixtralConfig, params: Params,
 
 
 def verify_step(cfg: MixtralConfig, params: Params, tokens: jax.Array,
-                cache, start_pos, spec_len):
+                cache, start_pos, spec_len, *,
+                block: Optional[int] = None):
     """Multi-token speculative verification for Mixtral: llama's dense
     verify window with the dense-routed top-2 expert MLP swapped in —
     per-token dense routing is composition-independent, so a verify
     column's logits equal the 1-token step's by construction."""
     return llama.verify_step(cfg, params, tokens, cache, start_pos,
-                             spec_len, mlp_fn=_moe_block)
+                             spec_len, mlp_fn=_moe_block, block=block)
 
 
 def verify_step_paged(cfg: MixtralConfig, params: Params,

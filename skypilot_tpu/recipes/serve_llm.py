@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from skypilot_tpu.agent import constants as agent_constants
-from skypilot_tpu.models import gemma, llama, mixtral, model_api
+from skypilot_tpu.models import family_name, gemma, llama, mixtral, model_api
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.observability import stepstats
 from skypilot_tpu.observability import tracing
@@ -58,9 +58,13 @@ MAX_PROMPT_TOKENS = 1024
 MAX_GEN_TOKENS = 256
 GEN_BUCKET = 16
 
-# Engine defaults (overridable per serve() call / env).
+# Engine defaults (overridable per serve() call / env). The prefill
+# chunk deliberately has NO constant here: the recipe leaves it at
+# resolve_kv_geometry's 0 sentinel so the one derivation (tuning
+# manifest -> DEFAULT_PREFILL_CHUNK fallback) lives in decode_engine —
+# a literal here was exactly the three-call-site drift magnet the
+# autotuner PR removed.
 ENGINE_SLOTS = int(os.environ.get("STPU_ENGINE_SLOTS", "4"))
-ENGINE_PREFILL_CHUNK = 64
 # Retired knob, still read so `stpu check`'s env contract and old
 # deployment env files stay valid: prefix caching is now the paged
 # pool's trie (always on under paging, zero-copy), and the dense
@@ -288,6 +292,16 @@ class _Handler(BaseHTTPRequestHandler):
                     "kv_quant": int(kv.get("kv_quant", 0)),
                     "weight_quant": int(kv.get("weight_quant", 0)),
                     "pool_blocks": int(kv.get("pool_blocks", 0)),
+                }
+                # Tuning line for `stpu perf`: the constants this
+                # replica actually decodes with and which manifest
+                # (payload-sha tag, or "default") supplied them.
+                doc["tuning"] = {
+                    "block": int(kv.get("block", 0)),
+                    "chunk": int(kv.get("chunk", 0)),
+                    "window": int(kv.get("window", 0)),
+                    "spec_k": int(kv.get("spec_k", 0)),
+                    "manifest": kv.get("manifest", "default"),
                 }
         return doc
 
@@ -668,7 +682,6 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
             return decode_engine.DecodeEngine(
                 cfg, params, slots=engine_slots,
                 max_seq=MAX_PROMPT_TOKENS + MAX_GEN_TOKENS,
-                prefill_chunk=ENGINE_PREFILL_CHUNK,
                 prefix_cache_mb=prefix_cache_mb,
                 mesh=mesh, rules=rules,
                 paged=bool(kv_paged),
@@ -932,12 +945,14 @@ def main(argv=None):
         slots=(args.engine_slots if args.engine_slots
                else ENGINE_SLOTS),
         max_seq=MAX_PROMPT_TOKENS + MAX_GEN_TOKENS,
-        prefill_chunk=ENGINE_PREFILL_CHUNK, paged=kv["paged"],
+        paged=kv["paged"],
         kv_pool_blocks=kv["pool_blocks"],
         kv_block_tokens=kv["block_tokens"],
         kv_quant=kv["kv_quant"], weight_quant=kv["weight_quant"],
         spec_k=kv["spec_k"], spec_ngram=kv["spec_ngram"],
-        spec_min_accept=kv["spec_min_accept"])
+        spec_min_accept=kv["spec_min_accept"],
+        family=family_name(cfg),
+        tp=(mesh.devices.size if mesh is not None else 1))
     if topology.hosts > 1 and rank > 0:
         # Non-zero hosts never front HTTP: they run the lockstep
         # follower loop against the leader's gang channel, mirroring
@@ -948,7 +963,6 @@ def main(argv=None):
                 slots=(args.engine_slots
                        if args.engine_slots else ENGINE_SLOTS),
                 max_seq=MAX_PROMPT_TOKENS + MAX_GEN_TOKENS,
-                prefill_chunk=ENGINE_PREFILL_CHUNK,
                 prefix_cache_mb=(args.prefix_cache_mb
                                  if args.prefix_cache_mb is not None
                                  else ENGINE_PREFIX_CACHE_MB),

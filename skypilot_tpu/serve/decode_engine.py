@@ -109,8 +109,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from skypilot_tpu.models import model_api
-from skypilot_tpu.models.llama import SPLIT_KV_BLOCK
+from skypilot_tpu.models import family_name, model_api
 from skypilot_tpu.observability import events
 from skypilot_tpu.observability import metrics
 from skypilot_tpu.observability import stepstats
@@ -317,8 +316,10 @@ class _Slot:
 
 
 # ------------------------------------------------------- jitted entry points
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-def _prefill_chunk(cfg, params, cache, buf, slot, start, valid):
+@functools.partial(jax.jit, static_argnums=(0, 7),
+                   donate_argnums=(2,))
+def _prefill_chunk(cfg, params, cache, buf, slot, start, valid,
+                   block):
     """Prefill ONE chunk of ONE slot's prompt into the shared cache.
 
     buf: (P,) tokens for positions [start, start+P) of row ``slot``
@@ -333,7 +334,7 @@ def _prefill_chunk(cfg, params, cache, buf, slot, start, valid):
            for k, v in cache.items()}
     logits, row = api.forward_with_cache(
         cfg, params, buf[None, :], row, start, valid_len=valid,
-        logits_at=jnp.maximum(valid - start - 1, 0))
+        logits_at=jnp.maximum(valid - start - 1, 0), block=block)
     cache = {k: jax.lax.dynamic_update_slice_in_dim(cache[k], row[k],
                                                     slot, axis=1)
              for k in cache}
@@ -378,15 +379,16 @@ def _paged_step(cfg, params, cache, toks, pos, table, window, temps,
     return nxt, cache
 
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-def _engine_step(cfg, params, cache, toks, pos, temps, seeds):
+@functools.partial(jax.jit, static_argnums=(0, 7),
+                   donate_argnums=(2,))
+def _engine_step(cfg, params, cache, toks, pos, temps, seeds, block):
     """One decode step over ALL slots: write each slot's last token at
     its own position, attend its own valid prefix, sample its next
     token. Free slots ride along with pos 0 and are ignored host-side.
     The cache is donated (in-place update)."""
     api = model_api(cfg)
     logits, cache = api.forward_with_cache(
-        cfg, params, toks[:, None], cache, pos)
+        cfg, params, toks[:, None], cache, pos, block=block)
     logits = logits[:, -1]
     nxt = _sample(logits, seeds, pos + 1, temps)
     return nxt, cache
@@ -420,8 +422,10 @@ def _accept_counts(toks, targets, spec_len):
                    axis=1)
 
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-def _spec_step(cfg, params, cache, toks, pos, spec_len, temps, seeds):
+@functools.partial(jax.jit, static_argnums=(0, 8),
+                   donate_argnums=(2,))
+def _spec_step(cfg, params, cache, toks, pos, spec_len, temps, seeds,
+               block):
     """One speculative verify step over ALL slots (dense cache): each
     slot's window [last token, draft_1..draft_k, padding] forwards in
     one pass (models verify_step), targets are sampled per position
@@ -434,7 +438,7 @@ def _spec_step(cfg, params, cache, toks, pos, spec_len, temps, seeds):
     masked exactly like any stale slot-reuse row."""
     api = model_api(cfg)
     logits, cache = api.verify_step(cfg, params, toks, cache, pos,
-                                    spec_len)
+                                    spec_len, block=block)
     targets = _sample_multi(logits, seeds, pos, temps)
     return targets, _accept_counts(toks, targets, spec_len), cache
 
@@ -473,14 +477,33 @@ def _sample(logits, seeds, positions, temps):
     return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
 
 
+def _default_split_kv_block() -> int:
+    """The kernel's hand-pinned tile default — imported lazily so the
+    ONE place the constant is consulted for geometry is this module's
+    derivation, not a module-global rebinding sites can drift from."""
+    from skypilot_tpu.models.llama import SPLIT_KV_BLOCK
+    return SPLIT_KV_BLOCK
+
+
+# Default prefill chunk / paged KV block size, tokens. Callers pass
+# prefill_chunk=0 ("resolve it for me"): the tuning manifest may
+# override, else this constant applies — the single derivation that
+# used to be serve_llm's ENGINE_PREFILL_CHUNK literal at three call
+# sites.
+DEFAULT_PREFILL_CHUNK = 64
+
+
 def resolve_kv_geometry(*, slots: int, max_seq: int,
-                        prefill_chunk: int = 64, paged: bool = False,
+                        prefill_chunk: int = 0, paged: bool = False,
                         kv_pool_blocks: int = 0,
                         kv_block_tokens: int = 0,
                         kv_quant: bool = False,
                         weight_quant: bool = False,
                         spec_k: int = 0, spec_ngram: int = 3,
-                        spec_min_accept: float = 0.0
+                        spec_min_accept: float = 0.0,
+                        block: int = 0, window_blocks: int = 0,
+                        family: Optional[str] = None, tp: int = 1,
+                        use_manifest: bool = True
                         ) -> Dict[str, Any]:
     """EFFECTIVE KV-cache geometry for an engine config — the single
     derivation DecodeEngine.__init__, kv_config() and the gang
@@ -496,21 +519,60 @@ def resolve_kv_geometry(*, slots: int, max_seq: int,
     flags: kv_quant halves bytes per block, so the AUTO pool sizing
     doubles — a leader/follower quant-flag drift means differently
     sized pools and divergent admission decisions, which the
-    handshake's dict comparison now rejects for free."""
+    handshake's dict comparison now rejects for free.
+
+    Tuned constants (skypilot_tpu/tune/): when ``family`` is given and
+    ``use_manifest`` is left on, the sha-pinned tuning manifest is
+    consulted for the key ``(family, batch-band(slots), tp,
+    quant-mode)`` and supplies ``block`` (split-KV attention tile),
+    ``chunk``, ``window_blocks`` (paged gather window, in blocks) and
+    ``spec_k`` — but ONLY for knobs the caller left at their 0
+    sentinel: explicit arguments (CLI flags, env knobs, sweep
+    candidates) always win over the manifest, and
+    ``STPU_TUNE_MANIFEST=0`` disables the lookup outright. The
+    manifest tag (payload-sha prefix, or "default") rides the output
+    dict, so gang members that resolved geometry from DIFFERENT
+    manifests fail the welcome handshake even if the constants
+    happen to coincide — tuned geometry drifts are join-fatal exactly
+    like kv/quant drifts."""
     max_seq = int(max_seq)
     if kv_quant and not paged:
         raise ValueError(
             "kv_quant requires paged=True — int8 KV lives in the "
             "paged block pool (the dense row cache has no scales "
             "array and was retired as a prefix-cache representation)")
+    manifest_tag = "default"
+    if use_manifest and family:
+        from skypilot_tpu.tune import manifest as tune_manifest
+        entry, manifest_tag = tune_manifest.entry_for(
+            family=family, slots=int(slots), tp=int(tp),
+            kv_quant=bool(kv_quant), weight_quant=bool(weight_quant))
+        if entry is not None:
+            if not block:
+                block = int(entry.get("block", 0))
+            if not prefill_chunk and not kv_block_tokens:
+                prefill_chunk = int(entry.get("chunk", 0))
+            if not window_blocks:
+                window_blocks = int(entry.get("window_blocks", 0))
+            if not spec_k:
+                spec_k = int(entry.get("spec_k", 0))
     if paged and kv_block_tokens:
         prefill_chunk = int(kv_block_tokens)
+    if not prefill_chunk:
+        prefill_chunk = DEFAULT_PREFILL_CHUNK
     chunk = max(min(int(prefill_chunk), max_seq), 1)
     while max_seq % chunk:
         chunk //= 2
+    # Effective dense attention tile: the tuned (or default) width
+    # clamped to the cache rows — always concrete in the dict, so the
+    # jitted dense entry points take it as a static argument and the
+    # handshake compares the value the kernel actually tiles by.
+    block_eff = max(min(int(block) or _default_split_kv_block(),
+                        max_seq), 1)
     out: Dict[str, Any] = {
         "paged": int(bool(paged)), "slots": int(slots),
         "max_seq": max_seq, "chunk": chunk,
+        "block": block_eff, "manifest": manifest_tag,
         "kv_quant": int(bool(kv_quant)),
         "weight_quant": int(bool(weight_quant)),
         "spec_k": int(spec_k), "spec_ngram": int(spec_ngram),
@@ -524,8 +586,14 @@ def resolve_kv_geometry(*, slots: int, max_seq: int,
         total = int(kv_pool_blocks) or (
             (2 if kv_quant else 1) *
             int(slots) * (max_seq // chunk) + 1)
-        window = max(min(SPLIT_KV_BLOCK, max_seq) // chunk * chunk,
-                     chunk)
+        if window_blocks:
+            window = max(min(int(window_blocks) * chunk,
+                             max_seq // chunk * chunk), chunk)
+        else:
+            # Mirror the dense tile so paged and dense tile boundaries
+            # align (the bit-parity condition), floored to whole
+            # blocks.
+            window = max(block_eff // chunk * chunk, chunk)
         nbw = window // chunk
         out.update(pool_blocks=total, window=window,
                    table_len=-(-(total - 1) // nbw) * nbw)
@@ -543,13 +611,14 @@ class DecodeEngine:
     """
 
     def __init__(self, cfg, params, *, slots: int = 4,
-                 max_seq: int = 1024, prefill_chunk: int = 64,
+                 max_seq: int = 1024, prefill_chunk: int = 0,
                  max_queue: int = 256, prefix_cache_mb: float = 0.0,
                  mesh=None, rules=None, paged: bool = False,
                  kv_pool_blocks: int = 0, kv_block_tokens: int = 0,
                  kv_quant: bool = False, weight_quant: bool = False,
                  spec_k: int = 0, spec_ngram: int = 3,
-                 spec_min_accept: float = 0.0):
+                 spec_min_accept: float = 0.0, block: int = 0,
+                 window_blocks: int = 0, use_manifest: bool = True):
         # prefix_cache_mb is accepted for call-site compatibility but
         # inert: prefix caching is the paged pool's trie (always on in
         # paged mode), the dense splice cache is gone.
@@ -615,10 +684,20 @@ class DecodeEngine:
             kv_quant=self._kv_quant,
             weight_quant=self._weight_quant, spec_k=self._spec_k,
             spec_ngram=self._spec_ngram,
-            spec_min_accept=self._spec_min_accept)
+            spec_min_accept=self._spec_min_accept,
+            block=block, window_blocks=window_blocks,
+            family=family_name(cfg),
+            tp=(mesh.devices.size if mesh is not None else 1),
+            use_manifest=use_manifest)
         self._kv_geometry = geo
         chunk = geo["chunk"]
         self._chunk = chunk
+        # Tuned constants may enable drafting / resize the tile even
+        # when the caller passed the 0 sentinel — read the EFFECTIVE
+        # values back from the geometry, the same dict the handshake
+        # compares.
+        self._block = geo["block"]
+        self._spec_k = geo["spec_k"]
         self._max_queue = int(max_queue)
         self.prefix_cache: Optional[Any] = None
         if self._paged:
@@ -628,10 +707,12 @@ class DecodeEngine:
             # of KV, plus the scratch block.
             total = geo["pool_blocks"]
             self._pool = kv_pool.BlockPool(total, chunk)
-            # Attention tile width, mirroring the dense engine's
-            # min(SPLIT_KV_BLOCK, max_seq) so paged and dense tile
-            # boundaries align (the bit-parity condition); floored to
-            # a block multiple so each tile gathers whole blocks.
+            # Attention tile width: by default it mirrors the dense
+            # engine's effective block so paged and dense tile
+            # boundaries align (the bit-parity condition), floored to
+            # a block multiple so each tile gathers whole blocks; a
+            # tuned window_blocks overrides the multiple (parity-gated
+            # by the sweep before it can reach a manifest).
             self._window = geo["window"]
             # Per-slot LOGICAL capacity is the pool, not a row: the
             # table can address every usable block (rounded up so the
@@ -1090,7 +1171,8 @@ class DecodeEngine:
             else:
                 logits, self._cache = _prefill_chunk(
                     self._cfg, self._params, self._cache, buf,
-                    jnp.int32(i), jnp.int32(start), jnp.int32(valid))
+                    jnp.int32(i), jnp.int32(start), jnp.int32(valid),
+                    self._block)
             req.prefill_chunks += 1
             slot.prefilled = valid
             slot.pos = valid
@@ -1256,7 +1338,7 @@ class DecodeEngine:
             targets, accepts, self._cache = _spec_step(
                 self._cfg, self._params, self._cache,
                 jnp.asarray(toks_np), pos, jnp.asarray(spec_np),
-                temps, seeds)
+                temps, seeds, self._block)
         if stepstats.ENABLED:
             self._stamp_dispatch(t0, accepts)
         targets = jax.device_get(targets)
@@ -1348,7 +1430,7 @@ class DecodeEngine:
         else:
             nxt, self._cache = _engine_step(
                 self._cfg, self._params, self._cache, toks, pos, temps,
-                seeds)
+                seeds, self._block)
         if stepstats.ENABLED:
             self._stamp_dispatch(t0, nxt)
         nxt = jax.device_get(nxt)

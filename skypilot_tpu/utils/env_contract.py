@@ -224,6 +224,13 @@ _KNOBS = (
        "always ignored: prefix caching is the paged pool's trie "
        "(always on under STPU_KV_PAGED=1), and the dense path's "
        "host splice cache no longer exists."),
+    _k("STPU_TUNE_MANIFEST", None,
+       "Tuning-manifest override for the decode engine: a path loads "
+       "that sha256-pinned `stpu tune` manifest, \"0\" disables "
+       "tuning (hand-pinned defaults), unset auto-loads "
+       "~/.stpu/tuning/manifest.json when present. Tuned geometry "
+       "rides the gang kv-config handshake, so every member must "
+       "resolve the same manifest."),
     _k("STPU_STREAM_TIMEOUT", "600",
        "Per-token stream timeout before the engine is declared "
        "wedged, seconds."),

@@ -22,6 +22,31 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache — the same one bench.py and the bench
+# tools use, so tier-1 reruns (and recipe subprocesses, which inherit the
+# env) skip recompiling the suite's hundreds of tiny programs. Program
+# cache keys include backend + jax version, so CPU test programs never
+# collide with tunneled-TPU bench entries. Opt out / redirect by setting
+# JAX_COMPILATION_CACHE_DIR yourself (empty string disables).
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.expanduser("~/.cache/stpu_jax_cache"))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+        # Subprocess tests (recipes, gang followers) pick it up too.
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = os.path.expanduser(
+            "~/.cache/stpu_jax_cache")
+        os.environ.setdefault(
+            "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+        os.environ.setdefault(
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    except Exception:  # noqa: BLE001 — cache is an optimization
+        pass
+
 # Don't spawn the on-host daemon for every local cluster the suite
 # launches; daemon/autostop tests opt back in via monkeypatch.
 os.environ.setdefault("STPU_DISABLE_DAEMON", "1")

@@ -156,6 +156,63 @@ def test_lower_metric_absent_in_old_is_skipped(tmp_path):
     assert bench_compare.main([old, new]) == 0
 
 
+def _doc_with_tuned(mfu, tuned_tok, tag="4af7e49baa9e"):
+    doc = _doc(mfu, 1700.0)
+    doc["detail"]["serving"]["llama_engine_tuned_tok_s"] = tuned_tok
+    doc["detail"]["serving"]["llama_engine_tuned_detail"] = {
+        "engine_tuned_default_tok_s": tuned_tok * 0.9,
+        "tuned_constants": {"block": 128, "prefill_chunk": 128},
+        "tune_manifest": tag,
+    }
+    return doc
+
+
+def test_tuned_leg_is_gated_by_default(tmp_path, capsys):
+    """The `stpu tune` serving leg sits in DEFAULT_METRICS like the
+    other engine tok/s legs — a tuned-throughput collapse (stale
+    manifest on new hardware) fails CI without extra flags."""
+    old = _write(tmp_path, "old.json", _doc_with_tuned(50.0, 1000.0))
+    worse = _write(tmp_path, "worse.json", _doc_with_tuned(50.0, 700.0))
+    assert bench_compare.main([old, worse]) == 1
+    assert "llama_engine_tuned_tok_s" in capsys.readouterr().out
+    same = _write(tmp_path, "same.json", _doc_with_tuned(50.0, 990.0))
+    assert bench_compare.main([old, same]) == 0
+
+
+def test_manifest_flag_reports_and_pins_provenance(tmp_path, capsys):
+    """--manifest prints which tuning manifest each round ran with;
+    --manifest TAG additionally pins the NEW round to that manifest
+    (a CI round silently tuned by an unreviewed manifest fails)."""
+    old = _write(tmp_path, "old.json",
+                 _doc_with_tuned(50.0, 1000.0, tag="aaaa00000000"))
+    new = _write(tmp_path, "new.json",
+                 _doc_with_tuned(50.0, 1000.0, tag="bbbb11111111"))
+    # Bare flag: provenance lines, no gating.
+    assert bench_compare.main([old, new, "--manifest"]) == 0
+    out = capsys.readouterr().out
+    assert "aaaa00000000 -> bbbb11111111" in out
+    # Pinned to the new round's actual tag: passes.
+    assert bench_compare.main([old, new, "--manifest",
+                               "bbbb11111111"]) == 0
+    # Pinned to something else: the mismatch is fatal.
+    assert bench_compare.main([old, new, "--manifest",
+                               "aaaa00000000"]) == 1
+    assert "bbbb11111111" in capsys.readouterr().err
+    # Pinning a round with NO tuned legs recorded is also fatal.
+    bare = _write(tmp_path, "bare.json", _doc(50.0, 1700.0))
+    assert bench_compare.main([old, bare, "--manifest",
+                               "aaaa00000000"]) == 1
+
+
+def test_manifest_tags_extractor_shapes():
+    assert bench_compare.manifest_tags(_doc(50.0, 1700.0)) == {}
+    # Driver-tracked wrapper shape unwraps like compare() does.
+    assert bench_compare.manifest_tags(
+        {"n": 1, "rc": 0,
+         "parsed": _doc_with_tuned(50.0, 900.0, tag="cafe12345678")}
+    ) == {"llama": "cafe12345678"}
+
+
 def test_lower_pattern_wins_polarity_overlap(tmp_path):
     """A broad higher-is-better glob must not claim latency paths away
     from the lower-is-better set (polarity inversion)."""

@@ -25,7 +25,9 @@ def test_mnist_converges():
 
 def test_glue_imdb_converges():
     from skypilot_tpu.recipes import glue_imdb
-    metrics = glue_imdb.main(["--steps", "160"])
+    # Converged (0.99+ deterministic) well before 80 steps; 160 only
+    # doubled the tier-1 wall time.
+    metrics = glue_imdb.main(["--steps", "80"])
     assert metrics["test_accuracy"] > 0.75
 
 

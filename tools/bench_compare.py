@@ -45,6 +45,11 @@ DEFAULT_METRICS = (
     # gating the block count here keeps the ratio from eroding
     # round-over-round (e.g. scale-array bloat shrinking the pool).
     "detail.serving.*_kv_pool_capacity_blocks",
+    # Tuned-constants ragged leg (`stpu tune` manifest applied): the
+    # autotuner only persists parity-gated winners measured >= the
+    # default through this same leg, so a drop here means the manifest
+    # went stale for the device this round ran on.
+    "detail.serving.*_engine_tuned_tok_s",
     "detail.serving.*_engine_tp_tok_s",
     "detail.serving.*_engine_prefix_tok_s",
     "detail.serving.*_prefix_hit_rate",
@@ -145,6 +150,21 @@ def compare(old: dict, new: dict, patterns: List[str],
     return report, regressions
 
 
+def manifest_tags(doc: dict) -> Dict[str, str]:
+    """Tuning-manifest provenance tags recorded by the serving leg:
+    ``{family: tag}`` from ``detail.serving.*_engine_tuned_detail``
+    (tag = manifest payload-sha prefix, "default", or "adhoc")."""
+    serving = (unwrap(doc).get("detail") or {}).get("serving") or {}
+    out: Dict[str, str] = {}
+    for key, val in serving.items():
+        if key.endswith("_engine_tuned_detail") and isinstance(val,
+                                                               dict):
+            tag = val.get("tune_manifest")
+            if tag:
+                out[key[:-len("_engine_tuned_detail")]] = str(tag)
+    return out
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Fail (exit 1) on >threshold%% regressions "
@@ -161,6 +181,14 @@ def main(argv=None) -> int:
                         help="comma-separated dotted-path globs of "
                              "LOWER-is-better metrics (default: the "
                              "tracked checkpoint-latency set)")
+    parser.add_argument("--manifest", nargs="?", const="", default=None,
+                        metavar="EXPECTED_TAG",
+                        help="report the tuning-manifest provenance "
+                             "tags (sha prefix) the two rounds' tuned "
+                             "serving legs ran with; with a value, "
+                             "ALSO fail unless every tag in the new "
+                             "file matches it — pins a CI round to "
+                             "one reviewed manifest")
     args = parser.parse_args(argv)
 
     with open(args.old) as f:
@@ -175,6 +203,20 @@ def main(argv=None) -> int:
                                   lower_patterns=lower)
     for line in report:
         print(line)
+    if args.manifest is not None:
+        old_tags, new_tags = manifest_tags(old), manifest_tags(new)
+        for fam in sorted(set(old_tags) | set(new_tags)):
+            print(f"manifest    {fam}: {old_tags.get(fam, '-')} -> "
+                  f"{new_tags.get(fam, '-')}")
+        if args.manifest:
+            bad = {f: t for f, t in new_tags.items()
+                   if t != args.manifest}
+            if bad or not new_tags:
+                print(f"\nbench_compare: new round's tuning manifest "
+                      f"!= expected {args.manifest!r}: "
+                      f"{bad or 'no tuned legs recorded'}",
+                      file=sys.stderr)
+                return 1
     if regressions:
         print(f"\nbench_compare: {len(regressions)} metric(s) "
               f"regressed more than {args.threshold:g}%",

@@ -27,7 +27,7 @@ def main() -> None:
                    default="mixtral")
     p.add_argument("--mode", choices=("fixed", "engine", "paged", "q8",
                                       "spec", "prefix", "ckpt",
-                                      "loadgen", "tp"),
+                                      "loadgen", "tp", "tuned"),
                    default="fixed",
                    help="fixed: bucketed batch decode (r01-r05 "
                         "comparable); engine: continuous-batching "
@@ -57,7 +57,13 @@ def main() -> None:
                         "(serve/gang_replica.py) over a --tp-wide "
                         "mesh — needs that many visible devices "
                         "(XLA_FLAGS=--xla_force_host_platform_"
-                        "device_count on CPU)")
+                        "device_count on CPU); tuned: the ragged "
+                        "engine leg at the `stpu tune` manifest's "
+                        "constants next to the hand-pinned defaults "
+                        "— the tuned >= default acceptance leg "
+                        "(STPU_TUNE_MANIFEST selects the manifest; "
+                        "with no entry a quick in-process "
+                        "ragged-only sweep supplies the constants)")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--tokens", type=int, default=128)
@@ -144,6 +150,37 @@ def main() -> None:
         result = decode_bench.measure_engine_tp(
             args.family, tp=args.tp, slots=args.slots,
             n_requests=args.requests, **shape_kw)
+    elif args.mode == "tuned":
+        from skypilot_tpu.tune import manifest as tune_manifest
+        entry, tag = tune_manifest.entry_for(family=args.family,
+                                             slots=args.slots)
+        if entry is None:
+            # No manifest for this config: a quick ragged-only sweep
+            # supplies (and parity-gates) the constants in-process —
+            # the leg then still measures tuned vs default the same
+            # way, just without a persisted provenance tag.
+            from skypilot_tpu.tune import sweep as tune_sweep
+            win = tune_sweep.sweep_one(
+                args.family, "ragged", quick=True, slots=args.slots,
+                shape_kw=shape_kw, log=lambda m: print(m,
+                                                       file=sys.stderr))
+            entry, tag = (win or {}).get("knobs", {}), "adhoc"
+        engine_kw = {k: v for k, v in
+                     (("block", entry.get("block", 0)),
+                      ("prefill_chunk", entry.get("chunk", 0))) if v}
+        tuned = decode_bench.measure_engine_ragged(
+            args.family, slots=args.slots, n_requests=args.requests,
+            engine_kw=engine_kw, **shape_kw)
+        default = decode_bench.measure_engine_ragged(
+            args.family, slots=args.slots, n_requests=args.requests,
+            **shape_kw)
+        result = dict(tuned)
+        result["engine_tuned_tok_s"] = result.pop(
+            "engine_ragged_tok_s")
+        result["engine_tuned_default_tok_s"] = \
+            default["engine_ragged_tok_s"]
+        result["tuned_constants"] = engine_kw
+        result["tune_manifest"] = tag
     else:
         result = decode_bench.measure_decode(
             args.family, batch=args.batch, prompt_len=args.prompt_len,
