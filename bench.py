@@ -459,6 +459,33 @@ def _serving_leg() -> dict:
         except Exception as e:  # noqa: BLE001
             out[key] = None
             out[f"{key}_error"] = str(e)[:200]
+        # Host-tier serving leg: the paged engine with the host-RAM
+        # KV spill tier on, under a prefix working set ~2x the HBM
+        # pool — evictions spill D2H, warm re-submissions re-admit
+        # H2D. bench_compare gates the throughput higher-is-better
+        # and the re-hit TTFT lower-is-better: a re-admission path
+        # that silently degrades to full prefill shows up as a
+        # re-hit TTFT rise, not just a tok/s dip.
+        key = f"{family}_engine_tier_tok_s"
+        try:
+            r = run_tool(["--family", family, "--mode", "tier"],
+                         timeout=1200)
+            out[key] = r["engine_tier_tok_s"]
+            out[f"{family}_tier_rehit_ttft_s"] = r["tier_rehit_ttft_s"]
+            out[f"{family}_tier_cold_ttft_s"] = r["tier_cold_ttft_s"]
+            out[f"{family}_tier_hit_rate"] = r["tier_hit_rate"]
+            out[f"{family}_engine_tier_detail"] = {
+                k: r.get(k) for k in ("slots", "requests",
+                                      "prompt_blocks", "pool_blocks",
+                                      "host_cache_mb",
+                                      "steps_to_first_token_cold",
+                                      "steps_to_first_token_rehit",
+                                      "host_tier",
+                                      "generated_tokens",
+                                      "wall_seconds")}
+        except Exception as e:  # noqa: BLE001
+            out[key] = None
+            out[f"{key}_error"] = str(e)[:200]
         # SLO-graded serving leg: the family's engine behind a real
         # serve_llm replica + in-process LB, driven by the open-loop
         # load generator (benchmark/loadgen.py) under the chat mix —

@@ -738,6 +738,120 @@ def measure_engine_prefix(family: str, slots: int = 8,
     }
 
 
+def measure_engine_tier(family: str, slots: int = 8,
+                        n_requests: int = 12,
+                        prompt_blocks: int = 2, max_tokens: int = 8,
+                        host_cache_mb: float = 64.0,
+                        engine_kw: Optional[Dict[str, Any]] = None,
+                        **shape_kw) -> Dict[str, Any]:
+    """Host-RAM KV tier: warm re-hit TTFT vs cold prefill under a
+    prefix working set ~2x the HBM pool.
+
+    ``n_requests`` distinct ``prompt_blocks``-block prompts publish
+    into a pool sized to hold only about HALF that working set, so
+    cold admissions evict and the evictions spill D2H into the host
+    tier. After the cold phase the trie is force-drained to the host
+    tier (paced against the spill queue) and every prompt is
+    re-submitted: a warm hit now costs one H2D block restore per
+    chunk instead of a chunk prefill. Reports the cold vs re-hit
+    median TTFT in BOTH wall seconds and steps-to-first-token (the
+    chunk-prefill count — deterministic, immune to dispatch
+    variance), the tier hit rate over the warm phase, and the host
+    pool's own spill/re-admit counters so the bench and /metrics can
+    never disagree."""
+    from skypilot_tpu.serve.decode_engine import DecodeEngine
+
+    mdl, cfg = build(family, **shape_kw)
+    params = mdl.init(cfg, jax.random.key(0))
+    chunk = 64
+    # A few tail tokens past the last full block so admission can
+    # re-admit ALL prompt_blocks blocks (an exact-multiple prompt
+    # keeps its final block for prefill).
+    prompt_len = prompt_blocks * chunk + 7
+    max_seq = prompt_len + max_tokens
+    max_seq += (-max_seq) % chunk       # keep chunk | max_seq
+    # Pool = half the published working set, plus headroom for the
+    # live slots' own rows (cold requests run one at a time).
+    working_blocks = n_requests * prompt_blocks
+    pool_blocks = working_blocks // 2 + 2 * (max_seq // chunk) + 1
+    kw = dict(prefill_chunk=chunk, paged=True,
+              kv_pool_blocks=pool_blocks,
+              prefix_cache_mb=host_cache_mb, use_manifest=False)
+    kw.update(engine_kw or {})
+    engine = DecodeEngine(cfg, params, slots=slots, max_seq=max_seq,
+                          **kw)
+    engine.start()
+    engine.warmup()
+
+    rng = random.Random(0)
+    prompts = [[rng.randint(1, cfg.vocab_size - 1)
+                for _ in range(prompt_len)]
+               for _ in range(n_requests)]
+
+    def _quiesce(deadline_s: float = 30.0) -> None:
+        t_end = time.perf_counter() + deadline_s
+        while (engine.spill_in_flight() > 0
+               and time.perf_counter() < t_end):
+            time.sleep(0.005)
+
+    try:
+        # Cold leg: sequential so each TTFT is pure prefill cost,
+        # not queueing. Evictions (and their spills) happen inline.
+        t0 = time.perf_counter()
+        cold_reqs = []
+        total = 0
+        for p in prompts:
+            r = engine.submit(p, max_tokens=max_tokens)
+            total += len(r.result(timeout=1800.0))
+            cold_reqs.append(r)
+        # Drain every published block to the host tier so the warm
+        # leg measures the re-admission path, paced so the bounded
+        # spill queue never overflows into drop-on-evict.
+        while True:
+            while engine.spill_in_flight() >= 16:
+                time.sleep(0.001)
+            if not engine.prefix_cache.evict_one():
+                break
+        _quiesce()
+
+        warm_reqs = []
+        for p in prompts:
+            r = engine.submit(p, max_tokens=max_tokens)
+            total += len(r.result(timeout=1800.0))
+            warm_reqs.append(r)
+        dt = time.perf_counter() - t0
+    finally:
+        tier = engine.host_tier_stats()
+        engine.shutdown()
+
+    cold_ttfts = sorted(r.first_token_at - r.submitted_at
+                        for r in cold_reqs)
+    warm_ttfts = sorted(r.first_token_at - r.submitted_at
+                        for r in warm_reqs)
+    hits = sum(1 for r in warm_reqs if r.cached_prompt_tokens > 0)
+    return {
+        "model": _model_info(family, cfg, params),
+        "slots": slots,
+        "requests": n_requests,
+        "prompt_blocks": prompt_blocks,
+        "pool_blocks": pool_blocks,
+        "host_cache_mb": host_cache_mb,
+        "generated_tokens": total,
+        "wall_seconds": round(dt, 3),
+        "engine_tier_tok_s": round(total / dt, 1),
+        "tier_cold_ttft_s": round(
+            cold_ttfts[len(cold_ttfts) // 2], 4),
+        "tier_rehit_ttft_s": round(
+            warm_ttfts[len(warm_ttfts) // 2], 4),
+        "tier_hit_rate": round(hits / max(n_requests, 1), 3),
+        "steps_to_first_token_cold": max(r.prefill_chunks
+                                         for r in cold_reqs),
+        "steps_to_first_token_rehit": max(r.prefill_chunks
+                                          for r in warm_reqs),
+        "host_tier": tier,
+    }
+
+
 def measure_engine_slo(family: str, *, slots: int = 8,
                        qps: float = 6.0, duration_s: float = 8.0,
                        seed: int = 0, slo_ttft_s: float = 3.0,

@@ -793,6 +793,16 @@ def _perf_snapshot_lines(doc: dict, label: str = "") -> list:
             + (f" (sampled every {sync.get('every')} steps, "
                f"n={sync.get('samples')})" if sync else
                "  (device: set STPU_STEPSTATS_SYNC_EVERY=N)"))
+    tier = doc.get("tier") or {}
+    if tier:
+        lines.append(
+            f"kv tier    host {tier.get('blocks', 0)} blocks"
+            f" / {tier.get('bytes', 0) / (1 << 20):.1f}"
+            f"/{tier.get('budget_mb', 0):.0f} MiB"
+            f"  spilled {tier.get('spilled', 0)}"
+            f"  dropped {tier.get('dropped', 0)}"
+            f"  readmitted {tier.get('readmitted', 0)}"
+            f"  rehits {tier.get('rehits', 0)}")
     tuning = doc.get("tuning") or {}
     if tuning:
         lines.append(

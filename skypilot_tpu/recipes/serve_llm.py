@@ -65,11 +65,12 @@ GEN_BUCKET = 16
 # a literal here was exactly the three-call-site drift magnet the
 # autotuner PR removed.
 ENGINE_SLOTS = int(os.environ.get("STPU_ENGINE_SLOTS", "4"))
-# Retired knob, still read so `stpu check`'s env contract and old
-# deployment env files stay valid: prefix caching is now the paged
-# pool's trie (always on under paging, zero-copy), and the dense
-# engine has no prefix cache at all — the value is accepted and
-# ignored.
+# Host-RAM KV spill tier budget (MiB) under the paged pool's trie:
+# LRU-evicted prefix blocks spill D2H into a bounded host pool and
+# re-admit H2D on a warm match, so the effective prefix cache grows
+# from the HBM pool to host RAM at the cost of one block transfer per
+# re-hit. 0 turns the tier off (evictions drop the leaf); default on
+# at 64 MiB. Ignored by the dense engine (no trie, no tier).
 ENGINE_PREFIX_CACHE_MB = float(
     os.environ.get("STPU_PREFIX_CACHE_MB", "64"))
 # Paged KV block pool (decode_engine paged mode): one device-resident
@@ -302,6 +303,25 @@ class _Handler(BaseHTTPRequestHandler):
                     "window": int(kv.get("window", 0)),
                     "spec_k": int(kv.get("spec_k", 0)),
                     "manifest": kv.get("manifest", "default"),
+                }
+            # Host KV tier line for `stpu perf`: spill/re-admit and
+            # residency counters from the engine's HostBlockPool
+            # (absent while the tier is off).
+            tier = {}
+            get_tier = getattr(engine, "host_tier_stats", None)
+            if callable(get_tier):
+                tier = get_tier() or {}
+            if tier:
+                doc["tier"] = {
+                    "budget_mb": float(tier.get("budget_mb", 0.0)),
+                    "bytes": int(tier.get("bytes", 0)),
+                    "blocks": int(tier.get("blocks", 0)),
+                    "spilled": int(tier.get("spilled", 0)),
+                    "dropped": int(tier.get("evict_drops", 0)),
+                    "lru_dropped": int(tier.get("lru_dropped", 0)),
+                    "readmitted": int(tier.get("readmitted_blocks",
+                                               0)),
+                    "rehits": int(tier.get("rehits", 0)),
                 }
         return doc
 
@@ -615,8 +635,11 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
     """Start the replica server. ``engine_slots`` > 0 (default: env
     STPU_ENGINE_SLOTS or 4) serves through the continuous-batching
     decode engine; 0 keeps the legacy locked fixed-batch path.
-    ``prefix_cache_mb`` is accepted but inert (prefix caching is the
-    paged pool's trie, always on under paging).
+    ``prefix_cache_mb`` (default: env STPU_PREFIX_CACHE_MB or 64) is
+    the host-RAM KV spill tier budget in MiB under the paged pool's
+    trie — evicted prefix blocks spill D2H and re-admit H2D on a warm
+    match; 0 turns the tier off (dense mode has no trie and ignores
+    it).
     ``stream_timeout`` (default: env STPU_STREAM_TIMEOUT or 600) is the
     per-token wait before a wedged engine surfaces as a clean error.
     ``kv_quant``/``weight_quant`` (default: env STPU_KV_QUANT /
@@ -746,6 +769,9 @@ def _resolve_kv(args) -> dict:
         "spec_min_accept": (float(args.spec_min_accept)
                             if args.spec_min_accept is not None
                             else ENGINE_SPEC_MIN_ACCEPT),
+        "prefix_cache_mb": (float(args.prefix_cache_mb)
+                            if args.prefix_cache_mb is not None
+                            else ENGINE_PREFIX_CACHE_MB),
     }
 
 
@@ -847,9 +873,11 @@ def main(argv=None):
                    help="decode-engine slots (0 = legacy locked path; "
                         "default env STPU_ENGINE_SLOTS or 4)")
     p.add_argument("--prefix-cache-mb", type=float, default=None,
-                   help="accepted but inert (retired knob): prefix "
-                        "caching is the paged pool's zero-copy trie, "
-                        "always on under --kv-paged")
+                   help="host-RAM KV spill tier budget in MiB under "
+                        "the paged trie: LRU-evicted prefix blocks "
+                        "spill D2H and re-admit H2D on a warm match. "
+                        "0 = tier off (evictions drop). Default env "
+                        "STPU_PREFIX_CACHE_MB or 64")
     p.add_argument("--kv-paged", type=int, choices=(0, 1),
                    default=None,
                    help="1 serves from the paged KV block pool (one "
@@ -951,6 +979,7 @@ def main(argv=None):
         kv_quant=kv["kv_quant"], weight_quant=kv["weight_quant"],
         spec_k=kv["spec_k"], spec_ngram=kv["spec_ngram"],
         spec_min_accept=kv["spec_min_accept"],
+        host_cache_mb=kv["prefix_cache_mb"],
         family=family_name(cfg),
         tp=(mesh.devices.size if mesh is not None else 1))
     if topology.hosts > 1 and rank > 0:
@@ -963,9 +992,7 @@ def main(argv=None):
                 slots=(args.engine_slots
                        if args.engine_slots else ENGINE_SLOTS),
                 max_seq=MAX_PROMPT_TOKENS + MAX_GEN_TOKENS,
-                prefix_cache_mb=(args.prefix_cache_mb
-                                 if args.prefix_cache_mb is not None
-                                 else ENGINE_PREFIX_CACHE_MB),
+                prefix_cache_mb=kv["prefix_cache_mb"],
                 mesh=mesh, rules=rules,
                 paged=kv["paged"],
                 kv_pool_blocks=kv["pool_blocks"],
@@ -1006,7 +1033,7 @@ def main(argv=None):
 
     httpd = serve(cfg, params, args.port,
                   engine_slots=args.engine_slots,
-                  prefix_cache_mb=args.prefix_cache_mb,
+                  prefix_cache_mb=kv["prefix_cache_mb"],
                   stream_timeout=args.stream_timeout,
                   engine_max_restarts=args.engine_max_restarts,
                   topology=topology, mesh=mesh, rules=rules,

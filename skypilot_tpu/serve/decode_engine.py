@@ -182,6 +182,24 @@ _ZERO_COPY_HITS = metrics.counter(
     "Prefix-cache hits served by aliasing pool blocks into the "
     "slot's block table — no insert/gather copies, no host "
     "round-trip.")
+_KV_HOST_BYTES = metrics.gauge(
+    "stpu_engine_kv_host_bytes",
+    "Bytes resident in the host-RAM KV spill tier (HostBlockPool), "
+    "bounded by the --prefix-cache-mb / STPU_PREFIX_CACHE_MB budget.")
+_KV_HOST_BLOCKS = metrics.gauge(
+    "stpu_engine_kv_host_blocks",
+    "Spilled KV blocks resident in the host tier.")
+_KV_TIER_HITS = metrics.counter(
+    "stpu_engine_kv_tier_hits_total",
+    "Paged admissions by the deepest tier their prompt prefix "
+    "reached: hbm = device-resident trie blocks aliased zero-copy; "
+    "host = at least one block re-admitted H2D from the host tier; "
+    "miss = no cached prefix.", ("tier",))
+_KV_HOST_READMITS = metrics.counter(
+    "stpu_engine_kv_host_readmitted_blocks_total",
+    "KV blocks restored H2D from the host tier into freshly reserved "
+    "pool blocks (warm re-hits paying one block transfer instead of "
+    "a chunk prefill).")
 _SPEC_DRAFTED = metrics.counter(
     "stpu_engine_spec_drafted_tokens_total",
     "Tokens drafted by the self-speculative n-gram matcher and "
@@ -290,8 +308,9 @@ class _Slot:
     """Host-side state of one cache row (or, paged, one block table)."""
 
     __slots__ = ("request", "pos", "generated", "prefilled", "tok",
-                 "held", "cached", "blocks", "reserved", "history",
-                 "ngram_index", "drafted", "accepted", "spec_off")
+                 "held", "cached", "blocks", "reserved", "pending",
+                 "history", "ngram_index", "drafted", "accepted",
+                 "spec_off")
 
     def __init__(self):
         self.request: Optional[Request] = None
@@ -303,6 +322,10 @@ class _Slot:
         self.cached = 0       # prompt tokens restored from the pool
         self.blocks = 0       # paged: valid block-table entries
         self.reserved = 0     # paged: blocks still promised, unclaimed
+        # Host-tier re-admits this slot still owes: (logical chunk
+        # index, trie node, fetched host payload) in chunk order,
+        # consumed one per engine iteration by _restore_one.
+        self.pending: List[tuple] = []
         # Speculative decoding (spec_k > 0 only): the slot's full
         # token history (prompt + emitted), an incremental n-gram ->
         # last-start index over it (O(1) draft lookup), and the
@@ -360,6 +383,33 @@ def _paged_prefill_chunk(cfg, params, cache, buf, table_row, start,
         valid_len=valid, logits_at=jnp.maximum(valid - start - 1, 0),
         window=window, write_block=wb)
     return logits[0, 0], cache
+
+
+@jax.jit
+def _slice_block(cache, block):
+    """D2H spill snapshot: every pool leaf's slice at physical block
+    ``block`` (axis 1 — codes and scales alike) as fresh device
+    buffers. Taking the slice pins the block's CONTENT: the XLA
+    runtime orders later donated in-place writes to the pool after
+    this read, so the drain thread can land the bytes while the block
+    is already reallocated and being overwritten. ``block`` is traced,
+    so one program serves every block id."""
+    return {k: jax.lax.dynamic_index_in_dim(v, block, axis=1,
+                                            keepdims=False)
+            for k, v in cache.items()}
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _host_restore_block(cache, block, parts):
+    """Re-admit ONE spilled KV block H2D: write the uploaded per-leaf
+    slices back at physical block ``block`` (axis 1 of every pool
+    leaf). The pool is donated — the restore is an in-place update,
+    preserving the paged engine's single-buffer invariant exactly as
+    prefill chunks and decode steps do. ``block`` is traced: one
+    program serves every restore."""
+    return {k: jax.lax.dynamic_update_index_in_dim(
+                cache[k], parts[k], block, axis=1)
+            for k in cache}
 
 
 @functools.partial(jax.jit, static_argnums=(0, 6),
@@ -502,6 +552,7 @@ def resolve_kv_geometry(*, slots: int, max_seq: int,
                         spec_k: int = 0, spec_ngram: int = 3,
                         spec_min_accept: float = 0.0,
                         block: int = 0, window_blocks: int = 0,
+                        host_cache_mb: float = 0.0,
                         family: Optional[str] = None, tp: int = 1,
                         use_manifest: bool = True
                         ) -> Dict[str, Any]:
@@ -595,8 +646,13 @@ def resolve_kv_geometry(*, slots: int, max_seq: int,
             # blocks.
             window = max(block_eff // chunk * chunk, chunk)
         nbw = window // chunk
+        # Host spill-tier budget (MiB) rides the geometry dict: the
+        # tier changes eviction outcomes and therefore admission
+        # timing, so a leader/follower budget drift is join-fatal via
+        # the same welcome comparison as a pool or quant drift.
         out.update(pool_blocks=total, window=window,
-                   table_len=-(-(total - 1) // nbw) * nbw)
+                   table_len=-(-(total - 1) // nbw) * nbw,
+                   host_mb=float(host_cache_mb))
     return out
 
 
@@ -619,10 +675,13 @@ class DecodeEngine:
                  spec_k: int = 0, spec_ngram: int = 3,
                  spec_min_accept: float = 0.0, block: int = 0,
                  window_blocks: int = 0, use_manifest: bool = True):
-        # prefix_cache_mb is accepted for call-site compatibility but
-        # inert: prefix caching is the paged pool's trie (always on in
-        # paged mode), the dense splice cache is gone.
-        del prefix_cache_mb
+        # prefix_cache_mb is the HOST-TIER byte budget (MiB) for the
+        # paged pool's trie: evicted prefix blocks spill D2H into a
+        # bounded host pool and re-admit H2D on a warm match. 0 turns
+        # the tier off (evictions drop the leaf, exactly the pre-tier
+        # engine). Dense mode has no trie, hence no tier — the knob is
+        # ignored there like the retired splice cache it once sized.
+        host_mb = float(prefix_cache_mb or 0.0)
         if slots < 1:
             raise ValueError("slots must be >= 1")
         if spec_k < 0:
@@ -686,6 +745,7 @@ class DecodeEngine:
             spec_ngram=self._spec_ngram,
             spec_min_accept=self._spec_min_accept,
             block=block, window_blocks=window_blocks,
+            host_cache_mb=(host_mb if self._paged else 0.0),
             family=family_name(cfg),
             tp=(mesh.devices.size if mesh is not None else 1),
             use_manifest=use_manifest)
@@ -700,6 +760,14 @@ class DecodeEngine:
         self._spec_k = geo["spec_k"]
         self._max_queue = int(max_queue)
         self.prefix_cache: Optional[Any] = None
+        # Host-RAM spill tier state (paged + host_mb > 0 only, but the
+        # attributes always exist — shutdown and introspection touch
+        # them on every engine).
+        self._host_pool: Optional[kv_pool.HostBlockPool] = None
+        self._spill_q: Optional["queue.Queue"] = None
+        self._spill_thread: Optional[threading.Thread] = None
+        self._spill_stop = False
+        self._readmitted_blocks = 0
         if self._paged:
             # ONE device-resident pool for slot growth AND the prefix
             # cache (serve/kv_pool.py). Default sizing matches the
@@ -721,11 +789,26 @@ class DecodeEngine:
             self._table = np.zeros((slots, self._table_len), np.int32)
             self._cache = self._api.init_paged_cache(
                 cfg, total, chunk, quantized=self._kv_quant)
+            # Host-RAM spill tier under the trie: evictions demote
+            # blocks D2H through a bounded queue drained off the
+            # compute thread; warm matches re-admit H2D during the
+            # prefill phase (_restore_one). Budget 0 = tier off.
+            host_mb_eff = float(geo.get("host_mb", 0.0))
+            if host_mb_eff > 0:
+                self._host_pool = kv_pool.HostBlockPool(
+                    int(host_mb_eff * (1 << 20)))
+                self._spill_q = queue.Queue(maxsize=32)
+                self._spill_thread = threading.Thread(
+                    target=self._drain_spills, name="kv-spill-drain",
+                    daemon=True)
+                self._spill_thread.start()
             # The unified pool IS the prefix cache: the trie is just an
             # index over blocks, so it is always on in paged mode (a
             # hit is a table write; a miss costs one dict walk).
-            self.prefix_cache = kv_pool.PagedPrefixCache(self._pool,
-                                                         chunk)
+            self.prefix_cache = kv_pool.PagedPrefixCache(
+                self._pool, chunk, host_pool=self._host_pool,
+                spill=(self._spill_block
+                       if self._host_pool is not None else None))
             _KV_POOL_TOTAL.set(self._pool.usable_blocks)
             _KV_POOL_FREE.set(self._pool.free_blocks())
             _KV_POOL_BLOCK_BYTES.set(sum(
@@ -857,6 +940,97 @@ class DecodeEngine:
             self._cond.notify()
         if self._thread is not None:
             self._thread.join(timeout=30.0)
+        if self._spill_thread is not None:
+            self._spill_stop = True
+            self._spill_thread.join(timeout=10.0)
+
+    # --------------------------------------------------- host KV tier
+    def _spill_block(self, node) -> bool:
+        """Offer an eviction victim to the host tier (called by the
+        trie's evict_one on the compute thread). MUST NOT block: it
+        snapshots the block's per-leaf slices (async device work),
+        kicks D2H with copy_to_host_async — the checkpoint writer's
+        overlap pattern — and hands the in-flight buffers to the drain
+        thread. False declines the spill (injected fault, drain
+        backlog, unreadable buffers) and the eviction degrades to a
+        plain drop-on-evict."""
+        if fault_injection.ENABLED:
+            try:
+                fault_injection.fire("engine.spill", block=node.block)
+            except fault_injection.InjectedFault:
+                return False
+        if node.path in self._host_pool:
+            # Inclusive tier: the bytes are already down (stored or in
+            # flight) — demotion is free, no second D2H.
+            return True
+        if self._spill_q.full():
+            # Bounded in-flight D2H: never queue-block an eviction on
+            # a slow drain; dropping under backlog is the safe cheap
+            # choice (the counter shows it).
+            return False
+        try:
+            slices = _slice_block(self._cache, jnp.int32(node.block))
+            for part in slices.values():
+                start = getattr(part, "copy_to_host_async", None)
+                if callable(start):
+                    start()
+        except RuntimeError:
+            return False
+        self._host_pool.mark_inflight(node.path)
+        self._spill_q.put((node.path, slices))
+        return True
+
+    def _drain_spills(self) -> None:
+        """Background D2H drain (daemon thread): land each in-flight
+        spill's bytes on host (np.asarray finds the copy_to_host_async
+        transfer done or rides it out) and store them in the host
+        pool. The compute thread never joins this — a slow host path
+        surfaces as spill-queue backpressure (drops), never as decode
+        stalls."""
+        while True:
+            try:
+                item = self._spill_q.get(timeout=0.1)
+            except queue.Empty:
+                if self._spill_stop:
+                    return
+                continue
+            path, slices = item
+            try:
+                arrays = {k: np.asarray(v) for k, v in slices.items()}
+            except Exception:  # noqa: BLE001 — deleted buffer / device
+                # error mid-drain: this spill is lost, serving is not.
+                self._host_pool.clear_inflight(path)
+                continue
+            self._host_pool.put(path, arrays)
+            self._update_host_gauges()
+
+    def _update_host_gauges(self) -> None:
+        if self._host_pool is not None:
+            s = self._host_pool.stats()
+            _KV_HOST_BYTES.set(s["bytes"])
+            _KV_HOST_BLOCKS.set(s["blocks"])
+
+    def spill_in_flight(self) -> int:
+        """Spills kicked D2H whose drain has not landed yet (0 = the
+        host tier is quiescent — tests and the bench leg poll this)."""
+        if self._host_pool is None:
+            return 0
+        return self._host_pool.stats()["inflight"]
+
+    def host_tier_stats(self) -> Dict[str, Any]:
+        """Host-tier introspection for /perf and the CLI tier line;
+        {} while the tier is off (dense engine or budget 0)."""
+        if self._host_pool is None:
+            return {}
+        out = dict(self._host_pool.stats())
+        out["budget_mb"] = float(self._kv_geometry.get("host_mb", 0.0))
+        out["readmitted_blocks"] = self._readmitted_blocks
+        trie = self.prefix_cache.stats()
+        out["host_chunks"] = trie["host_chunks"]
+        out["promotions"] = trie["promotions"]
+        out["evict_spills"] = trie["spills"]
+        out["evict_drops"] = trie["drops"]
+        return out
 
     # ------------------------------------------------------------ internals
     def _live(self) -> List[int]:
@@ -884,6 +1058,13 @@ class DecodeEngine:
         double-decrement (the cancel-mid-prefill hole the dense host
         pool had)."""
         slot = self._slots[i]
+        if slot.pending:
+            # Pending re-admits never took pool references — drop the
+            # trie pins only (cancel / error before their restore ran;
+            # the fetched payloads simply fall out of scope).
+            self.prefix_cache.unpin_pending(
+                [n for _, n, _ in slot.pending])
+            slot.pending = []
         aliased = len(slot.held)
         if slot.held:
             self.prefix_cache.unpin(slot.held)
@@ -969,36 +1150,68 @@ class DecodeEngine:
         never lose a block, so nothing decoding is ever rolled back).
         """
         nodes = self.prefix_cache.match(req.prompt)
-        self.prefix_cache.pin(nodes)
+        # Split the match by residency: a device-resident prefix (the
+        # zero-copy alias) followed by a host-resident suffix to
+        # re-admit H2D. Payloads are fetched NOW — holding the host
+        # arrays keeps the bytes alive against concurrent LRU drops
+        # for the life of the slot.
+        dev_nodes: List[Any] = []
+        pending: List[tuple] = []
+        for node in nodes:
+            if node.block >= 0 and not pending:
+                dev_nodes.append(node)
+            elif node.block < 0 and self._host_pool is not None:
+                payload = self._host_pool.get(node.path)
+                if payload is None:
+                    break       # D2H still in flight (or just dropped)
+                pending.append((node, payload))
+            else:
+                break
+        self.prefix_cache.pin(dev_nodes)
+        pend_nodes = [n for n, _ in pending]
+        self.prefix_cache.pin_pending(pend_nodes)
         total = self._pool.blocks_for(len(req.prompt) + req.max_tokens)
-        needed = total - len(nodes)
+        # Host re-admits draw FRESH blocks, budgeted like any other
+        # un-cached chunk (same worst-case reservation); the restore
+        # itself runs off the hot path in the prefill-phase interleave.
+        needed = total - len(dev_nodes)
         while self._pool.available() < needed:
-            if not self.prefix_cache.evict_one():
-                self.prefix_cache.unpin(nodes)
+            evicted = self.prefix_cache.evict_one()
+            if not evicted:
+                self.prefix_cache.unpin(dev_nodes)
+                self.prefix_cache.unpin_pending(pend_nodes)
                 return False
         self._pool.reserve(needed)
         slot = self._slots[i]
         slot.request = req
-        slot.held = nodes
-        for j, node in enumerate(nodes):
+        slot.held = dev_nodes
+        slot.pending = [(len(dev_nodes) + j, node, payload)
+                        for j, (node, payload) in enumerate(pending)]
+        for j, node in enumerate(dev_nodes):
             self._table[i, j] = node.block
-        slot.blocks = len(nodes)
+        slot.blocks = len(dev_nodes)
         slot.reserved = needed
-        slot.cached = len(nodes) * self._chunk
-        # The "restore" is already done: the aliased blocks ARE the
-        # prefilled prefix. Prefill resumes at the first non-cached
-        # token; no insert_cache_rows splice, no host round-trip.
+        slot.cached = len(dev_nodes) * self._chunk
+        # The device-resident "restore" is already done: the aliased
+        # blocks ARE the prefilled prefix. Host-resident chunks join
+        # the frontier one _restore_one at a time; prefill resumes
+        # after the last cached token either way.
         slot.prefilled = slot.pos = slot.cached
         slot.generated = 0
         slot.tok = 0
         req.cached_prompt_tokens = slot.cached
-        self.prefix_cache.note_result(len(nodes))
-        if nodes:
+        self.prefix_cache.note_result(len(dev_nodes) + len(pending))
+        if dev_nodes or pending:
             _PREFIX_HITS.inc()
-            _ZERO_COPY_HITS.inc()
-            _PREFIX_SAVED.inc(slot.cached)
+            if dev_nodes:
+                _ZERO_COPY_HITS.inc()
+            _PREFIX_SAVED.inc(
+                (len(dev_nodes) + len(pending)) * self._chunk)
         else:
             _PREFIX_MISSES.inc()
+        _KV_TIER_HITS.labels(tier=("host" if pending
+                                   else "hbm" if dev_nodes
+                                   else "miss")).inc()
         return True
 
     def _admit_paged(self) -> None:
@@ -1148,6 +1361,12 @@ class DecodeEngine:
             if tracing.ENABLED and req.trace is not None \
                     and req.trace.sampled and req.prefill_start is None:
                 req.prefill_start = time.perf_counter()
+            if slot.pending:
+                # Host-tier re-admits ride the prefill phase: ONE
+                # block restore per engine iteration, drawn from the
+                # slot's admission reservation like a chunked prefill
+                # — the decode step never waits on an H2D transfer.
+                return self._restore_one(i)
             start = slot.prefilled
             piece = req.prompt[start:start + self._chunk]
             # Pad host-side (numpy), NOT with a jnp zeros/at/set: the
@@ -1204,6 +1423,41 @@ class DecodeEngine:
                 self._maybe_finish(i)
             return len(piece)
         return 0
+
+    def _restore_one(self, i: int) -> int:
+        """Re-admit ONE pending host-tier block for slot ``i`` into
+        the paged pool (H2D), advancing the slot's cached frontier by
+        a chunk. The block comes out of the slot's admission
+        reservation exactly as a fresh prefill chunk's would; if
+        another slot already promoted the node back to HBM since
+        admission, this collapses to a plain zero-copy alias and the
+        spare reservation returns. Returns the chunk's token count —
+        prefill-phase work for the step telemetry."""
+        slot = self._slots[i]
+        req = slot.request
+        j, node, payload = slot.pending.pop(0)
+        if node.block < 0:
+            block = self._pool.alloc()
+            slot.reserved -= 1
+            self.prefix_cache.promote(node, block)
+            parts = {k: jnp.asarray(v) for k, v in payload.items()}
+            self._cache = _host_restore_block(
+                self._cache, jnp.int32(block), parts)
+            self._readmitted_blocks += 1
+            _KV_HOST_READMITS.inc()
+        else:
+            self._pool.retain(node.block)
+            self._pool.unreserve(1)
+            slot.reserved -= 1
+        # Chunk-order append keeps _release_paged's table-position
+        # invariant: held nodes are exactly table[0:len(held)].
+        slot.held.append(node)
+        self._table[i, j] = node.block
+        slot.blocks = j + 1
+        slot.cached += self._chunk
+        slot.prefilled = slot.pos = (j + 1) * self._chunk
+        req.cached_prompt_tokens = slot.cached
+        return self._chunk
 
     def _maybe_finish(self, i: int) -> None:
         slot = self._slots[i]
@@ -1635,6 +1889,10 @@ class EngineSupervisor:
     def kv_config(self) -> Dict[str, Any]:
         engine = self._engine
         return engine.kv_config() if engine is not None else {}
+
+    def host_tier_stats(self) -> Dict[str, Any]:
+        engine = self._engine
+        return engine.host_tier_stats() if engine is not None else {}
 
     def in_flight(self) -> int:
         engine = self._engine

@@ -33,17 +33,41 @@ Block 0 is a reserved SCRATCH block, never allocated: free slots ride
 along in the batched decode step with ``pos 0`` and their (ignored)
 K/V writes land there instead of clobbering a live slot's block.
 
+Below the device pool sits an optional second tier
+(:class:`HostBlockPool`): on LRU eviction a leaf's block is SPILLED
+D2H into a bounded host-RAM pool instead of destroyed — the trie node
+stays, flipping to HOST residency (``block == -1``) — and a later
+match that reaches the node re-admits it H2D into a freshly reserved
+block during the prefill phase. Residency along any root→leaf path is
+always a device-resident prefix followed by a host-resident suffix
+(spill picks deepest-device victims; re-admission and publish promote
+parent-first), which is what keeps match/eviction bookkeeping local.
+Tiering is INCLUSIVE: re-admission leaves the host copy in place, so
+re-evicting a promoted block is a free demotion.
+
 All mutation happens on the engine's compute thread; the trie lock
 only makes the read-only ``stats()``/``nodes()`` safe from tests and
-handlers. Stdlib + nothing else — no jax in here (the device arrays
-live in the engine; this module owns the arithmetic of who holds which
-block).
+handlers (and the spill callback, which the engine wires in, safe to
+hand blocks to). The host pool has its own lock: the engine's D2H
+drain thread ``put``s while the compute thread matches and ``get``s.
+Stdlib + the in-process metrics registry, nothing else — no jax in
+here (the device arrays live in the engine; this module owns the
+arithmetic of who holds which block).
 """
 from __future__ import annotations
 
 import collections
 import threading
-from typing import Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu.observability import metrics
+
+_EVICTIONS = metrics.counter(
+    "stpu_engine_kv_pool_evictions_total",
+    "Prefix-trie LRU evictions by outcome: spilled = block demoted "
+    "D2H into the host tier (the trie node survives, HOST-resident); "
+    "dropped = leaf destroyed outright (tier off, injected fault, or "
+    "drain backpressure).", ("outcome",))
 
 
 def block_bytes(block_tokens: int, n_layers: int, n_kv_heads: int,
@@ -169,12 +193,122 @@ class BlockPool:
         return self._refs.get(int(block), 0)
 
 
+class HostBlockPool:
+    """Bounded host-RAM spill tier under the paged trie.
+
+    Entries are spilled KV blocks keyed by the victim node's trie PATH
+    (the tuple of chunk token-tuples from the root — a block's contents
+    depend on the entire prefix through causal attention, so nothing
+    shorter can key them) and valued by a dict of per-leaf host arrays
+    (the drained D2H copies); sizing is by their ``nbytes``. LRU over
+    an OrderedDict against a byte budget: storing past the budget drops
+    the oldest entries first, and an entry larger than the whole budget
+    is refused outright.
+
+    ``mark_inflight`` lets the engine register a spill whose D2H drain
+    has not landed yet: ``has`` counts it (so the trie keeps the node
+    instead of pruning a prefix whose bytes are seconds away) but
+    ``get`` does not (admission can't restore bytes it can't read —
+    that request simply prefills the tail fresh).
+
+    Thread-safe under its own lock: the engine's background drain
+    thread ``put``s while the compute thread matches and ``get``s.
+    """
+
+    def __init__(self, budget_bytes: int):
+        self.budget_bytes = int(budget_bytes)
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[Tuple, Dict[str, Any]]"\
+            = collections.OrderedDict()
+        self._sizes: Dict[Tuple, int] = {}
+        self._inflight: set = set()
+        self.bytes_used = 0
+        self.stored = 0        # completed spills (successful put)
+        self.lru_dropped = 0   # entries dropped to fit the budget
+        self.rehits = 0        # get() hits -> re-admissions
+
+    def has(self, path: Tuple) -> bool:
+        """Stored OR in flight — the trie's keep-the-node predicate."""
+        with self._lock:
+            return path in self._entries or path in self._inflight
+
+    __contains__ = has
+
+    def mark_inflight(self, path: Tuple) -> None:
+        with self._lock:
+            self._inflight.add(path)
+
+    def clear_inflight(self, path: Tuple) -> None:
+        with self._lock:
+            self._inflight.discard(path)
+
+    def put(self, path: Tuple, arrays: Dict[str, Any]) -> bool:
+        """Store a drained block; False when it cannot fit (dropped)."""
+        nbytes = sum(int(getattr(v, "nbytes", 0))
+                     for v in arrays.values())
+        with self._lock:
+            self._inflight.discard(path)
+            if nbytes > self.budget_bytes:
+                return False
+            old = self._sizes.pop(path, 0)
+            if old:
+                del self._entries[path]
+                self.bytes_used -= old
+            while self._entries and \
+                    self.bytes_used + nbytes > self.budget_bytes:
+                dead, _ = self._entries.popitem(last=False)
+                self.bytes_used -= self._sizes.pop(dead)
+                self.lru_dropped += 1
+            self._entries[path] = arrays
+            self._sizes[path] = nbytes
+            self.bytes_used += nbytes
+            self.stored += 1
+            return True
+
+    def get(self, path: Tuple) -> Optional[Dict[str, Any]]:
+        """Fetch for re-admission (LRU-touches; the entry STAYS — the
+        tier is inclusive, so churn after the first spill is free)."""
+        with self._lock:
+            arrays = self._entries.get(path)
+            if arrays is not None:
+                self._entries.move_to_end(path)
+                self.rehits += 1
+            return arrays
+
+    def discard(self, path: Tuple) -> None:
+        """Drop an entry (trie pruned the node: the bytes are
+        unreachable through any future match)."""
+        with self._lock:
+            self._inflight.discard(path)
+            size = self._sizes.pop(path, None)
+            if size is not None:
+                del self._entries[path]
+                self.bytes_used -= size
+
+    def blocks(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"bytes": self.bytes_used,
+                    "blocks": len(self._entries),
+                    "budget_bytes": self.budget_bytes,
+                    "spilled": self.stored,
+                    "lru_dropped": self.lru_dropped,
+                    "rehits": self.rehits,
+                    "inflight": len(self._inflight)}
+
+
 class _BlockNode:
     """One prompt chunk in the paged trie: a token-tuple key mapping to
     one pool block. ``refs`` counts live slots whose admission aliased
-    this node (pins — never evicted while > 0)."""
+    this node (pins — never evicted while > 0). ``block == -1`` is the
+    HOST residency state: the device block was spilled to the host
+    tier, keyed by ``path`` (the full chunk-key chain from the root)."""
 
-    __slots__ = ("key", "parent", "children", "block", "refs", "tick")
+    __slots__ = ("key", "parent", "children", "block", "refs", "tick",
+                 "path")
 
     def __init__(self, key, parent: Optional["_BlockNode"], block: int):
         self.key = key
@@ -183,6 +317,8 @@ class _BlockNode:
         self.block = int(block)
         self.refs = 0
         self.tick = 0
+        self.path: Tuple = (() if parent is None
+                            else parent.path + (key,))
 
 
 class PagedPrefixCache:
@@ -195,19 +331,36 @@ class PagedPrefixCache:
     admission: when a new request's reservation does not fit, leaves
     are evicted until it does or nothing unpinned remains (then the
     request waits — deterministic FIFO backpressure).
+
+    With a ``host_pool`` + ``spill`` callback wired in (the tiered
+    engine), eviction first offers the victim to the spill path: on
+    success the device block is released but the NODE stays, flipping
+    to HOST residency (``block == -1``); a later match re-admits it.
+    ``spill(node)`` must be non-blocking — it snapshots the block D2H
+    asynchronously (the engine's drain thread lands the bytes) and
+    returns False to decline (fault, backpressure, tier off), which
+    degrades that eviction to today's drop.
     """
 
-    def __init__(self, pool: BlockPool, chunk: int):
+    def __init__(self, pool: BlockPool, chunk: int, *,
+                 host_pool: Optional[HostBlockPool] = None,
+                 spill: Optional[Callable[["_BlockNode"], bool]] = None):
         self.pool = pool
         self.chunk = int(chunk)
+        self.host_pool = host_pool
+        self._spill = spill if host_pool is not None else None
         self._root = _BlockNode(None, None, -1)
         self._lock = threading.Lock()
         self._tick = 0
         self._chunks = 0
+        self._host_chunks = 0
         self.hits = 0
         self.misses = 0
         self.tokens_saved = 0
         self.zero_copy_hits = 0
+        self.spills = 0        # evictions demoted to the host tier
+        self.drops = 0         # evictions that destroyed the leaf
+        self.promotions = 0    # host nodes re-admitted / re-published
 
     # ------------------------------------------------------------ match
     def match(self, prompt: List[int]) -> List[_BlockNode]:
@@ -223,9 +376,36 @@ class PagedPrefixCache:
                 child = node.children.get(key)
                 if child is None:
                     break
+                if child.block < 0:
+                    # HOST residency: matchable only while the spilled
+                    # bytes still exist (stored or D2H in flight). A
+                    # node whose payload was LRU-dropped from the host
+                    # tier is dead weight — prune it lazily here.
+                    if self.host_pool is None or \
+                            not self.host_pool.has(child.path):
+                        self._prune_dead_locked(child)
+                        break
                 matched.append(child)
                 node = child
             return matched
+
+    def _prune_dead_locked(self, node: _BlockNode) -> None:
+        """Delete a host-resident node whose payload is gone, plus its
+        (necessarily host-resident) subtree — unless anything in it is
+        still pinned by a pending re-admission. Caller holds the lock."""
+        stack, doomed = [node], []
+        while stack:
+            n = stack.pop()
+            if n.refs > 0 or n.block >= 0:
+                return
+            doomed.append(n)
+            stack.extend(n.children.values())
+        del node.parent.children[node.key]
+        for n in doomed:
+            self._chunks -= 1
+            self._host_chunks -= 1
+            if self.host_pool is not None:
+                self.host_pool.discard(n.path)
 
     def pin(self, nodes: List[_BlockNode]) -> None:
         """Pin matched nodes for a slot: bumps each node's pin count
@@ -250,6 +430,46 @@ class PagedPrefixCache:
                         f"trie pin underflow on chunk {node.key!r} — "
                         "double release")
                 self.pool.release(node.block)
+
+    def pin_pending(self, nodes: List[_BlockNode]) -> None:
+        """Pin HOST-resident nodes a slot is about to re-admit: bumps
+        the node pin count only — there is no device block to retain
+        yet (the restore path allocates one and :meth:`promote`\\ s).
+        The pin keeps eviction's drop path and match's lazy prune off
+        a node whose payload an admitted slot already fetched."""
+        with self._lock:
+            self._tick += 1
+            for node in nodes:
+                node.refs += 1
+                node.tick = self._tick
+
+    def unpin_pending(self, nodes: List[_BlockNode]) -> None:
+        """Inverse of :meth:`pin_pending` for nodes whose restore never
+        ran (cancel / error before the re-admit reached them)."""
+        with self._lock:
+            for node in nodes:
+                node.refs -= 1
+                if node.refs < 0:
+                    raise RuntimeError(
+                        f"trie pending-pin underflow on chunk "
+                        f"{node.key!r} — double release")
+
+    def promote(self, node: _BlockNode, block: int) -> None:
+        """Flip a HOST-resident node back to device residency after its
+        bytes were restored into ``block``: the trie takes ownership
+        (retain), mirroring adoption at publish. The host copy stays —
+        the tier is inclusive, so re-evicting this block later is a
+        free demotion (no second D2H)."""
+        with self._lock:
+            if node.block >= 0:
+                raise RuntimeError(
+                    f"promote of device-resident chunk {node.key!r}")
+            node.block = int(block)
+            self.pool.retain(node.block)
+            self._tick += 1
+            node.tick = self._tick
+            self._host_chunks -= 1
+            self.promotions += 1
 
     def note_result(self, matched_chunks: int) -> None:
         """Count a successful admission's hit/miss + tokens saved."""
@@ -286,31 +506,76 @@ class PagedPrefixCache:
                     self.pool.retain(child.block)
                     self._chunks += 1
                     adopted += 1
+                elif child.block < 0:
+                    # The slot prefilled this chunk fresh while the
+                    # node sat host-resident (its payload dropped or
+                    # still in flight at match time): adopt the fresh
+                    # block — a free promotion back to HBM.
+                    child.block = int(block_of(j))
+                    self.pool.retain(child.block)
+                    self._host_chunks -= 1
+                    self.promotions += 1
+                    adopted += 1
                 child.tick = self._tick
                 node = child
         return adopted
 
     # ----------------------------------------------------------- evict
-    def evict_one(self) -> bool:
-        """Drop the LRU unpinned LEAF (releasing its block back toward
-        the free list). False when everything left is pinned or
-        interior — the caller's admission then waits."""
+    def evict_one(self):
+        """Evict the LRU unpinned deepest-device node (releasing its
+        block back toward the free list). With a spill path wired in,
+        the victim is first offered to the host tier: ``"spilled"``
+        demotes it (node stays, HOST-resident), ``"dropped"`` destroys
+        it like the untiered cache always did — both truthy, so
+        admission loops are tier-agnostic. False when everything left
+        is pinned or interior — the caller's admission then waits.
+
+        Eligibility is "no device-resident child" rather than "no
+        child": a spilled node's descendants are never device-resident
+        (residency is a device prefix + host suffix along every path),
+        so host children don't shield a block the way cached deeper
+        prefixes do."""
         with self._lock:
             victim = None
             stack = list(self._root.children.values())
             while stack:
                 node = stack.pop()
-                if node.children:
-                    stack.extend(node.children.values())
-                elif node.refs <= 0 and (victim is None
-                                         or node.tick < victim.tick):
+                stack.extend(node.children.values())
+                if node.block < 0:
+                    continue
+                if any(c.block >= 0 for c in node.children.values()):
+                    continue
+                if node.refs <= 0 and (victim is None
+                                       or node.tick < victim.tick):
                     victim = node
             if victim is None:
                 return False
+            if self._spill is not None and self._spill(victim):
+                self.pool.release(victim.block)
+                victim.block = -1
+                self._host_chunks += 1
+                self.spills += 1
+                _EVICTIONS.labels(outcome="spilled").inc()
+                return "spilled"
+            # Drop: destroy the node and its (host-resident) subtree —
+            # unreachable once the parent is gone — discarding any
+            # spilled payloads the subtree still keyed.
+            doomed, stack = [], [victim]
+            while stack:
+                n = stack.pop()
+                doomed.append(n)
+                stack.extend(n.children.values())
             del victim.parent.children[victim.key]
             self.pool.release(victim.block)
-            self._chunks -= 1
-            return True
+            for n in doomed:
+                self._chunks -= 1
+                if n.block < 0:
+                    self._host_chunks -= 1
+                if self.host_pool is not None:
+                    self.host_pool.discard(n.path)
+            self.drops += 1
+            _EVICTIONS.labels(outcome="dropped").inc()
+            return "dropped"
 
     # ------------------------------------------------------------ intro
     def stats(self) -> Dict[str, int]:
@@ -319,6 +584,10 @@ class PagedPrefixCache:
                     "tokens_saved": self.tokens_saved,
                     "zero_copy_hits": self.zero_copy_hits,
                     "chunks": self._chunks,
+                    "host_chunks": self._host_chunks,
+                    "spills": self.spills,
+                    "drops": self.drops,
+                    "promotions": self.promotions,
                     "blocks_free": self.pool.free_blocks(),
                     "blocks_total": self.pool.usable_blocks}
 

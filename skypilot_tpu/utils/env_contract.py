@@ -220,10 +220,12 @@ _KNOBS = (
        "chunk — blocks and chunks are one unit (0 = the engine's "
        "prefill chunk, default 64)."),
     _k("STPU_PREFIX_CACHE_MB", "64",
-       "Retired knob, still read for env-file compatibility and "
-       "always ignored: prefix caching is the paged pool's trie "
-       "(always on under STPU_KV_PAGED=1), and the dense path's "
-       "host splice cache no longer exists."),
+       "Host-RAM KV spill-tier budget in MiB under the paged prefix "
+       "trie: LRU-evicted prefix blocks spill D2H into a bounded "
+       "host pool and re-admit H2D on a warm match instead of "
+       "re-prefilling. 0 disables the tier (evictions drop the KV). "
+       "Rides the gang kv-config handshake; ignored on the dense "
+       "path."),
     _k("STPU_TUNE_MANIFEST", None,
        "Tuning-manifest override for the decode engine: a path loads "
        "that sha256-pinned `stpu tune` manifest, \"0\" disables "

@@ -73,6 +73,10 @@ Known points (callers may add more; names are dotted subsystem.seam):
     train.step        recipes' training loops, after each optimizer
                       step — preempt/crash a run mid-epoch at a
                       deterministic step (``skip=K`` + ``kill``)
+    engine.spill      decode_engine._spill_block, before the D2H
+                      copy of an evicted KV block — a failed spill
+                      degrades that eviction to drop-on-evict (the
+                      engine never crashes on a tier fault)
 """
 from __future__ import annotations
 

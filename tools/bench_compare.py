@@ -53,6 +53,11 @@ DEFAULT_METRICS = (
     "detail.serving.*_engine_tp_tok_s",
     "detail.serving.*_engine_prefix_tok_s",
     "detail.serving.*_prefix_hit_rate",
+    # Host-RAM KV spill tier: decode throughput with spill/re-admit
+    # traffic in flight, and the warm-phase tier hit rate. The re-hit
+    # TTFT companion lives in DEFAULT_METRICS_LOWER.
+    "detail.serving.*_engine_tier_tok_s",
+    "detail.serving.*_tier_hit_rate",
     "detail.serving.*_slo_goodput",
     "detail.serving.*_loadgen_tok_s",
     # Training-goodput legs (bench.py _train_leg): live MFU from the
@@ -67,6 +72,10 @@ DEFAULT_METRICS_LOWER = (
     "detail.serving.*_ckpt_save_s",
     "detail.serving.*_ckpt_restore_s",
     "detail.serving.*_p99_ttft_s",
+    # Host-tier warm re-hit TTFT: a re-admission path that silently
+    # degrades to full prefill shows up here as a latency rise even
+    # when raw tok/s survives.
+    "detail.serving.*_tier_rehit_ttft_s",
 )
 
 

@@ -27,7 +27,8 @@ def main() -> None:
                    default="mixtral")
     p.add_argument("--mode", choices=("fixed", "engine", "paged", "q8",
                                       "spec", "prefix", "ckpt",
-                                      "loadgen", "tp", "tuned"),
+                                      "loadgen", "tp", "tuned",
+                                      "tier"),
                    default="fixed",
                    help="fixed: bucketed batch decode (r01-r05 "
                         "comparable); engine: continuous-batching "
@@ -63,7 +64,11 @@ def main() -> None:
                         "— the tuned >= default acceptance leg "
                         "(STPU_TUNE_MANIFEST selects the manifest; "
                         "with no entry a quick in-process "
-                        "ragged-only sweep supplies the constants)")
+                        "ragged-only sweep supplies the constants); "
+                        "tier: the host-RAM KV spill tier under a "
+                        "prefix working set ~2x the HBM pool — "
+                        "warm re-hit TTFT vs cold prefill TTFT, "
+                        "tier hit rate, spill/re-admit counters")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--tokens", type=int, default=128)
@@ -150,6 +155,10 @@ def main() -> None:
         result = decode_bench.measure_engine_tp(
             args.family, tp=args.tp, slots=args.slots,
             n_requests=args.requests, **shape_kw)
+    elif args.mode == "tier":
+        result = decode_bench.measure_engine_tier(
+            args.family, slots=args.slots, n_requests=args.requests,
+            **shape_kw)
     elif args.mode == "tuned":
         from skypilot_tpu.tune import manifest as tune_manifest
         entry, tag = tune_manifest.entry_for(family=args.family,
