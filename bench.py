@@ -509,6 +509,30 @@ def _serving_leg() -> dict:
         except Exception as e:  # noqa: BLE001
             out[key] = None
             out[f"{key}_error"] = str(e)[:200]
+        # Durable-streams chaos leg: the loadgen data plane over TWO
+        # replicas with one hard-killed mid-run. The LB's stream
+        # journal resumes the broken streams on the survivor, so the
+        # gated chaos_goodput_ratio (chaos / kill-free baseline, same
+        # schedule) holding near 1.0 IS the durability contract —
+        # bench_compare's 5% tolerance on the ratio is the "within 5%
+        # of kill-free" acceptance bound, and resumed_streams in the
+        # detail proves the healing actually exercised.
+        key = f"{family}_chaos_goodput_ratio"
+        try:
+            r = run_tool(["--family", family, "--mode", "chaos"],
+                         timeout=1800)
+            out[key] = r["chaos_goodput_ratio"]
+            out[f"{family}_chaos_slo_goodput"] = r["chaos_slo_goodput"]
+            out[f"{family}_chaos_detail"] = {
+                k: r.get(k) for k in ("baseline_slo_goodput",
+                                      "resumed_streams",
+                                      "lb_stream_resumes",
+                                      "resume_gap", "chaos_errors",
+                                      "kill_at_s", "offered_qps",
+                                      "requests", "schedule_sha256")}
+        except Exception as e:  # noqa: BLE001
+            out[key] = None
+            out[f"{key}_error"] = str(e)[:200]
         # Tensor-parallel engine leg (serve/gang_replica.py): the
         # sharded-replica code path — params by param_specs, KV cache
         # by cache_specs over a tp=2 mesh — under the same ragged mix

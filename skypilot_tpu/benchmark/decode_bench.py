@@ -46,6 +46,13 @@ Two measurements:
     (benchmark/loadgen.py): goodput under declared TTFT/TPOT SLOs,
     p99 TTFT, and achieved tok/s under Poisson load — the
     bench_compare-gated serving-SLO leg.
+  * ``measure_engine_chaos`` — the SLO leg with TWO replicas and a
+    hard replica kill mid-run: a kill-free baseline pass, then the
+    same schedule with one replica's engine + server torn down at
+    ``kill_at_frac`` of the run. In-flight streams on the dead
+    replica heal through the LB's journal resume; the gated headline
+    is ``chaos_goodput_ratio`` (chaos goodput / baseline goodput,
+    the durable-streams "within 5% of kill-free" contract).
 
 Models are scaled to fit one v5e chip (full 8x7B / 8B need a pod
 slice).
@@ -962,6 +969,157 @@ def measure_engine_slo(family: str, *, slots: int = 8,
         "loadgen_tok_s": report["tokens"]["tok_s"],
         "schedule_sha256": report["schedule_sha256"],
         "report_dir": report["out_dir"],
+    }
+
+
+def measure_engine_chaos(family: str, *, slots: int = 8,
+                         qps: float = 6.0, duration_s: float = 8.0,
+                         seed: int = 0, slo_ttft_s: float = 3.0,
+                         slo_tpot_s: float = 0.5,
+                         max_tokens: int = 16,
+                         kill_at_frac: float = 0.5,
+                         **shape_kw) -> Dict[str, Any]:
+    """Durable-streams chaos leg: the SLO leg's data plane with TWO
+    replicas, run twice on the same schedule — once kill-free
+    (baseline), once with replica A's engine and HTTP server torn
+    down ``kill_at_frac`` into the run (the in-process equivalent of
+    a SIGKILL: in-flight streams drop without ``[DONE]``, new
+    connects are refused). The LB's stream journal resumes the broken
+    streams on replica B and its breaker ejects A for the
+    pre-first-byte traffic, so goodput should barely move — the
+    reported ``chaos_goodput_ratio`` (chaos / baseline goodput) is
+    the "within 5% of kill-free" durable-streams contract, gated
+    higher-is-better by bench_compare alongside the absolute
+    ``chaos_slo_goodput``. ``resumed_streams`` > 0 is what separates
+    "healed by resume" from "nothing was in flight when A died".
+    """
+    import json
+    import tempfile
+    import threading
+    import urllib.request
+
+    from skypilot_tpu.benchmark import loadgen
+    from skypilot_tpu.recipes import serve_llm
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.serve.load_balancing_policies import (
+        PrefixAffinityPolicy)
+    from skypilot_tpu.serve.replica_managers import _free_port
+
+    mdl, cfg = build(family, **shape_kw)
+    params = mdl.init(cfg, jax.random.key(0))
+    lb_port = _free_port()
+    servers = []
+    urls = []
+    for _ in range(2):
+        port = _free_port()
+        httpd = serve_llm.serve(cfg, params, port, engine_slots=slots)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        servers.append(httpd)
+        urls.append(f"http://127.0.0.1:{port}")
+
+    deadline = time.time() + 600
+    pending = list(urls)
+    while pending and time.time() < deadline:
+        url = pending[0]
+        try:
+            with urllib.request.urlopen(url + "/health",
+                                        timeout=2) as resp:
+                if resp.status == 200:
+                    pending.pop(0)
+                    continue
+        except Exception:  # noqa: stpu-except — warming; poll again
+            pass
+        time.sleep(0.2)
+    if pending:
+        raise RuntimeError("replica never became healthy")
+
+    spec = loadgen.LoadSpec(
+        mix="chat", arrival="poisson", qps=qps, duration_s=duration_s,
+        seed=seed, max_tokens=max_tokens,
+        vocab=min(cfg.vocab_size, 32000))
+    # Warm BOTH replicas' full serving paths (same rationale as
+    # measure_engine_slo): a resume landing on a cold peer would
+    # measure the XLA compiler, not the splice.
+    warm_prefix = loadgen._prefixes(spec)[0]
+    for url in urls:
+        for i in range(2):
+            body = json.dumps({"prompt": warm_prefix + [17 + i],
+                               "max_tokens": 2}).encode()
+            warm_req = urllib.request.Request(
+                url + "/generate", data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(warm_req, timeout=600) as resp:
+                resp.read()
+
+    policy = PrefixAffinityPolicy()
+    policy.set_ready_replicas(list(urls))
+    lb = lb_lib.run_load_balancer(lb_port, policy,
+                                  lb_lib.RequestRecorder())
+    lb.RequestHandlerClass.upstream_timeout = 300.0
+    target = f"http://127.0.0.1:{lb_port}"
+    kill_at = max(duration_s * kill_at_frac, 0.1)
+
+    def _kill_replica_a() -> None:
+        # The in-process stand-in for a provider SIGKILL: engine
+        # shutdown drops every in-flight stream mid-token (no [DONE]),
+        # server_close refuses new connects. No drain, no notice.
+        victim = servers[0]
+        if victim.engine is not None:
+            victim.engine.shutdown()
+        victim.shutdown()
+        victim.server_close()
+
+    killer = threading.Timer(kill_at, _kill_replica_a)
+    killer.daemon = True
+    try:
+        baseline = loadgen.run(
+            target, spec, slo_ttft_s=slo_ttft_s,
+            slo_tpot_s=slo_tpot_s, scrape_interval=1.0,
+            out_dir=tempfile.mkdtemp(
+                prefix=f"stpu-chaos-base-{family}-"),
+            request_timeout=300.0)
+        killer.start()
+        chaos = loadgen.run(
+            target, spec, slo_ttft_s=slo_ttft_s,
+            slo_tpot_s=slo_tpot_s, scrape_interval=1.0,
+            out_dir=tempfile.mkdtemp(
+                prefix=f"stpu-chaos-kill-{family}-"),
+            request_timeout=300.0)
+    finally:
+        killer.cancel()
+        lb.shutdown()
+        for httpd in servers:
+            try:
+                if httpd.engine is not None:
+                    httpd.engine.shutdown()
+                httpd.shutdown()
+            except Exception:  # noqa: stpu-except — A is already dead
+                pass
+    base_frac = baseline["goodput"]["fraction"]
+    chaos_frac = chaos["goodput"]["fraction"]
+    server = chaos.get("server", {})
+    return {
+        "model": _model_info(family, cfg, params),
+        "slots": slots,
+        "replicas": 2,
+        "offered_qps": chaos["qps"]["offered"],
+        "requests": chaos["requests"]["scheduled"],
+        "kill_at_s": round(kill_at, 3),
+        "slo_ttft_s": slo_ttft_s,
+        "slo_tpot_s": slo_tpot_s,
+        "baseline_slo_goodput": base_frac,
+        "chaos_slo_goodput": chaos_frac,
+        "chaos_goodput_ratio": round(
+            chaos_frac / max(base_frac, 1e-9), 4),
+        "chaos_errors": chaos["requests"]["error"],
+        "resumed_streams": server.get("resumed_streams", 0.0),
+        "lb_stream_resumes": server.get("lb_stream_resumes", {}),
+        "resume_gap": server.get("resume_gap"),
+        "schedule_sha256": chaos["schedule_sha256"],
+        "baseline_report_dir": baseline["out_dir"],
+        "chaos_report_dir": chaos["out_dir"],
     }
 
 

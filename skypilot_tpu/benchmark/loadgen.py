@@ -701,6 +701,23 @@ def _build_report(spec, schedule, digest, results, wall, scraper,
     by_code = scraper.counter_by_label("stpu_lb_requests_total", "code")
     if by_code:
         server["lb_requests_by_code"] = by_code
+    # Durable-stream accounting: how many mid-stream upstream deaths
+    # the LB healed by resuming on a peer (outcome="ok"), plus the
+    # client-observed stall each splice cost (death -> first resumed
+    # byte). A chaos leg asserts on these; a kill-free run reports 0.
+    resumes = scraper.counter_by_label(
+        "stpu_lb_stream_resumes_total", "outcome")
+    server["resumed_streams"] = resumes.get("ok", 0.0)
+    if resumes:
+        server["lb_stream_resumes"] = resumes
+    gap_hist = scraper.histogram_delta("stpu_lb_resume_gap_seconds")
+    if gap_hist is not None and gap_hist.count > 0:
+        server["resume_gap"] = {
+            "count": gap_hist.count,
+            "p50": round(gap_hist.quantile(0.50), 6),
+            "p90": round(gap_hist.quantile(0.90), 6),
+            "p99": round(gap_hist.quantile(0.99), 6),
+        }
 
     offered = n_sched / spec.duration_s
     return {
@@ -805,6 +822,17 @@ def format_report(report: Dict[str, Any]) -> str:
         f"lb         retries {server.get('lb_retries', 0):g}  breaker "
         f"ejections {server.get('lb_breaker_ejections', 0):g}  scrapes "
         f"{server.get('scrapes', 0)}")
+    if server.get("lb_stream_resumes"):
+        outcomes = ", ".join(
+            f"{k}={v:g}" for k, v in
+            sorted(server["lb_stream_resumes"].items()))
+        line = (f"resumes    {server.get('resumed_streams', 0):g} "
+                f"streams resumed mid-flight ({outcomes})")
+        if server.get("resume_gap"):
+            g = server["resume_gap"]
+            line += (f"  gap p50 {g['p50'] * 1000:.1f}ms"
+                     f"  p99 {g['p99'] * 1000:.1f}ms")
+        lines.append(line)
     if server.get("replica_topology"):
         labels = ", ".join(t["label"]
                            for t in server["replica_topology"])

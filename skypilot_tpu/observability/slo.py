@@ -17,7 +17,8 @@ histogram — the client-observed TTFT including queueing, retries and
 upstream delays); a ``tpot`` decode step under ``threshold_seconds``
 (``stpu_engine_step_seconds{phase="decode"}``, present when replicas
 run with STPU_STEPSTATS=1); an ``error_rate`` request that did not
-fail (non-5xx/non-aborted ``stpu_lb_requests_total``).
+fail (non-5xx/non-upstream_aborted ``stpu_lb_requests_total``; a
+``client_closed`` hang-up is the client's doing, not an error).
 
 **Burn rate** (the Google-SRE multiwindow definition): over a window
 W, ``burn = bad_fraction / (1 - target)`` — the rate at which the
@@ -176,7 +177,14 @@ class SloMonitor:
             bad = 0.0
             for labels in self.store.labels_for(_ERROR_FAMILY):
                 code = labels.get("code", "")
-                if code.startswith("5") or code in ("0", "aborted"):
+                # upstream_aborted = a replica died mid-stream and the
+                # resume ladder could not heal it — our failure.
+                # client_closed = the CLIENT hung up mid-stream; not
+                # charged (burning error budget on closed tabs would
+                # page operators for user behavior). "aborted" is the
+                # pre-split legacy code, kept bad for old stores.
+                if code.startswith("5") or code in (
+                        "0", "aborted", "upstream_aborted"):
                     bad += self.store.window_delta(
                         _ERROR_FAMILY, window, now, **labels) or 0.0
             frac = bad / total

@@ -48,6 +48,7 @@ from skypilot_tpu.serve import decode_engine
 from skypilot_tpu.serve import gang_replica
 from skypilot_tpu.serve import load_balancing_policies
 from skypilot_tpu.train import distributed
+from skypilot_tpu.utils import fault_injection
 
 
 # Request limits: prompt/decode lengths are padded to buckets so the jit
@@ -110,6 +111,16 @@ ENGINE_SPEC_MIN_ACCEPT = float(
 # fast wedged-device detection should be vs. the slowest honest step.
 STREAM_TIMEOUT_SECONDS = float(
     os.environ.get("STPU_STREAM_TIMEOUT", "600"))
+# Preemption-notice watcher poll interval (seconds): how often the
+# replica checks the provider's metadata preemption signal (the fault
+# point ``replica.preempt_notice`` stands in for the metadata server in
+# tests and game-days). On a notice the replica KEEPS serving — it only
+# advertises the notice via /health so the controller can flip it
+# DRAINING and launch the replacement BEFORE the kill lands
+# (replace-ahead); in-flight streams resume on peers through the LB
+# journal when the kill arrives. 0 disables the watcher.
+PREEMPT_NOTICE_POLL = float(
+    os.environ.get("STPU_PREEMPT_NOTICE_POLL", "1.0"))
 # Engine supervision (decode_engine.EngineSupervisor): restart a
 # crashed engine loop this many times (capped exponential backoff
 # starting at BACKOFF seconds) before declaring the replica dead.
@@ -126,6 +137,11 @@ _TOPOLOGY_INFO = metrics.gauge(
     "stpu_replica_topology_info",
     "Replica serving topology (hosts x tensor-parallel degree); "
     "value is constant 1.", ("hosts", "tp"))
+_PREEMPT_NOTICES = metrics.counter(
+    "stpu_serve_preempt_notices_total",
+    "Provider preemption notices observed by the replica's metadata "
+    "watcher (fault point replica.preempt_notice); each one is a "
+    "replace-ahead trigger for the controller.")
 
 
 def _ceil_to(n: int, b: int) -> int:
@@ -238,7 +254,15 @@ class _Handler(BaseHTTPRequestHandler):
                 # blackholes its share of traffic.
                 self._json(503, {"status": "engine_down"})
             else:
-                self._json(200, {"status": "ok"})
+                payload = {"status": "ok"}
+                notice = ctx.get("preempt_notice")
+                if notice is not None and notice.is_set():
+                    # Preemption notice observed: the replica is still
+                    # fully serving (200), but the controller's probe
+                    # reads this flag and flips the replica DRAINING —
+                    # replace-ahead, before the kill ever lands.
+                    payload["preempt_notice"] = True
+                self._json(200, payload)
         elif self.path == "/drain":
             self._json(200, self._drain_payload())
         elif self.path == "/perf":
@@ -433,10 +457,36 @@ class _Handler(BaseHTTPRequestHandler):
             seed = int(req.get("seed", 0)) & 0xFFFFFFFF
             ctx = self.server_ctx
             stream = bool(req.get("stream"))
+            # LB mid-stream resume contract: ``resume.emitted`` are the
+            # tokens the client already received (they become a prompt
+            # extension in the engine), ``resume.pos`` the absolute
+            # emission position to continue from. The engine's
+            # fold_in(seed, position) sampling keys make the
+            # continuation bit-identical to the uninterrupted run.
+            resume = None
+            rd = req.get("resume")
+            if rd is not None:
+                if not isinstance(rd, dict):
+                    raise ValueError("resume must be an object")
+                resume = [int(t) for t in rd.get("emitted") or []]
+                if not resume:
+                    raise ValueError("resume.emitted must be non-empty")
+                if int(rd.get("pos", -1)) != len(resume):
+                    raise ValueError(
+                        "resume.pos must equal len(resume.emitted)")
+                if len(resume) >= mt:
+                    raise ValueError(
+                        "resume.emitted already covers max_tokens")
         except (KeyError, ValueError, TypeError) as e:
             self._json(400, {"error": str(e)})
             return
         engine = ctx.get("engine")
+        if resume is not None and engine is None:
+            # The legacy locked path has no absolute-position sampling
+            # contract to resume into; only engine replicas honor it.
+            self._json(400, {"error": "resume requires the decode "
+                                      "engine (engine_slots > 0)"})
+            return
         # Replica hop of the request's trace, continued from the LB's
         # X-STPU-Trace header (tracing.ENABLED guard = zero tracing
         # cost unarmed); the engine parents its queue/prefill/decode
@@ -448,6 +498,7 @@ class _Handler(BaseHTTPRequestHandler):
                 parent=tracing.extract(self.headers),
                 attrs={"prompt_tokens": len(prompt), "max_tokens": mt,
                        "stream": stream,
+                       "resume": len(resume) if resume else 0,
                        "engine": engine is not None})
         # Legacy-path in-flight accounting (the engine tracks its own):
         # GET /drain must see requests this handler is still streaming.
@@ -457,7 +508,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if engine is not None:
                 self._engine_generate(engine, prompt, mt, temperature,
-                                      seed, stream, span)
+                                      seed, stream, span, resume)
             else:
                 self._legacy_generate(ctx, prompt, mt, temperature,
                                       seed, stream, span)
@@ -482,9 +533,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ----------------------------------------------------- engine path
     def _engine_generate(self, engine, prompt, mt, temperature, seed,
-                         stream, span=None) -> None:
+                         stream, span=None, resume=None) -> None:
         gang = self.server_ctx.get("gang")
         trace = span.context() if span is not None else None
+        # Resume admission: ``mt`` is the ORIGINAL request budget — the
+        # engine re-prefills the emitted tokens as a prompt extension
+        # and regenerates only the remainder, emitting from the same
+        # absolute positions (same seed) the dead upstream would have.
+        remaining = mt - (len(resume) if resume else 0)
         if gang is not None:
             # Mirror the admission (prompt + sampling seed) to every
             # follower host BEFORE the local submit, so all hosts see
@@ -496,15 +552,16 @@ class _Handler(BaseHTTPRequestHandler):
             # state, and on a real ICI-federated slice a mismatched
             # SPMD program.
             with self.server_ctx["gang_admit_lock"]:
-                gang.broadcast_generate(prompt, mt, temperature, seed,
-                                        trace=trace)
-                req = engine.submit(prompt, max_tokens=mt,
+                gang.broadcast_generate(prompt, remaining, temperature,
+                                        seed, trace=trace,
+                                        resume=resume)
+                req = engine.submit(prompt, max_tokens=remaining,
                                     temperature=temperature, seed=seed,
-                                    trace=trace)
+                                    trace=trace, resume=resume)
         else:
-            req = engine.submit(prompt, max_tokens=mt,
+            req = engine.submit(prompt, max_tokens=remaining,
                                 temperature=temperature, seed=seed,
-                                trace=trace)
+                                trace=trace, resume=resume)
         timeout = self.server_ctx["stream_timeout"]
         if not stream:
             self._json(200, {"tokens": req.result(timeout=timeout)})
@@ -527,7 +584,8 @@ class _Handler(BaseHTTPRequestHandler):
         except StopIteration:
             self._json(200, {"tokens": []})
             return
-        self._sse(req, [first], it, span)
+        self._sse(req, [first], it, span,
+                  resume_len=len(resume) if resume else 0)
 
     # ----------------------------------------------------- legacy path
     def _legacy_generate(self, ctx, prompt, mt, temperature, seed,
@@ -568,7 +626,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._sse(None, [int(tok[0])], tokens(), span)
 
     # ------------------------------------------------------------- SSE
-    def _sse(self, req, first_tokens, rest_iter, span=None) -> None:
+    def _sse(self, req, first_tokens, rest_iter, span=None,
+             resume_len: int = 0) -> None:
         """SSE token stream: one `data: {"token": N}` event per decoded
         token, flushed as produced (chunked transfer), then
         `data: [DONE]` — the OpenAI-style contract LLM clients expect.
@@ -579,6 +638,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
+        if resume_len:
+            # Acknowledges the resume admission to the splicing LB:
+            # this stream's first event is the token at absolute
+            # position ``resume_len``, not position 0.
+            self.send_header("X-STPU-Resume", str(resume_len))
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
 
@@ -611,6 +675,31 @@ class _Handler(BaseHTTPRequestHandler):
                                     status="error",
                                     attrs={"tokens": sent,
                                            "aborted": True})
+
+
+def preempt_notice_watch(notice: threading.Event,
+                         poll: float = None) -> None:
+    """Watch the provider's preemption metadata signal.
+
+    Real deployments poll the cloud metadata endpoint (e.g. the GCE
+    ``instance/preempted`` key); this repro's signal source is the
+    fault point ``replica.preempt_notice`` — an injected fault IS the
+    notice, which makes the whole replace-ahead path game-day drivable.
+    On a notice: set the shared event (surfaced via /health as
+    ``preempt_notice: true``) and stop — the notice is terminal for
+    this replica's lifetime; the controller takes it from there.
+    """
+    if poll is None:
+        poll = PREEMPT_NOTICE_POLL
+    while not notice.is_set():
+        try:
+            if fault_injection.ENABLED:
+                fault_injection.fire("replica.preempt_notice")
+        except fault_injection.InjectedFault:
+            notice.set()
+            _PREEMPT_NOTICES.inc()
+            return
+        time.sleep(poll)
 
 
 def serve(cfg: llama.LlamaConfig, params, port: int,
@@ -689,6 +778,7 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
            "stream_timeout": float(stream_timeout),
            "draining": threading.Event(), "gang": gang,
            "gang_admit_lock": threading.Lock(),
+           "preempt_notice": threading.Event(),
            "inflight": [0], "inflight_lock": threading.Lock()}
     _TOPOLOGY_INFO.labels(
         hosts=str(topology.hosts if topology else 1),
@@ -739,6 +829,10 @@ def serve(cfg: llama.LlamaConfig, params, port: int,
         ctx["ready"].set()
 
     threading.Thread(target=warmup, daemon=True).start()
+    if PREEMPT_NOTICE_POLL > 0:
+        threading.Thread(target=preempt_notice_watch,
+                         args=(ctx["preempt_notice"],),
+                         daemon=True, name="preempt-watch").start()
     return httpd
 
 

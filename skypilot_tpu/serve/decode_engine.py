@@ -212,6 +212,11 @@ _SPEC_ACCEPT_RATE = metrics.histogram(
     "stpu_engine_spec_accept_rate",
     "Per-verify-step draft acceptance rate (accepted / drafted).",
     buckets=(0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0))
+_RESUME_ADMITS = metrics.counter(
+    "stpu_engine_resume_admissions_total",
+    "Requests admitted with a resume extension (prior-emitted tokens "
+    "prefilled as prompt, emission continuing at the original "
+    "absolute position).")
 _RESTARTS = metrics.counter(
     "stpu_engine_restarts_total",
     "Engine restarts by the supervisor after a compute-loop crash.")
@@ -231,8 +236,20 @@ class Request:
     """One in-flight generation; tokens arrive on an internal queue."""
 
     def __init__(self, prompt: List[int], max_tokens: int,
-                 temperature: float, seed: int, trace=None):
+                 temperature: float, seed: int, trace=None,
+                 resume=None):
         self.prompt = [int(t) for t in prompt]
+        # Resume admission: prior-emitted tokens become a prompt
+        # extension. The sampling key for the token at absolute
+        # position p is fold_in(fold_in(root, seed), p) regardless of
+        # where prompt ends and generation begins, so prefilling
+        # prompt + emitted and decoding with the ORIGINAL seed
+        # continues the stream bit-identically from position
+        # len(prompt) + len(resume). max_tokens stays "tokens still to
+        # generate" — the caller subtracts what was already emitted.
+        self.resume_len = len(resume) if resume else 0
+        if resume:
+            self.prompt.extend(int(t) for t in resume)
         self.max_tokens = int(max_tokens)
         self.temperature = float(temperature)
         self.seed = int(seed) & 0xFFFFFFFF
@@ -850,15 +867,27 @@ class DecodeEngine:
         return self
 
     def submit(self, prompt, max_tokens: int, temperature: float = 0.0,
-               seed: int = 0, trace=None) -> Request:
+               seed: int = 0, trace=None, resume=None) -> Request:
         """Enqueue a generation; returns the Request handle (stream()
         or result()). Raises EngineError on invalid size, full queue,
         or a dead engine. ``trace`` is an optional tracing.SpanContext
-        to parent the engine's per-phase spans under."""
+        to parent the engine's per-phase spans under.
+
+        ``resume`` (list of previously-emitted token ids) admits a
+        mid-stream continuation: the tokens prefill as a prompt
+        extension — through the prefix trie / host tier like any
+        prompt, zero-copy where the blocks survive — and emission
+        starts at absolute position len(prompt) + len(resume) under
+        the ORIGINAL seed, so the continuation is bit-identical to the
+        uninterrupted run. ``max_tokens`` is the REMAINING budget."""
         req = Request(prompt, max_tokens, temperature, seed,
-                      trace=trace)
+                      trace=trace, resume=resume)
         if not req.prompt:
             raise EngineError("empty prompt")
+        if req.max_tokens < 1:
+            raise EngineError("max_tokens must be >= 1")
+        if req.resume_len:
+            _RESUME_ADMITS.inc()
         if self._paged:
             # Under paging the admission bound is POOL CAPACITY, not a
             # per-slot row length: a request fits if its worst-case
@@ -1859,7 +1888,7 @@ class EngineSupervisor:
         return engine is not None and engine._failed is None
 
     def submit(self, prompt, max_tokens: int, temperature: float = 0.0,
-               seed: int = 0, trace=None) -> Request:
+               seed: int = 0, trace=None, resume=None) -> Request:
         if self.permanently_down:
             raise EngineError(
                 f"engine permanently down after {self.max_restarts} "
@@ -1870,7 +1899,7 @@ class EngineSupervisor:
         # A dead/restarting engine raises its own clean EngineError.
         return engine.submit(prompt, max_tokens=max_tokens,
                              temperature=temperature, seed=seed,
-                             trace=trace)
+                             trace=trace, resume=resume)
 
     def warmup(self) -> None:
         engine = self._engine

@@ -390,16 +390,22 @@ class GangLeader:
 
     def broadcast_generate(self, prompt, max_tokens: int,
                            temperature: float, seed: int,
-                           trace=None) -> None:
+                           trace=None, resume=None) -> None:
         """Mirror one admitted request (+ its sampling seed) to every
         follower so each host executes the identical jitted submission.
+        A resume admission (prior-emitted tokens re-submitted after a
+        mid-stream failure) rides the same broadcast, so followers
+        prefill the identical extended prompt and stay in lockstep.
         Recorded as the request's ``gang.run`` hop when traced."""
         t0 = time.perf_counter()
-        self._broadcast({"op": "generate",
-                         "prompt": [int(t) for t in prompt],
-                         "max_tokens": int(max_tokens),
-                         "temperature": float(temperature),
-                         "seed": int(seed)})
+        msg = {"op": "generate",
+               "prompt": [int(t) for t in prompt],
+               "max_tokens": int(max_tokens),
+               "temperature": float(temperature),
+               "seed": int(seed)}
+        if resume:
+            msg["resume"] = [int(t) for t in resume]
+        self._broadcast(msg)
         if tracing.ENABLED and trace is not None and trace.sampled:
             tracing.record_span(
                 "gang.run", "gang", trace, start_mono=t0,
@@ -779,7 +785,8 @@ def follower_serve(engine_factory: Callable[[], Any], topology:
                         msg["prompt"],
                         max_tokens=msg["max_tokens"],
                         temperature=msg.get("temperature", 0.0),
-                        seed=msg.get("seed", 0))
+                        seed=msg.get("seed", 0),
+                        resume=msg.get("resume"))
                 except Exception:  # noqa: stpu-except — the leader's own submit failed identically and answered the client; the mirror must not die over it
                     continue
                 threading.Thread(target=_drain_request, args=(req,),

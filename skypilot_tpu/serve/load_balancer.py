@@ -66,6 +66,19 @@ _RETRIES = metrics.counter(
     "stpu_lb_upstream_retries_total",
     "Upstream attempts re-routed to another replica after a "
     "pre-first-byte failure.")
+_RESUMES = metrics.counter(
+    "stpu_lb_stream_resumes_total",
+    "Mid-stream resume outcomes: ok (continuation spliced to [DONE]), "
+    "failed (a resume attempt died), exhausted (budget spent without "
+    "completing), no_replica (no peer left to resume on), evicted "
+    "(journal over the byte cap — stream degraded to plain abort), "
+    "client_closed (client died mid-splice).", ("outcome",))
+_RESUME_GAP = metrics.histogram(
+    "stpu_lb_resume_gap_seconds",
+    "Client-visible stream stall during a mid-stream resume: upstream "
+    "death to first spliced continuation byte (re-pick + re-prefill "
+    "of the emitted prefix on the peer).",
+    buckets=metrics.LATENCY_BUCKETS)
 _BREAKER_STATE = metrics.gauge(
     "stpu_lb_breaker_state",
     "Per-replica circuit-breaker state: 0=closed 1=open 2=half-open.",
@@ -75,8 +88,9 @@ _BREAKER_EJECTIONS = metrics.counter(
     "Replica ejections by the circuit breaker (closed -> open "
     "transitions).", ("replica",))
 
-# Bounded retry for PRE-first-byte upstream failures (a mid-stream
-# abort is never retried: the status line already went out). Default 2
+# Bounded retry for PRE-first-byte upstream failures (after the first
+# byte the status line already went out, so a full retry would corrupt
+# the stream — that is what the resume journal below is for). Default 2
 # extra attempts, each on a different replica.
 DEFAULT_MAX_RETRIES = int(os.environ.get("STPU_LB_RETRIES", "2"))
 # Reject request bodies above this before buffering them (413): the LB
@@ -84,6 +98,21 @@ DEFAULT_MAX_RETRIES = int(os.environ.get("STPU_LB_RETRIES", "2"))
 # client must not be able to OOM the proxy.
 DEFAULT_MAX_BODY_BYTES = int(os.environ.get(
     "STPU_LB_MAX_BODY_BYTES", str(10 * 1024 * 1024)))
+# Mid-stream resume budget: when a REPLICA dies mid-SSE (not the
+# client), the LB re-submits prompt + emitted-so-far to a peer with the
+# `resume` contract and splices the continuation into the same client
+# stream — at most this many times per request. The engine's
+# fold_in(seed, absolute_position) sampling keys make the continuation
+# bit-identical to the uninterrupted run. 0 disables journaling +
+# resume entirely (streams degrade to the pre-resume clean abort).
+DEFAULT_STREAM_RESUMES = int(os.environ.get(
+    "STPU_LB_STREAM_RESUMES", "1"))
+# Global cap (MiB) on resume-journal memory across ALL in-flight
+# streams. A stream whose journal cannot charge the budget is EVICTED:
+# it keeps streaming but an upstream death degrades to the plain
+# abort (outcome="evicted" on stpu_lb_stream_resumes_total).
+DEFAULT_RESUME_JOURNAL_MB = float(os.environ.get(
+    "STPU_LB_RESUME_JOURNAL_MB", "8"))
 
 
 class CircuitBreaker:
@@ -229,10 +258,130 @@ def _is_timeout(exc: BaseException) -> bool:
 class _UpstreamAborted(Exception):
     """Mid-stream failure attributable to the REPLICA (the upstream
     read died), as opposed to the client hanging up (a write-side
-    error). The distinction matters to the circuit breaker: a replica
-    that accepts connections and dies mid-generation must accumulate
-    failures, while a client closing its SSE tab must not be charged
-    to the replica."""
+    error). The distinction matters twice over: the circuit breaker
+    charges a replica that accepts connections and dies mid-generation
+    (never a client closing its SSE tab), and the resume journal only
+    splices a continuation for upstream deaths — a gone client has
+    nothing left to resume for."""
+
+
+def _sse_token(event: bytes) -> Optional[int]:
+    """The token id carried by one complete SSE event (``data:
+    {"token": N}\\n\\n``), or None for [DONE] / non-token events."""
+    if not event.startswith(b"data: "):
+        return None
+    payload = event[6:].strip()
+    if payload == b"[DONE]":
+        return None
+    try:
+        return int(json.loads(payload)["token"])
+    except (ValueError, KeyError, TypeError):
+        return None
+
+
+class JournalAccount:
+    """Global byte budget shared by every live stream journal — the
+    resume feature's memory footprint is bounded regardless of how
+    many long streams are in flight at once."""
+
+    def __init__(self, cap_bytes: Optional[int] = None):
+        self.cap = cap_bytes if cap_bytes is not None else int(
+            DEFAULT_RESUME_JOURNAL_MB * 1024 * 1024)
+        self._lock = threading.Lock()
+        self._bytes = 0
+
+    def charge(self, n: int) -> bool:
+        with self._lock:
+            if self._bytes + n > self.cap:
+                return False
+            self._bytes += n
+            return True
+
+    def release(self, n: int) -> None:
+        with self._lock:
+            self._bytes = max(0, self._bytes - n)
+
+    def used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+
+class StreamJournal:
+    """Per-request resume state while the LB proxies an SSE stream.
+
+    Holds everything needed to re-submit the generation to a peer if
+    the upstream dies mid-stream: the original request document
+    (prompt, seed, temperature, max_tokens) plus every token event
+    already forwarded to the client. ``resume_body()`` re-materializes
+    the request with the `resume: {emitted, pos}` extension; the
+    engine re-prefills the emitted tokens and continues emitting at
+    the same absolute positions with the original seed, so the splice
+    is bit-identical to the uninterrupted run.
+
+    Memory is charged against the shared :class:`JournalAccount`; a
+    charge failure EVICTS the journal (the stream keeps proxying but
+    can no longer resume — bounded memory beats unbounded promises).
+    """
+
+    TOKEN_BYTES = 8  # conservative per-token journal cost estimate
+
+    def __init__(self, request: dict, doc: dict, budget: int,
+                 account: JournalAccount):
+        self.request = request  # routing dict {path, body}
+        self.doc = doc  # parsed original /generate body
+        self.budget = budget  # resume attempts remaining
+        self.account = account
+        self.tried: Set[str] = set()
+        self.emitted: List[int] = []
+        self.evicted = False
+        self._charged = 0
+        if not self._charge(len(request.get("body") or b"") + 64):
+            self.evict()
+
+    def _charge(self, n: int) -> bool:
+        if not self.account.charge(n):
+            return False
+        self._charged += n
+        return True
+
+    def append(self, tok: int) -> None:
+        if self.evicted:
+            return
+        if not self._charge(self.TOKEN_BYTES):
+            self.evict()
+            return
+        self.emitted.append(tok)
+
+    def evict(self) -> None:
+        if not self.evicted:
+            self.evicted = True
+            _RESUMES.labels(outcome="evicted").inc()
+            self.release()
+
+    def release(self) -> None:
+        if self._charged:
+            self.account.release(self._charged)
+            self._charged = 0
+
+    def can_resume(self) -> bool:
+        return not self.evicted and self.budget > 0
+
+    def resume_body(self) -> bytes:
+        """The re-submission payload: the original request before any
+        token went out (plain re-submit — nothing to dedupe), the
+        `resume` extension after."""
+        if not self.emitted:
+            return self.request["body"]
+        doc = dict(self.doc)
+        doc["resume"] = {"emitted": list(self.emitted),
+                         "pos": len(self.emitted)}
+        return json.dumps(doc).encode()
+
+
+# Journal budget for bare handler subclasses that don't provision
+# their own (run_load_balancer / run_lb_process install a fresh one
+# per server so tests and multi-LB processes stay isolated).
+_GLOBAL_JOURNAL_ACCOUNT = JournalAccount()
 
 
 class RequestRecorder:
@@ -269,6 +418,10 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
     breaker: Optional[CircuitBreaker] = None
     max_retries: int = DEFAULT_MAX_RETRIES
     max_body_bytes: int = DEFAULT_MAX_BODY_BYTES
+    # Mid-stream resume: attempts per request (0 disables journaling)
+    # and the shared journal byte budget (None = module-global).
+    max_stream_resumes: int = DEFAULT_STREAM_RESUMES
+    journal_account: Optional[JournalAccount] = None
     # Per-service upstream (replica) timeout; the sync loop overwrites
     # this from the controller's spec (service_spec.py
     # upstream_timeout_seconds) so slow-first-byte services (cold model
@@ -442,18 +595,22 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
         try:
             self._proxy_inner(method, stats, span)
         finally:
-            # A replica dying mid-stream already sent the upstream's
-            # 2xx status line — record it as "aborted", not a clean
-            # 200, or a crash wave reads as healthy traffic.
-            code = ("aborted" if stats.get("aborted")
+            # A stream dying after the upstream's 2xx status line went
+            # out must not read as a clean 200 — and WHO died matters:
+            # "upstream_aborted" (replica death the resume ladder could
+            # not heal) is an error the SLO burn charges us for;
+            # "client_closed" (the client hung up) is not our failure.
+            aborted = (stats.get("upstream_aborted")
+                       or stats.get("client_closed"))
+            code = ("upstream_aborted" if stats.get("upstream_aborted")
+                    else "client_closed" if stats.get("client_closed")
                     else str(stats["code"] or 0))
             _REQUESTS.labels(method=method, code=code).inc()
             _LATENCY.labels(code=code).observe(
                 time.perf_counter() - t0)
             _STREAMED.observe(stats["bytes"])
             if span is not None:
-                span.end(status=("error" if stats.get("aborted")
-                                 else "ok"),
+                span.end(status=("error" if aborted else "ok"),
                          code=code, bytes=stats["bytes"])
 
     def _send_plain(self, code: int, payload: bytes,
@@ -502,50 +659,84 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
         # (prefix affinity) route on the request payload.
         body = self.rfile.read(length) if length else None
         request = {"path": self.path, "body": body}
+        journal = self._maybe_journal(method, body, request)
         tried: Set[str] = set()
         attempts = 1 + max(self.max_retries, 0)
-        for attempt in range(attempts):
-            target = self._pick_replica(request, tried, span)
-            if target is None:
-                break
-            if attempt:
-                _RETRIES.inc()
+        try:
+            for attempt in range(attempts):
+                target = self._pick_replica(request, tried, span)
+                if target is None:
+                    break
+                if attempt:
+                    _RETRIES.inc()
+                    if span is not None:
+                        span.event("retry", attempt=attempt,
+                                   target=target)
                 if span is not None:
-                    span.event("retry", attempt=attempt,
-                               target=target)
-            if span is not None:
-                # The policy decision, annotated on every attempt: who
-                # was picked, by which policy, excluding whom.
-                span.event("select", target=target, attempt=attempt,
-                           policy=type(self.policy).__name__)
-            tried.add(target)
-            # A retry only helps if another replica is left to try.
-            can_retry = (attempt < attempts - 1 and
-                         any(u not in tried
-                             for u in self._replica_urls()))
-            try:
-                retry = self._proxy_to(target, method, body, stats,
-                                       can_retry, span)
-            finally:
-                # Return the in-flight slot on every exit path (clean,
-                # HTTP error, aborted stream) — least-loaded accounting
-                # must not leak slots or a replica reads as busy
-                # forever.
-                self.policy.report_done(target)
-            if not retry:
-                return
-        if tried:
-            self._send_plain(502, b"Replica unreachable.\n", stats)
-        else:
-            self._send_plain(503, b"No ready replicas.\n", stats)
+                    # The policy decision, annotated on every attempt:
+                    # who was picked, by which policy, excluding whom.
+                    span.event("select", target=target,
+                               attempt=attempt,
+                               policy=type(self.policy).__name__)
+                tried.add(target)
+                if journal is not None:
+                    # The resume re-pick must exclude every replica
+                    # this request already burned, pre-first-byte
+                    # retries included.
+                    journal.tried.add(target)
+                # A retry only helps if another replica is left to try.
+                can_retry = (attempt < attempts - 1 and
+                             any(u not in tried
+                                 for u in self._replica_urls()))
+                try:
+                    retry = self._proxy_to(target, method, body, stats,
+                                           can_retry, span, journal)
+                finally:
+                    # Return the in-flight slot on every exit path
+                    # (clean, HTTP error, aborted stream) —
+                    # least-loaded accounting must not leak slots or a
+                    # replica reads as busy forever.
+                    self.policy.report_done(target)
+                if not retry:
+                    return
+            if tried:
+                self._send_plain(502, b"Replica unreachable.\n", stats)
+            else:
+                self._send_plain(503, b"No ready replicas.\n", stats)
+        finally:
+            if journal is not None:
+                journal.release()
+
+    def _maybe_journal(self, method: str, body: Optional[bytes],
+                       request: dict) -> Optional[StreamJournal]:
+        """A StreamJournal for requests the LB knows how to resume:
+        streaming POST /generate with a parseable JSON body that is
+        not ITSELF a resume re-submission (a resuming upstream LB tier
+        owns that journal). Anything else proxies exactly as before."""
+        if (self.max_stream_resumes <= 0 or method != "POST"
+                or self.path.split("?", 1)[0] != "/generate"
+                or not body):
+            return None
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            return None
+        if (not isinstance(doc, dict) or not doc.get("stream")
+                or doc.get("resume") is not None):
+            return None
+        return StreamJournal(
+            request, doc, self.max_stream_resumes,
+            self.journal_account or _GLOBAL_JOURNAL_ACCOUNT)
 
     def _proxy_to(self, target: str, method: str,
                   body: Optional[bytes], stats: Dict[str, int],
-                  can_retry: bool = False, span=None) -> bool:
+                  can_retry: bool = False, span=None,
+                  journal: Optional[StreamJournal] = None) -> bool:
         """One upstream attempt. Returns True iff the attempt failed
         BEFORE the first response byte reached the client and the
         caller should retry on another replica; in every other case the
-        response (success or error) has been sent."""
+        response (success or error) has been sent — possibly completed
+        by the mid-stream resume ladder when ``journal`` is armed."""
         url = target.rstrip("/") + self.path
         headers = {k: v for k, v in self.headers.items()
                    if k.lower() not in _HOP_HEADERS}
@@ -564,7 +755,7 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
             with urllib.request.urlopen(
                     req, timeout=self.upstream_timeout) as resp:
                 stats["code"] = resp.status
-                self._stream_response(resp, started, stats)
+                self._stream_response(resp, started, stats, journal)
             # Success recorded only after the WHOLE stream proxied:
             # recording at first byte would reset the consecutive count
             # right before a mid-stream failure increments it, so an
@@ -595,15 +786,20 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
         except _UpstreamAborted as e:
             # The REPLICA died mid-stream (upstream read failed —
             # http.client.IncompleteRead on a truncated body, reset,
-            # etc). The response line already went out: a second
-            # response would corrupt the byte stream, so drop the
-            # connection — the truncated body is the one honest signal
-            # left — and charge the replica's breaker (unless it was a
-            # read timeout: slow ≠ dead, see below).
-            stats["aborted"] = True
-            self.close_connection = True
+            # etc). Charge its breaker (unless it was a read timeout:
+            # slow ≠ dead, see below), then try the resume ladder: the
+            # journal re-submits prompt + emitted-so-far to a peer and
+            # splices the continuation into THIS client stream. Only
+            # when that is off/evicted/exhausted does the request
+            # degrade to the honest truncated-stream abort — a second
+            # response would corrupt the bytes, so drop the connection.
             if self.breaker is not None and not _is_timeout(e):
                 self.breaker.record_failure(target)
+            if journal is not None and journal.can_resume():
+                if self._resume_stream(journal, stats, span):
+                    return False
+            stats["upstream_aborted"] = True
+            self.close_connection = True
             return False
         except (urllib.error.URLError, ConnectionError, OSError,
                 TimeoutError, http.client.HTTPException) as e:
@@ -612,8 +808,10 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                 # raw failure after `started` is the CLIENT side dying
                 # (BrokenPipe on our wfile). Abort the proxying but do
                 # NOT charge the replica — a closed SSE tab is not a
-                # replica failure.
-                stats["aborted"] = True
+                # replica failure — and never resume: the journal only
+                # heals upstream deaths; there is no client left to
+                # splice for.
+                stats["client_closed"] = True
                 self.close_connection = True
                 return False
             # Pre-first-byte failure. Timeouts feed the RETRY but not
@@ -634,23 +832,35 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
             self._send_plain(502, b"Replica unreachable.\n", stats)
             return False
 
-    @staticmethod
-    def _read1(resp) -> bytes:
+    def _read1(self, resp, stats: Optional[Dict[str, int]] = None
+               ) -> bytes:
         """Upstream read, with failures re-raised as _UpstreamAborted
         so the caller can tell a dying REPLICA (this) from a dying
-        CLIENT (raw write-side errors)."""
+        CLIENT (raw write-side errors). Fault point ``lb.stream``
+        fires per read (ctx carries the proxied byte count) — the
+        game-day lever that kills a proxied stream after K reads."""
         try:
+            if fault_injection.ENABLED:
+                fault_injection.fire(
+                    "lb.stream",
+                    bytes=(stats or {}).get("bytes", 0))
             return resp.read1(65536)
         except (urllib.error.URLError, ConnectionError, OSError,
                 TimeoutError, http.client.HTTPException) as e:
             raise _UpstreamAborted() from e
 
     def _stream_response(self, resp, started: List[bool],
-                         stats: Dict[str, int]) -> None:
+                         stats: Dict[str, int],
+                         journal: Optional[StreamJournal] = None
+                         ) -> None:
         """Forward the replica's response as chunks ARRIVE (read1 =
         whatever bytes are available), never whole-response buffered.
         Appends to ``started`` before the first write so the caller can
-        tell a clean failure from a mid-stream one."""
+        tell a clean failure from a mid-stream one. With a ``journal``
+        armed the chunked (SSE) path forwards on EVENT boundaries
+        instead of raw reads — the client's received bytes then always
+        end at a whole event, the precondition for splicing a resumed
+        continuation without corrupting the stream."""
         started.append(True)
         if "t0" in stats:
             _TTFB.observe(time.perf_counter() - stats["t0"])
@@ -663,7 +873,7 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
             self.send_header("Content-Length", clen)
             self.end_headers()
             while True:
-                chunk = self._read1(resp)
+                chunk = self._read1(resp, stats)
                 if not chunk:
                     break
                 self.wfile.write(chunk)
@@ -674,13 +884,162 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
             # per chunk so the client sees tokens as they are produced.
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
+            if journal is not None:
+                self._pump_sse(resp, journal, stats)
+                end_chunks(self.wfile)
+                return
             while True:
-                chunk = self._read1(resp)
+                chunk = self._read1(resp, stats)
                 if not chunk:
                     break
                 write_chunk(self.wfile, chunk)
                 stats["bytes"] += len(chunk)
             end_chunks(self.wfile)
+
+    # ------------------------------------------------- mid-stream resume
+    def _pump_sse(self, resp, journal: StreamJournal,
+                  stats: Dict[str, int], skip: int = 0,
+                  gap_t0: Optional[float] = None) -> None:
+        """Forward an SSE upstream event-by-event (buffered to
+        ``\\n\\n`` boundaries), recording every token event into the
+        journal. Returns once the upstream's ``[DONE]`` event has been
+        forwarded; an upstream EOF before [DONE] is a mid-stream death
+        and raises _UpstreamAborted (the replica's own SSE endpoint
+        only terminates cleanly after [DONE]). ``skip`` drops the
+        first N token events — a continuation replica that replayed
+        the overlap instead of honoring `resume` — verifying each
+        against the journal: a mismatched overlap means the peer is
+        NOT reproducing the original stream, and splicing it would
+        corrupt the client bytes."""
+        buf = b""
+        skipped = 0
+        while True:
+            chunk = self._read1(resp, stats)
+            if not chunk:
+                raise _UpstreamAborted()
+            buf += chunk
+            while True:
+                cut = buf.find(b"\n\n")
+                if cut < 0:
+                    break
+                event, buf = buf[:cut + 2], buf[cut + 2:]
+                tok = _sse_token(event)
+                if tok is not None and skipped < skip:
+                    if (skipped >= len(journal.emitted)
+                            or journal.emitted[skipped] != tok):
+                        raise _UpstreamAborted()
+                    skipped += 1
+                    continue
+                if tok is not None:
+                    journal.append(tok)
+                if gap_t0 is not None:
+                    _RESUME_GAP.observe(
+                        time.perf_counter() - gap_t0)
+                    gap_t0 = None
+                write_chunk(self.wfile, event)
+                stats["bytes"] += len(event)
+                if event.strip() == b"data: [DONE]":
+                    return
+
+    def _resume_stream(self, journal: StreamJournal,
+                       stats: Dict[str, int], span=None) -> bool:
+        """The resume ladder: splice continuation(s) from peers into
+        the already-started client stream. Returns True iff the
+        stream's fate was decided here (carried to [DONE], or the
+        CLIENT died mid-splice); False degrades to the plain upstream
+        abort in the caller."""
+        while journal.budget > 0:
+            journal.budget -= 1
+            gap_t0 = time.perf_counter()
+            rspan = None
+            if tracing.ENABLED and span is not None:
+                rspan = tracing.start_span(
+                    "lb.resume", kind="lb", parent=span.context(),
+                    attrs={"pos": len(journal.emitted)})
+            target = self._pick_replica(journal.request, journal.tried,
+                                        rspan or span)
+            if target is None:
+                _RESUMES.labels(outcome="no_replica").inc()
+                if rspan is not None:
+                    rspan.end(status="error", outcome="no_replica")
+                return False
+            journal.tried.add(target)
+            ok = False
+            outcome = "failed"
+            try:
+                ok = self._splice_from(target, journal, stats, gap_t0)
+                outcome = "ok" if ok else "failed"
+            except _UpstreamAborted as e:
+                # The continuation died mid-splice too: charge it and,
+                # budget permitting, go around again — the client's
+                # bytes still end at an event boundary.
+                if self.breaker is not None and not _is_timeout(e):
+                    self.breaker.record_failure(target)
+            except (ConnectionError, OSError, TimeoutError):
+                # Raw write-side failure = the CLIENT died mid-splice.
+                # Nothing left to resume for.
+                stats["client_closed"] = True
+                self.close_connection = True
+                _RESUMES.labels(outcome="client_closed").inc()
+                if rspan is not None:
+                    rspan.end(status="error", outcome="client_closed",
+                              target=target)
+                return True
+            finally:
+                # The resume attempt consumed a policy slot like any
+                # admission.
+                self.policy.report_done(target)
+            _RESUMES.labels(outcome=outcome).inc()
+            if rspan is not None:
+                rspan.end(status="ok" if ok else "error",
+                          outcome=outcome, target=target)
+            if ok:
+                return True
+        _RESUMES.labels(outcome="exhausted").inc()
+        return False
+
+    def _splice_from(self, target: str, journal: StreamJournal,
+                     stats: Dict[str, int], gap_t0: float) -> bool:
+        """One resume attempt against ``target``: re-submit the
+        journaled request with the `resume` extension and pump the
+        continuation into the client stream. Returns True iff the
+        continuation reached [DONE] (client terminator sent); False
+        for a clean upstream refusal (connect failure / non-200).
+        Raises _UpstreamAborted if the continuation itself died
+        mid-splice; raw OSErrors are client-side write failures and
+        propagate to the caller."""
+        url = target.rstrip("/") + self.path
+        headers = {k: v for k, v in self.headers.items()
+                   if k.lower() not in _HOP_HEADERS}
+        headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(url, data=journal.resume_body(),
+                                     headers=headers, method="POST")
+        try:
+            resp_ctx = urllib.request.urlopen(
+                req, timeout=self.upstream_timeout)
+        except urllib.error.HTTPError as e:
+            e.read()
+            # The peer ANSWERED (connect-wise healthy) — it just
+            # refused the resume (e.g. draining). Not a breaker charge.
+            return False
+        except (urllib.error.URLError, ConnectionError, OSError,
+                TimeoutError, http.client.HTTPException) as e:
+            if self.breaker is not None and not _is_timeout(e):
+                self.breaker.record_failure(target)
+            return False
+        with resp_ctx as resp:
+            if resp.status != 200:
+                return False
+            # X-STPU-Resume acknowledges the resume admission: the
+            # first event is already the continuation. A peer that
+            # restarted from position 0 instead replays the overlap —
+            # _pump_sse drops (and verifies) those events.
+            honored = resp.getheader("X-STPU-Resume")
+            skip = 0 if honored else len(journal.emitted)
+            self._pump_sse(resp, journal, stats, skip=skip,
+                           gap_t0=gap_t0)
+            end_chunks(self.wfile)
+            return True
 
     def _serve_fleet(self) -> None:
         """GET /fleet: forwarded to the controller's sync server (the
@@ -749,7 +1108,8 @@ def run_load_balancer(port: int, policy: LoadBalancingPolicy,
     .shutdown() to stop)."""
     handler = type("Handler", (_ProxyHandler,),
                    {"policy": policy, "recorder": recorder,
-                    "breaker": CircuitBreaker()})
+                    "breaker": CircuitBreaker(),
+                    "journal_account": JournalAccount()})
     server = _ThreadingHTTPServer(("0.0.0.0", port), handler)
     server.breaker = handler.breaker  # visible for tests/introspection
     thread = threading.Thread(target=server.serve_forever, daemon=True)
@@ -787,6 +1147,7 @@ def run_lb_process(port: int, controller_url: str,
     handler_cls = type("Handler", (_ProxyHandler,),
                        {"policy": policy, "recorder": recorder,
                         "breaker": breaker,
+                        "journal_account": JournalAccount(),
                         # /fleet forwards to the controller, where the
                         # fleet telemetry store lives.
                         "controller_url": controller_url})

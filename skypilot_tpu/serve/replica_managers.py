@@ -452,7 +452,13 @@ class SkyPilotReplicaManager:
 
     def _probe_one(self, info: ReplicaInfo) -> None:
         spec = info.spec or self.spec
-        ok = self._http_probe(info.url, spec)
+        ok, notice = self._http_probe(info.url, spec)
+        if ok and notice:
+            # Healthy but doomed: the replica's metadata watcher saw
+            # the provider's preemption notice. Replace-ahead instead
+            # of waiting for probe-death detection.
+            self._handle_preempt_notice(info)
+            return
         if ok:
             info.consecutive_failures = 0
             self.consecutive_failure_count = 0
@@ -499,11 +505,38 @@ class SkyPilotReplicaManager:
             # controller's reconcile loop launches a replacement.
             self.scale_down(info.replica_id)
 
+    def _handle_preempt_notice(self, info: ReplicaInfo) -> None:
+        """Replace-ahead on a provider preemption notice.
+
+        The probe found the replica HEALTHY but advertising a
+        preemption notice (serve_llm's metadata watcher). Flip it
+        DRAINING now — ahead of the kill, ahead of probe-death
+        detection: DRAINING is not alive, so the controller's
+        same-tick reconcile launches the replacement immediately, and
+        the LB's next sync stops routing new requests to it. The husk
+        goes through the normal drain teardown; when the provider kill
+        lands mid-drain the drain poll breaks, and the replica's
+        still-open streams are resumed on peers by the LB's journal
+        instead of being drained to the deadline."""
+        with self._lock:
+            if info.status in (ReplicaStatus.DRAINING,
+                               ReplicaStatus.SHUTTING_DOWN):
+                return
+        events.emit("replica",
+                    f"{self.service_name}/{info.replica_id}",
+                    "preempt_notice", service=self.service_name)
+        self.scale_down(info.replica_id, keep_record=True, drain=True)
+
     def _http_probe(self, url: Optional[str],
-                    spec: Optional[SkyServiceSpec] = None) -> bool:
+                    spec: Optional[SkyServiceSpec] = None):
+        """One readiness probe. Returns ``(ok, preempt_notice)``:
+        ``ok`` = the readiness endpoint answered 2xx;
+        ``preempt_notice`` = the reply body carried
+        ``"preempt_notice": true`` (the replica is serving fine but its
+        host has been told it is about to be preempted)."""
         spec = spec or self.spec
         if url is None:
-            return False
+            return False, False
         full = url.rstrip("/") + spec.readiness_path
         try:
             if fault_injection.ENABLED:
@@ -517,10 +550,18 @@ class SkyPilotReplicaManager:
                 req = urllib.request.Request(full)
             with urllib.request.urlopen(
                     req, timeout=PROBE_TIMEOUT_SECONDS) as resp:
-                return 200 <= resp.status < 300
+                ok = 200 <= resp.status < 300
+                notice = False
+                if ok:
+                    try:
+                        notice = bool(json.loads(
+                            resp.read() or b"{}").get("preempt_notice"))
+                    except (ValueError, AttributeError, TypeError):
+                        notice = False  # non-JSON health body
+                return ok, notice
         except (urllib.error.URLError, ConnectionError, OSError,
                 TimeoutError):
-            return False
+            return False, False
 
     def _cluster_healthy(self, cluster_name: str) -> bool:
         record = global_user_state.get_cluster_from_name(cluster_name)

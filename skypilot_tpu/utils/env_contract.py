@@ -179,6 +179,14 @@ _KNOBS = (
        "Breaker half-open re-probe backoff base, seconds."),
     _k("STPU_LB_BREAKER_BACKOFF_CAP", "60",
        "Breaker backoff ceiling, seconds."),
+    _k("STPU_LB_STREAM_RESUMES", "1",
+       "Mid-stream resume attempts per proxied stream: upstream "
+       "deaths after the first byte re-submit prompt+emitted to a "
+       "peer and splice the continuation (0 disables journaling)."),
+    _k("STPU_LB_RESUME_JOURNAL_MB", "8",
+       "Global byte budget (MiB) for in-flight stream resume "
+       "journals; over-budget streams evict (degrade to plain "
+       "abort)."),
     # ------------------------------------------------ serve engine
     _k("STPU_ENGINE_SLOTS", "4",
        "Decode-engine slot count (continuous-batching concurrency)."),
@@ -240,6 +248,10 @@ _KNOBS = (
        "Consecutive fast engine crashes before permanent-down."),
     _k("STPU_ENGINE_RESTART_BACKOFF", "1.0",
        "Engine crash-restart backoff base, seconds."),
+    _k("STPU_PREEMPT_NOTICE_POLL", "1.0",
+       "Replica preemption-notice watcher poll interval, seconds "
+       "(fault point replica.preempt_notice; 0 disables). A notice "
+       "surfaces on /health and triggers controller replace-ahead."),
     # ------------------------------------------------ gang replicas
     _k("STPU_REPLICA_TOPOLOGY", None,
        "hosts x tp replica topology stamped by replica_managers into "

@@ -77,6 +77,17 @@ Known points (callers may add more; names are dotted subsystem.seam):
                       copy of an evicted KV block — a failed spill
                       degrades that eviction to drop-on-evict (the
                       engine never crashes on a tier fault)
+    lb.stream         load_balancer._read1, fired once per upstream
+                      read while proxying a response body — kill a
+                      stream mid-flight after K reads (``skip=K`` +
+                      ``raise``) to drive the LB's journal resume /
+                      upstream_aborted accounting
+    replica.preempt_notice
+                      recipes/serve_llm.preempt_notice_watch — the
+                      injected fault IS the provider's preemption
+                      notice: the replica flips /health to
+                      ``preempt_notice: true`` and the controller
+                      replaces it ahead of the kill
 """
 from __future__ import annotations
 

@@ -338,9 +338,11 @@ def test_lb_retries_503_when_peer_available():
 # ============================================ aborted-stream accounting
 def test_lb_mid_stream_death_counts_aborted_and_returns_slot():
     """Satellite: a replica dying MID-stream is recorded as
-    code="aborted" (not a clean 200), is NOT retried (the status line
-    already went out), and report_done still returns the in-flight
-    slot."""
+    code="upstream_aborted" (not a clean 200, and not the
+    client_closed code — the REPLICA died, the client was still
+    there), is NOT retried (the status line already went out; this is
+    a GET, so the stream journal doesn't apply either), and
+    report_done still returns the in-flight slot."""
 
     class _DieMidStream(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -379,7 +381,7 @@ def test_lb_mid_stream_death_counts_aborted_and_returns_slot():
     lb = lb_lib.run_load_balancer(0, policy, lb_lib.RequestRecorder())
     lb.breaker.threshold = 1       # one mid-stream death must eject
     aborted0 = lb_lib._REQUESTS.labels(method="GET",
-                                       code="aborted").get()
+                                       code="upstream_aborted").get()
     ok0 = lb_lib._REQUESTS.labels(method="GET", code="200").get()
     retries0 = lb_lib._RETRIES.get()
     try:
@@ -401,10 +403,10 @@ def test_lb_mid_stream_death_counts_aborted_and_returns_slot():
         conn.close()
         deadline = time.time() + 5
         while time.time() < deadline and lb_lib._REQUESTS.labels(
-                method="GET", code="aborted").get() == aborted0:
+                method="GET", code="upstream_aborted").get() == aborted0:
             time.sleep(0.05)
         assert lb_lib._REQUESTS.labels(
-            method="GET", code="aborted").get() == aborted0 + 1
+            method="GET", code="upstream_aborted").get() == aborted0 + 1
         assert lb_lib._REQUESTS.labels(
             method="GET", code="200").get() == ok0
         assert lb_lib._RETRIES.get() == retries0   # no mid-stream retry
@@ -860,7 +862,8 @@ def test_probe_anti_flap_requires_success_streak():
 
 
 # ================================================= gang-replica chaos
-def _spawn_gang_replica(port, env_extra=None, hosts=2):
+def _spawn_gang_replica(port, env_extra=None, hosts=2,
+                        extra_args=None):
     """2-process gang replica (serve_llm self-spawn mode), unsharded
     (tp=1) so the fault-path tests pay no mesh-compile tax."""
     import pathlib
@@ -874,7 +877,7 @@ def _spawn_gang_replica(port, env_extra=None, hosts=2):
     return subprocess.Popen(
         [sys.executable, "-m", "skypilot_tpu.recipes.serve_llm",
          "--model", "tiny", "--port", str(port),
-         "--replica-hosts", str(hosts)],
+         "--replica-hosts", str(hosts)] + list(extra_args or ()),
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         start_new_session=True)
 
@@ -998,3 +1001,251 @@ def test_gang_follower_kill_via_chaos_seam_recovers():
                 proc.wait(timeout=15)
             except Exception:  # noqa: stpu-except — best-effort teardown of a test subprocess
                 proc.kill()
+
+
+# ====================================== preemption-notice proactive drain
+def test_preempt_notice_watch_sets_event_and_counter():
+    """Unit: the metadata watcher treats an injected
+    ``replica.preempt_notice`` fault AS the provider's notice — it
+    sets the shared event (the /health surface), counts the notice,
+    and stops (the notice is terminal for the replica)."""
+    from skypilot_tpu.recipes import serve_llm
+    notice = threading.Event()
+    before = serve_llm._PREEMPT_NOTICES.get()
+    fi.activate("replica.preempt_notice")
+    try:
+        serve_llm.preempt_notice_watch(notice, poll=0.01)
+    finally:
+        fi.clear()
+    assert notice.is_set()
+    assert serve_llm._PREEMPT_NOTICES.get() == before + 1
+
+
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_preempt_notice_probe_drains_ahead_of_kill():
+    """Tentpole (3) at the manager layer: a replica that is serving
+    fine but advertising ``preempt_notice: true`` on /health is
+    flipped DRAINING by the very probe that saw the notice —
+    synchronously, so the same controller tick already counts it
+    not-alive and launches the replacement (replace-ahead) — with the
+    notice in the event log and the replica out of the ready set."""
+    from skypilot_tpu.observability import events
+    from skypilot_tpu.recipes import serve_llm
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve.serve_state import ReplicaStatus
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    from skypilot_tpu.task import Task
+
+    cfg, params = _tiny_llm()
+    ready = threading.Event()
+    httpd = serve_llm.serve(cfg, params, 0, ready_event=ready)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    assert ready.wait(timeout=120)
+    port = httpd.server_address[1]
+    url = f"http://127.0.0.1:{port}"
+
+    spec = SkyServiceSpec(readiness_path="/health", min_replicas=1,
+                          initial_delay_seconds=60,
+                          drain_timeout_seconds=30)
+    task = Task("preempt-svc", run="true")
+    task.set_resources(Resources(cloud="local"))
+    task.service = spec
+    mgr = replica_managers.SkyPilotReplicaManager("svc-preempt", spec,
+                                                  task)
+    info = replica_managers.ReplicaInfo(1, "svc-preempt-replica-1",
+                                        port, spec=spec)
+    info.url = url
+    info.status = ReplicaStatus.READY
+    info.first_ready_at = time.time()
+    mgr.replicas[1] = info
+    try:
+        # Healthy, no notice: the probe keeps it READY.
+        _, body = _get(url + "/health")
+        assert "preempt_notice" not in json.loads(body)
+        mgr._probe_one(info)
+        assert info.status == ReplicaStatus.READY
+
+        # The provider's notice lands (what preempt_notice_watch sets
+        # when the replica.preempt_notice fault fires): /health keeps
+        # answering 200 — the replica is NOT sick — but carries the
+        # notice.
+        httpd.RequestHandlerClass.server_ctx["preempt_notice"].set()
+        code, body = _get(url + "/health")
+        assert code == 200
+        assert json.loads(body)["preempt_notice"] is True
+
+        mgr._probe_one(info)
+        # DRAINING the moment the probe returns — not after a
+        # teardown thread got scheduled — so this tick's reconcile
+        # already sees alive < target and replaces ahead of the kill.
+        assert info.status == ReplicaStatus.DRAINING
+        assert not ReplicaStatus.DRAINING.is_alive()
+        assert url not in mgr.ready_urls()
+        evs = [e["event"] for e in events.read(kind="replica",
+                                               name="svc-preempt/1",
+                                               limit=None)]
+        assert "preempt_notice" in evs
+        # A second probe mid-drain must not double-drain.
+        mgr._probe_one(info)
+        assert evs.count("preempt_notice") == 1
+        # The husk drains through the normal teardown (drain_start in
+        # the log; the record survives for postmortem).
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            evs = [e["event"] for e in events.read(
+                kind="replica", name="svc-preempt/1", limit=None)]
+            if "drain_complete" in evs:
+                break
+            time.sleep(0.1)
+        assert "drain_start" in evs
+    finally:
+        httpd.engine.shutdown()
+        httpd.shutdown()
+
+
+# ============================================ gang SIGKILL + LB resume
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_gang_sigkill_mid_stream_lb_resume_bit_identical():
+    """ISSUE 19 acceptance: a 2-host gang replica SIGKILLed (the real
+    preemption, no drain, no goodbye) mid-stream with speculative
+    decode + paged int8 KV on — the LB's journal resumes the stream
+    on a peer replica and the CLIENT's bytes are bit-identical to the
+    uninterrupted run, greedy and seeded."""
+    flags = ["--kv-paged", "1", "--kv-quant", "1", "--spec-k", "3",
+             "--spec-ngram", "2"]
+    port_a, port_b = _free_port(), _free_port()
+    # A (the victim): 2-host gang, decode slowed through the fault
+    # seam so the SIGKILL demonstrably lands mid-stream. B (the
+    # survivor): same model + config, full speed.
+    proc_a = _spawn_gang_replica(
+        port_a, hosts=2, extra_args=flags,
+        env_extra={"STPU_FAULTS": "engine.step:delay:s=0.04"})
+    proc_b = _spawn_gang_replica(port_b, hosts=1, extra_args=flags)
+    a = f"http://127.0.0.1:{port_a}"
+    b = f"http://127.0.0.1:{port_b}"
+
+    class _Ordered:
+        def set_ready_replicas(self, urls):
+            pass
+
+        def select_replica(self, request=None, exclude=None):
+            for url in (a, b):
+                if url not in (exclude or ()):
+                    return url
+            return None
+
+        def report_done(self, url):
+            pass
+
+        def ready_replicas(self):
+            return [a, b]
+
+    def stream_bytes(base, doc, sink=None, timeout=120):
+        conn = http.client.HTTPConnection(
+            *base.split("//", 1)[1].split(":"), timeout=timeout)
+        try:
+            conn.request("POST", "/generate", body=json.dumps(doc),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            chunks = []
+            while True:
+                chunk = resp.read1(65536)
+                if not chunk:
+                    break
+                chunks.append(chunk)
+                if sink is not None:
+                    sink.append(chunk)
+            return resp.status, b"".join(chunks)
+        finally:
+            conn.close()
+
+    lb_handler = type("Handler", (lb_lib._ProxyHandler,), {
+        "policy": _Ordered(), "recorder": lb_lib.RequestRecorder(),
+        "breaker": None, "upstream_timeout": 300.0,
+        "journal_account": lb_lib.JournalAccount()})
+    lb = lb_lib._ThreadingHTTPServer(("127.0.0.1", _free_port()),
+                                     lb_handler)
+    threading.Thread(target=lb.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{lb.server_address[1]}"
+    follower_pids = []
+    try:
+        assert _wait_code(a + "/health", 200), "gang A never ready"
+        assert _wait_code(b + "/health", 200), "replica B never ready"
+        follower_pids = [m["pid"] for m in _gang_members(port_a)
+                         if m["role"] == "follower"]
+
+        prompt, mt = [1, 2, 3], 12
+        greedy = {"prompt": prompt, "max_tokens": mt, "stream": True}
+        seeded = dict(greedy, temperature=0.9, seed=21)
+        refs = {}
+        for name, doc in (("greedy", greedy), ("seeded", seeded)):
+            status, body = stream_bytes(b, doc)
+            assert status == 200, f"reference {name} failed"
+            refs[name] = body
+        assert refs["greedy"] != refs["seeded"]
+
+        # Round 1 (greedy): LB-side stream kill via the lb.stream
+        # fault point; the splice comes from gang A's peer B.
+        before_ok = lb_lib._RESUMES.labels(outcome="ok").get()
+        fi.activate("lb.stream", times=1, skip=4)
+        try:
+            status, body = stream_bytes(base, greedy)
+        finally:
+            fi.clear()
+        assert status == 200
+        assert body == refs["greedy"], "greedy splice diverged"
+
+        # Round 2 (seeded): SIGKILL the whole gang A process group
+        # mid-stream — the hard preemption. The journal resumes on B.
+        result = {}
+        sink = []
+
+        def consume():
+            result["out"] = stream_bytes(base, seeded, sink=sink)
+
+        client = threading.Thread(target=consume, daemon=True)
+        client.start()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if b"".join(sink).count(b"data: {") >= 2:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("stream never produced tokens via gang A")
+        import os
+        import signal as signal_lib
+        os.killpg(os.getpgid(proc_a.pid), signal_lib.SIGKILL)
+        client.join(timeout=120)
+        assert "out" in result, "client stream never finished"
+        status, body = result["out"]
+        assert status == 200
+        assert body == refs["seeded"], "post-SIGKILL splice diverged"
+        assert lb_lib._RESUMES.labels(
+            outcome="ok").get() >= before_ok + 2
+    finally:
+        fi.clear()
+        lb.shutdown()
+        import os
+        import signal as signal_lib
+        for proc in (proc_a, proc_b):
+            if proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid),
+                              signal_lib.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                proc.wait(timeout=10)
+        # The gang's self-spawned followers sit in their own sessions;
+        # the 2s heartbeat timeout reaps them, but don't leak on a
+        # fast exit either.
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and any(
+                _pid_alive(p) for p in follower_pids):
+            time.sleep(0.2)
+        for pid in follower_pids:
+            if _pid_alive(pid):
+                try:
+                    os.kill(pid, signal_lib.SIGKILL)
+                except ProcessLookupError:
+                    pass

@@ -449,7 +449,13 @@ def test_lb_inflight_returns_slots_under_burst_failures(tmp_state_dir,
     dead_url = f"http://127.0.0.1:{_free_port()}"
     policy = PrefixAffinityPolicy()
     policy.set_ready_replicas([good_url, flaky_url, dead_url])
-    lb, target = _start_lb(policy, max_body_bytes=64 * 1024)
+    # max_stream_resumes=0: this test is about slot accounting on the
+    # FAILURE exit paths, so mid-stream aborts must stay aborted —
+    # with the journal on, the LB would heal them on the good peer
+    # (tests/test_stream_resume.py owns that path, including its own
+    # slot-drain assertion).
+    lb, target = _start_lb(policy, max_body_bytes=64 * 1024,
+                           max_stream_resumes=0)
     spec = loadgen.LoadSpec(mix="chat", arrival="uniform", qps=40,
                             duration_s=1.0, seed=9, max_tokens=4)
     try:

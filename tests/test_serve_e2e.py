@@ -759,3 +759,100 @@ def test_serve_logs_targets():
                                       target="load_balancer") == 0
     finally:
         serve_core.down([name], timeout=60)
+
+
+# ---------------------------------------------------------------------------
+@pytest.mark.usefixtures("tmp_state_dir")
+def test_preempt_notice_replace_ahead_e2e():
+    """ISSUE 19 (preemption-notice proactive drain) at the controller:
+    a READY replica starts advertising ``preempt_notice: true`` on its
+    health endpoint (what serve_llm's metadata watcher surfaces when
+    the provider announces the kill) — the next probe flips it
+    DRAINING, the SAME reconcile loop launches the replacement ahead
+    of the kill, and the service returns to full strength with the
+    noticed replica gone."""
+    import json as json_lib
+    import os
+
+    from skypilot_tpu.observability import events
+
+    # Replica server: /health-style JSON on every GET, advertising the
+    # preemption notice iff the per-port flag file exists — the test's
+    # stand-in for the provider metadata signal.
+    task = Task("notice-svc", run=(
+        "cd $(mktemp -d) && cat > srv.py <<'EOF'\n"
+        "import http.server, json, os\n"
+        "port = int(os.environ['SKYPILOT_SERVE_REPLICA_PORT'])\n"
+        "flag = '/tmp/stpu-preempt-%d' % port\n"
+        "class H(http.server.BaseHTTPRequestHandler):\n"
+        "    def log_message(self, *a): pass\n"
+        "    def do_GET(self):\n"
+        "        doc = {'status': 'ok', 'port': port}\n"
+        "        if os.path.exists(flag):\n"
+        "            doc['preempt_notice'] = True\n"
+        "        body = json.dumps(doc).encode()\n"
+        "        self.send_response(200)\n"
+        "        self.send_header('Content-Type', 'application/json')\n"
+        "        self.send_header('Content-Length', str(len(body)))\n"
+        "        self.end_headers()\n"
+        "        self.wfile.write(body)\n"
+        "http.server.HTTPServer(('', port), H).serve_forever()\n"
+        "EOF\n"
+        "exec python3 srv.py"))
+    task.set_resources(Resources(cloud="local"))
+    task.service = SkyServiceSpec(readiness_path="/",
+                                  initial_delay_seconds=60,
+                                  min_replicas=2)
+    name, endpoint = serve_core.up(task, "svc-notice",
+                                   controller="local")
+    flag = None
+    try:
+        serve_core.wait_ready(name, timeout=90)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            reps = serve_state.get_replicas(name)
+            ready = [r for r in reps
+                     if r["status"] == ReplicaStatus.READY]
+            if len(ready) == 2:
+                break
+            time.sleep(0.3)
+        else:
+            pytest.fail(f"never reached 2 READY: {reps}")
+
+        victim = ready[0]
+        vid = victim["replica_id"]
+        vport = int(victim["url"].rsplit(":", 1)[1])
+        # Confirm the victim is serving and notice-free, then land the
+        # provider's preemption notice.
+        _, body = _get(victim["url"] + "/")
+        assert "preempt_notice" not in json_lib.loads(body)
+        flag = f"/tmp/stpu-preempt-{vport}"
+        with open(flag, "w"):
+            pass
+
+        # Replace-ahead: the victim leaves the ready set and a NEW
+        # replica id reaches READY — service back to 2 READY without
+        # ever waiting for the kill itself.
+        deadline = time.time() + 90
+        replaced = False
+        while time.time() < deadline:
+            reps = serve_state.get_replicas(name)
+            ready_ids = {r["replica_id"] for r in reps
+                         if r["status"] == ReplicaStatus.READY}
+            if vid not in ready_ids and len(ready_ids) == 2:
+                replaced = True
+                break
+            time.sleep(0.3)
+        assert replaced, f"no replace-ahead: {reps}"
+        evs = [e["event"] for e in events.read(
+            kind="replica", name=f"{name}/{vid}", limit=None)]
+        assert "preempt_notice" in evs
+        assert serve_state.get_service(name)["status"] == \
+            ServiceStatus.READY
+    finally:
+        if flag:
+            try:
+                os.remove(flag)
+            except FileNotFoundError:
+                pass
+        serve_core.down([name], timeout=60)

@@ -118,15 +118,20 @@ def test_tpot_objective_reads_decode_phase_only():
 
 def test_error_rate_objective_counts_5xx_zero_and_aborted():
     store = _store()
-    for code, t0, t1 in (("200", 0.0, 90.0), ("500", 0.0, 5.0),
-                         ("aborted", 0.0, 3.0), ("0", 0.0, 2.0),
+    for code, t0, t1 in (("200", 0.0, 86.0), ("500", 0.0, 5.0),
+                         ("aborted", 0.0, 1.0), ("0", 0.0, 2.0),
+                         ("upstream_aborted", 0.0, 2.0),
+                         ("client_closed", 0.0, 4.0),
                          ("404", 0.0, 10.0)):
         store.record("stpu_lb_requests_total", t0, ts=0.0, code=code)
         store.record("stpu_lb_requests_total", t1, ts=5.0, code=code)
     monitor = _monitor(store, kind="error_rate", target=0.9,
                        threshold_s=None)
     entry = monitor.evaluate(now=5.0)["objectives"][0]
-    # bad = 5 + 3 + 2 of 110 total (404 is a client error, not bad).
+    # bad = 5 + 1 + 2 + 2 of 110 total: 5xx, the legacy "aborted",
+    # "0", and "upstream_aborted" burn budget; a 404 is a client
+    # error and "client_closed" is the client hanging up — neither
+    # is the service's failure.
     assert entry["burn_fast"] == pytest.approx((10 / 110) / 0.1)
 
 

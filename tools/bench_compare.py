@@ -60,6 +60,12 @@ DEFAULT_METRICS = (
     "detail.serving.*_tier_hit_rate",
     "detail.serving.*_slo_goodput",
     "detail.serving.*_loadgen_tok_s",
+    # Durable-streams chaos leg: goodput with a replica hard-killed
+    # mid-run over goodput kill-free on the same schedule. The LB's
+    # journal resume holds this near 1.0; the compare threshold on
+    # the ratio IS the "within 5% of kill-free" durability bound
+    # (chaos_slo_goodput rides the *_slo_goodput glob above).
+    "detail.serving.*_chaos_goodput_ratio",
     # Training-goodput legs (bench.py _train_leg): live MFU from the
     # armed trainstats recipe runs — a regression in recipe-loop
     # goodput or the telemetry itself fails CI like a serving one.

@@ -27,8 +27,8 @@ def main() -> None:
                    default="mixtral")
     p.add_argument("--mode", choices=("fixed", "engine", "paged", "q8",
                                       "spec", "prefix", "ckpt",
-                                      "loadgen", "tp", "tuned",
-                                      "tier"),
+                                      "loadgen", "chaos", "tp",
+                                      "tuned", "tier"),
                    default="fixed",
                    help="fixed: bucketed batch decode (r01-r05 "
                         "comparable); engine: continuous-batching "
@@ -54,6 +54,10 @@ def main() -> None:
                         "loadgen: the full serve_llm+LB data plane "
                         "under the open-loop load generator, graded "
                         "against TTFT/TPOT SLOs (goodput, p99 TTFT); "
+                        "chaos: the loadgen leg over TWO replicas "
+                        "with one hard-killed mid-run — goodput vs "
+                        "the kill-free baseline (the LB stream-"
+                        "resume durability contract); "
                         "tp: the tensor-parallel sharded engine "
                         "(serve/gang_replica.py) over a --tp-wide "
                         "mesh — needs that many visible devices "
@@ -148,6 +152,11 @@ def main() -> None:
             args.family, repeats=args.repeats, **shape_kw)
     elif args.mode == "loadgen":
         result = decode_bench.measure_engine_slo(
+            args.family, slots=args.slots, qps=args.qps,
+            duration_s=args.duration, slo_ttft_s=args.slo_ttft,
+            slo_tpot_s=args.slo_tpot, **shape_kw)
+    elif args.mode == "chaos":
+        result = decode_bench.measure_engine_chaos(
             args.family, slots=args.slots, qps=args.qps,
             duration_s=args.duration, slo_ttft_s=args.slo_ttft,
             slo_tpot_s=args.slo_tpot, **shape_kw)
