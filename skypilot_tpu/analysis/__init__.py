@@ -15,6 +15,7 @@ Rules live in ``rules_*.py`` modules and self-register on import:
   * ``stpu-donation``    — use-after-donate on jitted entry points
   * ``stpu-host-sync``   — device syncs on the decode hot path
   * ``stpu-env``         — STPU_* env reads vs utils/env_contract.py
+  * ``stpu-armed-guard`` — unguarded observability calls on hot paths
 
 Entry points: ``stpu check`` (cli.py), ``python tools/check_*.py``
 (thin shims), and ``tests/test_static_analysis.py`` (tier-1).
@@ -31,6 +32,7 @@ from skypilot_tpu.analysis import rules_collectives  # noqa: F401,E402
 from skypilot_tpu.analysis import rules_donation  # noqa: F401,E402
 from skypilot_tpu.analysis import rules_host_sync  # noqa: F401,E402
 from skypilot_tpu.analysis import rules_env  # noqa: F401,E402
+from skypilot_tpu.analysis import rules_armed  # noqa: F401,E402
 
 __all__ = ["Finding", "Rule", "all_rules", "get_rule", "register",
            "run_check"]

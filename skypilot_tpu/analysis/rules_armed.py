@@ -1,0 +1,242 @@
+"""``stpu-armed-guard`` — observability call sites on serving/training
+hot paths must be disarm-free.
+
+Every observability subsystem in this repo follows the same contract:
+a module-level ``ENABLED`` flag that is ``False`` by default, armed
+via one env knob, and a hot-path discipline of *one flag load and a
+falsy branch* when disarmed (pinned by the monkeypatch-bomb tests).
+That contract only holds if every call from a hot module into
+``tracing`` / ``stepstats`` / ``trainstats`` / ``fault_injection`` /
+``reqlog`` sits under the subsystem's flag — an unguarded
+``stepstats.record(...)`` costs dict building and a lock on every
+step even when nobody asked for telemetry, and an unguarded
+``fault_injection.fire(...)`` re-reads its plan on the per-token
+path.
+
+A call into one of those modules is compliant when ANY of:
+
+  * it sits (lexically) under an ``if``/``elif`` whose test references
+    ``<mod>.ENABLED`` — compound tests count
+    (``if reqlog.ENABLED and stats.get("reqlog") is not None:``), as
+    does a local alias bound from the flag
+    (``armed = stepstats.ENABLED`` ... ``if armed:``) and a call in
+    the test itself AFTER the short-circuiting flag check
+    (``if trainstats.ENABLED and trainstats.sync_due():``);
+  * it lives in an armed-only helper: a same-file function whose
+    EVERY call site is itself guarded (the engine's
+    ``_record_step`` / ``_stamp_dispatch`` / ``_record_admission``
+    pattern — "only reached while stepstats.ENABLED, the callers
+    guard"). The closure is computed per file, to a fixpoint, so a
+    guarded helper calling another helper stays compliant;
+  * the callee is a documented NOOP-returning / pure helper that is
+    safe disarmed (``_SANCTIONED``): the tracing context plumbing
+    (``extract`` / ``format_ctx`` / ``parse_ctx`` / ``child_env`` /
+    ``SpanContext`` are pure; ``start_span`` / ``record_span`` return
+    no-ops when disarmed), the crash-path flight dumps
+    (``dump_flight`` runs once at teardown, never per-token), and the
+    operator-requested admin reads (``snapshot``, ``reqlog.read`` /
+    ``requests_path`` and the profile capture trio serve explicit
+    ``/perf`` / ``/requests`` / ``/profile`` requests, not the
+    decode loop).
+
+Anything else is a finding. A genuinely-exempt site carries
+``# noqa: stpu-armed-guard <reason>``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.core import FileContext, Finding, Rule
+
+# The serving + training hot modules (same '/'-bounded match as
+# stpu-host-sync). Cold control-plane code may call the subsystems
+# unguarded — one dict build per launch is noise; per-token is not.
+TARGET_FILES = ("serve/decode_engine.py", "serve/load_balancer.py",
+                "serve/gang_replica.py", "recipes/serve_llm.py",
+                "train/trainer.py", "train/checkpoint.py",
+                "recipes/llama_lora.py", "recipes/mixtral_ep.py",
+                "recipes/resnet_ddp.py")
+
+# The flag-gated observability subsystems this rule polices.
+MODULES = ("tracing", "stepstats", "trainstats", "fault_injection",
+           "reqlog")
+
+# Documented safe-when-disarmed callees (module docstring has the
+# per-entry rationale). Everything here either returns a no-op /
+# pure value with the flag down, or only runs on a crash/teardown or
+# operator-requested admin path.
+_SANCTIONED = {
+    "tracing.start_span", "tracing.record_span", "tracing.extract",
+    "tracing.format_ctx", "tracing.parse_ctx", "tracing.child_env",
+    "tracing.SpanContext",
+    "stepstats.dump_flight", "trainstats.dump_flight",
+    "stepstats.snapshot", "trainstats.snapshot",
+    "stepstats.begin_profile", "stepstats.capture_profile",
+    "stepstats.profiles_dir",
+    "reqlog.read", "reqlog.requests_path",
+}
+
+
+def _call_module(node: ast.Call) -> Optional[str]:
+    """The polices-this module a call targets, else None."""
+    path = core.dotted_path(node.func)
+    if path is None or "." not in path:
+        return None
+    head = path.split(".", 1)[0]
+    return head if head in MODULES else None
+
+
+def _flag_aliases(fn: Optional[ast.AST], mod: str) -> Set[str]:
+    """Local names bound from ``<mod>.ENABLED`` inside fn (e.g.
+    ``armed = stepstats.ENABLED`` or ``traced = tracing.ENABLED and
+    ...``) — an ``if armed:`` over one of these IS a flag guard."""
+    names: Set[str] = set()
+    if fn is None:
+        return names
+    want = f"{mod}.ENABLED"
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        if any(core.dotted_path(n) == want
+               for n in ast.walk(node.value)
+               if isinstance(n, ast.Attribute)):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _mentions_flag(test: ast.AST, mod: str, aliases: Set[str]) -> bool:
+    want = f"{mod}.ENABLED"
+    for n in ast.walk(test):
+        if isinstance(n, ast.Attribute) and core.dotted_path(n) == want:
+            return True
+        if isinstance(n, ast.Name) and n.id in aliases:
+            return True
+    return False
+
+
+def _is_guarded(ctx: FileContext, node: ast.AST, mod: str,
+                aliases: Set[str]) -> bool:
+    """True when node sits under (or inside the test of) an if/elif
+    that references the module's ENABLED flag."""
+    prev: ast.AST = node
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.If, ast.IfExp)):
+            in_test = prev is cur.test
+            body = cur.body if isinstance(cur.body, list) else [cur.body]
+            in_body = prev in body
+            if (in_test or in_body) and _mentions_flag(
+                    cur.test, mod, aliases):
+                return True
+            # The orelse of a flag check is the DISARMED branch —
+            # keep walking, an outer guard may still apply.
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda, ast.ClassDef)):
+            # Guards don't cross a function boundary lexically; the
+            # armed-only-helper closure handles that case.
+            return False
+        prev, cur = cur, ctx.parents.get(cur)
+    return False
+
+
+def _function_index(ctx: FileContext) -> Dict[str, ast.AST]:
+    index: Dict[str, ast.AST] = {}
+    for node in ctx.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            index.setdefault(node.name, node)
+    return index
+
+
+def _enclosing_function(ctx: FileContext,
+                        node: ast.AST) -> Optional[ast.AST]:
+    return ctx.enclosing(node, ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _armed_only(ctx: FileContext, mod: str,
+                index: Dict[str, ast.AST]) -> Set[str]:
+    """Fixpoint of same-file functions that are only ever called with
+    the module's flag up: every call site is lexically guarded, or
+    sits inside a function already in the set."""
+    # name -> [(call node, enclosing fn name or None)]
+    sites: Dict[str, List[Tuple[ast.Call, Optional[str]]]] = {}
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        name = core.call_name(node)
+        if name not in index:
+            continue
+        fn = _enclosing_function(ctx, node)
+        sites.setdefault(name, []).append(
+            (node, fn.name if fn is not None else None))
+
+    armed: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, calls in sites.items():
+            if name in armed:
+                continue
+            ok = True
+            for call, caller in calls:
+                aliases = _flag_aliases(
+                    index.get(caller) if caller else None, mod)
+                if _is_guarded(ctx, call, mod, aliases):
+                    continue
+                if caller is not None and caller in armed:
+                    continue
+                ok = False
+                break
+            if ok:
+                armed.add(name)
+                changed = True
+    return armed
+
+
+@core.register
+class ArmedGuardRule(Rule):
+    id = "stpu-armed-guard"
+    title = "unguarded observability call on a hot path"
+    rationale = ("The zero-cost-when-disarmed contract (one flag "
+                 "load, falsy branch) only holds if hot-path calls "
+                 "into tracing/stepstats/trainstats/fault_injection/"
+                 "reqlog sit under the subsystem's ENABLED flag or "
+                 "are documented no-op helpers.")
+
+    def targets(self, rel: str) -> bool:
+        return any(rel == t or rel.endswith("/" + t)
+                   for t in TARGET_FILES)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        index = _function_index(ctx)
+        armed_cache: Dict[str, Set[str]] = {}
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            mod = _call_module(node)
+            if mod is None:
+                continue
+            path = core.dotted_path(node.func)
+            if path in _SANCTIONED:
+                continue
+            fn = _enclosing_function(ctx, node)
+            aliases = _flag_aliases(fn, mod)
+            if _is_guarded(ctx, node, mod, aliases):
+                continue
+            if mod not in armed_cache:
+                armed_cache[mod] = _armed_only(ctx, mod, index)
+            if fn is not None and fn.name in armed_cache[mod]:
+                continue
+            yield Finding(
+                ctx.rel, node.lineno, self.id,
+                f"{path}(...) on a hot path without a {mod}.ENABLED "
+                "guard — disarmed requests pay for telemetry nobody "
+                "asked for; guard the call site (compound tests "
+                "count), move it into a helper whose callers all "
+                "guard, or annotate '# noqa: stpu-armed-guard "
+                "<reason>' for a documented no-op helper")

@@ -213,6 +213,135 @@ def schedule_digest(schedule: List[ScheduledRequest]) -> str:
         json.dumps(doc, separators=(",", ":")).encode()).hexdigest()
 
 
+def save_schedule(path: str, spec: LoadSpec,
+                  schedule: List[ScheduledRequest]) -> str:
+    """Write a schedule.json (the spec that produced the trace, the
+    pinned digest, and every concrete request) — the interchange
+    format ``run()`` emits per run dir, ``--schedule`` reads back, and
+    ``stpu loadgen capture`` synthesizes from captured records.
+    Returns the digest."""
+    digest = schedule_digest(schedule)
+    with open(path, "w") as f:
+        json.dump({
+            "spec": dataclasses.asdict(spec),
+            "digest": digest,
+            "requests": [
+                {"index": r.index, "at": r.at,
+                 "prompt": list(r.prompt), "max_tokens": r.max_tokens,
+                 "temperature": r.temperature, "seed": r.seed}
+                for r in schedule],
+        }, f)
+    return digest
+
+
+def load_schedule(path: str
+                  ) -> Tuple[LoadSpec, List[ScheduledRequest], str]:
+    """Read a saved schedule.json back into a runnable trace. The
+    pinned digest is VERIFIED against the loaded content (float
+    offsets survive the JSON round-trip exactly), so a hand-edited or
+    truncated file fails loudly instead of silently benchmarking a
+    different workload."""
+    with open(path, "r") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "requests" not in doc:
+        raise ValueError(f"{path}: not a schedule.json")
+    known = {f.name for f in dataclasses.fields(LoadSpec)}
+    spec = LoadSpec(**{k: v for k, v in (doc.get("spec") or {}).items()
+                       if k in known})
+    schedule = [ScheduledRequest(
+        index=int(r["index"]), at=float(r["at"]),
+        prompt=tuple(int(t) for t in r["prompt"]),
+        max_tokens=int(r["max_tokens"]),
+        temperature=float(r.get("temperature", 0.0)),
+        seed=int(r["seed"]))
+        for r in doc["requests"]]
+    digest = schedule_digest(schedule)
+    pinned = doc.get("digest")
+    if pinned and pinned != digest:
+        raise ValueError(
+            f"{path}: content does not match its pinned digest "
+            f"(expected {str(pinned)[:12]}…, recomputed "
+            f"{digest[:12]}…) — the file was edited or truncated")
+    return spec, schedule, digest
+
+
+# ------------------------------------------------------ capture bridge
+def derive_spec(records: List[Dict[str, Any]]) -> LoadSpec:
+    """Fit a LoadSpec to captured request records (observability/
+    reqlog.py) — the capture→replay bridge: arrival rate and
+    burstiness from the record timestamps, prompt-length and
+    max-tokens mix from the workload-shape fields, and prefix-reuse
+    structure from the leading-chunk hashes (the records never carry
+    prompt text, so replay prompts are SYNTHESIZED with the same
+    sharing structure, not replayed verbatim). Deterministic: the
+    same records — in any order — derive the identical spec, and
+    therefore (via build_schedule) a bit-identical schedule digest."""
+    reqs = sorted(
+        (r for r in records
+         if r.get("path") == "/generate"
+         and isinstance(r.get("prompt_tokens"), int)),
+        key=lambda r: (float(r.get("ts", 0.0)),
+                       str(r.get("request_id", ""))))
+    if not reqs:
+        raise ValueError(
+            "no /generate records with workload-shape fields — was "
+            "the capture run made with STPU_REQLOG=1 at the LB?")
+    ts = [float(r.get("ts", 0.0)) for r in reqs]
+    span = max(ts) - min(ts)
+    duration = max(round(span, 3), 1.0)
+    qps = round(len(reqs) / duration, 3)
+    # Burstiness: coefficient of variation of the inter-arrival gaps.
+    # Poisson arrivals sit near 1; a diurnal/bursty capture runs well
+    # above it.
+    gaps = [b - a for a, b in zip(ts, ts[1:]) if b >= a]
+    cov = 0.0
+    if len(gaps) >= 2:
+        mean = sum(gaps) / len(gaps)
+        if mean > 1e-9:
+            var = sum((g - mean) ** 2 for g in gaps) / len(gaps)
+            cov = math.sqrt(var) / mean
+    plens = [int(r["prompt_tokens"]) for r in reqs]
+    mean_plen = sum(plens) / len(plens)
+    hashes = {r.get("prefix_hash") for r in reqs
+              if r.get("prefix_hash")}
+    max_tokens = max((int(r["max_tokens"]) for r in reqs
+                      if isinstance(r.get("max_tokens"), int)),
+                     default=32)
+    temps = [float(r["temperature"]) for r in reqs
+             if isinstance(r.get("temperature"), (int, float))]
+    if mean_plen > 320:
+        mix = "long_context"
+    elif cov >= 2.0:
+        mix = "bursty"
+    else:
+        mix = "chat"
+    # The replay seed is a pure function of the capture content, so two
+    # derivations from the same records pin the same schedule digest.
+    seed = int(hashlib.sha256(json.dumps(
+        [len(reqs), sorted(str(h) for h in hashes), plens],
+        separators=(",", ":")).encode()).hexdigest()[:8], 16)
+    return LoadSpec(
+        mix=mix,
+        arrival="poisson",
+        qps=max(qps, 0.1),
+        duration_s=duration,
+        seed=seed,
+        n_prefixes=max(len(hashes), 1),
+        # Moment-match the synthesizer: the chat mix draws total
+        # length ~ Uniform(shared_prefix + 4, prompt_tokens), so the
+        # cap that reproduces the OBSERVED mean is
+        # 2*mean - shared_prefix - 4 (shared_prefix stays the default
+        # 64 — the records carry prefix identity, not prefix length).
+        prompt_tokens=(96 if mix == "long_context"
+                       else min(max(2 * int(round(mean_plen)) - 68,
+                                    72), 960)),
+        long_prompt_tokens=(max(int(round(mean_plen)), 16)
+                            if mix == "long_context" else 640),
+        max_tokens=max(max_tokens, 1),
+        temperature=round(temps[0], 1) if temps else 0.0,
+    ).validate()
+
+
 # ------------------------------------------------------------- scraper
 class MetricsScraper:
     """Run-scoped /metrics snapshotter: every ``interval`` seconds the
@@ -450,8 +579,14 @@ class _RequestWorker(threading.Thread):
                                 done = True
                                 continue
                             try:
-                                json.loads(payload)
+                                doc = json.loads(payload)
                             except ValueError:
+                                continue
+                            if not (isinstance(doc, dict)
+                                    and "token" in doc):
+                                # Non-token SSE payload (e.g. a stats
+                                # frame from a reqlog-armed replica
+                                # behind a disarmed LB) — not a token.
                                 continue
                             tokens += 1
                             last_at = now
@@ -486,36 +621,40 @@ class _RequestWorker(threading.Thread):
             self._sink.append(record)
 
 
-def run(target: str, spec: LoadSpec, *,
+def run(target: str, spec: Optional[LoadSpec] = None, *,
         slo_ttft_s: Optional[float] = None,
         slo_tpot_s: Optional[float] = None,
         scrape_interval: float = 1.0,
         out_dir: Optional[str] = None,
         faults: Optional[str] = None,
         faults_at: float = 0.0,
-        request_timeout: float = 120.0) -> Dict[str, Any]:
+        request_timeout: float = 120.0,
+        schedule_file: Optional[str] = None) -> Dict[str, Any]:
     """Fire ``spec``'s schedule at ``target`` (the LB endpoint) and
     return the SLO report (also persisted to ``<out_dir>/report.json``
-    next to ``schedule.json`` and the scraped ``metrics.jsonl``)."""
-    spec.validate()
+    next to ``schedule.json`` and the scraped ``metrics.jsonl``).
+    With ``schedule_file`` the saved/derived trace is replayed VERBATIM
+    instead of built from ``spec`` (which may be None); the report's
+    ``source`` field records the provenance either way, and
+    ``schedule_sha256`` pins the digest that actually ran."""
+    if schedule_file:
+        spec, schedule, digest = load_schedule(schedule_file)
+        source = "schedule"
+    elif spec is not None:
+        spec.validate()
+        schedule = build_schedule(spec)
+        digest = schedule_digest(schedule)
+        source = "spec"
+    else:
+        raise ValueError("run() needs a spec or a schedule_file")
     if faults:
         # Fail fast on a malformed spec — not mid-run with the scraper
         # already started and partial artifacts on disk.
         fault_injection.parse_spec(faults)
-    schedule = build_schedule(spec)
-    digest = schedule_digest(schedule)
     run_dir = _resolve_out_dir(out_dir, spec)
     os.makedirs(run_dir, exist_ok=True)
-    with open(os.path.join(run_dir, "schedule.json"), "w") as f:
-        json.dump({
-            "spec": dataclasses.asdict(spec),
-            "digest": digest,
-            "requests": [
-                {"index": r.index, "at": r.at,
-                 "prompt": list(r.prompt), "max_tokens": r.max_tokens,
-                 "temperature": r.temperature, "seed": r.seed}
-                for r in schedule],
-        }, f)
+    save_schedule(os.path.join(run_dir, "schedule.json"), spec,
+                  schedule)
 
     scraper = MetricsScraper(target, scrape_interval,
                              os.path.join(run_dir, "metrics.jsonl"))
@@ -581,7 +720,9 @@ def run(target: str, spec: LoadSpec, *,
                            dispatch_window=dispatch_window,
                            slo_ttft_s=slo_ttft_s,
                            slo_tpot_s=slo_tpot_s,
-                           faults=faults, faults_at=faults_at)
+                           faults=faults, faults_at=faults_at,
+                           source=source,
+                           scrape_interval=scrape_interval)
     report["out_dir"] = run_dir
     with open(os.path.join(run_dir, "report.json"), "w") as f:
         json.dump(report, f, indent=1)
@@ -623,7 +764,8 @@ def latest_run_dir() -> Optional[str]:
 
 def _build_report(spec, schedule, digest, results, wall, scraper,
                   target, *, dispatch_window, slo_ttft_s, slo_tpot_s,
-                  faults, faults_at) -> Dict[str, Any]:
+                  faults, faults_at, source="spec",
+                  scrape_interval=1.0) -> Dict[str, Any]:
     results = sorted(results, key=lambda r: r["index"])
     ok = [r for r in results if r["ok"]]
     ttfts = [r["ttft_s"] for r in ok if r["ttft_s"] is not None]
@@ -719,12 +861,34 @@ def _build_report(spec, schedule, digest, results, wall, scraper,
             "p99": round(gap_hist.quantile(0.99), 6),
         }
 
+    # Open-loop integrity: how late each dispatch actually fired
+    # relative to its scheduled instant. A single-process driver that
+    # saturates under-drives SILENTLY — achieved < offered then blames
+    # the server for queueing that never happened. Surfacing the lag
+    # (and warning once it exceeds a scrape interval, the report's own
+    # resolution) makes the shortfall attributable.
+    lags = [r["dispatch_lag_s"] for r in results
+            if r.get("dispatch_lag_s") is not None]
+    driver: Dict[str, Any] = {"lag_s": _pctiles(lags),
+                              "warning": None}
+    lag_p99 = _percentile(lags, 0.99) if lags else None
+    driver["lag_p99_s"] = round(lag_p99, 6) if lag_p99 is not None \
+        else None
+    if lag_p99 is not None and lag_p99 > scrape_interval:
+        driver["warning"] = (
+            f"driver saturated: dispatch lag p99 {lag_p99:.3f}s "
+            f"exceeds the {scrape_interval:g}s scrape interval — "
+            "'achieved < offered' is (at least partly) the DRIVER "
+            "under-driving, not the server queueing")
+
     offered = n_sched / spec.duration_s
     return {
         "version": 1,
         "target": target,
+        "source": source,
         "spec": dataclasses.asdict(spec),
         "schedule_sha256": digest,
+        "driver": driver,
         "wall_seconds": round(wall, 3),
         "serving_window_seconds": round(window, 3),
         "faults": faults, "faults_at_s": faults_at if faults else None,
@@ -782,7 +946,8 @@ def format_report(report: Dict[str, Any]) -> str:
         f" qps={spec.get('qps')} duration={spec.get('duration_s')}s"
         f" seed={spec.get('seed')}",
         f"schedule   {reqs.get('scheduled')} requests"
-        f" sha256={str(report.get('schedule_sha256', ''))[:12]}…",
+        f" sha256={str(report.get('schedule_sha256', ''))[:12]}…"
+        f" source={report.get('source', 'spec')}",
         f"qps        offered {qps.get('offered')}  sent {qps.get('sent')}"
         f"  achieved {qps.get('achieved')}",
         f"requests   ok {reqs.get('ok')}  error {reqs.get('error')}"
@@ -795,6 +960,13 @@ def format_report(report: Dict[str, Any]) -> str:
     if report.get("faults"):
         lines.append(f"faults     {report['faults']} "
                      f"(armed at t+{report.get('faults_at_s')}s)")
+    driver = report.get("driver") or {}
+    if driver.get("lag_p99_s") is not None:
+        lines.append(
+            f"driver     dispatch lag p99 "
+            f"{driver['lag_p99_s'] * 1000:.1f}ms")
+    if driver.get("warning"):
+        lines.append(f"WARNING    {driver['warning']}")
 
     def fmt_p(name: str, p: Optional[Dict[str, float]]) -> str:
         if not p:

@@ -1240,13 +1240,20 @@ def slo_cmd(service, url):
 @click.option("--out", default=None,
               help="Run directory (default "
                    "~/.stpu/logs/loadgen/<stamp>-<mix>-seed<seed>).")
+@click.option("--schedule", "schedule_file", default=None,
+              type=click.Path(exists=True, dir_okay=False),
+              help="Replay a saved schedule.json verbatim (a prior "
+                   "run's artifact or `stpu loadgen capture` output); "
+                   "overrides every spec knob, the report records "
+                   "source=schedule + the pinned digest.")
 @click.option("--json", "as_json", is_flag=True,
               help="Print the raw report JSON instead of the "
                    "rendered summary.")
 @click.pass_context
 def loadgen(ctx, target, mix, arrival, qps, duration, seed, max_tokens,
             prompt_tokens, shared_prefix, slo_ttft, slo_tpot,
-            scrape_interval, faults, faults_at, out, as_json):
+            scrape_interval, faults, faults_at, out, schedule_file,
+            as_json):
     """Trace-driven open-loop load harness with SLO reports.
 
     Fires a seeded, replayable request schedule at a live serving
@@ -1264,14 +1271,18 @@ def loadgen(ctx, target, mix, arrival, qps, duration, seed, max_tokens,
 
     from skypilot_tpu.benchmark import loadgen as loadgen_lib
     try:
-        spec = loadgen_lib.LoadSpec(
-            mix=mix, arrival=arrival, qps=qps, duration_s=duration,
-            seed=seed, max_tokens=max_tokens,
-            prompt_tokens=prompt_tokens, shared_prefix=shared_prefix)
+        spec = None
+        if schedule_file is None:
+            spec = loadgen_lib.LoadSpec(
+                mix=mix, arrival=arrival, qps=qps, duration_s=duration,
+                seed=seed, max_tokens=max_tokens,
+                prompt_tokens=prompt_tokens,
+                shared_prefix=shared_prefix)
         report = loadgen_lib.run(
             target, spec, slo_ttft_s=slo_ttft, slo_tpot_s=slo_tpot,
             scrape_interval=scrape_interval, out_dir=out,
-            faults=faults, faults_at=faults_at)
+            faults=faults, faults_at=faults_at,
+            schedule_file=schedule_file)
     except (ValueError, OSError) as e:
         raise click.ClickException(str(e)) from e
     if as_json:
@@ -1313,6 +1324,205 @@ def loadgen_report(run, as_json):
         click.echo(json_lib.dumps(report, indent=1))
     else:
         click.echo(loadgen_lib.format_report(report))
+
+
+@loadgen.command(name="capture")
+@click.option("--from", "source", "--from-file", default=None,
+              type=click.Path(exists=True, dir_okay=False),
+              help="requests.jsonl to derive from (default "
+                   "~/.stpu/logs/requests.jsonl).")
+@click.option("--out", default="schedule.json", show_default=True,
+              help="Where to write the derived schedule.json.")
+@click.option("--since", type=float, default=None,
+              help="Only use records from the last SINCE seconds.")
+def loadgen_capture(source, out, since):
+    """Derive a replayable schedule.json from captured request
+    records.
+
+    Fits the arrival rate/burstiness, prompt-length distribution,
+    max-tokens budget, and prefix-reuse structure of the wide-event
+    records (observability/reqlog.py — arm the serving stack with
+    STPU_REQLOG=1 first) into a synthesized LoadSpec, then freezes it
+    into a bit-identically-replayable schedule: the derivation is
+    deterministic, so the same records always produce the same
+    digest. Replay with `stpu loadgen --target ... --schedule FILE`.
+    Records carry only a leading-chunk hash — no prompt text rides
+    along; replayed prompts are synthetic with matching shape."""
+    import time as time_lib
+
+    from skypilot_tpu.benchmark import loadgen as loadgen_lib
+    from skypilot_tpu.observability import reqlog
+    records = reqlog.read(path=source)
+    if since is not None:
+        cutoff = time_lib.time() - since
+        records = [r for r in records
+                   if isinstance(r.get("ts"), (int, float))
+                   and r["ts"] >= cutoff]
+    try:
+        spec = loadgen_lib.derive_spec(records)
+        schedule = loadgen_lib.build_schedule(spec)
+        digest = loadgen_lib.save_schedule(out, spec, schedule)
+    except (ValueError, OSError) as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f"Derived {len(schedule)} requests from "
+               f"{len(records)} records "
+               f"(mix={spec.mix} qps={spec.qps:.2f} "
+               f"duration={spec.duration_s:.1f}s "
+               f"prompt_tokens={spec.prompt_tokens}).")
+    click.echo(f"Wrote {out} (digest {digest[:16]}). Replay with "
+               f"`stpu loadgen --target URL --schedule {out}`.")
+
+
+def _requests_rows(url, since, status, slow, replica):
+    """Fetch + filter wide-event request records for `stpu requests`:
+    from the LB's /requests endpoint when a URL is known, else the
+    local ~/.stpu/logs/requests.jsonl."""
+    import time as time_lib
+
+    from skypilot_tpu.observability import reqlog
+    if url is not None:
+        import json as json_lib
+        import urllib.request as urllib_request
+        try:
+            with urllib_request.urlopen(
+                    url.rstrip("/") + "/requests", timeout=5.0) as r:
+                rows = json_lib.load(r)
+        except Exception as e:
+            raise click.ClickException(
+                f"cannot fetch {url}/requests: {e}") from e
+        rows = [r for r in rows if isinstance(r, dict)]
+    else:
+        rows = reqlog.read()
+    if since is not None:
+        cutoff = time_lib.time() - since
+        rows = [r for r in rows
+                if isinstance(r.get("ts"), (int, float))
+                and r["ts"] >= cutoff]
+    if status is not None:
+        rows = [r for r in rows if str(r.get("status")) == status]
+    if slow:
+        rows = [r for r in rows if reqlog.is_slow(r)]
+    if replica is not None:
+        rows = [r for r in rows
+                if str(r.get("replica", "")).find(replica) >= 0]
+    return rows
+
+
+@cli.group(name="requests", cls=_PerfGroup,
+           invoke_without_command=True)
+@click.option("--service", "-s", default=None,
+              help="Service whose LB /requests to fetch (also "
+                   "accepted as a bare leading argument: "
+                   "`stpu requests svc`).")
+@click.option("--url", default=None,
+              help="Explicit LB endpoint (e.g. "
+                   "http://127.0.0.1:8080); reads its /requests "
+                   "endpoint instead of the local log.")
+@click.option("--since", type=float, default=None,
+              help="Only records from the last SINCE seconds.")
+@click.option("--status", default=None,
+              help="Filter on final status (200, 503, "
+                   "upstream_aborted, ...).")
+@click.option("--slow", is_flag=True,
+              help="Only records over the slow thresholds "
+                   "(STPU_REQLOG_SLOW_TTFT / _SLOW_E2E).")
+@click.option("--replica", default=None,
+              help="Substring filter on the serving replica.")
+@click.option("--limit", "-n", type=int, default=30,
+              show_default=True, help="Max records shown (newest "
+                                      "last).")
+@click.option("--json", "as_json", is_flag=True,
+              help="Raw record JSON, one per line.")
+@click.pass_context
+def requests_cmd(ctx, service, url, since, status, slow, replica,
+                 limit, as_json):
+    """Per-request wide-event analytics (arm with STPU_REQLOG=1).
+
+    One joined record per request — the LB half (policy pick,
+    retries, resume outcome, client TTFT/e2e) folded with the
+    engine half (queue wait, token counts, KV tier, speculative
+    accept counts, per-request device-time share). Tail-biased:
+    errors, resumed streams, and slow requests are always kept even
+    when STPU_REQLOG_SAMPLE thins the rest. See
+    docs/observability.md."""
+    if ctx.invoked_subcommand is not None:
+        return
+    import json as json_lib
+    import time as time_lib
+    rows = _requests_rows(_resolve_service_url(url, service), since,
+                          status, slow, replica)
+    if not rows:
+        click.echo("No request records (arm the serving stack with "
+                   "STPU_REQLOG=1).")
+        return
+    rows = rows[-limit:]
+    if as_json:
+        for r in rows:
+            click.echo(json_lib.dumps(r, default=str))
+        return
+    fmt = "{:<10} {:<19} {:>6} {:>8} {:>8} {:>6} {:<8} {}"
+    click.echo(fmt.format("REQUEST", "STARTED", "STATUS", "TTFT",
+                          "E2E", "TOKENS", "KEEP", "REPLICA"))
+    for r in rows:
+        ts = r.get("ts")
+        stamp = (time_lib.strftime("%Y-%m-%d %H:%M:%S",
+                                   time_lib.localtime(ts))
+                 if isinstance(ts, (int, float)) else "-")
+        eng = r.get("engine") or {}
+        ttft = r.get("ttft_s")
+        e2e = r.get("e2e_s")
+        click.echo(fmt.format(
+            str(r.get("request_id", "?"))[:8],
+            stamp, str(r.get("status", "?")),
+            _fmt_dur(ttft) if isinstance(ttft, (int, float)) else "-",
+            _fmt_dur(e2e) if isinstance(e2e, (int, float)) else "-",
+            eng.get("generated_tokens", "-"),
+            r.get("keep") or "-",
+            r.get("replica") or "-"))
+
+
+@requests_cmd.command(name="show")
+@click.argument("request_id", required=True)
+def requests_show(request_id):
+    """Render one joined request record in full. REQUEST_ID may be
+    abbreviated; cross-links `stpu trace show` when the request's
+    trace was sampled."""
+    from skypilot_tpu.observability import reqlog
+    rows = reqlog.read(request_id=request_id)
+    if not rows:
+        raise click.ClickException(
+            f"No request record matches {request_id!r}.")
+    ids = {r.get("request_id") for r in rows}
+    if len(ids) > 1:
+        raise click.ClickException(
+            f"{request_id!r} is ambiguous ({len(ids)} requests); "
+            "give more characters.")
+    rec = rows[-1]
+    rid = rec.get("request_id", "?")
+    click.echo(f"request {rid}")
+    eng = rec.get("engine") or {}
+    order = ("ts", "method", "path", "status", "keep", "replica",
+             "policy", "attempts", "retries", "resumed",
+             "resume_outcome", "ttft_s", "e2e_s", "bytes_streamed",
+             "prompt_tokens", "max_tokens", "temperature", "stream",
+             "prefix_hash", "trace_sampled")
+    for key in order:
+        if key in rec:
+            click.echo(f"  {key:<18} {rec[key]}")
+    for key in sorted(rec):
+        if key not in order and key not in ("request_id", "engine"):
+            click.echo(f"  {key:<18} {rec[key]}")
+    if eng:
+        click.echo("  engine:")
+        for key in sorted(eng):
+            click.echo(f"    {key:<16} {eng[key]}")
+    else:
+        click.echo("  engine:            (none — LB-only record: "
+                   "legacy replica or stream never reached the "
+                   "trailing stats event)")
+    if rec.get("trace_sampled"):
+        click.echo(f"  trace was sampled — `stpu trace show {rid}` "
+                   "has the span tree.")
 
 
 @cli.group(name="trace")

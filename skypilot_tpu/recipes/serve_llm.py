@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from skypilot_tpu.agent import constants as agent_constants
 from skypilot_tpu.models import family_name, gemma, llama, mixtral, model_api
 from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import reqlog
 from skypilot_tpu.observability import stepstats
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import decode_engine
@@ -536,6 +537,14 @@ class _Handler(BaseHTTPRequestHandler):
                          stream, span=None, resume=None) -> None:
         gang = self.server_ctx.get("gang")
         trace = span.context() if span is not None else None
+        if trace is None and reqlog.ENABLED:
+            # Request-analytics join key: with tracing disarmed the LB
+            # still stamps X-STPU-Trace (a reqlog-minted id, sampled
+            # flag 00), and carrying it into the engine keys the
+            # engine half of the request record. extract/parse are
+            # pure string work — no tracing I/O, and the 00 flag keeps
+            # every engine tracing guard short-circuited.
+            trace = tracing.extract(self.headers)
         # Resume admission: ``mt`` is the ORIGINAL request budget — the
         # engine re-prefills the emitted tokens as a prompt extension
         # and regenerates only the remainder, emitting from the same
@@ -658,6 +667,8 @@ class _Handler(BaseHTTPRequestHandler):
             for tok in rest_iter:
                 emit(json.dumps({"token": int(tok)}))
                 sent += 1
+            if reqlog.ENABLED and req is not None:
+                self._emit_stats_frame(req)
             emit("[DONE]")
             end_chunks(self.wfile)
             if span is not None:
@@ -675,6 +686,33 @@ class _Handler(BaseHTTPRequestHandler):
                                     status="error",
                                     attrs={"tokens": sent,
                                            "aborted": True})
+
+    def _emit_stats_frame(self, req) -> None:
+        """Trailing ``event: stats`` SSE frame (reqlog armed only): the
+        engine half of the wide-event request record, assembled by
+        _free_slot and readable once the token iterator exhausts
+        (_DONE is queued after the record is attached), enriched with
+        the engine-level fields the slot cannot see (quant modes,
+        restarts survived). The LB strips this frame from the client
+        stream and folds it into its half; a legacy LB/custom client
+        that does not strip must ignore non-``data:``-only SSE events
+        per the SSE spec. Emission failures fall through to _sse's
+        abort path like any other mid-stream write error."""
+        from skypilot_tpu.serve.load_balancer import write_chunk
+        half = getattr(req, "reqlog_record", None)
+        if half is None:
+            return
+        engine = self.server_ctx.get("engine")
+        if engine is not None:
+            kv = engine.kv_config()
+            half["kv_quant"] = bool(kv.get("kv_quant"))
+            half["weight_quant"] = bool(kv.get("weight_quant"))
+            half["kv_paged"] = bool(kv.get("paged"))
+            half["restarts"] = int(getattr(engine, "restarts", 0))
+        write_chunk(self.wfile,
+                    b"event: stats\ndata: "
+                    + json.dumps(half, default=str).encode()
+                    + b"\n\n")
 
 
 def preempt_notice_watch(notice: threading.Event,

@@ -112,6 +112,7 @@ import numpy as np
 from skypilot_tpu.models import family_name, model_api
 from skypilot_tpu.observability import events
 from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import reqlog
 from skypilot_tpu.observability import stepstats
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve import kv_pool
@@ -278,6 +279,15 @@ class Request:
         # chips).
         self.cached_prompt_tokens = 0
         self.prefill_chunks = 0
+        # Request-analytics accounting (observability/reqlog.py), only
+        # ever written under ``reqlog.ENABLED`` guards: the request's
+        # device-time share (step_dur/live_slots summed per decode
+        # step), the KV tier its prefix matched, and the finished
+        # engine-half record _free_slot attaches for the serve layer
+        # to read after _DONE.
+        self.device_time_s = 0.0
+        self.kv_tier: Optional[str] = None
+        self.reqlog_record: Optional[Dict[str, Any]] = None
         self._out: "queue.Queue[Any]" = queue.Queue()
 
     def cancel(self) -> None:
@@ -1143,6 +1153,30 @@ class DecodeEngine:
                                "accepted": slot.accepted,
                                "accept_rate": round(
                                    slot.accepted / slot.drafted, 4)})
+            if reqlog.ENABLED:
+                # Engine half of the wide-event request record: every
+                # field is something the slot/request already tracks.
+                # Attached to the request BEFORE _finish puts _DONE,
+                # so the serve handler's stream loop can read it once
+                # the iterator exhausts and ship it to the LB as the
+                # trailing stats frame.
+                req.reqlog_record = {
+                    "queue_wait_s": (
+                        round(req.admitted_at - req.submitted_at, 6)
+                        if req.admitted_at is not None else None),
+                    "prompt_tokens": len(req.prompt),
+                    "cached_prompt_tokens": req.cached_prompt_tokens,
+                    "generated_tokens": slot.generated,
+                    "kv_tier": req.kv_tier,
+                    "spec_drafted": slot.drafted,
+                    "spec_accepted": slot.accepted,
+                    "ttft_s": (
+                        round(req.first_token_at - req.submitted_at, 6)
+                        if req.first_token_at is not None else None),
+                    "device_time_s": round(req.device_time_s, 6),
+                    "outcome": outcome,
+                    "error": error,
+                }
             slot.request._finish(error)
             _REQUESTS.labels(outcome=outcome).inc()
         if self._paged:
@@ -1238,9 +1272,10 @@ class DecodeEngine:
                 (len(dev_nodes) + len(pending)) * self._chunk)
         else:
             _PREFIX_MISSES.inc()
-        _KV_TIER_HITS.labels(tier=("host" if pending
-                                   else "hbm" if dev_nodes
-                                   else "miss")).inc()
+        tier = "host" if pending else "hbm" if dev_nodes else "miss"
+        _KV_TIER_HITS.labels(tier=tier).inc()
+        if reqlog.ENABLED:
+            req.kv_tier = tier
         return True
 
     def _admit_paged(self) -> None:
@@ -1267,6 +1302,11 @@ class DecodeEngine:
                 slot = self._slots[i]
                 if stepstats.ENABLED:
                     self._record_admission(i, req, slot)
+                if reqlog.ENABLED:
+                    # Queue-wait stamp for the request record; the
+                    # traced path below overwrites it with the same
+                    # clock read.
+                    req.admitted_at = time.perf_counter()
                 if traced:
                     req.admitted_at = time.perf_counter()
                     emits.append(("engine.queue", req.trace,
@@ -1338,6 +1378,8 @@ class DecodeEngine:
                     slot.pos = slot.generated = slot.prefilled = 0
                     traced = (tracing.ENABLED and req.trace is not None
                               and req.trace.sampled)
+                    if reqlog.ENABLED:
+                        req.admitted_at = time.perf_counter()
                     if traced:
                         req.admitted_at = time.perf_counter()
                         # Queue-wait child span, retroactive from the
@@ -1627,6 +1669,13 @@ class DecodeEngine:
         targets = jax.device_get(targets)
         accepts = jax.device_get(accepts)
         dt = max(time.perf_counter() - t0, 1e-9)
+        if reqlog.ENABLED:
+            # Device-time share for cost attribution: the step's wall
+            # duration split evenly across the slots that rode it —
+            # host-side bookkeeping only, the jitted step is untouched.
+            share = dt / len(live)
+            for i in live:
+                self._slots[i].request.device_time_s += share
         emitted = 0
         drafted_step = accepted_step = 0
         for i in live:
@@ -1718,6 +1767,11 @@ class DecodeEngine:
             self._stamp_dispatch(t0, nxt)
         nxt = jax.device_get(nxt)
         dt = max(time.perf_counter() - t0, 1e-9)
+        if reqlog.ENABLED:
+            # Per-request device-time share (see _verify_decode_step).
+            share = dt / len(live)
+            for i in live:
+                self._slots[i].request.device_time_s += share
         _TOK_RATE.observe(len(live) / dt)
         for i in live:
             slot = self._slots[i]

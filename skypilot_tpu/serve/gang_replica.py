@@ -405,6 +405,15 @@ class GangLeader:
                "seed": int(seed)}
         if resume:
             msg["resume"] = [int(t) for t in resume]
+        if trace is not None:
+            # The request's identity rides the broadcast too: traced
+            # runs parent the mirrored submissions under the same
+            # trace, and reqlog-only runs (sampled flag 00 — tracing
+            # guards stay cold) key follower-side accounting by the
+            # same request id.
+            ctx = tracing.format_ctx(trace)
+            if ctx:
+                msg["trace"] = ctx
         self._broadcast(msg)
         if tracing.ENABLED and trace is not None and trace.sampled:
             tracing.record_span(
@@ -786,6 +795,7 @@ def follower_serve(engine_factory: Callable[[], Any], topology:
                         max_tokens=msg["max_tokens"],
                         temperature=msg.get("temperature", 0.0),
                         seed=msg.get("seed", 0),
+                        trace=tracing.parse_ctx(msg.get("trace")),
                         resume=msg.get("resume"))
                 except Exception:  # noqa: stpu-except — the leader's own submit failed identically and answered the client; the mirror must not die over it
                     continue

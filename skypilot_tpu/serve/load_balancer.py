@@ -14,6 +14,7 @@ SSE) is re-chunked to the client with a flush per chunk.
 """
 from __future__ import annotations
 
+import hashlib
 import http.client
 import http.server
 import json
@@ -23,10 +24,12 @@ import socketserver
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Callable, Dict, List, Optional, Set
 
 from skypilot_tpu.observability import metrics
+from skypilot_tpu.observability import reqlog
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
 from skypilot_tpu.utils import fault_injection
@@ -34,6 +37,11 @@ from skypilot_tpu.utils import fault_injection
 _HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding",
                 "te", "trailer", "upgrade", "proxy-authorization",
                 "proxy-authenticate", "host", "content-length"}
+
+# Leading-chunk width for the request-record prefix hash — matches the
+# loadgen shared-prefix granularity so derive_spec's reuse structure
+# lines up with how schedules are built.
+_PREFIX_HASH_TOKENS = 64
 
 # Proxy-path metrics. Observed AFTER the upstream response completes —
 # no metric lock is ever held during upstream I/O; the per-request cost
@@ -592,6 +600,25 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                 "lb.request", kind="lb",
                 parent=tracing.extract(self.headers),
                 attrs={"method": method, "path": self.path})
+        if reqlog.ENABLED:
+            # LB half of the wide-event request record
+            # (observability/reqlog.py). The request id IS the trace
+            # id when one exists (the span's, or an inbound header
+            # from an upper tier); otherwise reqlog mints one and
+            # _proxy_to rides it on X-STPU-Trace (sampled flag 00) so
+            # the engine half joins by the same key.
+            sctx = span.context() if span is not None else None
+            if sctx is None:
+                sctx = tracing.extract(self.headers)
+            stats["reqlog"] = {
+                "request_id": (sctx.trace_id if sctx is not None
+                               else reqlog.mint_id()),
+                "ts": time.time(),
+                "method": method,
+                "path": self.path.split("?", 1)[0],
+                "trace_sampled": bool(sctx is not None
+                                      and sctx.sampled),
+            }
         try:
             self._proxy_inner(method, stats, span)
         finally:
@@ -609,6 +636,14 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
             _LATENCY.labels(code=code).observe(
                 time.perf_counter() - t0)
             _STREAMED.observe(stats["bytes"])
+            if reqlog.ENABLED and stats.get("reqlog") is not None:
+                rlog = stats["reqlog"]
+                rlog["status"] = code
+                rlog["e2e_s"] = round(time.perf_counter() - t0, 6)
+                rlog["bytes_streamed"] = stats["bytes"]
+                rlog["retries"] = max(rlog.get("attempts", 1) - 1, 0)
+                rlog.setdefault("resumed", False)
+                reqlog.write_record(rlog)
             if span is not None:
                 span.end(status=("error" if aborted else "ok"),
                          code=code, bytes=stats["bytes"])
@@ -659,6 +694,26 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
         # (prefix affinity) route on the request payload.
         body = self.rfile.read(length) if length else None
         request = {"path": self.path, "body": body}
+        rlog = stats.get("reqlog")
+        if rlog is not None and body \
+                and self.path.split("?", 1)[0] == "/generate":
+            # Workload-shape fields for loadgen.derive_spec. The record
+            # carries a hash of the LEADING prompt chunk (the shared-
+            # prefix granularity), never prompt text/tokens — enough to
+            # recover prefix-reuse structure, nothing to leak.
+            try:
+                doc = json.loads(body)
+                prompt = [int(t) for t in doc.get("prompt") or []]
+                rlog["prompt_tokens"] = len(prompt)
+                rlog["max_tokens"] = int(doc.get("max_tokens", 16))
+                rlog["temperature"] = float(doc.get("temperature", 0.0))
+                rlog["stream"] = bool(doc.get("stream"))
+                rlog["prefix_hash"] = hashlib.sha256(
+                    json.dumps(prompt[:_PREFIX_HASH_TOKENS],
+                               separators=(",", ":")).encode()
+                ).hexdigest()[:16]
+            except (ValueError, TypeError, KeyError):
+                pass
         journal = self._maybe_journal(method, body, request)
         tried: Set[str] = set()
         attempts = 1 + max(self.max_retries, 0)
@@ -678,6 +733,10 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                     span.event("select", target=target,
                                attempt=attempt,
                                policy=type(self.policy).__name__)
+                if rlog is not None:
+                    rlog["replica"] = target
+                    rlog["policy"] = type(self.policy).__name__
+                    rlog["attempts"] = attempt + 1
                 tried.add(target)
                 if journal is not None:
                     # The resume re-pick must exclude every replica
@@ -746,6 +805,17 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
             ctx = tracing.format_ctx(span.context())
             if ctx:
                 headers[tracing.HEADER] = ctx
+        elif reqlog.ENABLED and stats.get("reqlog") is not None \
+                and tracing.extract(self.headers) is None:
+            # Tracing disarmed: the reqlog-minted request id still
+            # rides X-STPU-Trace (sampled flag 00 — pure string work,
+            # every replica tracing guard stays short-circuited) so
+            # the engine assembles its record half under the same key.
+            # An inbound header from an upper tier passes through
+            # untouched above instead.
+            headers[tracing.HEADER] = tracing.format_ctx(
+                tracing.SpanContext(stats["reqlog"]["request_id"],
+                                    reqlog.mint_id()[:16], False))
         req = urllib.request.Request(url, data=body, headers=headers,
                                      method=method)
         started: List[bool] = []
@@ -864,6 +934,13 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
         started.append(True)
         if "t0" in stats:
             _TTFB.observe(time.perf_counter() - stats["t0"])
+            if stats.get("reqlog") is not None:
+                # Client-visible TTFT for the request record (first
+                # upstream byte = first token for a streaming client);
+                # a retried request overwrites with the attempt that
+                # actually delivered.
+                stats["reqlog"]["ttft_s"] = round(
+                    time.perf_counter() - stats["t0"], 6)
         self.send_response(resp.status)
         clen = resp.getheader("Content-Length")
         for k, v in resp.getheaders():
@@ -888,6 +965,16 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                 self._pump_sse(resp, journal, stats)
                 end_chunks(self.wfile)
                 return
+            if reqlog.ENABLED and stats.get("reqlog") is not None \
+                    and (resp.getheader("Content-Type") or ""
+                         ).startswith("text/event-stream"):
+                # Journal-less SSE with reqlog armed: the replica's
+                # trailing stats frame must not leak to the client, so
+                # forward on event boundaries (strip + fold) instead
+                # of raw reads. Disarmed keeps the raw zero-parse path.
+                self._pump_events(resp, stats)
+                end_chunks(self.wfile)
+                return
             while True:
                 chunk = self._read1(resp, stats)
                 if not chunk:
@@ -895,6 +982,55 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                 write_chunk(self.wfile, chunk)
                 stats["bytes"] += len(chunk)
             end_chunks(self.wfile)
+
+    # --------------------------------------------- request-record folding
+    def _fold_stats_frame(self, event: bytes,
+                          stats: Dict[str, int]) -> None:
+        """Fold a replica's trailing ``event: stats`` SSE frame (the
+        engine half of the request record, emitted by serve_llm before
+        [DONE]) into this request's LB half. A malformed frame is
+        dropped — the record degrades to LB-only, same as a legacy
+        replica that never emits one."""
+        rlog = stats.get("reqlog")
+        if rlog is None:
+            return
+        for line in event.split(b"\n"):
+            if line.startswith(b"data: "):
+                try:
+                    half = json.loads(line[6:])
+                except ValueError:
+                    return
+                if isinstance(half, dict):
+                    rlog["engine"] = half
+                return
+
+    def _pump_events(self, resp, stats: Dict[str, int]) -> None:
+        """Event-boundary forwarding for journal-less SSE while reqlog
+        is armed: everything passes through verbatim except ``event:
+        stats`` frames, which are folded into the request record. EOF
+        flushes any residual partial event — unlike _pump_sse this
+        path has no resume journal, so termination semantics stay
+        those of the raw chunk loop (upstream EOF ends the stream;
+        read failures raise _UpstreamAborted from _read1)."""
+        buf = b""
+        while True:
+            chunk = self._read1(resp, stats)
+            if not chunk:
+                break
+            buf += chunk
+            while True:
+                cut = buf.find(b"\n\n")
+                if cut < 0:
+                    break
+                event, buf = buf[:cut + 2], buf[cut + 2:]
+                if event.startswith(b"event: stats"):
+                    self._fold_stats_frame(event, stats)
+                    continue
+                write_chunk(self.wfile, event)
+                stats["bytes"] += len(event)
+        if buf:
+            write_chunk(self.wfile, buf)
+            stats["bytes"] += len(buf)
 
     # ------------------------------------------------- mid-stream resume
     def _pump_sse(self, resp, journal: StreamJournal,
@@ -923,6 +1059,18 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                 if cut < 0:
                     break
                 event, buf = buf[:cut + 2], buf[cut + 2:]
+                if event.startswith(b"event: stats"):
+                    # The replica's trailing request-record frame is
+                    # LB-internal: fold it into the record half and
+                    # never forward it (a resumed continuation's frame
+                    # supersedes the dead upstream's — the half that
+                    # actually finished the stream wins). Stripped
+                    # even with reqlog disarmed here: this path is
+                    # already event-parsing, and a frame the armed
+                    # replica emitted is not part of the client
+                    # contract.
+                    self._fold_stats_frame(event, stats)
+                    continue
                 tok = _sse_token(event)
                 if tok is not None and skipped < skip:
                     if (skipped >= len(journal.emitted)
@@ -948,6 +1096,12 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
         stream's fate was decided here (carried to [DONE], or the
         CLIENT died mid-splice); False degrades to the plain upstream
         abort in the caller."""
+        rlog = stats.get("reqlog")
+        if rlog is not None:
+            # A resumed stream is always kept by the request log (the
+            # tail-bias contract); the outcome fields update as the
+            # ladder runs.
+            rlog["resumed"] = True
         while journal.budget > 0:
             journal.budget -= 1
             gap_t0 = time.perf_counter()
@@ -960,6 +1114,8 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                                         rspan or span)
             if target is None:
                 _RESUMES.labels(outcome="no_replica").inc()
+                if rlog is not None:
+                    rlog["resume_outcome"] = "no_replica"
                 if rspan is not None:
                     rspan.end(status="error", outcome="no_replica")
                 return False
@@ -981,6 +1137,8 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                 stats["client_closed"] = True
                 self.close_connection = True
                 _RESUMES.labels(outcome="client_closed").inc()
+                if rlog is not None:
+                    rlog["resume_outcome"] = "client_closed"
                 if rspan is not None:
                     rspan.end(status="error", outcome="client_closed",
                               target=target)
@@ -990,12 +1148,16 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
                 # admission.
                 self.policy.report_done(target)
             _RESUMES.labels(outcome=outcome).inc()
+            if rlog is not None:
+                rlog["resume_outcome"] = outcome
             if rspan is not None:
                 rspan.end(status="ok" if ok else "error",
                           outcome=outcome, target=target)
             if ok:
                 return True
         _RESUMES.labels(outcome="exhausted").inc()
+        if rlog is not None:
+            rlog["resume_outcome"] = "exhausted"
         return False
 
     def _splice_from(self, target: str, journal: StreamJournal,
@@ -1072,12 +1234,38 @@ class _ProxyHandler(http.server.BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _serve_requests(self) -> None:
+        """GET /requests[?limit=N]: the newest wide-event request
+        records (observability/reqlog.py) from this LB's local
+        requests.jsonl, newest last — lets `stpu requests SERVICE`
+        read analytics without shell access to the LB host. Like
+        /metrics and /perf, observability never counts as traffic.
+        Serves whatever is on disk even when reqlog is currently
+        disarmed (the file is the artifact, the flag gates writes)."""
+        limit = 200
+        if "?" in self.path:
+            q = urllib.parse.parse_qs(self.path.split("?", 1)[1])
+            try:
+                limit = max(int(q.get("limit", ["200"])[0]), 1)
+            except ValueError:
+                pass
+        records = reqlog.read()[-limit:]
+        body = json.dumps(records, default=str).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         if self.path == "/metrics":
             self._serve_metrics()
             return
         if self.path == "/perf":
             self._serve_perf()
+            return
+        if self.path.split("?", 1)[0] == "/requests":
+            self._serve_requests()
             return
         if self.path.split("?", 1)[0] == "/fleet":
             self._serve_fleet()

@@ -77,6 +77,18 @@ _KNOBS = (
        "Sample a timed block_until_ready every N decode steps to "
        "split dispatch vs device time (0 disables; the only "
        "sanctioned sync on the serve hot path)."),
+    _k("STPU_REQLOG", "0",
+       "\"1\" arms the wide-event per-request analytics log "
+       "(requests.jsonl: one joined LB+engine record per request)."),
+    _k("STPU_REQLOG_SAMPLE", "1",
+       "Request-log keep rate in [0, 1] for SUCCESSFUL requests; "
+       "errors, resumed streams and slow requests are always kept."),
+    _k("STPU_REQLOG_SLOW_TTFT", "1.0",
+       "TTFT seconds at or above which a request counts as slow and "
+       "bypasses request-log sampling."),
+    _k("STPU_REQLOG_SLOW_E2E", "10.0",
+       "End-to-end seconds at or above which a request counts as slow "
+       "and bypasses request-log sampling."),
     _k("STPU_DISABLE_USAGE_COLLECTION", "0",
        "\"1\" disables usage reporting (wins over configured sinks)."),
     # ------------------------------------------------ fleet telemetry

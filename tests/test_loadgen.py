@@ -591,3 +591,187 @@ def test_measure_engine_slo_tiny_end_to_end(tmp_state_dir, monkeypatch):
     assert result["slo_goodput"] == 1.0
     assert result["p99_ttft_s"] > 0
     assert result["loadgen_tok_s"] > 0
+
+
+# ========================================= schedule files + replay (sat.)
+def test_schedule_save_load_roundtrip_and_tamper(tmp_path):
+    """save_schedule → load_schedule is lossless (spec, requests, and
+    float offsets at full precision → identical digest); a hand-edited
+    file fails the pinned-digest check loudly."""
+    spec = loadgen.LoadSpec(mix="chat", qps=18, duration_s=1.5, seed=13,
+                            max_tokens=6)
+    schedule = loadgen.build_schedule(spec)
+    path = str(tmp_path / "schedule.json")
+    digest = loadgen.save_schedule(path, spec, schedule)
+    assert digest == loadgen.schedule_digest(schedule)
+    spec2, schedule2, digest2 = loadgen.load_schedule(path)
+    assert spec2 == spec
+    assert schedule2 == schedule
+    assert digest2 == digest
+    # Tamper: change one prompt token — the recomputed digest no
+    # longer matches the pinned one.
+    doc = json.loads(pathlib.Path(path).read_text())
+    doc["requests"][0]["prompt"][0] += 1
+    pathlib.Path(path).write_text(json.dumps(doc))
+    with pytest.raises(ValueError, match="pinned digest"):
+        loadgen.load_schedule(path)
+    # Not-a-schedule fails before digest math.
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    with pytest.raises(ValueError, match="not a schedule"):
+        loadgen.load_schedule(str(bad))
+
+
+def test_run_schedule_file_replays_verbatim(tmp_state_dir, tmp_path):
+    """`run(schedule_file=...)` replays a saved trace with NO spec in
+    hand: the report records source="schedule" and pins the digest of
+    what actually ran; spec-driven runs say source="spec"; neither
+    input is an error."""
+    replica, _ = _start_replica(
+        type("Sched", (_SSEHandler,), {"delay": 0.001}))
+    policy = RoundRobinPolicy()
+    policy.set_ready_replicas(
+        [f"http://127.0.0.1:{replica.server_address[1]}"])
+    lb, target = _start_lb(policy)
+    spec = loadgen.LoadSpec(mix="chat", qps=12, duration_s=1.0, seed=21,
+                            max_tokens=4)
+    schedule = loadgen.build_schedule(spec)
+    path = str(tmp_path / "schedule.json")
+    digest = loadgen.save_schedule(path, spec, schedule)
+    try:
+        report = loadgen.run(target, None, schedule_file=path,
+                             scrape_interval=0.5,
+                             out_dir=str(tmp_path / "replay"))
+        spec_report = loadgen.run(target, spec, scrape_interval=0.5,
+                                  out_dir=str(tmp_path / "fromspec"))
+        with pytest.raises(ValueError, match="spec or a schedule"):
+            loadgen.run(target, None)
+    finally:
+        lb.shutdown()
+        replica.shutdown()
+    assert report["source"] == "schedule"
+    assert report["schedule_sha256"] == digest
+    assert report["requests"]["scheduled"] == len(schedule)
+    assert report["requests"]["error"] == 0
+    assert spec_report["source"] == "spec"
+    assert spec_report["schedule_sha256"] == digest   # same trace
+    assert "source=schedule" in loadgen.format_report(report)
+    # The replay leg re-persists the trace it ran, digest-stable.
+    replay_doc = json.loads(
+        (pathlib.Path(report["out_dir"]) / "schedule.json").read_text())
+    assert replay_doc["digest"] == digest
+
+
+def test_derive_spec_determinism_and_mix_detection():
+    """derive_spec is order-insensitive and classifies the mix from
+    the records alone: steady short prompts → chat, high inter-arrival
+    CoV → bursty, long mean prompt → long_context. The chat cap is
+    moment-matched: a schedule built from the derived spec reproduces
+    the observed mean prompt length."""
+    def rec(i, ts, plen, prefix="aa" * 8):
+        return {"request_id": f"{i:04x}" * 8, "ts": ts,
+                "path": "/generate", "prompt_tokens": plen,
+                "max_tokens": 8, "temperature": 0.0,
+                "prefix_hash": prefix, "status": "200"}
+
+    # Steady arrivals, mean plen 82, two prefixes.
+    chat = [rec(i, 100.0 + i * 0.1, 68 + (i % 2) * 28,
+                prefix=("aa" * 8 if i % 2 else "bb" * 8))
+            for i in range(40)]
+    d1 = loadgen.derive_spec(chat)
+    d2 = loadgen.derive_spec(list(reversed(chat)))
+    assert d1 == d2
+    assert loadgen.schedule_digest(loadgen.build_schedule(d1)) == \
+        loadgen.schedule_digest(loadgen.build_schedule(d2))
+    assert d1.mix == "chat"
+    assert d1.n_prefixes == 2
+    assert d1.max_tokens == 8
+    sched = loadgen.build_schedule(d1)
+    observed_mean = sum(r["prompt_tokens"] for r in chat) / len(chat)
+    derived_mean = sum(len(r.prompt) for r in sched) / len(sched)
+    assert abs(derived_mean - observed_mean) <= 8, \
+        (observed_mean, derived_mean)
+    # Different records → different content-derived seed → digest.
+    other = loadgen.derive_spec(chat[:30])
+    assert other.seed != d1.seed
+
+    # Bursty: tight clumps separated by long gaps → CoV >> 1.
+    ts = []
+    for clump in range(8):
+        ts.extend(clump * 3.0 + k * 0.01 for k in range(5))
+    bursty = [rec(i, 100.0 + t, 80) for i, t in enumerate(ts)]
+    assert loadgen.derive_spec(bursty).mix == "bursty"
+
+    # Long-context: mean prompt length over the 320-token knee.
+    lctx = [rec(i, 100.0 + i * 0.1, 600) for i in range(20)]
+    d = loadgen.derive_spec(lctx)
+    assert d.mix == "long_context"
+    assert d.long_prompt_tokens == 600
+
+    # No usable records is a loud error, not an empty spec.
+    with pytest.raises(ValueError, match="no /generate records"):
+        loadgen.derive_spec([{"path": "/metrics", "ts": 1.0}])
+
+
+def test_report_driver_lag_and_saturation_warning(tmp_path):
+    """Open-loop integrity: the report carries dispatch-lag
+    percentiles, and a lag p99 above one scrape interval raises the
+    driver-saturation WARNING (rendered by format_report)."""
+    spec = loadgen.LoadSpec(mix="chat", qps=5, duration_s=1.0, seed=2)
+    schedule = loadgen.build_schedule(spec)
+    digest = loadgen.schedule_digest(schedule)
+    scraper = loadgen.MetricsScraper("http://127.0.0.1:1",  # never run
+                                     1.0, tmp_path / "m.jsonl")
+
+    def results(lag):
+        return [{"index": r.index, "ok": True, "code": 200,
+                 "error": None, "ttft_s": 0.01, "tpot_s": 0.005,
+                 "e2e_s": 0.05, "tokens": 4,
+                 "sent_offset": r.at + lag, "dispatch_lag_s": lag}
+                for r in schedule]
+
+    healthy = loadgen._build_report(
+        spec, schedule, digest, results(0.002), 1.5, scraper, "t",
+        dispatch_window=1.0, slo_ttft_s=None, slo_tpot_s=None,
+        faults=None, faults_at=0.0, scrape_interval=1.0)
+    assert healthy["driver"]["lag_p99_s"] == pytest.approx(0.002)
+    assert healthy["driver"]["lag_s"]["p50"] is not None
+    assert healthy["driver"]["warning"] is None
+    assert "WARNING" not in loadgen.format_report(healthy)
+
+    saturated = loadgen._build_report(
+        spec, schedule, digest, results(2.5), 4.0, scraper, "t",
+        dispatch_window=3.5, slo_ttft_s=None, slo_tpot_s=None,
+        faults=None, faults_at=0.0, scrape_interval=1.0)
+    assert saturated["driver"]["warning"] is not None
+    assert "under-driving" in saturated["driver"]["warning"]
+    rendered = loadgen.format_report(saturated)
+    assert "WARNING" in rendered and "driver saturated" in rendered
+
+
+def test_cli_loadgen_schedule_flag(tmp_state_dir, tmp_path):
+    """`stpu loadgen --schedule FILE` replays a saved trace without
+    any workload flags; the rendered report says so."""
+    from click.testing import CliRunner
+
+    from skypilot_tpu.cli import cli
+    replica, url = _start_replica(
+        type("CliSched", (_SSEHandler,), {"delay": 0.001}))
+    policy = RoundRobinPolicy()
+    policy.set_ready_replicas([url])
+    lb, target = _start_lb(policy)
+    spec = loadgen.LoadSpec(mix="chat", qps=10, duration_s=1.0, seed=5,
+                            max_tokens=4)
+    path = str(tmp_path / "schedule.json")
+    digest = loadgen.save_schedule(path, spec,
+                                   loadgen.build_schedule(spec))
+    runner = CliRunner()
+    try:
+        res = runner.invoke(cli, ["loadgen", "--target", target,
+                                  "--schedule", path])
+        assert res.exit_code == 0, res.output
+        assert "source=schedule" in res.output
+        assert f"sha256={digest[:12]}" in res.output
+    finally:
+        lb.shutdown()
+        replica.shutdown()

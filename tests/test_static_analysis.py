@@ -53,7 +53,7 @@ def test_repo_clean_all_rules():
     ids = {r.id for r in analysis.all_rules()}
     assert {"stpu-wallclock", "stpu-span-leak", "stpu-except",
             "stpu-atomic", "stpu-collective", "stpu-donation",
-            "stpu-host-sync", "stpu-env"} <= ids
+            "stpu-host-sync", "stpu-env", "stpu-armed-guard"} <= ids
 
 
 # ================================================= suppression grammar
@@ -526,6 +526,101 @@ def test_host_sync_jit_factory_taints_train_loop(tmp_path):
         """)
     findings = _run(tmp_path, "stpu-host-sync")
     assert _lines(findings, "recipes/other_recipe.py") == []
+
+
+def test_armed_guard_rule(tmp_path):
+    """The good/bad/noqa trio for stpu-armed-guard: unguarded
+    observability calls on a hot module are findings; flag guards
+    (plain, compound, alias, elif, in-test), armed-only helpers, the
+    sanctioned no-op callees, and explained noqas all pass."""
+    _write(tmp_path, "serve/decode_engine.py", """\
+        from skypilot_tpu.observability import reqlog, stepstats, tracing
+        from skypilot_tpu.utils import fault_injection
+
+        def bad_step(live):
+            stepstats.record(live=len(live))
+            fault_injection.fire("engine.step")
+
+        def good_plain(live):
+            if stepstats.ENABLED:
+                stepstats.record(live=len(live))
+
+        def good_compound(stats):
+            if reqlog.ENABLED and stats.get("reqlog") is not None:
+                reqlog.write_record(stats["reqlog"])
+
+        def good_alias(live):
+            armed = stepstats.ENABLED
+            if armed and live:
+                stepstats.record(live=len(live))
+
+        def good_in_test():
+            if stepstats.ENABLED and stepstats.sync_due():
+                pass
+
+        def good_elif(x):
+            if x:
+                pass
+            elif reqlog.ENABLED and x is None:
+                reqlog.mint_id()
+
+        def _record_helper(i):
+            stepstats.record_admission(i)
+
+        def caller(i):
+            if stepstats.ENABLED:
+                _record_helper(i)
+
+        def good_sanctioned(headers):
+            return tracing.extract(headers)
+
+        def noqad():
+            stepstats.record(x=1)  # noqa: stpu-armed-guard one-shot startup probe, never per-token
+
+        def bad_disarmed_branch():
+            if stepstats.ENABLED:
+                pass
+            else:
+                stepstats.record(x=1)
+        """)
+    findings = _run(tmp_path, "stpu-armed-guard")
+    lines = _lines(findings, "serve/decode_engine.py")
+    assert lines == [5, 6, 48]
+    assert "stepstats.ENABLED" in {f.line: f.message
+                                   for f in findings}[5]
+
+
+def test_armed_guard_unguarded_helper_is_flagged(tmp_path):
+    """A helper whose call sites do NOT all guard gets no armed-only
+    credit — the call inside it is a finding."""
+    _write(tmp_path, "serve/load_balancer.py", """\
+        from skypilot_tpu.observability import reqlog
+
+        def helper(rec):
+            reqlog.write_record(rec)
+
+        def guarded_caller(rec):
+            if reqlog.ENABLED:
+                helper(rec)
+
+        def unguarded_caller(rec):
+            helper(rec)
+        """)
+    findings = _run(tmp_path, "stpu-armed-guard")
+    assert _lines(findings, "serve/load_balancer.py") == [4]
+
+
+def test_armed_guard_targets_hot_modules_only(tmp_path):
+    """Cold control-plane code is out of scope: the same unguarded
+    call in a non-target file is never flagged."""
+    _write(tmp_path, "serve/controller.py", """\
+        from skypilot_tpu.observability import stepstats
+
+        def f():
+            stepstats.record(x=1)
+        """)
+    findings = _run(tmp_path, "stpu-armed-guard")
+    assert _lines(findings, "serve/controller.py") == []
 
 
 def test_env_rule_seeded_fixture(tmp_path):
